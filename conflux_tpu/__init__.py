@@ -1,0 +1,31 @@
+"""conflux_tpu — a TPU-native communication-optimal dense linear algebra framework.
+
+A from-scratch rebuild of the capabilities of eth-cscs/conflux (CONFLUX
+distributed LU with tournament pivoting, CONFCHOX distributed Cholesky) on
+JAX/XLA/Pallas. The reference's 2.5D/3D MPI process grid becomes a named
+`jax.sharding.Mesh` over ('x', 'y', 'z'); its block-cyclic tile distribution,
+butterfly tournament pivoting, and z-replicated trailing updates become
+`shard_map` programs built on `psum` / `ppermute` / `all_gather` collectives;
+its CBLAS/LAPACKE tile kernels become XLA ops and Pallas kernels.
+
+Reference layer map: /root/reference (see SURVEY.md). This package is an
+independent TPU-first design, not a translation.
+"""
+
+from conflux_tpu.geometry import (
+    Grid3,
+    LUGeometry,
+    CholeskyGeometry,
+    choose_grid,
+    choose_cholesky_grid,
+)
+
+__version__ = "0.1.0"
+
+__all__ = [
+    "Grid3",
+    "LUGeometry",
+    "CholeskyGeometry",
+    "choose_grid",
+    "choose_cholesky_grid",
+]

@@ -1,0 +1,324 @@
+"""Problem geometry: 3D grids, padding, and block-cyclic tile maps.
+
+TPU-native equivalent of the reference's problem/grid setup layer
+(`src/conflux/lu/lu_params.hpp:21-138` — grid auto-selection, padding to
+tile-grid multiples, local tile counts — and the Cholesky geometry in
+`src/conflux/cholesky/CholeskyProperties.cpp:71-235`). Pure host-side Python:
+no communication happens here. The chosen (Px, Py, Pz) maps 1:1 onto a
+`jax.sharding.Mesh` with axis names ('x', 'y', 'z').
+
+Tile distribution is 2D block-cyclic over the (x, y) plane: tile (i, j) of the
+global tile grid lives on mesh coordinate (i mod Px, j mod Py) at local tile
+slot (i // Px, j // Py). The z axis does not own distinct tiles — it carries
+2.5D *replicated partial sums* of the trailing matrix (reference P3 strategy,
+`SURVEY.md` §2.4).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+
+import numpy as np
+
+
+# --------------------------------------------------------------------------- #
+# Grids
+# --------------------------------------------------------------------------- #
+
+
+@dataclasses.dataclass(frozen=True)
+class Grid3:
+    """A 3D processor/device grid (Px, Py, Pz) — mesh axes ('x', 'y', 'z')."""
+
+    Px: int
+    Py: int
+    Pz: int
+
+    @property
+    def P(self) -> int:
+        return self.Px * self.Py * self.Pz
+
+    def __post_init__(self):
+        if self.Px < 1 or self.Py < 1 or self.Pz < 1:
+            raise ValueError(f"grid dims must be >= 1, got {self}")
+
+    def __str__(self) -> str:
+        return f"{self.Px}x{self.Py}x{self.Pz}"
+
+    @classmethod
+    def parse(cls, s: str) -> "Grid3":
+        """Parse 'Px,Py,Pz' or 'PxxPyxPz' CLI syntax."""
+        sep = "," if "," in s else "x"
+        parts = [int(t) for t in s.split(sep)]
+        if len(parts) != 3:
+            raise ValueError(f"expected 3 grid dims, got {s!r}")
+        return cls(*parts)
+
+
+def _isqrt(n: int) -> int:
+    return int(math.isqrt(n))
+
+
+def _best_grid(P: int, target_ratio: float) -> Grid3:
+    """Exhaustive search over factor triples of P.
+
+    Considers every (Px, Py, Pz) with Px*Py*Pz == P and Px >= Py >= Pz (the
+    z axis carries 2.5D replication, so Pz larger than the 2D grid sides is
+    never useful), and minimizes
+        |log((Px/Py) / target_ratio)| + 0.35 * ln(Pz)
+    i.e. match the matrix aspect ratio in the 2D plane, with a mild penalty
+    on replication depth. Unlike the reference's closed-form heuristic
+    (`lu_params.hpp:21-47`) this always uses *all* P devices; on the
+    published experiment grids (BASELINE.md) it reproduces the reference's
+    choices exactly (2x2x1, 2x2x2, 4x4x1, 4x4x2, ..., 32x32x1).
+    """
+    if P < 1:
+        raise ValueError("P must be >= 1")
+    best = None
+    best_key = None
+    for Pz in range(1, P + 1):
+        if P % Pz:
+            continue
+        Q = P // Pz
+        for Py in range(1, Q + 1):
+            if Q % Py:
+                continue
+            Px = Q // Py
+            if not (Px >= Py >= Pz):
+                continue
+            score = abs(math.log((Px / Py) / target_ratio)) + 0.35 * math.log(Pz)
+            key = (score, Pz, Px)
+            if best_key is None or key < best_key:
+                best_key, best = key, Grid3(Px, Py, Pz)
+    assert best is not None  # (P, 1, 1) always qualifies
+    return best
+
+
+def choose_grid(P: int, M: int, N: int) -> Grid3:
+    """Pick (Px, Py, Pz) for an LU factorization of an M x N matrix on P
+    devices (role of the reference auto-pick, `lu_params.hpp:21-47`)."""
+    ratio = max(M, N) / max(1, min(M, N))
+    return _best_grid(P, ratio)
+
+
+def choose_cholesky_grid(P: int) -> Grid3:
+    """Pick (Px, Py, Pz) for Cholesky on P devices (role of the reference
+    driver's grid pick, `Cholesky.cpp:76-114`, generalized to any P)."""
+    return _best_grid(P, 1.0)
+
+
+# --------------------------------------------------------------------------- #
+# Block-cyclic index math
+# --------------------------------------------------------------------------- #
+
+
+def tile_owner(t: int, Pdim: int) -> int:
+    """Mesh coordinate along one axis owning global tile index t."""
+    return t % Pdim
+
+
+def tile_local(t: int, Pdim: int) -> int:
+    """Local tile slot of global tile t on its owner."""
+    return t // Pdim
+
+
+def tile_global(p: int, lt: int, Pdim: int) -> int:
+    """Global tile index of local slot lt on mesh coordinate p."""
+    return lt * Pdim + p
+
+
+def row_owner(r: int, v: int, Pdim: int) -> int:
+    """Mesh x-coordinate owning global row r (tile size v)."""
+    return (r // v) % Pdim
+
+
+def row_local(r: int, v: int, Pdim: int) -> int:
+    """Local row index of global row r on its owner."""
+    return (r // v) // Pdim * v + r % v
+
+
+def row_global(p: int, lr: int, v: int, Pdim: int) -> int:
+    """Global row index of local row lr on mesh coordinate p."""
+    return (lr // v * Pdim + p) * v + lr % v
+
+
+def local_row_indices(p: int, Ml: int, v: int, Pdim: int) -> np.ndarray:
+    """Global row indices (length Ml) owned by x-coordinate p, in local order."""
+    lr = np.arange(Ml)
+    return (lr // v * Pdim + p) * v + lr % v
+
+
+# --------------------------------------------------------------------------- #
+# LU geometry
+# --------------------------------------------------------------------------- #
+
+
+@dataclasses.dataclass(frozen=True)
+class LUGeometry:
+    """All derived sizes for a distributed LU problem.
+
+    Equivalent role to the reference's `lu_params` container
+    (`lu_params.hpp:49-138`), minus the communicators (which on TPU are just
+    named mesh axes) and the matrix storage (owned by the algorithm).
+    """
+
+    M: int  # padded global rows
+    N: int  # padded global cols
+    Mbase: int  # requested rows before padding
+    Nbase: int  # requested cols before padding
+    v: int  # tile size
+    grid: Grid3
+
+    @classmethod
+    def create(cls, M: int, N: int, v: int, grid: Grid3) -> "LUGeometry":
+        """Pad M, N up to multiples of v*Px / v*Py (reference `lu_params.hpp:67-71`)."""
+        if v < 1:
+            raise ValueError("tile size v must be >= 1")
+        Mp = v * grid.Px * math.ceil(M / (v * grid.Px))
+        Np = v * grid.Py * math.ceil(N / (v * grid.Py))
+        return cls(M=Mp, N=Np, Mbase=M, Nbase=N, v=v, grid=grid)
+
+    # Tile counts
+    @property
+    def Mt(self) -> int:
+        return self.M // self.v
+
+    @property
+    def Nt(self) -> int:
+        return self.N // self.v
+
+    # Local tile counts per device (block-cyclic, exact by construction)
+    @property
+    def Mtl(self) -> int:
+        return self.Mt // self.grid.Px
+
+    @property
+    def Ntl(self) -> int:
+        return self.Nt // self.grid.Py
+
+    # Local matrix extents
+    @property
+    def Ml(self) -> int:
+        return self.Mtl * self.v
+
+    @property
+    def Nl(self) -> int:
+        return self.Ntl * self.v
+
+    @property
+    def nlayr(self) -> int:
+        """Columns of each z-layer's slab of a v-wide panel (2.5D split)."""
+        return -(-self.v // self.grid.Pz)
+
+    @property
+    def n_steps(self) -> int:
+        """Number of supersteps = number of v-wide panels to factor."""
+        return min(self.Mt, self.Nt)
+
+    # ---------------- host-side scatter/gather ---------------- #
+
+    def scatter(self, A: np.ndarray) -> np.ndarray:
+        """Distribute a global (M, N) matrix into per-device block-cyclic shards.
+
+        Returns an array of shape (Px, Py, Ml, Nl): shard [pi, pj] holds the
+        tiles {(i, j) : i mod Px == pi, j mod Py == pj} in local tile order.
+        The z axis is not represented — layer 0 owns initial data, other
+        layers start at zero (2.5D convention, reference `python/conflux.py`
+        initial distribution).
+        """
+        M, N, v = self.M, self.N, self.v
+        Px, Py = self.grid.Px, self.grid.Py
+        if A.shape != (M, N):
+            padded = np.zeros((M, N), dtype=A.dtype)
+            padded[: A.shape[0], : A.shape[1]] = A
+            # identity on the padding diagonal keeps padded LU well-posed
+            for d in range(min(A.shape[0], A.shape[1]), min(M, N)):
+                padded[d, d] = 1.0
+            A = padded
+        # (Mt, v, Nt, v) -> (Px, Mtl, v, Py, Ntl, v) -> (Px, Py, Ml, Nl)
+        T = A.reshape(self.Mt, v, self.Nt, v)
+        T = T.reshape(self.Mtl, Px, v, self.Ntl, Py, v)
+        # tile index i = lt*Px + px  => axis order (lt, px)
+        out = np.transpose(T, (1, 4, 0, 2, 3, 5)).reshape(Px, Py, self.Ml, self.Nl)
+        return np.ascontiguousarray(out)
+
+    def gather(self, shards: np.ndarray) -> np.ndarray:
+        """Inverse of :meth:`scatter`: (Px, Py, Ml, Nl) -> (M, N)."""
+        Px, Py, v = self.grid.Px, self.grid.Py, self.v
+        T = shards.reshape(Px, Py, self.Mtl, v, self.Ntl, v)
+        T = np.transpose(T, (2, 0, 3, 4, 1, 5))  # (Mtl, Px, v, Ntl, Py, v)
+        return np.ascontiguousarray(T.reshape(self.M, self.N))
+
+    def global_row_index(self) -> np.ndarray:
+        """(Px, Ml) array: global row index of each local row per x-coordinate.
+
+        TPU equivalent of the reference's `gri` global-row-index tracking
+        (`conflux_opt.hpp:427-440`) — here a static map, since rows never
+        physically move (pivoting is value-level masking, not compaction).
+        """
+        return np.stack(
+            [local_row_indices(p, self.Ml, self.v, self.grid.Px) for p in range(self.grid.Px)]
+        )
+
+
+# --------------------------------------------------------------------------- #
+# Cholesky geometry
+# --------------------------------------------------------------------------- #
+
+
+def choose_cholesky_tile(N: int, P: int) -> int:
+    """Tile-size heuristic for Cholesky (reference `Cholesky.cpp:116-134`):
+    grow v until the per-device panel memory is a small fraction of the
+    matrix share; cap to keep at least a few tiles per device."""
+    v = 128
+    while v * 2 <= 1024 and N // (v * 2) >= 2 * _isqrt(P):
+        v *= 2
+    return min(v, max(1, N))
+
+
+@dataclasses.dataclass(frozen=True)
+class CholeskyGeometry:
+    """Derived sizes for distributed Cholesky (reference `CholeskyProperties`)."""
+
+    N: int
+    Nbase: int
+    v: int
+    grid: Grid3
+
+    @classmethod
+    def create(cls, N: int, v: int, grid: Grid3) -> "CholeskyGeometry":
+        lcm = v * grid.Px * grid.Py // math.gcd(grid.Px, grid.Py)
+        Np = lcm * math.ceil(N / lcm)
+        return cls(N=Np, Nbase=N, v=v, grid=grid)
+
+    @property
+    def Kappa(self) -> int:
+        """Number of tile columns = supersteps (reference calls this Kappa)."""
+        return self.N // self.v
+
+    @property
+    def Mtl(self) -> int:
+        return self.Kappa // self.grid.Px
+
+    @property
+    def Ntl(self) -> int:
+        return self.Kappa // self.grid.Py
+
+    @property
+    def Ml(self) -> int:
+        return self.Mtl * self.v
+
+    @property
+    def Nl(self) -> int:
+        return self.Ntl * self.v
+
+    @property
+    def nlayr(self) -> int:
+        return -(-self.v // self.grid.Pz)
+
+    def scatter(self, A: np.ndarray) -> np.ndarray:
+        return LUGeometry.create(self.N, self.N, self.v, self.grid).scatter(A)
+
+    def gather(self, shards: np.ndarray) -> np.ndarray:
+        return LUGeometry.create(self.N, self.N, self.v, self.grid).gather(shards)

@@ -1,0 +1,5 @@
+"""LU factorization with (tournament) pivoting — the CONFLUX side."""
+
+from conflux_tpu.lu.single import lu_factor_blocked
+
+__all__ = ["lu_factor_blocked"]

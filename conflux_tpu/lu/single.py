@@ -1,0 +1,84 @@
+"""Single-device blocked right-looking LU with partial pivoting.
+
+This is the minimum end-to-end slice (SURVEY.md §7 step 2): the same
+superstep structure as the reference's `LU_rep` (`conflux_opt.hpp:343-1827`)
+collapsed onto a 1x1x1 grid — panel factorization, row pivoting, two TRSMs,
+trailing GEMM — expressed as one jittable XLA program. Tiles stay HBM-resident
+for the whole factorization; each superstep's trailing update is a single
+large MXU matmul.
+
+The number of supersteps Nt = N/v is a static Python value, so the loop
+unrolls at trace time with *exact* shapes (no masking overhead): total flops
+are the true 2/3 N^3. For very large Nt use `lu_factor_masked` (fori_loop +
+static-shape masking) in conflux_tpu/lu/masked.py.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+from conflux_tpu.ops import blas
+
+
+def lu_factor_blocked(A: jax.Array, v: int, precision=None, backend: str | None = None):
+    """Factor A (M x N, M >= N, both multiples of v) as P A = L U.
+
+    Returns (LU, perm):
+      LU   — (M, N) packed factors: strictly-lower part of column-block k
+             holds L, upper part holds U (LAPACK getrf layout).
+      perm — (M,) row indices such that A[perm, :] == L @ U.
+    """
+    M, N = A.shape
+    if M % v or N % v:
+        raise ValueError(f"shape {A.shape} not a multiple of tile size {v}")
+    if M < N:
+        raise ValueError("lu_factor_blocked requires M >= N")
+    # resolve config outside jit so it lands in the jit cache key
+    precision = blas.matmul_precision() if precision is None else precision
+    backend = blas.get_backend() if backend is None else backend
+    return _lu_factor_blocked(A, v, precision, backend)
+
+
+@functools.partial(jax.jit, static_argnames=("v", "precision", "backend"))
+def _lu_factor_blocked(A: jax.Array, v: int, precision, backend: str):
+    M, N = A.shape
+    n_steps = N // v
+
+    perm = jnp.arange(M)
+
+    for k in range(n_steps):
+        off = k * v
+        # --- panel factorization (reference step 1: pivoting + A00) ------- #
+        panel = A[off:, off : off + v]
+        lu_panel, pperm = blas.panel_lu(panel)
+        # apply the panel's row permutation to the trailing rows of A and to
+        # the global permutation (value-level row movement, single device)
+        A = A.at[off:, :].set(A[off:, :][pperm])
+        perm = perm.at[off:].set(perm[off:][pperm])
+        A = A.at[off:, off : off + v].set(lu_panel)
+
+        if off + v < N:
+            # --- A01 TRSM (reference step 5) ------------------------------ #
+            L00 = blas.unit_lower(lu_panel[:v])
+            A01 = blas.trsm_left_lower_unit(L00, A[off : off + v, off + v :])
+            A = A.at[off : off + v, off + v :].set(A01)
+            # --- trailing GEMM (reference step 6, the hot op) ------------- #
+            L10 = lu_panel[v:, :]
+            A = A.at[off + v :, off + v :].set(
+                blas.gemm(L10, A01, c=A[off + v :, off + v :], alpha=-1.0,
+                          precision=precision, backend=backend)
+            )
+
+    return A, perm
+
+
+def unpack_lu(LU: jax.Array):
+    """Split packed factors into (L (M, N) unit-lower, U (N, N) upper)."""
+    M, N = LU.shape
+    L = jnp.tril(LU, -1)[:, :N]
+    L = L.at[:N, :].add(jnp.eye(N, dtype=LU.dtype))
+    U = jnp.triu(LU[:N, :])
+    return L, U

@@ -1,0 +1,26 @@
+"""Tile-level compute kernels (the pluggable BLAS boundary).
+
+TPU-native equivalent of the reference's BLAS shim (`src/conflux/lu/blas.cpp`,
+CMake option CONFLUX_BLAS): a small registry of tile ops (gemm, trsm, panel
+LU, potrf) with an XLA backend and, for the hot ops, Pallas TPU kernels.
+"""
+
+from conflux_tpu.ops.blas import (
+    gemm,
+    trsm_left_lower_unit,
+    trsm_right_upper,
+    panel_lu,
+    potrf,
+    set_backend,
+    get_backend,
+)
+
+__all__ = [
+    "gemm",
+    "trsm_left_lower_unit",
+    "trsm_right_upper",
+    "panel_lu",
+    "potrf",
+    "set_backend",
+    "get_backend",
+]

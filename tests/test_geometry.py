@@ -1,0 +1,159 @@
+"""Geometry unit tests — the role of the reference's serial gtest suite
+(`tests/unit/test_utils.cpp`): hand-checked values for the pure index math."""
+
+import numpy as np
+import pytest
+
+from conflux_tpu.geometry import (
+    CholeskyGeometry,
+    Grid3,
+    LUGeometry,
+    choose_cholesky_grid,
+    choose_grid,
+    local_row_indices,
+    row_global,
+    row_local,
+    row_owner,
+    tile_global,
+    tile_local,
+    tile_owner,
+)
+
+
+def test_grid3_basics():
+    g = Grid3(4, 4, 2)
+    assert g.P == 32
+    assert str(g) == "4x4x2"
+    assert Grid3.parse("4,4,2") == g
+    assert Grid3.parse("4x4x2") == g
+    with pytest.raises(ValueError):
+        Grid3(0, 1, 1)
+
+
+@pytest.mark.parametrize(
+    "P,expect",
+    [
+        (1, (1, 1, 1)),
+        (4, (2, 2, 1)),
+        (8, (2, 2, 2)),
+        (16, (4, 4, 1)),
+        (32, (4, 4, 2)),
+        (64, (8, 8, 1)),
+        (1024, (32, 32, 1)),
+    ],
+)
+def test_choose_grid_square_matrix(P, expect):
+    # matches the published experiment grids (BASELINE.md / params_weak.ini)
+    g = choose_grid(P, 1 << 16, 1 << 16)
+    assert (g.Px, g.Py, g.Pz) == expect
+    assert g.P <= P
+
+
+@pytest.mark.parametrize("P", [1, 2, 4, 8, 16, 32, 64, 128, 256, 512])
+def test_choose_cholesky_grid(P):
+    g = choose_cholesky_grid(P)
+    assert g.P == P  # always uses every device
+    if P in (8, 32, 128, 512):
+        assert g.Pz == 2
+
+
+def test_choose_grid_uses_all_devices():
+    for P in [24, 96, 125, 2048, 7, 12, 48]:
+        g = choose_grid(P, 4096, 4096)
+        assert g.P == P, (P, g)
+        assert g.Px >= g.Py >= g.Pz
+    # exact cube
+    assert tuple(dataclasses_astuple(choose_grid(125, 1024, 1024))) == (5, 5, 5)
+
+
+def dataclasses_astuple(g):
+    return (g.Px, g.Py, g.Pz)
+
+
+def test_choose_grid_rectangular():
+    g = choose_grid(64, 4 * 8192, 8192)
+    assert g.P == 64
+    assert g.Px / g.Py == 4  # matches the 4:1 aspect ratio
+
+
+def test_blockcyclic_roundtrip():
+    Px = 4
+    for t in range(40):
+        p, l = tile_owner(t, Px), tile_local(t, Px)
+        assert tile_global(p, l, Px) == t
+
+
+def test_row_maps():
+    v, Px = 4, 2
+    # rows 0..3 tile 0 -> owner 0; rows 4..7 tile 1 -> owner 1; 8..11 tile 2 -> owner 0
+    assert row_owner(0, v, Px) == 0
+    assert row_owner(5, v, Px) == 1
+    assert row_owner(9, v, Px) == 0
+    assert row_local(9, v, Px) == 5
+    assert row_global(0, 5, v, Px) == 9
+    for r in range(64):
+        p = row_owner(r, v, Px)
+        assert row_global(p, row_local(r, v, Px), v, Px) == r
+
+
+def test_local_row_indices_partition():
+    v, Px, Ml = 4, 2, 16
+    all_rows = np.concatenate([local_row_indices(p, Ml, v, Px) for p in range(Px)])
+    assert sorted(all_rows.tolist()) == list(range(Ml * Px))
+
+
+def test_lu_geometry_padding():
+    g = LUGeometry.create(M=100, N=100, v=16, grid=Grid3(2, 2, 1))
+    # padded to multiples of 16*2 = 32
+    assert g.M == 128 and g.N == 128
+    assert g.Mt == 8 and g.Nt == 8
+    assert g.Ml == 64 and g.Nl == 64
+    assert g.n_steps == 8
+
+
+def test_lu_geometry_nlayr():
+    g = LUGeometry.create(M=256, N=256, v=32, grid=Grid3(2, 2, 2))
+    assert g.nlayr == 16
+
+
+def test_scatter_gather_roundtrip():
+    geom = LUGeometry.create(M=64, N=64, v=8, grid=Grid3(2, 2, 1))
+    rng = np.random.default_rng(0)
+    A = rng.standard_normal((64, 64))
+    shards = geom.scatter(A)
+    assert shards.shape == (2, 2, 32, 32)
+    back = geom.gather(shards)
+    np.testing.assert_array_equal(A, back)
+
+
+def test_scatter_places_tiles_blockcyclic():
+    geom = LUGeometry.create(M=32, N=32, v=8, grid=Grid3(2, 2, 1))
+    A = np.zeros((32, 32))
+    # tile (2, 3) -> owner (0, 1), local slot (1, 1)
+    A[16:24, 24:32] = 5.0
+    shards = geom.scatter(A)
+    np.testing.assert_array_equal(shards[0, 1][8:16, 8:16], 5.0)
+    assert shards[0, 0].sum() == 0 and shards[1, 1].sum() == 0
+
+
+def test_scatter_pads_with_identity():
+    geom = LUGeometry.create(M=40, N=40, v=8, grid=Grid3(2, 2, 1))
+    assert geom.M == 48
+    A = np.eye(40)
+    full = geom.gather(geom.scatter(A))
+    np.testing.assert_array_equal(full, np.eye(48))
+
+
+def test_global_row_index():
+    geom = LUGeometry.create(M=32, N=32, v=4, grid=Grid3(2, 2, 1))
+    gri = geom.global_row_index()
+    assert gri.shape == (2, 16)
+    assert sorted(np.concatenate(gri).tolist()) == list(range(32))
+    assert gri[1][0] == 4  # first local row of x-rank 1 is global row 4
+
+
+def test_cholesky_geometry():
+    g = CholeskyGeometry.create(N=1000, v=128, grid=Grid3(2, 2, 2))
+    assert g.N % (128 * 2) == 0
+    assert g.Kappa == g.N // 128
+    assert g.nlayr == 64

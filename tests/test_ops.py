@@ -1,0 +1,77 @@
+"""Tile-op layer tests: backend registry and Pallas kernels (interpret mode
+on the CPU test platform)."""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from conflux_tpu.ops import blas
+from conflux_tpu.ops import pallas_kernels
+
+
+def test_backend_registry():
+    assert blas.get_backend() == "xla"
+    with pytest.raises(ValueError):
+        blas.set_backend("cuda")
+    blas.set_backend("pallas")
+    assert blas.get_backend() == "pallas"
+    blas.set_backend("xla")
+
+
+def test_gemm_alpha_beta():
+    rng = np.random.default_rng(0)
+    a = jnp.asarray(rng.standard_normal((8, 4)))
+    b = jnp.asarray(rng.standard_normal((4, 8)))
+    c = jnp.asarray(rng.standard_normal((8, 8)))
+    out = blas.gemm(a, b, c=c, alpha=-1.0, beta=1.0)
+    np.testing.assert_allclose(np.asarray(out), c - a @ b, rtol=1e-12)
+
+
+@pytest.mark.parametrize("shape", [(128, 128, 128), (256, 128, 384), (100, 60, 130)])
+def test_pallas_gemm_matches_xla(shape):
+    M, N, K = shape
+    rng = np.random.default_rng(1)
+    a = jnp.asarray(rng.standard_normal((M, K)).astype(np.float32))
+    b = jnp.asarray(rng.standard_normal((K, N)).astype(np.float32))
+    out = pallas_kernels.gemm(a, b)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(a) @ np.asarray(b), atol=1e-4)
+
+
+def test_gemm_backend_dispatch():
+    rng = np.random.default_rng(2)
+    a = jnp.asarray(rng.standard_normal((128, 128)).astype(np.float32))
+    b = jnp.asarray(rng.standard_normal((128, 128)).astype(np.float32))
+    out = blas.gemm(a, b, backend="pallas")
+    np.testing.assert_allclose(np.asarray(out), np.asarray(a) @ np.asarray(b), atol=1e-4)
+
+
+def test_trsm_left_lower_unit():
+    rng = np.random.default_rng(3)
+    L = np.tril(rng.standard_normal((16, 16)), -1) + np.eye(16)
+    B = rng.standard_normal((16, 32))
+    X = blas.trsm_left_lower_unit(jnp.asarray(L), jnp.asarray(B))
+    np.testing.assert_allclose(L @ np.asarray(X), B, atol=1e-10)
+
+
+def test_trsm_right_upper():
+    rng = np.random.default_rng(4)
+    U = np.triu(rng.standard_normal((16, 16))) + 4 * np.eye(16)
+    B = rng.standard_normal((32, 16))
+    X = blas.trsm_right_upper(jnp.asarray(U), jnp.asarray(B))
+    np.testing.assert_allclose(np.asarray(X) @ U, B, atol=1e-10)
+
+
+def test_potrf():
+    from conflux_tpu.validation import make_spd_matrix
+
+    A = make_spd_matrix(32)
+    L = blas.potrf(jnp.asarray(A))
+    np.testing.assert_allclose(np.tril(L) @ np.tril(L).T, A, atol=1e-9)
+
+
+def test_unit_lower():
+    rng = np.random.default_rng(5)
+    lu00 = jnp.asarray(rng.standard_normal((8, 8)))
+    L = blas.unit_lower(lu00)
+    assert np.allclose(np.diag(np.asarray(L)), 1.0)
+    assert np.allclose(np.triu(np.asarray(L), 1), 0.0)
