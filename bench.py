@@ -1,0 +1,93 @@
+"""Driver benchmark: prints ONE JSON line with the headline metric.
+
+Protocol follows the reference miniapp (`examples/conflux_miniapp.cpp:138-167`):
+warm-up run excluded, then timed repetitions; metric is GFLOP/s of the
+flagship LU factorization at 2/3 N^3 flops (BASELINE.md).
+
+Measurement note: this environment reaches the TPU through a tunnel with a
+~75 ms host round-trip floor, so single-call timing is meaningless (and
+remote compiles are slow, so the unroll is kept to N/V = 8 supersteps). We time
+R chained factorizations inside one jitted program (each feeding its output
+forward to serialize them) and divide by R.
+
+vs_baseline = TPU GFLOP/s / host-CPU LAPACK (scipy getrf) GFLOP/s on the
+same problem — the reference's own comparison point is CPU ScaLAPACK
+(BASELINE.json north star).
+"""
+
+import json
+import time
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+N = 4096
+V = 512
+REPS = 16
+
+
+def tpu_gflops() -> float:
+    from conflux_tpu.lu import single as lu_single
+    from conflux_tpu.ops import blas
+
+    A = jnp.asarray(
+        np.random.default_rng(0).standard_normal((N, N)).astype(np.float32)
+        + 2 * np.eye(N, dtype=np.float32)
+    )
+
+    precision = blas.matmul_precision()
+
+    @jax.jit
+    def chained(a):
+        def body(i, a):
+            lu, _ = lu_single._lu_factor_blocked(a, V, precision, "xla")
+            # keep magnitudes bounded so the chain doesn't overflow
+            return lu / jnp.maximum(jnp.max(jnp.abs(lu)), 1.0)
+
+        return lax.fori_loop(0, REPS, body, a)
+
+    float(chained(A).sum())  # warm-up (compile + 1 chain)
+    t0 = time.time()
+    float(chained(A).sum())
+    dt = (time.time() - t0) / REPS
+    return (2 / 3) * N**3 / dt / 1e9
+
+
+def cpu_gflops() -> float:
+    import scipy.linalg
+
+    A = (
+        np.random.default_rng(0).standard_normal((N, N)).astype(np.float32)
+        + 2 * np.eye(N, dtype=np.float32)
+    )
+    scipy.linalg.lu_factor(A)  # warm-up
+    t0 = time.time()
+    scipy.linalg.lu_factor(A)
+    dt = time.time() - t0
+    return (2 / 3) * N**3 / dt / 1e9
+
+
+def main():
+    tpu = tpu_gflops()
+    try:
+        cpu = cpu_gflops()
+    except Exception:
+        cpu = float("nan")
+    print(
+        json.dumps(
+            {
+                "metric": f"LU N={N} v={V} f32 GFLOP/s (single chip)",
+                "value": round(tpu, 1),
+                "unit": "GFLOP/s",
+                "vs_baseline": round(tpu / cpu, 2) if cpu == cpu else None,
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

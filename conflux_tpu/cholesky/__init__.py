@@ -1,0 +1,5 @@
+"""Distributed Cholesky factorization — the CONFCHOX side."""
+
+from conflux_tpu.cholesky.single import cholesky_blocked
+
+__all__ = ["cholesky_blocked"]

@@ -1,0 +1,196 @@
+"""Distributed 2.5D Cholesky over the (x, y, z) mesh.
+
+TPU-native re-design of the reference's CONFCHOX driver
+(`Cholesky.cpp:743-784` phases: choleskyA00 -> updateComputeA10 ->
+computeA11 -> reduceA11 -> scatterA11). Same design language as
+`conflux_tpu.lu.distributed`, minus pivoting:
+
+ - block-cyclic (x, y) tile shards holding z-partial sums; the true matrix
+   is the sum over 'z'; factors are written on layer z==0 only;
+ - panel column k: one `psum` over ('y','z') (reference reduceA11 +
+   scatterA11 rolled into a single collective);
+ - diagonal tile broadcast (reference's shrinking-bcast-comm machinery,
+   `Processor.cpp:131-250`): a masked `psum` over 'x' — fixed mesh
+   collectives make the ladder of communicators unnecessary (SURVEY P7);
+ - L10^T redistribution row-owners -> column-owners (reference's
+   MPI_SUBTILE Isend mesh, `Cholesky.cpp:459-480`): a masked-gather `psum`
+   over 'x' delivering exactly the rows each device's columns need;
+ - trailing update: each z layer multiplies its nlayr-wide slab of the
+   panel (reference's subtile split `l = v/Pz`), sharing the syrk flops
+   across layers; `MPI_Waitany`-driven overlap (reference
+   `Cholesky.cpp:487-550`) is the XLA latency-hiding scheduler's job, not
+   ours.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+from jax.sharding import PartitionSpec as P
+
+from conflux_tpu.geometry import CholeskyGeometry, Grid3
+from conflux_tpu.ops import blas
+from conflux_tpu.parallel.mesh import (
+    AXIS_X,
+    AXIS_Y,
+    AXIS_Z,
+    lookup_mesh,
+    make_mesh,
+    mesh_cache_key,
+)
+
+
+@functools.lru_cache(maxsize=32)
+def _build(geom: CholeskyGeometry, mesh_key, precision, backend: str):
+    mesh = lookup_mesh(mesh_key)
+    v = geom.v
+    Px, Py, Pz = geom.grid.Px, geom.grid.Py, geom.grid.Pz
+    Ml, Nl = geom.Ml, geom.Nl
+    nlayr = geom.nlayr
+    n_steps = geom.Kappa
+    v_pad = Pz * nlayr
+
+    def device_fn(blk):
+        x = lax.axis_index(AXIS_X)
+        y = lax.axis_index(AXIS_Y)
+        z = lax.axis_index(AXIS_Z)
+        dtype = blk.dtype
+
+        Aloc = jnp.where(z == 0, blk[0, 0], jnp.zeros((), dtype))
+
+        lr = jnp.arange(Ml, dtype=jnp.int32)
+        rtile = (lr // v) * Px + x  # global row-tile id per local row
+        lc = jnp.arange(Nl, dtype=jnp.int32)
+        ctile = (lc // v) * Py + y  # global col-tile id per local col
+        # my local columns' global row coordinates (for the L10^T exchange):
+        # column with global index g corresponds to row g, owned by x-rank
+        # (g // v) % Px at local row ((g // v) // Px) * v + g % v
+        gcol = ctile * v + (lc % v)
+        col_owner_x = (gcol // v) % Px
+        col_local_row = ((gcol // v) // Px) * v + gcol % v
+
+        def body(k, carry):
+            Aloc = carry
+            i0 = jnp.zeros((), jnp.int32)
+            xo = (k % Px).astype(jnp.int32)  # diag tile row owner
+            yo = (k % Py).astype(jnp.int32)  # panel column owner
+            lj = ((k // Py) * v).astype(jnp.int32)
+            ldiag = ((k // Px) * v).astype(jnp.int32)
+
+            # ---- panel column k: z-reduce + y-broadcast ------------------- #
+            panel_loc = lax.dynamic_slice(Aloc, (i0, lj), (Ml, v))
+            panel = lax.psum(
+                jnp.where(y == yo, panel_loc, jnp.zeros((), dtype)),
+                (AXIS_Y, AXIS_Z),
+            )
+
+            # panel math in the compute dtype (f32 when storage is bf16)
+            cdtype = blas.compute_dtype(dtype)
+            panel = panel.astype(cdtype)
+
+            # ---- diagonal tile: x-broadcast + potrf ----------------------- #
+            diag_slice = lax.dynamic_slice(panel, (ldiag, i0), (v, v))
+            Akk = lax.psum(
+                jnp.where(x == xo, diag_slice, jnp.zeros((), cdtype)), AXIS_X
+            )
+            L00 = blas.potrf(Akk)
+
+            # ---- L10 for rows below the diagonal -------------------------- #
+            below = rtile > k
+            act_panel = jnp.where(below[:, None], panel, jnp.zeros((), cdtype))
+            L10 = blas.trsm_right_lower_t(L00, act_panel)  # (Ml, v)
+
+            # ---- L10^T redistribution to column owners over 'x' ----------- #
+            # row g of the global panel -> every device whose columns include
+            # g; diag-tile columns take L00 rows
+            from_L10 = jnp.where(
+                (col_owner_x == x)[:, None], L10[col_local_row], jnp.zeros((), cdtype)
+            )
+            Lc = lax.psum(from_L10, AXIS_X)  # (Nl, v) = L10 rows for my cols
+            diag_cols = ctile == k
+            L00_rows = L00[gcol % v]  # (Nl, v), valid where diag_cols
+            Lc = jnp.where(diag_cols[:, None], L00_rows, Lc)
+
+            # ---- trailing syrk-style update on this layer's slab ---------- #
+            # GEMM rides the storage dtype (bf16 fast path when selected)
+            L10p = jnp.pad(L10.astype(dtype), ((0, 0), (0, v_pad - v)))
+            Lcp = jnp.pad(Lc.astype(dtype), ((0, 0), (0, v_pad - v)))
+            zoff = (z * nlayr).astype(jnp.int32)
+            L10s = lax.dynamic_slice(L10p, (i0, zoff), (Ml, nlayr))
+            Lcs = lax.dynamic_slice(Lcp, (i0, zoff), (Nl, nlayr))
+            upd = blas.gemm(L10s, Lcs.T, precision=precision, backend=backend)
+            col_trail = ctile > k
+            Anew = Aloc - jnp.where(
+                below[:, None] & col_trail[None, :], upd, jnp.zeros((), dtype)
+            )
+
+            # ---- factor writes: panel column on layer z==0 ---------------- #
+            on_diag = rtile == k
+            L00_local = jnp.where(
+                z == 0, jnp.tril(L00)[lr % v].astype(dtype), jnp.zeros((), dtype)
+            )
+            pcol_cur = lax.dynamic_slice(Anew, (i0, lj), (Ml, v))
+            pcol_new = jnp.where(
+                on_diag[:, None],
+                L00_local,
+                jnp.where(below[:, None],
+                          jnp.where(z == 0, L10.astype(dtype), jnp.zeros((), dtype)),
+                          pcol_cur),
+            )
+            Anew = jnp.where(
+                y == yo,
+                lax.dynamic_update_slice(Anew, pcol_new, (i0, lj)),
+                Anew,
+            )
+            return Anew
+
+        Aloc = lax.fori_loop(0, n_steps, body, Aloc)
+        Aout = lax.psum(Aloc, AXIS_Z)
+        return Aout[None, None]
+
+    fn = jax.shard_map(
+        device_fn,
+        mesh=mesh,
+        in_specs=P(AXIS_X, AXIS_Y, None, None),
+        out_specs=P(AXIS_X, AXIS_Y, None, None),
+    )
+    return jax.jit(fn)
+
+
+
+def cholesky_factor_distributed(shards, geom: CholeskyGeometry, mesh,
+                                precision=None, backend: str | None = None):
+    """Factor block-cyclic shards of an SPD matrix; returns factored shards
+    (lower triangle = L, upper triangle unspecified)."""
+    precision = blas.matmul_precision() if precision is None else precision
+    backend = blas.get_backend() if backend is None else backend
+    fn = _build(geom, mesh_cache_key(mesh), precision, backend)
+    return fn(shards)
+
+
+def cholesky_distributed_host(A: np.ndarray, grid: Grid3, v: int, mesh=None,
+                              precision=None, backend: str | None = None):
+    """Scatter an SPD matrix, factor on the mesh, gather L back.
+
+    Role of the reference's initialize/parallelCholesky/finalize sequence
+    (`Cholesky.h:19-23`). Returns (L (N, N) lower-triangular, geom).
+    """
+    if A.shape[0] != A.shape[1]:
+        raise ValueError(f"matrix must be square, got {A.shape}")
+    geom = CholeskyGeometry.create(A.shape[0], v, grid)
+    if mesh is None:
+        mesh = make_mesh(grid)
+    if geom.N != A.shape[0]:
+        Ap = np.eye(geom.N, dtype=A.dtype)
+        Ap[: A.shape[0], : A.shape[0]] = A
+        A = Ap
+    shards = geom.scatter(A)
+    out = cholesky_factor_distributed(
+        jnp.asarray(shards), geom, mesh, precision=precision, backend=backend
+    )
+    L = np.tril(geom.gather(np.asarray(out)))
+    return L, geom

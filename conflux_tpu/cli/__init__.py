@@ -1,0 +1,1 @@
+"""Command-line miniapps mirroring the reference drivers in `examples/`."""

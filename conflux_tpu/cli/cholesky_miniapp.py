@@ -1,0 +1,106 @@
+"""Cholesky miniapp — the role of `examples/cholesky_miniapp.cpp`.
+
+Same CLI vocabulary (--dim, --tile, --grid, --run) and a printTimings-style
+report (`examples/cholesky_miniapp.cpp:34-50`), plus the `_result_` line
+protocol for machine parsing.
+
+Examples:
+    python -m conflux_tpu.cli.cholesky_miniapp --dim 2048 --tile 128 --run 2
+    python -m conflux_tpu.cli.cholesky_miniapp --dim 512 --tile 64 \
+        --grid 2,2,2 --platform cpu --validate
+"""
+
+from __future__ import annotations
+
+import argparse
+
+import numpy as np
+
+from conflux_tpu.cli.common import WallTimer, add_common_args, np_dtype, setup_platform, sync
+
+
+def parse_args(argv=None):
+    p = argparse.ArgumentParser("cholesky_miniapp", description=__doc__)
+    p.add_argument("--dim", type=int, default=2048, help="matrix dimension N")
+    p.add_argument("--tile", type=int, default=None, help="tile size v (default: heuristic)")
+    p.add_argument("--grid", default=None, help="Px,Py,Pz (default: auto)")
+    p.add_argument("--run", type=int, default=2, help="timed repetitions")
+    p.add_argument("--validate", action="store_true", help="residual ||A-LL^T||_F check")
+    add_common_args(p)
+    return p.parse_args(argv)
+
+
+def main(argv=None) -> int:
+    args = parse_args(argv)
+    setup_platform(args)
+
+    import jax
+    import jax.numpy as jnp
+
+    from conflux_tpu import profiler
+    from conflux_tpu.cholesky.distributed import cholesky_factor_distributed
+    from conflux_tpu.geometry import (
+        CholeskyGeometry,
+        Grid3,
+        choose_cholesky_grid,
+        choose_cholesky_tile,
+    )
+    from conflux_tpu.parallel.mesh import make_mesh
+    from conflux_tpu.validation import cholesky_residual, make_spd_matrix
+
+    n_devices = len(jax.devices())
+    grid = Grid3.parse(args.grid) if args.grid else choose_cholesky_grid(n_devices)
+    if grid.P > n_devices:
+        raise SystemExit(f"grid {grid} needs {grid.P} devices, have {n_devices}")
+    v = args.tile or choose_cholesky_tile(args.dim, grid.P)
+
+    dtype = np_dtype(args.dtype)
+    geom = CholeskyGeometry.create(args.dim, v, grid)
+    mesh = make_mesh(grid, devices=jax.devices()[: grid.P])
+
+    with profiler.region("init_matrix"):
+        A = make_spd_matrix(geom.N, dtype=dtype)
+        shards = jnp.asarray(geom.scatter(A))
+        if args.dtype == "bfloat16":
+            shards = shards.astype(jnp.bfloat16)
+        sync(shards)
+
+    times = []
+    for rep in range(args.run + 1):
+        with WallTimer() as t:
+            with profiler.region("cholesky_factorization"):
+                out = cholesky_factor_distributed(shards, geom, mesh)
+                sync(out)
+        if rep > 0:
+            times.append(t.ms)
+
+    # printTimings-style block (reference cholesky_miniapp.cpp:34-50)
+    print("==========================================")
+    print("    PROBLEM PARAMETERS:")
+    print(f"    Matrix dimension: {geom.N} (requested {args.dim})")
+    print(f"    Tile size: {geom.v}")
+    print(f"    Grid: {grid} on {grid.P} devices")
+    print(f"    Runs: {len(times)}")
+    print("    TIMINGS [ms]:")
+    for ms in times:
+        print(f"       {ms:.3f}")
+    print("==========================================")
+    for ms in times:
+        print(
+            f"_result_ cholesky,conflux_tpu,{geom.N},{args.dim},{grid.P},"
+            f"{grid},time,{args.dtype},{ms:.3f},{geom.v}"
+        )
+
+    if args.validate:
+        with profiler.region("validation"):
+            L = np.tril(geom.gather(np.asarray(out)))
+            res = cholesky_residual(np.asarray(A, np.float64), L)
+        print(f"_residual_ {res:.3e}")
+
+    if args.profile:
+        profiler.report()
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1,0 +1,65 @@
+"""Shared CLI plumbing for the miniapps."""
+
+from __future__ import annotations
+
+import argparse
+import time
+
+import numpy as np
+
+import jax
+
+
+def add_common_args(p: argparse.ArgumentParser) -> None:
+    p.add_argument(
+        "--platform", default=None, choices=["cpu", "tpu", "axon"],
+        help="force a JAX platform (default: whatever the environment gives); "
+        "'cpu' also enables --devices simulated host devices",
+    )
+    p.add_argument(
+        "--devices", type=int, default=8,
+        help="simulated device count when --platform cpu (default 8)",
+    )
+    p.add_argument(
+        "--dtype", default="float32", choices=["float32", "float64", "bfloat16"],
+        help="element type (float64 requires a CPU platform: the TPU LU "
+        "custom call is f32-only)",
+    )
+    p.add_argument("--profile", action="store_true", help="print region timings")
+
+
+def setup_platform(args) -> None:
+    """Must run before any JAX backend initializes."""
+    if args.platform == "cpu":
+        import os
+
+        os.environ["XLA_FLAGS"] = (
+            f"--xla_force_host_platform_device_count={args.devices} "
+            + os.environ.get("XLA_FLAGS", "")
+        )
+        jax.config.update("jax_platforms", "cpu")
+    elif args.platform in ("tpu", "axon"):
+        pass  # the environment default
+    if args.dtype == "float64":
+        jax.config.update("jax_enable_x64", True)
+
+
+def np_dtype(name: str):
+    return {"float32": np.float32, "float64": np.float64, "bfloat16": np.float32}[name]
+
+
+def sync(x) -> float:
+    """Block until x is truly materialized (through-tunnel safe) and return
+    a checksum — `block_until_ready` alone does not guarantee completion on
+    tunneled platforms. A reduction (not ravel/indexing) so it works on
+    arrays sharded over a mesh."""
+    return float(jax.numpy.sum(x))
+
+
+class WallTimer:
+    def __enter__(self):
+        self.t0 = time.perf_counter()
+        return self
+
+    def __exit__(self, *exc):
+        self.ms = (time.perf_counter() - self.t0) * 1e3

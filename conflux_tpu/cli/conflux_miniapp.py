@@ -1,0 +1,105 @@
+"""LU miniapp — the role of `examples/conflux_miniapp.cpp`.
+
+Same CLI vocabulary (-N, -b, --p_grid, -r) and the same machine-parsable
+result protocol (`examples/conflux_miniapp.cpp:119,156-165`):
+
+    _result_ lu,conflux_tpu,<N>,<N_base>,<P>,<PxxPyxPz>,time,<type>,<ms>,<v>
+
+plus an optional --validate residual check (the CONFLUX_WITH_VALIDATION
+equivalent, computed directly instead of via ScaLAPACK pdgemm).
+
+Examples:
+    python -m conflux_tpu.cli.conflux_miniapp -N 2048 -b 128 -r 2
+    python -m conflux_tpu.cli.conflux_miniapp -N 512 -b 64 --p_grid 2,2,2 \
+        --platform cpu --devices 8 --dtype float64 --validate
+"""
+
+from __future__ import annotations
+
+import argparse
+
+import numpy as np
+
+from conflux_tpu.cli.common import WallTimer, add_common_args, np_dtype, setup_platform, sync
+
+
+def parse_args(argv=None):
+    p = argparse.ArgumentParser("conflux_miniapp", description=__doc__)
+    p.add_argument("-M", type=int, default=None, help="rows (default: N)")
+    p.add_argument("-N", type=int, default=2048, help="matrix dimension")
+    p.add_argument("-b", "--block_size", type=int, default=128, help="tile size v")
+    p.add_argument(
+        "--p_grid", default=None,
+        help="Px,Py,Pz (default: auto-pick over all available devices)",
+    )
+    p.add_argument("-r", "--n_rep", type=int, default=2, help="timed repetitions")
+    p.add_argument(
+        "-t", "--type", default="lu", choices=["lu"], help="benchmark type tag"
+    )
+    p.add_argument("--validate", action="store_true", help="residual ||PA-LU||_F check")
+    add_common_args(p)
+    return p.parse_args(argv)
+
+
+def main(argv=None) -> int:
+    args = parse_args(argv)
+    setup_platform(args)
+
+    import jax
+    import jax.numpy as jnp
+
+    from conflux_tpu import profiler
+    from conflux_tpu.geometry import Grid3, LUGeometry, choose_grid
+    from conflux_tpu.lu.distributed import (
+        full_permutation,
+        lu_factor_distributed,
+    )
+    from conflux_tpu.parallel.mesh import make_mesh
+    from conflux_tpu.validation import lu_residual, make_test_matrix
+
+    M = args.M or args.N
+    n_devices = len(jax.devices())
+    grid = Grid3.parse(args.p_grid) if args.p_grid else choose_grid(n_devices, M, args.N)
+    if grid.P > n_devices:
+        raise SystemExit(f"grid {grid} needs {grid.P} devices, have {n_devices}")
+
+    dtype = np_dtype(args.dtype)
+    geom = LUGeometry.create(M, args.N, args.block_size, grid)
+    mesh = make_mesh(grid, devices=jax.devices()[: grid.P])
+
+    with profiler.region("init_matrix"):
+        A = make_test_matrix(geom.M, geom.N, dtype=dtype)
+        shards = jnp.asarray(geom.scatter(A))
+        if args.dtype == "bfloat16":
+            shards = shards.astype(jnp.bfloat16)
+        sync(shards)
+
+    times = []
+    for rep in range(args.n_rep + 1):  # rep 0 is the mandatory warm-up
+        with WallTimer() as t:
+            with profiler.region("lu_factorization"):
+                out, pivots = lu_factor_distributed(shards, geom, mesh)
+                sync(out)
+        if rep > 0:
+            times.append(t.ms)
+
+    for ms in times:
+        print(
+            f"_result_ lu,conflux_tpu,{geom.N},{args.N},{grid.P},"
+            f"{grid},time,{args.dtype},{ms:.3f},{geom.v}"
+        )
+
+    if args.validate:
+        with profiler.region("validation"):
+            LU = geom.gather(np.asarray(out))
+            perm = full_permutation(np.asarray(pivots), geom.M)
+            res = lu_residual(np.asarray(A, np.float64), LU[perm], perm)
+        print(f"_residual_ {res:.3e}")
+
+    if args.profile:
+        profiler.report()
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1,0 +1,59 @@
+"""Debug-build assertions — the reference's -DDEBUG in-situ checks.
+
+The reference guards its superstep loop with NaN/Inf scans
+(`has_valid_data`, `memory_utils.hpp:37-49`, used at
+`conflux_opt.hpp:592-601`), post-tournament non-zero-pivot asserts
+(`conflux_opt.hpp:793-800`), and a global row-count conservation check via
+MPI_Allgather (`conflux_opt.hpp:980-1000`). Here the same checks are
+host-side helpers over gathered results plus a jit-compatible checify layer.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+
+def has_valid_data(x) -> bool:
+    """NaN/Inf-free scan (reference `memory_utils.hpp:37-49`)."""
+    return bool(np.isfinite(np.asarray(x)).all())
+
+
+def assert_valid(x, what: str = "buffer") -> None:
+    if not has_valid_data(x):
+        bad = int((~np.isfinite(np.asarray(x))).sum())
+        raise FloatingPointError(f"{what} contains {bad} non-finite values")
+
+
+def assert_nonzero_pivots(LU, what: str = "LU") -> None:
+    """Post-factorization zero-pivot check (reference
+    `conflux_opt.hpp:793-800`)."""
+    d = np.abs(np.diag(np.asarray(LU)))
+    if (d == 0).any():
+        k = int(np.argmin(d != 0))
+        raise ZeroDivisionError(f"{what}: zero pivot at position {k}")
+
+
+def assert_pivot_conservation(pivots, M: int) -> None:
+    """Every row is eliminated exactly once (the row-count conservation
+    check, reference `conflux_opt.hpp:980-1000`)."""
+    p = np.asarray(pivots).reshape(-1)
+    uniq = np.unique(p)
+    if uniq.size != p.size:
+        raise AssertionError(f"duplicate pivot rows: {p.size - uniq.size}")
+    if p.min() < 0 or p.max() >= M:
+        raise AssertionError(f"pivot row out of range [0, {M}): {p.min()}..{p.max()}")
+
+
+def checked_isfinite(x: jax.Array, what: str) -> jax.Array:
+    """jit-compatible in-graph check: returns x, raising at runtime via
+    jax.debug callbacks when non-finite values appear (debug builds only)."""
+    def _cb(ok):
+        if not bool(ok):
+            raise FloatingPointError(f"{what}: non-finite values inside jit")
+
+    ok = jnp.isfinite(x).all()
+    jax.debug.callback(_cb, ok)
+    return x

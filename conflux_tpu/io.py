@@ -1,0 +1,57 @@
+"""Matrix I/O and deterministic input generation.
+
+Role of the reference's `CholeskyIO` (`src/conflux/cholesky/CholeskyIO.cpp`):
+distributed SPD input generation (`:100-172` — identical seeded tile
+everywhere plus diagonal dominance), file parse + tile scatter (`:185-375`),
+and binary dump of matrices for debug verification (`:384-501`, MPI-IO).
+The MPI-IO role is played by plain row-major binary files written from the
+gathered host copy.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from conflux_tpu.geometry import CholeskyGeometry, LUGeometry
+
+
+def generate_spd_tiles(geom: CholeskyGeometry, seed: int = 2020,
+                       dtype=np.float64) -> np.ndarray:
+    """Distributed-convention SPD input, built tile-locally.
+
+    Same scheme as the reference generator (`CholeskyIO.cpp:100-172`): every
+    off-diagonal tile is the *same* seeded v x v block (so any rank can
+    materialize its tiles without communication), the matrix is symmetrized,
+    and the diagonal gets an N-scaled boost for positive definiteness.
+    Returns the full (N, N) matrix; use `geom.scatter` for shards.
+    """
+    N, v = geom.N, geom.v
+    rng = np.random.default_rng(seed)
+    tile = rng.uniform(-1.0, 1.0, size=(v, v)).astype(dtype)
+    sym = (tile + tile.T) / 2
+    A = np.tile(sym, (N // v, N // v))
+    A[np.arange(N), np.arange(N)] += N
+    return A
+
+
+def save_matrix(path: str, A: np.ndarray) -> None:
+    """Row-major binary dump: int64 header (M, N, dtype code) + data.
+    Same spirit as the reference's `data/output_N.bin` debug dumps."""
+    A = np.ascontiguousarray(A)
+    code = {np.dtype(np.float32): 0, np.dtype(np.float64): 1}[A.dtype]
+    with open(path, "wb") as f:
+        np.array([A.shape[0], A.shape[1], code], dtype=np.int64).tofile(f)
+        A.tofile(f)
+
+
+def load_matrix(path: str) -> np.ndarray:
+    with open(path, "rb") as f:
+        M, N, code = np.fromfile(f, dtype=np.int64, count=3)
+        dtype = [np.float32, np.float64][int(code)]
+        A = np.fromfile(f, dtype=dtype).reshape(int(M), int(N))
+    return A
+
+
+def load_and_scatter(path: str, geom: LUGeometry | CholeskyGeometry) -> np.ndarray:
+    """File parse + tile scatter (role of `CholeskyIO.cpp:185-375`)."""
+    return geom.scatter(load_matrix(path))

@@ -1,0 +1,126 @@
+"""Layout descriptors and redistribution — the COSTA role.
+
+The reference delegates grid/layout redistribution to the vendored COSTA
+library via its `conflux_layout` adapter (`src/conflux/lu/layout.cpp:31-135`):
+a conflux tile distribution is described either as a ScaLAPACK-style
+`block_cyclic_layout` or as a `custom_layout` with explicit per-tile owners,
+and `costa::transform` moves data between any two such layouts.
+
+Here a layout is a small descriptor over a host matrix, and `transform`
+re-buckets tiles between two block-cyclic layouts (different tile sizes
+and/or grids) in one vectorized pass. On device, resharding between meshes
+is XLA's job (`jax.device_put` with a new NamedSharding) — this module is
+the host-side half, used by the CLIs, the checkpoint layer, and the
+ScaLAPACK-interop surface.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import numpy as np
+
+from conflux_tpu.geometry import Grid3
+
+
+@dataclasses.dataclass(frozen=True)
+class BlockCyclicLayout:
+    """ScaLAPACK-descriptor-style block-cyclic layout over a (Prows, Pcols)
+    grid (role of `costa::block_cyclic_layout` as used in `layout.cpp:63-113`).
+    """
+
+    M: int
+    N: int
+    vr: int  # row tile size
+    vc: int  # col tile size
+    Prows: int
+    Pcols: int
+
+    @classmethod
+    def for_grid(cls, M: int, N: int, v: int, grid: Grid3) -> "BlockCyclicLayout":
+        return cls(M=M, N=N, vr=v, vc=v, Prows=grid.Px, Pcols=grid.Py)
+
+    def owner(self, ti: int, tj: int) -> tuple[int, int]:
+        """Owning grid coordinate of tile (ti, tj) — the conflux
+        owner-computes map (`layout.cpp:114-123`)."""
+        return ti % self.Prows, tj % self.Pcols
+
+    def tile_counts(self) -> tuple[int, int]:
+        return -(-self.M // self.vr), -(-self.N // self.vc)
+
+    def local_shape(self, p: int, q: int) -> tuple[int, int]:
+        """Local buffer extent on grid coordinate (p, q), numroc-style
+        (role of `examples/utils.hpp` local-size math)."""
+        Mt, Nt = self.tile_counts()
+        nrt = (Mt - p + self.Prows - 1) // self.Prows
+        nct = (Nt - q + self.Pcols - 1) // self.Pcols
+        last_r = self.M - (Mt - 1) * self.vr
+        last_c = self.N - (Nt - 1) * self.vc
+        rows = nrt * self.vr - (last_r != self.vr and self.owner(Mt - 1, 0)[0] == p) * (self.vr - last_r)
+        cols = nct * self.vc - (last_c != self.vc and self.owner(0, Nt - 1)[1] == q) * (self.vc - last_c)
+        return rows, cols
+
+    def owner_map(self) -> np.ndarray:
+        """(Mt, Nt, 2) explicit per-tile owner array — the
+        `costa::custom_layout` form (`layout.cpp:114-135`)."""
+        Mt, Nt = self.tile_counts()
+        ti = np.arange(Mt)[:, None]
+        tj = np.arange(Nt)[None, :]
+        return np.stack(
+            np.broadcast_arrays(ti % self.Prows, tj % self.Pcols), axis=-1
+        )
+
+
+def scatter(A: np.ndarray, layout: BlockCyclicLayout) -> list[list[np.ndarray]]:
+    """Split a global matrix into per-coordinate local buffers (tiles in
+    local block-cyclic order, row-major within)."""
+    return [
+        [_gather_tiles(A, layout, p, q) for q in range(layout.Pcols)]
+        for p in range(layout.Prows)
+    ]
+
+
+def _gather_tiles(A: np.ndarray, lay: BlockCyclicLayout, p: int, q: int) -> np.ndarray:
+    Mt, Nt = lay.tile_counts()
+    row_tiles = range(p, Mt, lay.Prows)
+    col_tiles = range(q, Nt, lay.Pcols)
+    if not len(row_tiles) or not len(col_tiles):
+        # this coordinate owns no tiles (grid larger than the tile grid)
+        return np.zeros((0, 0), A.dtype)
+    blocks = [
+        np.concatenate(
+            [r[:, tj * lay.vc : min((tj + 1) * lay.vc, lay.N)] for tj in col_tiles],
+            axis=1,
+        )
+        for r in (A[ti * lay.vr : min((ti + 1) * lay.vr, lay.M)] for ti in row_tiles)
+    ]
+    return np.concatenate(blocks, axis=0)
+
+
+def gather(shards: list[list[np.ndarray]], layout: BlockCyclicLayout) -> np.ndarray:
+    """Inverse of :func:`scatter`."""
+    dtype = shards[0][0].dtype
+    A = np.zeros((layout.M, layout.N), dtype=dtype)
+    Mt, Nt = layout.tile_counts()
+    for p in range(layout.Prows):
+        for q in range(layout.Pcols):
+            loc = shards[p][q]
+            for li, ti in enumerate(range(p, Mt, layout.Prows)):
+                r0, r1 = ti * layout.vr, min((ti + 1) * layout.vr, layout.M)
+                for lj, tj in enumerate(range(q, Nt, layout.Pcols)):
+                    c0, c1 = tj * layout.vc, min((tj + 1) * layout.vc, layout.N)
+                    A[r0:r1, c0:c1] = loc[
+                        li * layout.vr : li * layout.vr + (r1 - r0),
+                        lj * layout.vc : lj * layout.vc + (c1 - c0),
+                    ]
+    return A
+
+
+def transform(shards: list[list[np.ndarray]], src: BlockCyclicLayout,
+              dst: BlockCyclicLayout) -> list[list[np.ndarray]]:
+    """Redistribute between two block-cyclic layouts (the `costa::transform`
+    role, `examples/conflux_miniapp.cpp:349-353`): src shards -> global ->
+    dst shards. Shapes must agree; tile sizes and grids may differ."""
+    if (src.M, src.N) != (dst.M, dst.N):
+        raise ValueError(f"layout shapes differ: {(src.M, src.N)} vs {(dst.M, dst.N)}")
+    return scatter(gather(shards, src), dst)

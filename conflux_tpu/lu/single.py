@@ -9,8 +9,10 @@ large MXU matmul.
 
 The number of supersteps Nt = N/v is a static Python value, so the loop
 unrolls at trace time with *exact* shapes (no masking overhead): total flops
-are the true 2/3 N^3. For very large Nt use `lu_factor_masked` (fori_loop +
-static-shape masking) in conflux_tpu/lu/masked.py.
+are the true 2/3 N^3. For very large Nt (where the unrolled program gets
+expensive to compile) run the distributed implementation on a 1x1x1 grid —
+it is a single `fori_loop` body with static-shape masking, compiling in
+O(1) steps (see conflux_tpu/lu/distributed.py).
 """
 
 from __future__ import annotations
@@ -49,24 +51,28 @@ def _lu_factor_blocked(A: jax.Array, v: int, precision, backend: str):
 
     perm = jnp.arange(M)
 
+    cdtype = blas.compute_dtype(A.dtype)
     for k in range(n_steps):
         off = k * v
         # --- panel factorization (reference step 1: pivoting + A00) ------- #
-        panel = A[off:, off : off + v]
+        # panel math in the compute dtype (f32 when storage is bf16)
+        panel = A[off:, off : off + v].astype(cdtype)
         lu_panel, pperm = blas.panel_lu(panel)
         # apply the panel's row permutation to the trailing rows of A and to
         # the global permutation (value-level row movement, single device)
         A = A.at[off:, :].set(A[off:, :][pperm])
         perm = perm.at[off:].set(perm[off:][pperm])
-        A = A.at[off:, off : off + v].set(lu_panel)
+        A = A.at[off:, off : off + v].set(lu_panel.astype(A.dtype))
 
         if off + v < N:
             # --- A01 TRSM (reference step 5) ------------------------------ #
             L00 = blas.unit_lower(lu_panel[:v])
-            A01 = blas.trsm_left_lower_unit(L00, A[off : off + v, off + v :])
+            A01 = blas.trsm_left_lower_unit(
+                L00, A[off : off + v, off + v :].astype(cdtype)
+            ).astype(A.dtype)
             A = A.at[off : off + v, off + v :].set(A01)
             # --- trailing GEMM (reference step 6, the hot op) ------------- #
-            L10 = lu_panel[v:, :]
+            L10 = lu_panel[v:, :].astype(A.dtype)
             A = A.at[off + v :, off + v :].set(
                 blas.gemm(L10, A01, c=A[off + v :, off + v :], alpha=-1.0,
                           precision=precision, backend=backend)

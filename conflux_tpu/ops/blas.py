@@ -97,6 +97,16 @@ def _acc_dtype(dtype) -> jnp.dtype:
     return dtype
 
 
+def compute_dtype(dtype):
+    """Dtype for panel factorizations and triangular solves.
+
+    bfloat16 storage uses float32 panel math (LU/potrf kernels have no bf16
+    path, and panel accuracy sets the factorization's accuracy); the trailing
+    GEMMs stay in the storage dtype so bf16 runs ride the fast MXU path.
+    """
+    return _acc_dtype(dtype)
+
+
 # --------------------------------------------------------------------------- #
 # Triangular solves
 # --------------------------------------------------------------------------- #

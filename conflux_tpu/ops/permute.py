@@ -1,0 +1,53 @@
+"""Row-permutation and pivot-compaction ops.
+
+TPU-native equivalent of the reference's OpenMP row-permutation machinery
+(`src/conflux/lu/utils.hpp:12-160`: `permute_rows`, `inverse_permute_rows`,
+`prepend_column`) and the pivot compaction kernel `push_pivots_up`
+(`conflux_opt.hpp:176-218`). On TPU these are value-level gathers/scatters —
+XLA turns them into HBM-bandwidth copies; no in-place threading needed.
+
+The distributed LU itself never moves rows (it masks instead — SURVEY P6),
+but these ops are part of the public API surface for users who want the
+reference's explicit-permutation workflow, and they back the validation
+path's factor reconstruction.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def permute_rows(A: jax.Array, perm: jax.Array) -> jax.Array:
+    """out[i, :] = A[perm[i], :]  (reference `utils.hpp` permute_rows)."""
+    return A[perm, :]
+
+
+def inverse_permute_rows(A: jax.Array, perm: jax.Array) -> jax.Array:
+    """out[perm[i], :] = A[i, :] — the inverse of :func:`permute_rows`."""
+    inv = jnp.zeros_like(perm).at[perm].set(jnp.arange(perm.shape[0], dtype=perm.dtype))
+    return A[inv, :]
+
+
+def invert_permutation(perm: jax.Array) -> jax.Array:
+    return jnp.zeros_like(perm).at[perm].set(jnp.arange(perm.shape[0], dtype=perm.dtype))
+
+
+def prepend_column(A: jax.Array, col: jax.Array) -> jax.Array:
+    """Glue an index column onto a candidate buffer (reference
+    `utils.hpp:12-26` — used to carry global row ids through local LUs)."""
+    return jnp.concatenate([col[:, None].astype(A.dtype), A], axis=1)
+
+
+def push_pivots_up(A: jax.Array, pivot_mask: jax.Array) -> tuple[jax.Array, jax.Array]:
+    """Stable partition: rows with pivot_mask True move to the top, others
+    keep their relative order below (the role of `push_pivots_up`,
+    `conflux_opt.hpp:176-218`, as a value-level permutation).
+
+    Returns (A_permuted, perm) with A_permuted = A[perm].
+    """
+    n = A.shape[0]
+    idx = jnp.arange(n)
+    # stable argsort of (not pivot) keeps pivots first, original order within
+    perm = jnp.argsort(jnp.where(pivot_mask, idx, idx + n), stable=True)
+    return A[perm, :], perm

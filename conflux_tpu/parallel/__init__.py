@@ -1,0 +1,18 @@
+"""Device mesh and collectives — the communication backend.
+
+TPU-native replacement for the reference's MPI-3 layer (SURVEY.md §2.4 C1):
+the 3D Cartesian communicator and its five sub-communicators become named
+axes of one `jax.sharding.Mesh`, and every MPI exchange becomes an XLA
+collective over a subset of axis names, riding ICI within a slice and DCN
+across slices.
+"""
+
+from conflux_tpu.parallel.mesh import (
+    AXIS_X,
+    AXIS_Y,
+    AXIS_Z,
+    make_mesh,
+    comm,
+)
+
+__all__ = ["AXIS_X", "AXIS_Y", "AXIS_Z", "make_mesh", "comm"]

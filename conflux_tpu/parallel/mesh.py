@@ -1,0 +1,73 @@
+"""The 3D device mesh and the six communicator patterns.
+
+The reference builds one 3D Cartesian communicator plus five sub-communicators
+(`lu_params.hpp:84-108`): lu (xyz), jk (yz), ik (xz), ij (xy), k (z), i (x).
+On TPU these are not objects — they are *names*: collectives take mesh axis
+names, and a "sub-communicator" is just a subset of axes. `comm` maps the
+reference's communicator vocabulary onto axis-name tuples so algorithm code
+can speak in the same terms the reference does.
+
+| reference comm | axes      | used for                                        |
+|----------------|-----------|-------------------------------------------------|
+| `lu_comm`      | x, y, z   | whole-grid ops                                  |
+| `jk_comm`      | y, z      | panel broadcast / A10 slab scatter              |
+| `ik_comm`      | x, z      | pivot-row reduce + distribute / A01 slab scatter|
+| `ij_comm`      | x, y      | validation-layout assembly                      |
+| `k_comm`       | z         | 2.5D partial-sum reduction                      |
+| `i_comm`       | x         | tournament pivoting butterfly                   |
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+import jax
+
+from conflux_tpu.geometry import Grid3
+
+AXIS_X = "x"  # row dimension of the tile grid (Px)
+AXIS_Y = "y"  # column dimension of the tile grid (Py)
+AXIS_Z = "z"  # 2.5D replication depth (Pz)
+
+comm = {
+    "lu": (AXIS_X, AXIS_Y, AXIS_Z),
+    "jk": (AXIS_Y, AXIS_Z),
+    "ik": (AXIS_X, AXIS_Z),
+    "ij": (AXIS_X, AXIS_Y),
+    "k": (AXIS_Z,),
+    "i": (AXIS_X,),
+}
+
+
+_MESH_REGISTRY: dict = {}
+
+
+def mesh_cache_key(mesh: jax.sharding.Mesh):
+    """Hashable identity for a mesh, and register it for `lookup_mesh`.
+
+    Compiled program builders are lru_cached on geometry + this key; keying
+    by (device ids, axis names) means two equivalent Mesh objects share one
+    compiled program, and the registry holds one canonical mesh per key
+    (bounded by the number of distinct device layouts, so no growth over
+    repeated calls).
+    """
+    key = (tuple(d.id for d in mesh.devices.flat), mesh.axis_names)
+    _MESH_REGISTRY[key] = mesh
+    return key
+
+
+def lookup_mesh(key) -> jax.sharding.Mesh:
+    return _MESH_REGISTRY[key]
+
+
+def make_mesh(grid: Grid3, devices=None) -> jax.sharding.Mesh:
+    """Build the ('x', 'y', 'z') mesh for a Grid3.
+
+    On real hardware, axis order matters for ICI locality: jax.make_mesh
+    chooses a device assignment that keeps the fastest-varying axes on
+    physically adjacent chips. For tests, pass an explicit device list.
+    """
+    if devices is None:
+        return jax.make_mesh((grid.Px, grid.Py, grid.Pz), (AXIS_X, AXIS_Y, AXIS_Z))
+    devs = np.asarray(devices).reshape(grid.Px, grid.Py, grid.Pz)
+    return jax.sharding.Mesh(devs, (AXIS_X, AXIS_Y, AXIS_Z))

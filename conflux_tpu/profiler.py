@@ -1,0 +1,88 @@
+"""Scoped-region profiler — the role of the vendored semiprof
+(`libs/semiprof/include/semiprof/semiprof.hpp:38-52`) and the PE/PL/PP/PC
+macro shims (`src/conflux/lu/profiler.hpp`, `cholesky/CholeskyProfiler.h`).
+
+`region(name)` is both a context manager and a decorator; it wraps the body
+in `jax.named_scope` (so regions show up in XLA/`jax.profiler` traces under
+the same names) and accumulates host-side wall time and call counts.
+`report()` prints a semiprof-style table sorted by total time; `clear()`
+resets. Region names follow the reference's step vocabulary
+(`step0_reduce`, `step1_pivoting`, ..., `conflux_opt.hpp:635,777,1346`).
+
+For on-device timing of jitted code use `trace(logdir)` which forwards to
+`jax.profiler.trace` (XPlane output readable in TensorBoard/XProf).
+"""
+
+from __future__ import annotations
+
+import contextlib
+import functools
+import time
+from collections import defaultdict
+
+import jax
+
+_times: dict[str, float] = defaultdict(float)
+_counts: dict[str, int] = defaultdict(int)
+_enabled = True
+
+
+def enable(on: bool = True) -> None:
+    """Compile-time switch analog (reference CONFLUX_WITH_PROFILING)."""
+    global _enabled
+    _enabled = on
+
+
+@contextlib.contextmanager
+def region(name: str):
+    """Profiled named scope: `with profiler.region('step1_pivoting'): ...`"""
+    if not _enabled:
+        with jax.named_scope(name):
+            yield
+        return
+    t0 = time.perf_counter()
+    with jax.named_scope(name):
+        yield
+    _times[name] += time.perf_counter() - t0
+    _counts[name] += 1
+
+
+def profiled(name: str):
+    """Decorator form of :func:`region`."""
+
+    def deco(fn):
+        @functools.wraps(fn)
+        def wrapper(*args, **kwargs):
+            with region(name):
+                return fn(*args, **kwargs)
+
+        return wrapper
+
+    return deco
+
+
+def report() -> str:
+    """semiprof-style table (reference README.md:120-165 output shape)."""
+    lines = [f"{'REGION':<32}{'CALLS':>8}{'THREAD':>12}{'WALL':>12}{'%':>8}"]
+    total = sum(_times.values()) or 1.0
+    for name, t in sorted(_times.items(), key=lambda kv: -kv[1]):
+        lines.append(
+            f"{name:<32}{_counts[name]:>8}{t:>12.3f}{t:>12.3f}{100 * t / total:>8.1f}"
+        )
+    out = "\n".join(lines)
+    print(out)
+    return out
+
+
+def clear() -> None:
+    _times.clear()
+    _counts.clear()
+
+
+def timings() -> dict[str, tuple[int, float]]:
+    return {k: (_counts[k], _times[k]) for k in _times}
+
+
+def trace(logdir: str):
+    """Device-level tracing: `with profiler.trace('/tmp/trace'): ...`"""
+    return jax.profiler.trace(logdir)

@@ -1,0 +1,73 @@
+"""Miniapp CLIs driven in-process on the CPU test platform — covers the
+reference's driver surface (`examples/conflux_miniapp.cpp`,
+`examples/cholesky_miniapp.cpp`) including the `_result_` protocol."""
+
+import re
+
+import pytest
+
+from conflux_tpu.cli import cholesky_miniapp, conflux_miniapp
+
+
+def run_cli(main, argv, capsys):
+    rc = main(argv)
+    assert rc == 0
+    return capsys.readouterr().out
+
+
+def test_conflux_miniapp_result_line(capsys):
+    out = run_cli(
+        conflux_miniapp.main,
+        ["-N", "64", "-b", "16", "--p_grid", "2,2,1", "-r", "2", "--validate",
+         "--dtype", "float64"],
+        capsys,
+    )
+    lines = [l for l in out.splitlines() if l.startswith("_result_")]
+    assert len(lines) == 2
+    m = re.match(
+        r"_result_ lu,conflux_tpu,64,64,4,2x2x1,time,float64,([\d.]+),16", lines[0]
+    )
+    assert m, lines[0]
+    res = [l for l in out.splitlines() if l.startswith("_residual_")]
+    assert len(res) == 1
+    assert float(res[0].split()[1]) < 1e-10
+
+
+def test_conflux_miniapp_auto_grid(capsys):
+    out = run_cli(conflux_miniapp.main, ["-N", "64", "-b", "8", "-r", "1"], capsys)
+    assert "_result_" in out
+
+
+def test_conflux_miniapp_grid_too_large():
+    with pytest.raises(SystemExit):
+        conflux_miniapp.main(["-N", "64", "-b", "8", "--p_grid", "4,4,4"])
+
+
+def test_cholesky_miniapp(capsys):
+    out = run_cli(
+        cholesky_miniapp.main,
+        ["--dim", "64", "--tile", "16", "--grid", "2,2,2", "--run", "2", "--validate"],
+        capsys,
+    )
+    assert "PROBLEM PARAMETERS" in out
+    lines = [l for l in out.splitlines() if l.startswith("_result_")]
+    assert len(lines) == 2
+    assert lines[0].startswith("_result_ cholesky,conflux_tpu,64,64,8,2x2x2,time,")
+    res = [l for l in out.splitlines() if l.startswith("_residual_")]
+    assert float(res[0].split()[1]) < 1e-4
+
+
+def test_profiler_report(capsys):
+    from conflux_tpu import profiler
+
+    profiler.clear()
+    with profiler.region("step0_reduce"):
+        pass
+    with profiler.region("step0_reduce"):
+        pass
+    t = profiler.timings()
+    assert t["step0_reduce"][0] == 2
+    out = profiler.report()
+    assert "step0_reduce" in out
+    profiler.clear()
+    assert profiler.timings() == {}

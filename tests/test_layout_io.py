@@ -1,0 +1,85 @@
+"""Layout (COSTA-role) and IO (CholeskyIO-role) tests."""
+
+import numpy as np
+import pytest
+
+from conflux_tpu.geometry import CholeskyGeometry, Grid3, LUGeometry
+from conflux_tpu.io import generate_spd_tiles, load_and_scatter, load_matrix, save_matrix
+from conflux_tpu.layout import BlockCyclicLayout, gather, scatter, transform
+from conflux_tpu import debug
+
+
+def test_layout_roundtrip():
+    lay = BlockCyclicLayout(M=20, N=12, vr=4, vc=4, Prows=2, Pcols=3)
+    A = np.arange(240.0).reshape(20, 12)
+    back = gather(scatter(A, lay), lay)
+    np.testing.assert_array_equal(A, back)
+
+
+def test_layout_ragged_edges():
+    # non-divisible extents exercise the partial-tile paths
+    lay = BlockCyclicLayout(M=10, N=7, vr=4, vc=3, Prows=2, Pcols=2)
+    A = np.random.default_rng(0).standard_normal((10, 7))
+    back = gather(scatter(A, lay), lay)
+    np.testing.assert_array_equal(A, back)
+
+
+def test_layout_transform_between_tile_sizes():
+    A = np.random.default_rng(1).standard_normal((24, 24))
+    src = BlockCyclicLayout(M=24, N=24, vr=4, vc=4, Prows=2, Pcols=2)
+    dst = BlockCyclicLayout(M=24, N=24, vr=8, vc=8, Prows=3, Pcols=1)
+    moved = transform(scatter(A, src), src, dst)
+    np.testing.assert_array_equal(gather(moved, dst), A)
+
+
+def test_layout_transform_shape_mismatch():
+    src = BlockCyclicLayout(M=8, N=8, vr=4, vc=4, Prows=1, Pcols=1)
+    dst = BlockCyclicLayout(M=16, N=8, vr=4, vc=4, Prows=1, Pcols=1)
+    with pytest.raises(ValueError):
+        transform(scatter(np.zeros((8, 8)), src), src, dst)
+
+
+def test_owner_map():
+    lay = BlockCyclicLayout(M=16, N=16, vr=4, vc=4, Prows=2, Pcols=2)
+    om = lay.owner_map()
+    assert om.shape == (4, 4, 2)
+    assert om[2, 3].tolist() == [0, 1]
+
+
+def test_spd_tiles_deterministic_and_spd():
+    geom = CholeskyGeometry.create(64, 16, Grid3(2, 2, 1))
+    A1 = generate_spd_tiles(geom, seed=5)
+    A2 = generate_spd_tiles(geom, seed=5)
+    np.testing.assert_array_equal(A1, A2)
+    np.testing.assert_array_equal(A1, A1.T)
+    assert np.linalg.eigvalsh(A1).min() > 0
+
+
+def test_matrix_file_roundtrip(tmp_path):
+    A = np.random.default_rng(2).standard_normal((12, 8)).astype(np.float32)
+    p = str(tmp_path / "m.bin")
+    save_matrix(p, A)
+    np.testing.assert_array_equal(load_matrix(p), A)
+    geom = LUGeometry.create(12, 8, 4, Grid3(1, 1, 1))
+    shards = load_and_scatter(p, geom)
+    assert shards.shape[0] == 1
+
+
+def test_debug_checks():
+    debug.assert_valid(np.ones(4))
+    with pytest.raises(FloatingPointError):
+        debug.assert_valid(np.array([1.0, np.nan]))
+    with pytest.raises(ZeroDivisionError):
+        debug.assert_nonzero_pivots(np.diag([1.0, 0.0, 2.0]))
+    debug.assert_pivot_conservation(np.array([[0, 1], [2, 3]]), 4)
+    with pytest.raises(AssertionError):
+        debug.assert_pivot_conservation(np.array([0, 0, 1]), 4)
+
+
+def test_layout_grid_larger_than_tile_grid():
+    """A grid coordinate owning zero tiles must produce empty shards, not crash."""
+    lay = BlockCyclicLayout(M=4, N=4, vr=2, vc=4, Prows=1, Pcols=2)
+    A = np.arange(16.0).reshape(4, 4)
+    shards = scatter(A, lay)
+    assert shards[0][1].size == 0
+    np.testing.assert_array_equal(gather(shards, lay), A)

@@ -1,0 +1,70 @@
+"""Permutation-op tests — direct analog of the reference's gtest suite
+(`tests/unit/test_utils.cpp`: push_pivots_up with hand-computed expected
+output, permute_rows over shape cases, inverse round-trip property)."""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from conflux_tpu.ops.permute import (
+    inverse_permute_rows,
+    invert_permutation,
+    permute_rows,
+    prepend_column,
+    push_pivots_up,
+)
+
+
+def test_push_pivots_up_hand_checked():
+    # mirrors the hand-computed style of test_utils.cpp:8-84
+    A = jnp.asarray(np.arange(20.0).reshape(5, 4))
+    mask = jnp.asarray([False, True, False, False, True])
+    out, perm = push_pivots_up(A, mask)
+    expected_order = [1, 4, 0, 2, 3]  # pivots first, stable within groups
+    assert perm.tolist() == expected_order
+    np.testing.assert_array_equal(np.asarray(out), np.asarray(A)[expected_order])
+
+
+def test_push_pivots_up_no_pivots():
+    A = jnp.asarray(np.random.default_rng(0).standard_normal((6, 3)))
+    out, perm = push_pivots_up(A, jnp.zeros(6, bool))
+    np.testing.assert_array_equal(np.asarray(out), np.asarray(A))
+    assert perm.tolist() == list(range(6))
+
+
+def test_push_pivots_up_all_pivots():
+    A = jnp.asarray(np.random.default_rng(1).standard_normal((4, 4)))
+    out, perm = push_pivots_up(A, jnp.ones(4, bool))
+    np.testing.assert_array_equal(np.asarray(out), np.asarray(A))
+
+
+@pytest.mark.parametrize("shape", [(1, 1), (4, 4), (7, 3), (16, 5)])
+def test_permute_rows_shapes(shape):
+    rng = np.random.default_rng(shape[0])
+    A = jnp.asarray(rng.standard_normal(shape))
+    perm = jnp.asarray(rng.permutation(shape[0]))
+    out = permute_rows(A, perm)
+    np.testing.assert_array_equal(np.asarray(out), np.asarray(A)[np.asarray(perm)])
+
+
+def test_inverse_permute_roundtrip():
+    # the round-trip property test (test_utils.cpp:426-768)
+    rng = np.random.default_rng(3)
+    A = jnp.asarray(rng.standard_normal((12, 7)))
+    perm = jnp.asarray(rng.permutation(12))
+    back = inverse_permute_rows(permute_rows(A, perm), perm)
+    np.testing.assert_array_equal(np.asarray(back), np.asarray(A))
+
+
+def test_invert_permutation():
+    perm = jnp.asarray([2, 0, 3, 1])
+    inv = invert_permutation(perm)
+    assert np.asarray(inv)[np.asarray(perm)].tolist() == [0, 1, 2, 3]
+
+
+def test_prepend_column():
+    A = jnp.ones((3, 2))
+    col = jnp.asarray([5, 6, 7])
+    out = prepend_column(A, col)
+    assert out.shape == (3, 3)
+    assert out[:, 0].tolist() == [5.0, 6.0, 7.0]
