@@ -236,6 +236,11 @@ class LUGeometry:
             for d in range(min(A.shape[0], A.shape[1]), min(M, N)):
                 padded[d, d] = 1.0
             A = padded
+        from conflux_tpu import native
+
+        fast = native.scatter(A, v, Px, Py)
+        if fast is not None:
+            return fast
         # (Mt, v, Nt, v) -> (Px, Mtl, v, Py, Ntl, v) -> (Px, Py, Ml, Nl)
         T = A.reshape(self.Mt, v, self.Nt, v)
         T = T.reshape(self.Mtl, Px, v, self.Ntl, Py, v)
@@ -246,6 +251,11 @@ class LUGeometry:
     def gather(self, shards: np.ndarray) -> np.ndarray:
         """Inverse of :meth:`scatter`: (Px, Py, Ml, Nl) -> (M, N)."""
         Px, Py, v = self.grid.Px, self.grid.Py, self.v
+        from conflux_tpu import native
+
+        fast = native.gather(np.asarray(shards), v, Px, Py)
+        if fast is not None:
+            return fast
         T = shards.reshape(Px, Py, self.Mtl, v, self.Ntl, v)
         T = np.transpose(T, (2, 0, 3, 4, 1, 5))  # (Mtl, Px, v, Ntl, Py, v)
         return np.ascontiguousarray(T.reshape(self.M, self.N))

@@ -1,0 +1,92 @@
+"""Native (C++) acceleration for host-side layout work.
+
+The reference's redistribution layer is native (COSTA, a C++ library wired
+in through `src/conflux/lu/layout.cpp`); this package is its counterpart:
+an OpenMP C++ scatter/gather for the block-cyclic device layout, loaded via
+ctypes (no pybind11 in this environment). Build on demand with
+
+    python -m conflux_tpu.native.build
+
+`available()` reports whether the shared library is loadable; the pure-
+NumPy paths in `conflux_tpu.geometry` are used as fallback when it is not.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+
+import numpy as np
+
+_LIB = None
+_TRIED = False
+
+_SO_PATH = os.path.join(os.path.dirname(__file__), "libconflux_layout.so")
+
+
+def _load():
+    global _LIB, _TRIED
+    if _TRIED:
+        return _LIB
+    _TRIED = True
+    if os.path.exists(_SO_PATH):
+        try:
+            lib = ctypes.CDLL(_SO_PATH)
+            for name in ("conflux_scatter_f32", "conflux_scatter_f64",
+                         "conflux_gather_f32", "conflux_gather_f64"):
+                fn = getattr(lib, name)
+                fn.restype = None
+                ptr = ctypes.c_float if name.endswith("f32") else ctypes.c_double
+                fn.argtypes = [ctypes.POINTER(ptr), ctypes.POINTER(ptr)] + [ctypes.c_int64] * 5
+            lib.conflux_native_nthreads.restype = ctypes.c_int
+            _LIB = lib
+        except (OSError, AttributeError):
+            # unloadable or stale .so (e.g. built before a symbol was added):
+            # fall back to the pure-NumPy paths
+            _LIB = None
+    return _LIB
+
+
+def available() -> bool:
+    return _load() is not None
+
+
+def nthreads() -> int:
+    lib = _load()
+    return lib.conflux_native_nthreads() if lib else 0
+
+
+def _ptr(a: np.ndarray):
+    ct = ctypes.c_float if a.dtype == np.float32 else ctypes.c_double
+    return a.ctypes.data_as(ctypes.POINTER(ct))
+
+
+def scatter(A: np.ndarray, v: int, Px: int, Py: int) -> np.ndarray | None:
+    """(M, N) row-major -> (Px, Py, Ml, Nl) shards; None if not applicable."""
+    lib = _load()
+    if lib is None or A.dtype not in (np.float32, np.float64):
+        return None
+    M, N = A.shape
+    if M % (v * Px) or N % (v * Py):
+        return None
+    A = np.ascontiguousarray(A)
+    Ml, Nl = M // Px, N // Py
+    out = np.empty((Px, Py, Ml, Nl), dtype=A.dtype)
+    fn = lib.conflux_scatter_f32 if A.dtype == np.float32 else lib.conflux_scatter_f64
+    fn(_ptr(A), _ptr(out), M, N, v, Px, Py)
+    return out
+
+
+def gather(shards: np.ndarray, v: int, Px: int, Py: int) -> np.ndarray | None:
+    lib = _load()
+    if lib is None or shards.dtype not in (np.float32, np.float64):
+        return None
+    _, _, Ml, Nl = shards.shape
+    if Ml % v or Nl % v:
+        return None
+    M, N = Ml * Px, Nl * Py
+    shards = np.ascontiguousarray(shards)
+    out = np.empty((M, N), dtype=shards.dtype)
+    fn = lib.conflux_gather_f32 if shards.dtype == np.float32 else lib.conflux_gather_f64
+    fn(_ptr(shards), _ptr(out), M, N, v, Px, Py)
+    return out

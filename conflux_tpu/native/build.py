@@ -1,0 +1,33 @@
+"""Build the native layout library: `python -m conflux_tpu.native.build`."""
+
+from __future__ import annotations
+
+import os
+import shutil
+import subprocess
+import sys
+
+
+def build(verbose: bool = True) -> str:
+    here = os.path.dirname(__file__)
+    src = os.path.join(here, "layout_native.cpp")
+    out = os.path.join(here, "libconflux_layout.so")
+    cxx = os.environ.get("CXX") or shutil.which("g++") or shutil.which("c++")
+    if cxx is None:
+        raise RuntimeError("no C++ compiler found (set CXX)")
+    cmd = [cxx, "-O3", "-march=native", "-fopenmp", "-shared", "-fPIC",
+           "-std=c++17", src, "-o", out]
+    if verbose:
+        print(" ".join(cmd))
+    subprocess.run(cmd, check=True)
+    return out
+
+
+if __name__ == "__main__":
+    path = build()
+    from conflux_tpu import native
+
+    native._TRIED = False  # force re-probe
+    ok = native.available()
+    print(f"built {path}; loadable={ok}; omp threads={native.nthreads()}")
+    sys.exit(0 if ok else 1)

@@ -1,0 +1,11 @@
+"""Executable algorithm specification in pure NumPy.
+
+Role of the reference's `python/` prototype (`python/conflux.py:12-366`,
+`python/pivoting.py`): a single-process simulation of every device's buffers
+and every collective, used to develop and debug the algorithm without
+hardware, with pluggable pivoting strategies.
+"""
+
+from conflux_tpu.spec.numpy_lu import simulate_lu, PIVOTING_STRATEGIES
+
+__all__ = ["simulate_lu", "PIVOTING_STRATEGIES"]

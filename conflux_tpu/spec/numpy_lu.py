@@ -1,0 +1,193 @@
+"""Pure-NumPy simulation of the distributed LU — the executable spec.
+
+Simulates all Px*Py*Pz devices' local buffers in one process, with every
+collective written out as an explicit sum/gather over the simulated device
+array — exactly the technique the reference used to develop its algorithm
+without a cluster (`python/conflux.py:40`: `A11Buff = np.zeros([P, Nl, Nl])`).
+
+This spec mirrors `conflux_tpu.lu.distributed` step for step (z-partial
+shards, value-level pivot masks, layer-0 factor writes), so tests can check
+the shard_map implementation against it buffer-for-buffer. Pivoting is
+pluggable (reference `python/pivoting.py:14-18`):
+
+  'tournament' — local candidate LU + stacked election (the production path)
+  'partial'    — global partial pivoting by |max| column scan (quality oracle)
+  'none'       — no pivoting (only safe for diagonally dominant inputs)
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import scipy.linalg
+
+from conflux_tpu.geometry import Grid3, LUGeometry
+
+
+def _lu_packed(A: np.ndarray):
+    """Packed LU with row pivoting: returns (lu, perm) with A[perm] = L@U."""
+    P, L, U = scipy.linalg.lu(A)
+    # perm from P: A = P L U  =>  A[perm] = L U with perm = argmax over P^T
+    perm = np.argmax(P.T, axis=1) if P.shape[0] else np.arange(0)
+    lu = np.tril(L[:, : U.shape[0]], -1) + np.pad(
+        U, ((0, L.shape[0] - U.shape[0]), (0, 0))
+    )
+    return lu, perm
+
+
+def _select_tournament(cand: np.ndarray, gri_m: np.ndarray, Px: int, v: int):
+    """Local LU picks v candidates per x-rank; one stacked LU elects winners."""
+    blocks, gris = [], []
+    for px in range(Px):
+        _, perm_l = _lu_packed(cand[px])
+        top = perm_l[:v]
+        blocks.append(cand[px][top])
+        gris.append(gri_m[px][top])
+    stacked = np.concatenate(blocks, axis=0)
+    sgri = np.concatenate(gris, axis=0)
+    lu_f, perm_f = _lu_packed(stacked)
+    return sgri[perm_f[:v]], lu_f[:v]
+
+
+def _select_partial(cand: np.ndarray, gri_m: np.ndarray, Px: int, v: int):
+    """Global partial pivoting: eliminate column by column over the full
+    stacked candidate set (the quality oracle the tournament approximates)."""
+    stacked = np.concatenate(list(cand), axis=0).copy()
+    sgri = np.concatenate(list(gri_m), axis=0)
+    m = stacked.shape[0]
+    order = np.arange(m)
+    for j in range(v):
+        p = j + int(np.argmax(np.abs(stacked[j:, j])))
+        stacked[[j, p]] = stacked[[p, j]]
+        order[[j, p]] = order[[p, j]]
+        piv = stacked[j, j]
+        if piv != 0:
+            stacked[j + 1 :, j] /= piv
+            stacked[j + 1 :, j + 1 :] -= np.outer(stacked[j + 1 :, j], stacked[j, j + 1 :])
+    return sgri[order[:v]], stacked[:v]
+
+
+def _select_none(cand: np.ndarray, gri_m: np.ndarray, Px: int, v: int):
+    """Take the v lowest-numbered active rows, in global row order."""
+    sgri = np.concatenate(list(gri_m), axis=0)
+    stacked = np.concatenate(list(cand), axis=0)
+    order = np.argsort(sgri, kind="stable")[:v]
+    lu, _ = _lu_packed_nopiv(stacked[order])
+    return sgri[order], lu
+
+
+def _lu_packed_nopiv(A: np.ndarray):
+    lu = A.astype(float).copy()
+    v = lu.shape[1]
+    for j in range(min(v, lu.shape[0])):
+        lu[j + 1 :, j] /= lu[j, j]
+        lu[j + 1 :, j + 1 :] -= np.outer(lu[j + 1 :, j], lu[j, j + 1 :])
+    return lu, np.arange(lu.shape[0])
+
+
+PIVOTING_STRATEGIES = {
+    "tournament": _select_tournament,
+    "partial": _select_partial,
+    "none": _select_none,
+}
+
+
+def simulate_lu(A: np.ndarray, grid: Grid3, v: int, pivoting: str = "tournament"):
+    """Run the full distributed algorithm on simulated devices.
+
+    Returns (LU (M, N) packed factors in original row order, pivots
+    (n_steps, v) global rows in elimination order), matching the outputs of
+    `conflux_tpu.lu.distributed.lu_factor_distributed` exactly.
+    """
+    select = PIVOTING_STRATEGIES[pivoting]
+    geom = LUGeometry.create(A.shape[0], A.shape[1], v, grid)
+    Px, Py, Pz = grid.Px, grid.Py, grid.Pz
+    Ml, Nl = geom.Ml, geom.Nl
+    nlayr = geom.nlayr
+
+    # per-device state, indexed [x, y, z]
+    shards = geom.scatter(A).astype(np.float64)
+    Aloc = np.zeros((Px, Py, Pz, Ml, Nl))
+    Aloc[:, :, 0] = shards  # data enters on layer z=0
+    done = np.zeros((Px, Ml), bool)
+
+    gri = geom.global_row_index()  # single source of truth for the row map
+    ctile = np.stack(
+        [(np.arange(Nl) // v) * Py + y for y in range(Py)]
+    )
+
+    pivots = np.zeros((geom.n_steps, v), np.int64)
+
+    for k in range(geom.n_steps):
+        yo, lj = k % Py, (k // Py) * v
+
+        # panel = psum over (y, z) of the owner column        [collective]
+        panel = Aloc[:, yo, :, :, lj : lj + v].sum(axis=1)  # (Px, Ml, v)
+
+        # pivot selection over the x axis                     [collective]
+        cand = np.where(done[:, :, None], 0.0, panel)
+        gri_m = np.where(done, np.iinfo(np.int64).max, gri)
+        gpiv, lu00 = select(cand, gri_m, Px, v)
+        pivots[k] = gpiv
+        U00 = np.triu(lu00)
+        L00 = np.tril(lu00, -1) + np.eye(v)
+
+        match = gri[:, :, None] == gpiv[None, None, :]  # (Px, Ml, v)
+        is_piv = match.any(axis=2)
+        done_new = done | is_piv
+
+        # L10 for active rows (duplicated compute)
+        act = np.where(done_new[:, :, None], 0.0, panel)
+        # X U00 = act  =>  U00^T X^T = act^T
+        L10 = scipy.linalg.solve_triangular(
+            U00, act.reshape(-1, v).T, trans="T", lower=False
+        ).T.reshape(Px, Ml, v)
+
+        # pivot rows: gather + psum over (x, z)               [collective]
+        Prows = np.zeros((Py, v, Nl))
+        for x in range(Px):
+            for q in range(v):
+                hits = np.nonzero(match[x, :, q])[0]
+                if hits.size:
+                    Prows[:, q, :] += Aloc[x, :, :, hits[0], :].sum(axis=1)
+        U01 = np.stack(
+            [scipy.linalg.solve_triangular(L00, Prows[y], lower=True, unit_diagonal=True)
+             for y in range(Py)]
+        )  # (Py, v, Nl)
+
+        # trailing update: each z layer applies its slab
+        for x in range(Px):
+            for y in range(Py):
+                trail = ctile[y] > k
+                for z in range(Pz):
+                    s0, s1 = z * nlayr, min((z + 1) * nlayr, v)
+                    upd = L10[x][:, s0:s1] @ U01[y][s0:s1, :]
+                    Aloc[x, y, z][:, trail] -= upd[:, trail]
+
+        # factor writes on layer 0; pivot rows zeroed elsewhere
+        for x in range(Px):
+            piv_rows = np.nonzero(is_piv[x])[0]
+            pos = np.argmax(match[x][piv_rows], axis=1)
+            for y in range(Py):
+                trail = ctile[y] > k
+                for z in range(Pz):
+                    if z == 0:
+                        Aloc[x, y, z][np.ix_(piv_rows, trail)] = U01[y][pos][:, trail]
+                    else:
+                        Aloc[x, y, z][np.ix_(piv_rows, trail)] = 0.0
+            # panel column on the owner y
+            for z in range(Pz):
+                col = Aloc[x, yo, z][:, lj : lj + v]
+                if z == 0:
+                    col[piv_rows] = lu00[pos]
+                    active = ~done_new[x]
+                    col[active] = L10[x][active]
+                else:
+                    # pivot + active rows zeroed; earlier-done rows are
+                    # already zero on z != 0 from their own step
+                    col[~done[x]] = 0.0
+                Aloc[x, yo, z][:, lj : lj + v] = col
+
+        done = done_new
+
+    LU = geom.gather(Aloc.sum(axis=2))
+    return LU, pivots

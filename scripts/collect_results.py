@@ -1,0 +1,60 @@
+#!/usr/bin/env python
+"""Parse `_result_` lines from benchmark logs into a CSV summary.
+
+Role of the reference's result-collection half (`scripts/launch_on_daint.py`
+launched jobs whose stdout logs carried `_result_` lines; this script is the
+parser). Computes GFLOP/s per row (2/3 N^3 for LU, 1/3 N^3 for Cholesky).
+
+Usage: python scripts/collect_results.py data/benchmarks/*.txt
+"""
+
+from __future__ import annotations
+
+import argparse
+import csv
+import sys
+
+
+FLOPS = {"lu": 2.0 / 3.0, "cholesky": 1.0 / 3.0}
+
+
+def parse_line(line: str):
+    # _result_ lu,conflux_tpu,<N>,<Nbase>,<P>,<grid>,time,<dtype>,<ms>,<v>
+    parts = line.split()[1].split(",")
+    algo, _, N, Nbase, P, grid, _, dtype, ms, v = parts
+    N, ms = int(N), float(ms)
+    gflops = FLOPS[algo] * N**3 / (ms * 1e-3) / 1e9
+    return {
+        "algorithm": algo, "N": N, "N_base": int(Nbase), "P": int(P),
+        "grid": grid, "dtype": dtype, "time_ms": ms, "tile": int(v),
+        "gflops": round(gflops, 2),
+    }
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser()
+    p.add_argument("logs", nargs="+")
+    p.add_argument("--out", default="-")
+    args = p.parse_args(argv)
+    rows = []
+    for path in args.logs:
+        with open(path) as f:
+            for line in f:
+                if line.startswith("_result_"):
+                    try:
+                        rows.append(parse_line(line))
+                    except (ValueError, IndexError, KeyError):
+                        print(f"skipping malformed line in {path}: {line.strip()}",
+                              file=sys.stderr)
+    out = sys.stdout if args.out == "-" else open(args.out, "w")
+    w = csv.DictWriter(out, fieldnames=list(rows[0].keys()) if rows else ["empty"])
+    w.writeheader()
+    w.writerows(rows)
+    if out is not sys.stdout:
+        out.close()
+        print(f"{len(rows)} rows -> {args.out}")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
