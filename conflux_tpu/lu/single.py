@@ -41,11 +41,14 @@ def lu_factor_blocked(A: jax.Array, v: int, precision=None, backend: str | None 
     # resolve config outside jit so it lands in the jit cache key
     precision = blas.matmul_precision() if precision is None else precision
     backend = blas.get_backend() if backend is None else backend
-    return _lu_factor_blocked(A, v, precision, backend)
+    return _lu_factor_blocked(A, v, precision, backend, blas.get_panel_algo())
 
 
-@functools.partial(jax.jit, static_argnames=("v", "precision", "backend"))
-def _lu_factor_blocked(A: jax.Array, v: int, precision, backend: str):
+@functools.partial(
+    jax.jit, static_argnames=("v", "precision", "backend", "panel_algo")
+)
+def _lu_factor_blocked(A: jax.Array, v: int, precision, backend: str,
+                       panel_algo: str = "auto"):
     M, N = A.shape
     n_steps = N // v
 
@@ -57,7 +60,7 @@ def _lu_factor_blocked(A: jax.Array, v: int, precision, backend: str):
         # --- panel factorization (reference step 1: pivoting + A00) ------- #
         # panel math in the compute dtype (f32 when storage is bf16)
         panel = A[off:, off : off + v].astype(cdtype)
-        lu_panel, pperm = blas.panel_lu(panel)
+        lu_panel, pperm = blas.panel_lu(panel, algo=panel_algo)
         # apply the panel's row permutation to the trailing rows of A and to
         # the global permutation (value-level row movement, single device)
         A = A.at[off:, :].set(A[off:, :][pperm])

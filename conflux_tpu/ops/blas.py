@@ -140,17 +140,140 @@ def trsm_right_lower_t(L: jax.Array, B: jax.Array) -> jax.Array:
 # Panel factorizations
 # --------------------------------------------------------------------------- #
 
+# Rows per local chunk in the tournament panel factorization. XLA's TPU LU
+# custom call factors an (m, v) panel serially in m x 128 column blocks and
+# overflows its 16 MB scoped VMEM once m reaches ~16384; chunking keeps every
+# LU call at a bounded height. 4096 measured fastest on a v5e chip.
+_PANEL_CHUNK = 4096
 
-def panel_lu(panel: jax.Array):
-    """Partial-pivoted LU of an (m, v) panel.
+# 'auto' uses plain partial pivoting for short panels and the tournament for
+# tall ones; 'partial'/'tournament' force one path (tests and experiments).
+_PANEL_ALGO = "auto"
+
+
+def set_panel_algo(name: str) -> None:
+    if name not in ("auto", "partial", "tournament"):
+        raise ValueError(f"unknown panel algo {name!r}")
+    global _PANEL_ALGO
+    _PANEL_ALGO = name
+
+
+def get_panel_algo() -> str:
+    return _PANEL_ALGO
+
+
+def panel_lu(panel: jax.Array, algo: str | None = None):
+    """Pivoted LU of an (m, v) panel.
 
     Returns (lu_packed, perm) where perm is a length-m row permutation such
     that panel[perm] == L @ U with L unit-lower (m, v) and U upper (v, v)
     packed into lu_packed. This is the local kernel inside tournament
     pivoting (role of `LUP`, reference `conflux_opt.hpp:143-166`).
+
+    Short panels use exact partial pivoting (`lax.linalg.lu`); tall panels
+    use communication-avoiding tournament pivoting (:func:`panel_lu_tournament`),
+    which bounds every LU call's height and keeps the MXU busy.
+
+    `algo` defaults to the module setting **at trace time**; jitted callers
+    must resolve it outside jit and pass it as a static argument (see
+    `conflux_tpu/lu/single.py`) so it participates in the jit cache key.
+
+    Tile-size ceiling on TPU: every LU call is at least v rows tall (the
+    tournament's reduction rounds stack 2v), and XLA's LuDecompositionBlock
+    custom call overflows its scoped VMEM at ~16384 rows — so v <= 4096 is
+    the safe regime on TPU (v=1024 measured fastest anyway; see bench.py).
     """
+    m, v = panel.shape
+    algo = _PANEL_ALGO if algo is None else algo
+    if algo not in ("auto", "partial", "tournament"):
+        raise ValueError(f"unknown panel algo {algo!r}")
+    if algo == "auto":
+        algo = "tournament" if m > 2 * max(_PANEL_CHUNK, v) else "partial"
+    if algo == "tournament":
+        return panel_lu_tournament(panel)
     lu_packed, _pivots, perm = lax.linalg.lu(panel)
     return lu_packed, perm
+
+
+def tournament_winners(panel: jax.Array, chunk: int | None = None):
+    """Elect v pivot rows of an (m, v) panel by tournament (CALU).
+
+    Single-device analogue of the reference's butterfly tournament
+    (`tournament_rounds`, `conflux_opt.hpp:220-336`): rows are split into
+    chunks, each chunk's local partial-pivoted LU nominates its top v rows,
+    and a binary reduction tree of stacked (2v, v) LUs elects the winners.
+    All LU calls are height-bounded (chunk or 2v rows) and the chunk round
+    is batched, so this scales to arbitrarily tall panels.
+
+    Returns (lu00, gpiv): lu00 is the packed (v, v) LU of the winning rows in
+    pivot order; gpiv gives their row indices in `panel`. Requires the panel
+    to have full column rank: a rank-deficient panel can elect zero pad rows,
+    whose out-of-range ids are dropped by the caller's scatter (the same
+    panels break exact partial pivoting too — zero pivots).
+    """
+    m, v = panel.shape
+    c = chunk if chunk is not None else _PANEL_CHUNK
+    c = min(c, -(-m // v) * v)  # never taller than the (tile-rounded) panel
+    c = max(v, c // v * v)  # multiple of v, at least one tile tall
+    nch = -(-m // c)
+    mp = nch * c
+    if mp != m:  # zero rows lose every pivot contest against real rows
+        panel = jnp.pad(panel, ((0, mp - m), (0, 0)))
+    ids = jnp.arange(mp, dtype=jnp.int32)
+
+    cand = panel.reshape(nch, c, v)
+    cid = ids.reshape(nch, c)
+    lu_c, _, perm_c = lax.linalg.lu(cand)  # batched (nch, c, v)
+    top = perm_c[:, :v]
+    win = jnp.take_along_axis(cand, top[:, :, None], axis=1)  # (nch, v, v)
+    wid = jnp.take_along_axis(cid, top, axis=1)
+
+    n = 1 << (nch - 1).bit_length()
+    if n != nch:
+        # pad blocks are all-zero rows with out-of-range ids: they lose every
+        # contest against full-rank data, and if ever elected (rank-deficient
+        # panel) their ids are dropped by the caller's scatter, not aliased
+        # onto a real row
+        win = jnp.pad(win, ((0, n - nch), (0, 0), (0, 0)))
+        wid = jnp.pad(wid, ((0, n - nch), (0, 0)), constant_values=mp)
+
+    if n == 1:  # single chunk: its local LU already decided everything
+        return lu_c[0, :v], wid[0]
+
+    lu_r = None
+    while n > 1:
+        stacked = win.reshape(n // 2, 2 * v, v)
+        sid = wid.reshape(n // 2, 2 * v)
+        lu_r, _, perm_r = lax.linalg.lu(stacked)  # batched (n/2, 2v, v)
+        top = perm_r[:, :v]
+        win = jnp.take_along_axis(stacked, top[:, :, None], axis=1)
+        wid = jnp.take_along_axis(sid, top, axis=1)
+        n //= 2
+    # final round's packed LU rows 0..v are exactly the winners, factored
+    return lu_r[0, :v], wid[0]
+
+
+def panel_lu_tournament(panel: jax.Array, chunk: int | None = None):
+    """Tournament-pivoted (CALU) LU of a tall (m, v) panel.
+
+    Same contract as :func:`panel_lu`. Pivot growth of CALU is bounded and
+    in practice indistinguishable from partial pivoting (the reference ships
+    the same trade, `python/pivoting.py` 'tournament' strategy); residuals are
+    checked by the test suite, not assumed.
+    """
+    m, v = panel.shape
+    lu00, gpiv = tournament_winners(panel, chunk)
+    ids = jnp.arange(m, dtype=jnp.int32)
+    is_piv = jnp.zeros((m,), bool).at[gpiv].set(True, mode="drop")
+    pos = jnp.zeros((m,), jnp.int32).at[gpiv].set(
+        jnp.arange(v, dtype=jnp.int32), mode="drop"
+    )
+    # winners first (in pivot order), remaining rows after (in original order)
+    key = jnp.where(is_piv, pos, v + ids)
+    perm = jnp.argsort(key)
+    rest = panel[perm[v:]]
+    L10 = trsm_right_upper(jnp.triu(lu00), rest)
+    return jnp.concatenate([lu00, L10], axis=0), perm
 
 
 def unit_lower(lu00: jax.Array) -> jax.Array:

@@ -1,0 +1,80 @@
+"""Tournament (CALU) panel factorization: correctness of the single-device
+tournament against exact partial pivoting — same contract, height-bounded LU
+calls (role of the reference's `tournament_rounds`, `conflux_opt.hpp:220-336`,
+collapsed onto one device)."""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from conflux_tpu.ops import blas
+from conflux_tpu.validation import lu_residual, make_test_matrix, residual_bound
+
+
+def _panel_residual(panel, lu_packed, perm):
+    """|| panel[perm] - L @ U ||_F / || panel ||_F for an (m, v) panel."""
+    m, v = panel.shape
+    L = np.tril(np.asarray(lu_packed), -1)
+    L[:v] += np.eye(v)
+    U = np.triu(np.asarray(lu_packed)[:v])
+    return np.linalg.norm(panel[np.asarray(perm)] - L @ U) / np.linalg.norm(panel)
+
+
+@pytest.mark.parametrize("m,v,chunk", [(32, 8, 8), (64, 8, 16), (96, 16, 32), (80, 16, 32)])
+def test_tournament_panel_residual(m, v, chunk):
+    panel = make_test_matrix(m, v, seed=m + v)
+    lu_packed, perm = blas.panel_lu_tournament(jnp.asarray(panel), chunk=chunk)
+    assert sorted(np.asarray(perm).tolist()) == list(range(m))
+    assert _panel_residual(panel, lu_packed, perm) < residual_bound(m, np.float64)
+
+
+def test_tournament_single_chunk_matches_partial_pivots():
+    # one chunk: the tournament must elect the same pivot rows (in the same
+    # order) as exact partial pivoting; only the ordering of the *non*-pivot
+    # tail may differ
+    import jax
+
+    panel = make_test_matrix(32, 8, seed=1)
+    lu_t, perm_t = blas.panel_lu_tournament(jnp.asarray(panel), chunk=64)
+    _, _, perm_p = jax.lax.linalg.lu(jnp.asarray(panel))
+    np.testing.assert_array_equal(np.asarray(perm_t)[:8], np.asarray(perm_p)[:8])
+    assert _panel_residual(panel, lu_t, perm_t) < residual_bound(32, np.float64)
+
+
+def test_tournament_picks_large_pivots():
+    # a panel whose top chunk is tiny: winners must come from the bottom
+    rng = np.random.default_rng(7)
+    panel = rng.standard_normal((64, 8)) * 1e-8
+    panel[48:] = rng.standard_normal((16, 8)) + 3 * np.sign(rng.standard_normal((16, 8)))
+    lu_packed, perm = blas.panel_lu_tournament(jnp.asarray(panel), chunk=16)
+    winners = set(np.asarray(perm)[:8].tolist())
+    assert winners <= set(range(48, 64)), winners
+    assert _panel_residual(panel, lu_packed, perm) < residual_bound(64, np.float64)
+
+
+def test_tournament_nonpow2_chunks():
+    # m/chunk = 3 chunks exercises the pad-to-power-of-two path
+    panel = make_test_matrix(96, 8, seed=9)
+    lu_packed, perm = blas.panel_lu_tournament(jnp.asarray(panel), chunk=32)
+    assert _panel_residual(panel, lu_packed, perm) < residual_bound(96, np.float64)
+
+
+def test_blocked_lu_with_forced_tournament():
+    # full blocked LU with every panel going through the tournament
+    from conflux_tpu.lu.single import lu_factor_blocked
+
+    blas.set_panel_algo("tournament")
+    try:
+        N, v = 128, 16
+        A = make_test_matrix(N, N, seed=2)
+        LU, perm = lu_factor_blocked(jnp.asarray(A), v=v)
+        assert lu_residual(A, LU, perm) < residual_bound(N, np.float64)
+    finally:
+        blas.set_panel_algo("auto")
+
+
+def test_tournament_f32():
+    panel = make_test_matrix(64, 16, dtype=np.float32, seed=4)
+    lu_packed, perm = blas.panel_lu_tournament(jnp.asarray(panel), chunk=32)
+    assert lu_packed.dtype == jnp.float32
+    assert _panel_residual(panel, lu_packed, perm) < residual_bound(64, np.float32)
