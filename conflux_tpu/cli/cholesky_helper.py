@@ -1,0 +1,135 @@
+"""Offline SPD matrix generator / result comparator — the role of the
+reference's `examples/cholesky_helper.cpp` (binary input/result files for
+very large N, produced once and reused across benchmark runs) and of
+`python/compare_res.py` (norm-based comparison of a computed result against
+a reference result file).
+
+Subcommands:
+    generate  write input_N.bin (SPD, deterministic) and optionally
+              result_N.bin (its lower Cholesky factor, host LAPACK)
+    compare   relative Frobenius distance between two matrix files
+    factor    read an input file, factor it on the current JAX platform,
+              write the lower factor — produces the file `compare` consumes
+
+Files use the framework's binary format (`conflux_tpu.io`): int64 header
+(M, N, dtype code) + row-major data.
+
+Examples:
+    python -m conflux_tpu.cli.cholesky_helper generate --dim 4096 \
+        --out /tmp/input_4096.bin --result /tmp/result_4096.bin
+    python -m conflux_tpu.cli.cholesky_helper factor /tmp/input_4096.bin \
+        /tmp/mine_4096.bin --tile 256
+    python -m conflux_tpu.cli.cholesky_helper compare /tmp/mine_4096.bin \
+        /tmp/result_4096.bin --tol 1e-5
+"""
+
+from __future__ import annotations
+
+import argparse
+
+import numpy as np
+
+from conflux_tpu.cli.common import add_common_args, np_dtype, setup_platform
+from conflux_tpu.io import load_matrix, save_matrix
+from conflux_tpu.validation import make_spd_matrix
+
+
+def parse_args(argv=None):
+    p = argparse.ArgumentParser("cholesky_helper", description=__doc__)
+    sub = p.add_subparsers(dest="cmd", required=True)
+
+    g = sub.add_parser("generate", help="write a deterministic SPD input file")
+    g.add_argument("--dim", type=int, required=True)
+    g.add_argument("--seed", type=int, default=7)
+    g.add_argument("--out", required=True, help="input matrix path")
+    g.add_argument("--result", default=None,
+                   help="also write the reference lower factor here (host LAPACK)")
+    add_common_args(g)
+
+    c = sub.add_parser("compare", help="relative Frobenius distance of two files")
+    c.add_argument("a")
+    c.add_argument("b")
+    c.add_argument("--tol", type=float, default=None,
+                   help="exit 1 if the distance exceeds this")
+    c.add_argument("--lower", action="store_true",
+                   help="compare only the lower triangles (factor files)")
+
+    f = sub.add_parser("factor", help="factor an input file on this platform")
+    f.add_argument("infile")
+    f.add_argument("outfile")
+    f.add_argument("--tile", type=int, default=None)
+    f.add_argument("--grid", default=None, help="Px,Py,Pz (default: auto)")
+    add_common_args(f)
+    return p.parse_args(argv)
+
+
+def _generate(args) -> int:
+    setup_platform(args)
+    dtype = np_dtype(args.dtype)
+    A = make_spd_matrix(args.dim, seed=args.seed, dtype=dtype)
+    save_matrix(args.out, A)
+    print(f"wrote {args.out}: SPD {args.dim}x{args.dim} {np.dtype(dtype).name}")
+    if args.result:
+        import scipy.linalg
+
+        L = scipy.linalg.cholesky(A.astype(np.float64), lower=True)
+        save_matrix(args.result, L.astype(dtype))
+        print(f"wrote {args.result}: reference lower factor")
+    return 0
+
+
+def _compare(args) -> int:
+    A = load_matrix(args.a).astype(np.float64)
+    B = load_matrix(args.b).astype(np.float64)
+    if A.shape != B.shape:
+        print(f"shape mismatch: {A.shape} vs {B.shape}")
+        return 1
+    if args.lower:
+        A, B = np.tril(A), np.tril(B)
+    dist = float(np.linalg.norm(A - B) / max(np.linalg.norm(B), 1e-30))
+    print(f"_compare_ {args.a},{args.b},{dist:.6e}")
+    if args.tol is not None and dist > args.tol:
+        print(f"FAIL: {dist:.3e} > tol {args.tol:.3e}")
+        return 1
+    return 0
+
+
+def _factor(args) -> int:
+    setup_platform(args)
+
+    import jax
+    import jax.numpy as jnp
+
+    from conflux_tpu.cholesky.distributed import cholesky_factor_distributed
+    from conflux_tpu.geometry import (
+        CholeskyGeometry,
+        Grid3,
+        choose_cholesky_grid,
+        choose_cholesky_tile,
+    )
+    from conflux_tpu.parallel.mesh import make_mesh
+
+    A = load_matrix(args.infile)
+    N = A.shape[0]
+    n_devices = len(jax.devices())
+    grid = Grid3.parse(args.grid) if args.grid else choose_cholesky_grid(n_devices)
+    v = args.tile or choose_cholesky_tile(N, grid.P)
+    geom = CholeskyGeometry.create(N, v, grid)
+    mesh = make_mesh(grid, devices=jax.devices()[: grid.P])
+
+    shards = jnp.asarray(geom.scatter(A))
+    out = cholesky_factor_distributed(shards, geom, mesh)
+    L = np.tril(geom.gather(np.asarray(out)))[:N, :N]
+    save_matrix(args.outfile, L.astype(A.dtype))
+    print(f"wrote {args.outfile}: lower factor of {args.infile} "
+          f"(grid {grid}, tile {geom.v})")
+    return 0
+
+
+def main(argv=None) -> int:
+    args = parse_args(argv)
+    return {"generate": _generate, "compare": _compare, "factor": _factor}[args.cmd](args)
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -34,24 +34,105 @@ def generate_spd_tiles(geom: CholeskyGeometry, seed: int = 2020,
     return A
 
 
+# Binary file format: int64 header (M, N, dtype code) + row-major data.
+# The header helpers below are the single source of truth for the format.
+_HEADER_BYTES = 3 * 8
+_DTYPES = [np.dtype(np.float32), np.dtype(np.float64)]
+
+
+def _write_header(f, M: int, N: int, dtype) -> None:
+    code = _DTYPES.index(np.dtype(dtype))
+    np.array([M, N, code], dtype=np.int64).tofile(f)
+
+
+def _read_header(path: str) -> tuple[int, int, np.dtype]:
+    with open(path, "rb") as f:
+        M, N, code = np.fromfile(f, dtype=np.int64, count=3)
+    return int(M), int(N), _DTYPES[int(code)]
+
+
 def save_matrix(path: str, A: np.ndarray) -> None:
-    """Row-major binary dump: int64 header (M, N, dtype code) + data.
-    Same spirit as the reference's `data/output_N.bin` debug dumps."""
+    """Row-major binary dump. Same spirit as the reference's
+    `data/output_N.bin` debug dumps."""
     A = np.ascontiguousarray(A)
-    code = {np.dtype(np.float32): 0, np.dtype(np.float64): 1}[A.dtype]
     with open(path, "wb") as f:
-        np.array([A.shape[0], A.shape[1], code], dtype=np.int64).tofile(f)
+        _write_header(f, A.shape[0], A.shape[1], A.dtype)
         A.tofile(f)
 
 
 def load_matrix(path: str) -> np.ndarray:
+    M, N, dtype = _read_header(path)
     with open(path, "rb") as f:
-        M, N, code = np.fromfile(f, dtype=np.int64, count=3)
-        dtype = [np.float32, np.float64][int(code)]
-        A = np.fromfile(f, dtype=dtype).reshape(int(M), int(N))
+        f.seek(_HEADER_BYTES)
+        A = np.fromfile(f, dtype=dtype).reshape(M, N)
     return A
 
 
 def load_and_scatter(path: str, geom: LUGeometry | CholeskyGeometry) -> np.ndarray:
     """File parse + tile scatter (role of `CholeskyIO.cpp:185-375`)."""
     return geom.scatter(load_matrix(path))
+
+
+def load_scattered(path: str, geom: LUGeometry | CholeskyGeometry) -> np.ndarray:
+    """Stream a matrix file straight into (Px, Py, Ml, Nl) shards.
+
+    Unlike :func:`load_and_scatter` the global matrix is never materialized:
+    the native mmap engine (or an `np.memmap` fallback working one tile row
+    at a time) reads tiles in place, so matrices larger than host RAM flow
+    through the page cache -- the role of the reference's collective MPI-IO
+    reads (`CholeskyIO.cpp:185-375`). The file's padded shape must match the
+    geometry's (M, N).
+    """
+    M, N, dtype = _read_header(path)
+    gM = getattr(geom, "M", geom.N)
+    gN = geom.N
+    if (M, N) != (gM, gN):
+        raise ValueError(f"file is {M}x{N}, geometry needs {gM}x{gN}")
+    from conflux_tpu import native
+
+    Px, Py, v = geom.grid.Px, geom.grid.Py, geom.v
+    fast = native.file_scatter(path, _HEADER_BYTES, gM, gN, v, Px, Py, dtype)
+    if fast is not None:
+        return fast
+    A = np.memmap(path, dtype=dtype, mode="r", offset=_HEADER_BYTES,
+                  shape=(gM, gN))
+    Ml, Nl, Ntl = gM // Px, gN // Py, gN // (v * Py)
+    shards = np.empty((Px, Py, Ml, Nl), dtype=dtype)
+    for ti in range(gM // v):  # one (v, N) strip resident at a time
+        px, lt = ti % Px, ti // Px
+        strip = np.asarray(A[ti * v : (ti + 1) * v]).reshape(v, Ntl, Py, v)
+        shards[px, :, lt * v : (lt + 1) * v] = (
+            strip.transpose(2, 0, 1, 3).reshape(Py, v, Nl)
+        )
+    return shards
+
+
+def save_scattered(path: str, shards: np.ndarray,
+                   geom: LUGeometry | CholeskyGeometry) -> None:
+    """Inverse of :func:`load_scattered`: stream shards to a matrix file
+    (role of the reference's MPI-IO dumps, `CholeskyIO.cpp:384-501`)."""
+    shards = np.asarray(shards)
+    gM = getattr(geom, "M", geom.N)
+    gN = geom.N
+    Px, Py, v = geom.grid.Px, geom.grid.Py, geom.v
+    if shards.shape != (Px, Py, gM // Px, gN // Py):
+        raise ValueError(f"shards shape {shards.shape} does not match "
+                         f"geometry ({Px}, {Py}, {gM // Px}, {gN // Py})")
+    with open(path, "wb") as f:
+        _write_header(f, gM, gN, shards.dtype)
+    from conflux_tpu import native
+
+    if native.file_gather(path, shards, _HEADER_BYTES, v, Px, Py):
+        return
+    with open(path, "r+b") as f:  # grow to full size for the memmap
+        f.truncate(_HEADER_BYTES + gM * gN * shards.dtype.itemsize)
+    A = np.memmap(path, dtype=shards.dtype, mode="r+", offset=_HEADER_BYTES,
+                  shape=(gM, gN))
+    Nl, Ntl = gN // Py, gN // (v * Py)
+    for ti in range(gM // v):  # one (v, N) strip written at a time
+        px, lt = ti % Px, ti // Px
+        strip = shards[px, :, lt * v : (lt + 1) * v]  # (Py, v, Nl)
+        A[ti * v : (ti + 1) * v] = (
+            strip.reshape(Py, v, Ntl, v).transpose(1, 2, 0, 3).reshape(v, gN)
+        )
+    A.flush()

@@ -20,6 +20,7 @@ import numpy as np
 
 _LIB = None
 _TRIED = False
+_FILE_OK = False
 
 _SO_PATH = os.path.join(os.path.dirname(__file__), "libconflux_layout.so")
 
@@ -44,6 +45,27 @@ def _load():
             # unloadable or stale .so (e.g. built before a symbol was added):
             # fall back to the pure-NumPy paths
             _LIB = None
+            return _LIB
+        # file IO symbols are newer: a stale .so keeps the in-memory fast
+        # paths and only loses the streaming ones
+        global _FILE_OK
+        try:
+            for name in ("conflux_file_scatter_f32", "conflux_file_scatter_f64",
+                         "conflux_file_gather_f32", "conflux_file_gather_f64"):
+                fn = getattr(lib, name)
+                fn.restype = ctypes.c_int
+                ptr = ctypes.c_float if name.endswith("f32") else ctypes.c_double
+                fn.argtypes = [ctypes.c_char_p, ctypes.POINTER(ptr)] + [ctypes.c_int64] * 6
+            _FILE_OK = True
+        except AttributeError:
+            import warnings
+
+            warnings.warn(
+                "stale libconflux_layout.so lacks the streaming file IO "
+                "symbols; rebuild with `python -m conflux_tpu.native.build`",
+                stacklevel=2,
+            )
+            _FILE_OK = False
     return _LIB
 
 
@@ -77,10 +99,56 @@ def scatter(A: np.ndarray, v: int, Px: int, Py: int) -> np.ndarray | None:
     return out
 
 
+def file_scatter(path: str, header: int, M: int, N: int, v: int, Px: int,
+                 Py: int, dtype) -> np.ndarray | None:
+    """Stream a row-major on-disk matrix (after `header` bytes) straight into
+    (Px, Py, Ml, Nl) shards via mmap — the global matrix is never
+    materialized in memory. None if the native engine can't handle it."""
+    lib = _load()
+    dtype = np.dtype(dtype)
+    if lib is None or not _FILE_OK or dtype not in (np.float32, np.float64):
+        return None
+    if M % (v * Px) or N % (v * Py):
+        return None
+    out = np.empty((Px, Py, M // Px, N // Py), dtype=dtype)
+    fn = (lib.conflux_file_scatter_f32 if dtype == np.float32
+          else lib.conflux_file_scatter_f64)
+    rc = fn(path.encode(), _ptr(out), header, M, N, v, Px, Py)
+    if rc != 0:
+        raise OSError(f"native file_scatter({path!r}) failed with code {rc}")
+    return out
+
+
+def file_gather(path: str, shards: np.ndarray, header: int, v: int, Px: int,
+                Py: int) -> bool:
+    """Stream shards into an on-disk row-major matrix after `header` bytes.
+    The file must exist with the header already written; it is grown to the
+    full size. Returns False if the native engine can't handle it."""
+    lib = _load()
+    if lib is None or not _FILE_OK or shards.dtype not in (np.float32, np.float64):
+        return False
+    if shards.ndim != 4 or shards.shape[:2] != (Px, Py):
+        raise ValueError(f"shards shape {shards.shape} does not match grid "
+                         f"({Px}, {Py}, Ml, Nl)")
+    _, _, Ml, Nl = shards.shape
+    if Ml % v or Nl % v:
+        return False
+    shards = np.ascontiguousarray(shards)
+    fn = (lib.conflux_file_gather_f32 if shards.dtype == np.float32
+          else lib.conflux_file_gather_f64)
+    rc = fn(path.encode(), _ptr(shards), header, Ml * Px, Nl * Py, v, Px, Py)
+    if rc != 0:
+        raise OSError(f"native file_gather({path!r}) failed with code {rc}")
+    return True
+
+
 def gather(shards: np.ndarray, v: int, Px: int, Py: int) -> np.ndarray | None:
     lib = _load()
     if lib is None or shards.dtype not in (np.float32, np.float64):
         return None
+    if shards.ndim != 4 or shards.shape[:2] != (Px, Py):
+        raise ValueError(f"shards shape {shards.shape} does not match grid "
+                         f"({Px}, {Py}, Ml, Nl)")
     _, _, Ml, Nl = shards.shape
     if Ml % v or Nl % v:
         return None

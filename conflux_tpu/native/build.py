@@ -10,13 +10,14 @@ import sys
 
 def build(verbose: bool = True) -> str:
     here = os.path.dirname(__file__)
-    src = os.path.join(here, "layout_native.cpp")
+    srcs = [os.path.join(here, "layout_native.cpp"),
+            os.path.join(here, "io_native.cpp")]
     out = os.path.join(here, "libconflux_layout.so")
     cxx = os.environ.get("CXX") or shutil.which("g++") or shutil.which("c++")
     if cxx is None:
         raise RuntimeError("no C++ compiler found (set CXX)")
     cmd = [cxx, "-O3", "-march=native", "-fopenmp", "-shared", "-fPIC",
-           "-std=c++17", src, "-o", out]
+           "-std=c++17", *srcs, "-o", out]
     if verbose:
         print(" ".join(cmd))
     subprocess.run(cmd, check=True)

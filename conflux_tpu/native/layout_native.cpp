@@ -5,81 +5,32 @@
 // redistribution layer (COSTA, consumed through src/conflux/lu/layout.cpp):
 // the host-side half of moving matrices between user layout and the
 // framework's block-cyclic device layout. The hot path is a strided tile
-// copy, memory-bandwidth-bound, parallelized over tiles with OpenMP.
+// copy, memory-bandwidth-bound, parallelized over tiles with OpenMP
+// (kernels in tile_copy.hpp, shared with the streaming IO engine).
 //
 // Exposed as a plain C ABI for ctypes (no pybind11 in this environment).
-//
-// Layout convention (matches conflux_tpu.geometry.LUGeometry.scatter):
-//   global tile (ti, tj) of size v x v lives on device (ti % Px, tj % Py)
-//   at local tile slot (ti / Px, tj / Py); shards is one contiguous buffer
-//   of shape (Px, Py, Ml, Nl) with Ml = Mt/Px*v, Nl = Nt/Py*v.
 
 #include <cstdint>
-#include <cstring>
 
-#if defined(_OPENMP)
-#include <omp.h>
-#endif
-
-namespace {
-
-template <typename T>
-void scatter_impl(const T* A, T* shards, int64_t M, int64_t N, int64_t v,
-                  int64_t Px, int64_t Py) {
-  const int64_t Mt = M / v, Nt = N / v;
-  const int64_t Ml = (Mt / Px) * v, Nl = (Nt / Py) * v;
-#pragma omp parallel for collapse(2) schedule(static)
-  for (int64_t ti = 0; ti < Mt; ++ti) {
-    for (int64_t tj = 0; tj < Nt; ++tj) {
-      const int64_t px = ti % Px, py = tj % Py;
-      const int64_t lt = ti / Px, lj = tj / Py;
-      const T* src = A + ti * v * N + tj * v;
-      T* dst = shards + ((px * Py + py) * Ml + lt * v) * Nl + lj * v;
-      for (int64_t r = 0; r < v; ++r) {
-        std::memcpy(dst + r * Nl, src + r * N, sizeof(T) * v);
-      }
-    }
-  }
-}
-
-template <typename T>
-void gather_impl(const T* shards, T* A, int64_t M, int64_t N, int64_t v,
-                 int64_t Px, int64_t Py) {
-  const int64_t Mt = M / v, Nt = N / v;
-  const int64_t Ml = (Mt / Px) * v, Nl = (Nt / Py) * v;
-#pragma omp parallel for collapse(2) schedule(static)
-  for (int64_t ti = 0; ti < Mt; ++ti) {
-    for (int64_t tj = 0; tj < Nt; ++tj) {
-      const int64_t px = ti % Px, py = tj % Py;
-      const int64_t lt = ti / Px, lj = tj / Py;
-      T* dst = A + ti * v * N + tj * v;
-      const T* src = shards + ((px * Py + py) * Ml + lt * v) * Nl + lj * v;
-      for (int64_t r = 0; r < v; ++r) {
-        std::memcpy(dst + r * N, src + r * Nl, sizeof(T) * v);
-      }
-    }
-  }
-}
-
-}  // namespace
+#include "tile_copy.hpp"
 
 extern "C" {
 
 void conflux_scatter_f32(const float* A, float* shards, int64_t M, int64_t N,
                          int64_t v, int64_t Px, int64_t Py) {
-  scatter_impl(A, shards, M, N, v, Px, Py);
+  conflux_native::scatter_impl(A, shards, M, N, v, Px, Py);
 }
 void conflux_scatter_f64(const double* A, double* shards, int64_t M, int64_t N,
                          int64_t v, int64_t Px, int64_t Py) {
-  scatter_impl(A, shards, M, N, v, Px, Py);
+  conflux_native::scatter_impl(A, shards, M, N, v, Px, Py);
 }
 void conflux_gather_f32(const float* shards, float* A, int64_t M, int64_t N,
                         int64_t v, int64_t Px, int64_t Py) {
-  gather_impl(shards, A, M, N, v, Px, Py);
+  conflux_native::gather_impl(shards, A, M, N, v, Px, Py);
 }
 void conflux_gather_f64(const double* shards, double* A, int64_t M, int64_t N,
                         int64_t v, int64_t Px, int64_t Py) {
-  gather_impl(shards, A, M, N, v, Px, Py);
+  conflux_native::gather_impl(shards, A, M, N, v, Px, Py);
 }
 
 int conflux_native_nthreads() {

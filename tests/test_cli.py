@@ -71,3 +71,39 @@ def test_profiler_report(capsys):
     assert "step0_reduce" in out
     profiler.clear()
     assert profiler.timings() == {}
+
+
+def test_cholesky_helper_roundtrip(tmp_path, capsys):
+    """generate -> factor -> compare pipeline (the reference's
+    cholesky_helper + compare_res.py workflow)."""
+    from conflux_tpu.cli import cholesky_helper
+
+    inp = str(tmp_path / "input_64.bin")
+    ref = str(tmp_path / "result_64.bin")
+    mine = str(tmp_path / "mine_64.bin")
+    rc = cholesky_helper.main(
+        ["generate", "--dim", "64", "--out", inp, "--result", ref,
+         "--dtype", "float64"]
+    )
+    assert rc == 0
+    rc = cholesky_helper.main(
+        ["factor", inp, mine, "--tile", "16", "--grid", "2,2,1",
+         "--dtype", "float64"]
+    )
+    assert rc == 0
+    rc = cholesky_helper.main(["compare", mine, ref, "--lower", "--tol", "1e-8"])
+    assert rc == 0
+    out = capsys.readouterr().out
+    assert "_compare_" in out
+
+
+def test_cholesky_helper_compare_fails_above_tol(tmp_path, capsys):
+    import numpy as np
+
+    from conflux_tpu.cli import cholesky_helper
+    from conflux_tpu.io import save_matrix
+
+    a, b = str(tmp_path / "a.bin"), str(tmp_path / "b.bin")
+    save_matrix(a, np.eye(8))
+    save_matrix(b, 2 * np.eye(8))
+    assert cholesky_helper.main(["compare", a, b, "--tol", "1e-3"]) == 1
