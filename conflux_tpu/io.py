@@ -47,8 +47,14 @@ def _write_header(f, M: int, N: int, dtype) -> None:
 
 def _read_header(path: str) -> tuple[int, int, np.dtype]:
     with open(path, "rb") as f:
-        M, N, code = np.fromfile(f, dtype=np.int64, count=3)
-    return int(M), int(N), _DTYPES[int(code)]
+        header = np.fromfile(f, dtype=np.int64, count=3)
+    if header.size != 3:
+        raise ValueError(f"{path!r} is too short to hold a matrix header")
+    M, N, code = (int(x) for x in header)
+    if M < 0 or N < 0 or not 0 <= code < len(_DTYPES):
+        raise ValueError(f"{path!r} has an invalid matrix header "
+                         f"(M={M}, N={N}, dtype code={code})")
+    return M, N, _DTYPES[code]
 
 
 def save_matrix(path: str, A: np.ndarray) -> None:

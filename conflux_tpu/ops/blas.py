@@ -152,10 +152,23 @@ _PANEL_ALGO = "auto"
 
 
 def set_panel_algo(name: str) -> None:
-    if name not in ("auto", "partial", "tournament"):
+    if name not in ("auto", "partial", "tournament", "pallas"):
         raise ValueError(f"unknown panel algo {name!r}")
     global _PANEL_ALGO
     _PANEL_ALGO = name
+
+
+# VMEM ceiling of the Pallas elimination kernel: the (m, 128) block, the
+# lane-padded (m, 1) masks/temporaries and the double-buffered outputs must
+# stay under the 16 MB scoped VMEM (m=8192 measured 3.8 MB over)
+_PALLAS_MAX_ROWS = 4096
+
+
+def _pallas_panel_ok(dtype, m: int, v: int) -> bool:
+    """Whether the Pallas elimination kernel can factor an (m, v) panel
+    (off-TPU it runs in interpret mode, so no backend check here)."""
+    return (jnp.dtype(dtype) == jnp.float32 and v % 128 == 0
+            and m <= _PALLAS_MAX_ROWS)
 
 
 def get_panel_algo() -> str:
@@ -185,17 +198,32 @@ def panel_lu(panel: jax.Array, algo: str | None = None):
     """
     m, v = panel.shape
     algo = _PANEL_ALGO if algo is None else algo
-    if algo not in ("auto", "partial", "tournament"):
+    if algo not in ("auto", "partial", "tournament", "pallas"):
         raise ValueError(f"unknown panel algo {algo!r}")
     if algo == "auto":
+        # measured on v5e (m=4096, v=1024): XLA custom call 11.7 ms, pallas
+        # masked elimination 17 ms (its per-step scalar reductions serialize
+        # the pipeline) — so 'auto' prefers partial/tournament and 'pallas'
+        # stays opt-in until the kernel wins
         algo = "tournament" if m > 2 * max(_PANEL_CHUNK, v) else "partial"
+    if algo == "pallas":
+        if not _pallas_panel_ok(panel.dtype, min(m, _PALLAS_MAX_ROWS), v):
+            raise ValueError(
+                f"pallas panel kernel supports float32 with width a multiple "
+                f"of 128, got {panel.dtype} ({m}, {v})"
+            )
+        if m > _PALLAS_MAX_ROWS:  # too tall for VMEM: tournament over chunks
+            return panel_lu_tournament(panel, chunk=_PALLAS_MAX_ROWS,
+                                       use_pallas=True)
+        return panel_lu_pallas(panel)
     if algo == "tournament":
         return panel_lu_tournament(panel)
     lu_packed, _pivots, perm = lax.linalg.lu(panel)
     return lu_packed, perm
 
 
-def tournament_winners(panel: jax.Array, chunk: int | None = None):
+def tournament_winners(panel: jax.Array, chunk: int | None = None,
+                       use_pallas: bool = False):
     """Elect v pivot rows of an (m, v) panel by tournament (CALU).
 
     Single-device analogue of the reference's butterfly tournament
@@ -223,7 +251,13 @@ def tournament_winners(panel: jax.Array, chunk: int | None = None):
 
     cand = panel.reshape(nch, c, v)
     cid = ids.reshape(nch, c)
-    lu_c, _, perm_c = lax.linalg.lu(cand)  # batched (nch, c, v)
+    if use_pallas and _pallas_panel_ok(panel.dtype, c, v):
+        outs = [panel_lu_pallas(cand[i]) for i in range(nch)]
+        perm_c = jnp.stack([o[1] for o in outs])
+        lu0 = outs[0][0][:v]
+    else:
+        lu_c, _, perm_c = lax.linalg.lu(cand)  # batched (nch, c, v)
+        lu0 = lu_c[0, :v]
     top = perm_c[:, :v]
     win = jnp.take_along_axis(cand, top[:, :, None], axis=1)  # (nch, v, v)
     wid = jnp.take_along_axis(cid, top, axis=1)
@@ -238,31 +272,94 @@ def tournament_winners(panel: jax.Array, chunk: int | None = None):
         wid = jnp.pad(wid, ((0, n - nch), (0, 0)), constant_values=mp)
 
     if n == 1:  # single chunk: its local LU already decided everything
-        return lu_c[0, :v], wid[0]
+        return lu0, wid[0]
 
-    lu_r = None
+    lu_top = None
     while n > 1:
         stacked = win.reshape(n // 2, 2 * v, v)
         sid = wid.reshape(n // 2, 2 * v)
-        lu_r, _, perm_r = lax.linalg.lu(stacked)  # batched (n/2, 2v, v)
+        if use_pallas and _pallas_panel_ok(panel.dtype, 2 * v, v):
+            outs = [panel_lu_pallas(stacked[i]) for i in range(n // 2)]
+            perm_r = jnp.stack([o[1] for o in outs])
+            lu_top = jnp.stack([o[0][:v] for o in outs])
+        else:
+            lu_r, _, perm_r = lax.linalg.lu(stacked)  # batched (n/2, 2v, v)
+            lu_top = lu_r[:, :v]
         top = perm_r[:, :v]
         win = jnp.take_along_axis(stacked, top[:, :, None], axis=1)
         wid = jnp.take_along_axis(sid, top, axis=1)
         n //= 2
     # final round's packed LU rows 0..v are exactly the winners, factored
-    return lu_r[0, :v], wid[0]
+    return lu_top[0], wid[0]
 
 
-def panel_lu_tournament(panel: jax.Array, chunk: int | None = None):
+def panel_lu_pallas(panel: jax.Array):
+    """Blocked panel LU with full-height partial pivoting, Pallas elimination.
+
+    Same contract as :func:`panel_lu`. The (m, v) panel is factored in
+    128-wide column blocks; each block is eliminated by the VMEM-resident
+    Pallas kernel (`pallas_kernels.lu_block`) with *no row movement* — pivot
+    rows keep their positions, an `alive` mask shrinks, and the inter-block
+    update is a row-gathered TRSM plus one masked MXU GEMM. Rows are gathered
+    into LAPACK order exactly once at the end. This sidesteps the serial
+    row-swapping LU custom call entirely (VMEM bound: m <= 4096, see
+    `pallas_kernels.lu_block`; taller panels go through
+    `panel_lu(algo='pallas')`, which routes them to the tournament with
+    pallas chunks).
+    """
+    from conflux_tpu.ops import pallas_kernels
+
+    w = pallas_kernels._PANEL_W
+    m, v = panel.shape
+    if v % w:
+        raise ValueError(f"panel width {v} not a multiple of {w}")
+    A = panel
+    alive = jnp.ones((m, 1), jnp.int8)
+    pivs = []
+    for off in range(0, v, w):
+        blk = lax.dynamic_slice(A, (0, off), (m, w))
+        out, alive_new, piv = pallas_kernels.lu_block(blk, alive)
+        A = lax.dynamic_update_slice(A, out, (0, off))
+        pivrows = piv[0]  # (w,) absolute row ids in pivot order
+        pivs.append(pivrows)
+        if off + w < v:
+            # inter-block update on the trailing columns of the panel
+            L00 = out[pivrows]  # (w, w) packed rows in pivot order
+            rest = lax.dynamic_slice(A, (0, off + w), (m, v - off - w))
+            U01 = trsm_left_lower_unit(unit_lower(L00), rest[pivrows])
+            # multipliers of still-live rows only (pivot rows contribute 0)
+            L10 = jnp.where(alive_new != 0, out, 0.0)
+            rest = rest - jnp.matmul(
+                L10, U01, precision=lax.Precision.HIGHEST,
+                preferred_element_type=_acc_dtype(L10.dtype),
+            ).astype(rest.dtype)
+            rest = rest.at[pivrows].set(U01)
+            A = lax.dynamic_update_slice(A, rest, (0, off + w))
+        alive = alive_new
+    gpiv = jnp.concatenate(pivs)  # (v,) rows in elimination order
+    ids = jnp.arange(m, dtype=jnp.int32)
+    is_piv = jnp.zeros((m,), bool).at[gpiv].set(True, mode="drop")
+    pos = jnp.zeros((m,), jnp.int32).at[gpiv].set(
+        jnp.arange(v, dtype=jnp.int32), mode="drop"
+    )
+    key = jnp.where(is_piv, pos, v + ids)
+    perm = jnp.argsort(key)
+    return A[perm], perm
+
+
+def panel_lu_tournament(panel: jax.Array, chunk: int | None = None,
+                        use_pallas: bool = False):
     """Tournament-pivoted (CALU) LU of a tall (m, v) panel.
 
     Same contract as :func:`panel_lu`. Pivot growth of CALU is bounded and
     in practice indistinguishable from partial pivoting (the reference ships
     the same trade, `python/pivoting.py` 'tournament' strategy); residuals are
-    checked by the test suite, not assumed.
+    checked by the test suite, not assumed. `use_pallas` runs the chunk and
+    reduction-tree factorizations through the Pallas elimination kernel
+    instead of the XLA custom call.
     """
     m, v = panel.shape
-    lu00, gpiv = tournament_winners(panel, chunk)
+    lu00, gpiv = tournament_winners(panel, chunk, use_pallas)
     ids = jnp.arange(m, dtype=jnp.int32)
     is_piv = jnp.zeros((m,), bool).at[gpiv].set(True, mode="drop")
     pos = jnp.zeros((m,), jnp.int32).at[gpiv].set(

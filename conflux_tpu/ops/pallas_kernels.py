@@ -77,3 +77,113 @@ def gemm(a: jax.Array, b: jax.Array, *, bm: int = 256, bn: int = 256,
     bk = min(bk, _round_up(K, 128))
     interpret = jax.default_backend() != "tpu"
     return _gemm(a, b, bm, bn, bk, interpret)
+
+
+# --------------------------------------------------------------------------- #
+# Panel LU: masked Gaussian elimination on a VMEM-resident column block
+# --------------------------------------------------------------------------- #
+#
+# The role of the reference's per-rank `LAPACKE_dgetrf` panel kernel (`LUP`,
+# `conflux_opt.hpp:143-166`), redesigned for the TPU vector unit: rows never
+# move (XLA's LU custom call swaps rows serially per column and overflows its
+# scoped VMEM on tall panels). Instead the whole (m, w) block lives in VMEM
+# and each of the w elimination steps is a handful of full-array masked VPU
+# ops: select pivot by masked argmax, record it, write multipliers in place,
+# rank-1-update the live rows. Pivot rows keep their (now U-row) values in
+# their original positions; `alive` marks rows not yet chosen. The caller
+# gathers rows into LAPACK order once at the end of the full panel.
+
+_PANEL_W = 128  # column-block width == one lane tile
+
+
+def _lu_block_kernel(a_ref, alive_ref, out_ref, alive_out_ref, piv_ref):
+    m, w = a_ref.shape
+    rows = jax.lax.broadcasted_iota(jnp.int32, (m, w), 0)
+    cols = jax.lax.broadcasted_iota(jnp.int32, (m, w), 1)
+    cols1 = jax.lax.broadcasted_iota(jnp.int32, (1, w), 1)
+
+    out_ref[:] = a_ref[:]
+    alive_out_ref[:] = alive_ref[:]
+    piv_ref[:] = jnp.zeros((1, w), jnp.int32)
+
+    # Mutate the output refs per step; the loop carry stays scalar (Mosaic
+    # cannot legalize scf.for with large value carries). Two more Mosaic
+    # constraints shape the body: there is no (m, 1) -> (m, w) lane
+    # broadcast (the pivot column/row are spread with small MXU matmuls
+    # instead), and boolean ops between lane-iota-derived masks (sublane-
+    # replicated i1 layout) and data-derived masks trigger invalid i1
+    # relayouts — so every mask is cast to f32 and combined arithmetically.
+    def body(j, carry):
+        A = out_ref[:]
+        alive_f = (alive_out_ref[:] != 0).astype(jnp.float32)
+        # broadcast column j across lanes with a roll-reduction tree: the
+        # masked array has a single nonzero per row, so the cyclic tree sum
+        # is EXACT in f32 (an MXU broadcast would truncate to bf16 passes)
+        colj = jnp.where(cols == j, A, 0.0)
+        s = 1
+        while s < w:
+            colj = colj + pltpu.roll(colj, s, 1)
+            s *= 2
+        cand = jnp.abs(colj) * alive_f - (1.0 - alive_f)  # dead rows -> -1
+        # masked argmax as reductions to scalar (lowest row wins ties)
+        p = jnp.min(jnp.where(cand == jnp.max(cand), rows, m)).astype(jnp.int32)
+        isp_f = (rows == p).astype(jnp.float32)
+        # pivot row: dynamic sublane read (supported, unlike lane indexing),
+        # then an exact sublane broadcast
+        rowp_bc = jnp.broadcast_to(out_ref[pl.ds(p, 1), :], (m, w))
+        colmask_f = (cols == j).astype(jnp.float32)
+        gtmask_f = (cols > j).astype(jnp.float32)
+        pivval = jnp.sum(isp_f * colmask_f * A)
+        live_f = alive_f * (1.0 - isp_f)
+        l = colj / pivval * live_f  # (m, w) multipliers, 0 on dead/pivot rows
+        # rank-1 update of live rows, trailing columns only; multipliers into
+        # column j of live non-pivot rows
+        A = A - gtmask_f * (l * rowp_bc)
+        maskf = colmask_f * live_f
+        A = A * (1.0 - maskf) + l * maskf
+        out_ref[:] = A
+        alive_out_ref[:] = live_f.astype(jnp.int8)
+        piv_ref[:] = jnp.where(cols1 == j, p, piv_ref[:])
+        return carry
+
+    jax.lax.fori_loop(0, w, body, 0)
+
+
+@functools.partial(jax.jit, static_argnames=("interpret",))
+def _lu_block(a, alive, interpret: bool):
+    m, w = a.shape
+    return pl.pallas_call(
+        _lu_block_kernel,
+        in_specs=[
+            pl.BlockSpec(memory_space=pltpu.VMEM),
+            pl.BlockSpec(memory_space=pltpu.VMEM),
+        ],
+        out_specs=(
+            pl.BlockSpec(memory_space=pltpu.VMEM),
+            pl.BlockSpec(memory_space=pltpu.VMEM),
+            pl.BlockSpec(memory_space=pltpu.VMEM),
+        ),
+        out_shape=(
+            jax.ShapeDtypeStruct((m, w), a.dtype),
+            jax.ShapeDtypeStruct((m, w), jnp.int8),
+            jax.ShapeDtypeStruct((1, w), jnp.int32),
+        ),
+        interpret=interpret,
+    )(a, alive)
+
+
+def lu_block(a: jax.Array, alive: jax.Array):
+    """Eliminate one (m, 128) column block in place (no row movement).
+
+    `alive` is an (m, 1) mask of rows still eligible as pivots. Returns
+    (out, alive_out, piv): `out` has U-row values sitting at the pivot rows'
+    original positions and L multipliers at live rows; `piv` (1, 128) gives
+    the chosen pivot row per elimination step. VMEM bound: the f32 block, the
+    int8 mask and the (m, w) f32 temporaries must fit the 16 MB scoped VMEM
+    — m <= 4096 is safe (m=8192 measured over the limit).
+    """
+    m, w = a.shape
+    interpret = jax.default_backend() != "tpu"
+    alive_mw = jnp.broadcast_to(alive.astype(jnp.int8), (m, w))
+    out, alive_out, piv = _lu_block(a, alive_mw, interpret)
+    return out, alive_out[:, :1].astype(jnp.int32), piv

@@ -83,3 +83,19 @@ def test_layout_grid_larger_than_tile_grid():
     shards = scatter(A, lay)
     assert shards[0][1].size == 0
     np.testing.assert_array_equal(gather(shards, lay), A)
+
+
+def test_read_header_rejects_corrupt_file(tmp_path):
+    import numpy as np
+    import pytest
+
+    from conflux_tpu.io import load_matrix
+
+    bad = tmp_path / "bad.bin"
+    np.array([8, 8, -1], dtype=np.int64).tofile(str(bad))
+    with pytest.raises(ValueError):
+        load_matrix(str(bad))
+    short = tmp_path / "short.bin"
+    short.write_bytes(b"\x01\x02")
+    with pytest.raises(ValueError):
+        load_matrix(str(short))

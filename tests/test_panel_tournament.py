@@ -78,3 +78,100 @@ def test_tournament_f32():
     lu_packed, perm = blas.panel_lu_tournament(jnp.asarray(panel), chunk=32)
     assert lu_packed.dtype == jnp.float32
     assert _panel_residual(panel, lu_packed, perm) < residual_bound(64, np.float32)
+
+
+# ---------------- Pallas blocked panel LU (interpret mode on CPU) ---------- #
+
+
+def test_lu_block_kernel_matches_elimination():
+    """One 128-wide block: kernel output must reproduce exact partial-pivot
+    elimination (same pivots as LAPACK up to tie-breaks, valid factors)."""
+    import jax.numpy as jnp
+    import numpy as np
+
+    from conflux_tpu.ops import pallas_kernels
+
+    m, w = 192, 128  # kernel width is fixed at 128; m > w leaves live rows
+    rng = np.random.default_rng(0)
+    panel = rng.standard_normal((m, w)).astype(np.float32)
+    alive = np.ones((m, 1), np.int32)
+    out, alive_out, piv = pallas_kernels.lu_block(
+        jnp.asarray(panel), jnp.asarray(alive)
+    )
+    out, piv = np.asarray(out), np.asarray(piv)[0]
+    assert len(set(piv.tolist())) == w  # distinct pivots
+    # reconstruct: pivot rows in order give the packed (w, w) LU00; the
+    # remaining live rows hold L10 multipliers
+    order = np.concatenate([piv, np.setdiff1d(np.arange(m), piv)])
+    L = np.tril(out[order], -1) + np.eye(m, w, dtype=np.float32)
+    U = np.triu(out[piv])
+    np.testing.assert_allclose(panel[order], L @ U, rtol=0, atol=5e-4)
+    assert int(np.asarray(alive_out).sum()) == m - w  # w rows were chosen
+
+
+def test_panel_lu_pallas_contract():
+    import jax.numpy as jnp
+    import numpy as np
+
+    m, v = 96, 128
+    panel = make_test_matrix(m, v, seed=8, dtype=np.float64).astype(np.float32)
+    # pad rows so m >= v (contract requires m >= v for full election)
+    panel = np.vstack([panel, make_test_matrix(64, v, seed=9).astype(np.float32)])
+    lu_packed, perm = blas.panel_lu_pallas(jnp.asarray(panel))
+    assert sorted(np.asarray(perm).tolist()) == list(range(panel.shape[0]))
+    assert _panel_residual(panel, lu_packed, perm) < residual_bound(
+        panel.shape[0], np.float32
+    )
+
+
+def test_panel_lu_pallas_multiblock():
+    # v = 256: two 128-wide blocks exercises the inter-block TRSM/GEMM path
+    import jax.numpy as jnp
+    import numpy as np
+
+    m, v = 384, 256
+    panel = make_test_matrix(m, v, seed=11).astype(np.float32)
+    lu_packed, perm = blas.panel_lu_pallas(jnp.asarray(panel))
+    assert _panel_residual(panel, lu_packed, perm) < residual_bound(m, np.float32)
+
+
+def test_blocked_lu_with_forced_pallas():
+    import jax.numpy as jnp
+    import numpy as np
+
+    from conflux_tpu.lu.single import lu_factor_blocked
+
+    blas.set_panel_algo("pallas")
+    try:
+        N, v = 256, 128
+        A = make_test_matrix(N, N, seed=13).astype(np.float32)
+        LU, perm = lu_factor_blocked(jnp.asarray(A), v=v)
+        assert lu_residual(A, LU, perm) < residual_bound(N, np.float32)
+    finally:
+        blas.set_panel_algo("auto")
+
+
+def test_panel_lu_pallas_tall_routes_through_tournament():
+    # taller than the VMEM ceiling: panel_lu(algo='pallas') must chunk
+    import jax.numpy as jnp
+    import numpy as np
+
+    old = blas._PALLAS_MAX_ROWS
+    blas._PALLAS_MAX_ROWS = 64  # shrink the ceiling so the test stays small
+    try:
+        m, v = 256, 128
+        panel = make_test_matrix(m, v, seed=17).astype(np.float32)
+        lu_packed, perm = blas.panel_lu(jnp.asarray(panel), algo="pallas")
+        assert _panel_residual(panel, lu_packed, perm) < residual_bound(m, np.float32)
+    finally:
+        blas._PALLAS_MAX_ROWS = old
+
+
+def test_panel_lu_pallas_rejects_bad_dtype():
+    import jax.numpy as jnp
+    import numpy as np
+    import pytest
+
+    panel = make_test_matrix(128, 128, seed=1)  # float64
+    with pytest.raises(ValueError):
+        blas.panel_lu(jnp.asarray(panel), algo="pallas")
