@@ -197,21 +197,10 @@ def panel_lu(panel: jax.Array, algo: str | None = None):
     the safe regime on TPU (v=1024 measured fastest anyway; see bench.py).
     """
     m, v = panel.shape
-    algo = _PANEL_ALGO if algo is None else algo
-    if algo not in ("auto", "partial", "tournament", "pallas"):
-        raise ValueError(f"unknown panel algo {algo!r}")
-    if algo == "auto":
-        # measured on v5e (m=4096, v=1024): XLA custom call 11.7 ms, pallas
-        # masked elimination 17 ms (its per-step scalar reductions serialize
-        # the pipeline) — so 'auto' prefers partial/tournament and 'pallas'
-        # stays opt-in until the kernel wins
-        algo = "tournament" if m > 2 * max(_PANEL_CHUNK, v) else "partial"
+    algo = _resolve_panel_algo(
+        panel.dtype, m, v, _PANEL_ALGO if algo is None else algo
+    )
     if algo == "pallas":
-        if not _pallas_panel_ok(panel.dtype, min(m, _PALLAS_MAX_ROWS), v):
-            raise ValueError(
-                f"pallas panel kernel supports float32 with width a multiple "
-                f"of 128, got {panel.dtype} ({m}, {v})"
-            )
         if m > _PALLAS_MAX_ROWS:  # too tall for VMEM: tournament over chunks
             return panel_lu_tournament(panel, chunk=_PALLAS_MAX_ROWS,
                                        use_pallas=True)
@@ -220,6 +209,25 @@ def panel_lu(panel: jax.Array, algo: str | None = None):
         return panel_lu_tournament(panel)
     lu_packed, _pivots, perm = lax.linalg.lu(panel)
     return lu_packed, perm
+
+
+def _resolve_panel_algo(dtype, m: int, v: int, algo: str) -> str:
+    """Shared dispatch for :func:`panel_lu` / :func:`panel_winners`:
+    validate, resolve 'auto', and gate the pallas kernel's eligibility."""
+    if algo not in ("auto", "partial", "tournament", "pallas"):
+        raise ValueError(f"unknown panel algo {algo!r}")
+    if algo == "auto":
+        # measured on v5e (m=4096, v=1024): XLA custom call 11.7 ms, pallas
+        # masked elimination 17 ms (its per-step scalar reductions serialize
+        # the pipeline) — so 'auto' prefers partial/tournament and 'pallas'
+        # stays opt-in until the kernel wins
+        algo = "tournament" if m > 2 * max(_PANEL_CHUNK, v) else "partial"
+    if algo == "pallas" and not _pallas_panel_ok(dtype, min(m, _PALLAS_MAX_ROWS), v):
+        raise ValueError(
+            f"pallas panel kernel supports float32 with width a multiple "
+            f"of 128, got {jnp.dtype(dtype)} ({m}, {v})"
+        )
+    return algo
 
 
 def tournament_winners(panel: jax.Array, chunk: int | None = None,
@@ -345,6 +353,30 @@ def panel_lu_pallas(panel: jax.Array):
     key = jnp.where(is_piv, pos, v + ids)
     perm = jnp.argsort(key)
     return A[perm], perm
+
+
+def panel_winners(panel: jax.Array, algo: str = "auto"):
+    """Elect the v pivot rows of an (m, v) panel and factor them.
+
+    Returns (lu00, gpiv): the packed (v, v) LU of the winners in pivot order
+    and their row positions in `panel`. This is the selection half of
+    :func:`panel_lu` without the L10 solve or row reordering — callers that
+    place rows themselves (see `conflux_tpu/lu/single.py`'s swap-minimal
+    update) use this directly. For rank-deficient panels the tournament may
+    report out-of-range pad ids in gpiv (see :func:`tournament_winners`);
+    `permute.swap_minimal_perm` sanitizes them.
+    """
+    m, v = panel.shape
+    algo = _resolve_panel_algo(panel.dtype, m, v, algo)
+    if algo == "pallas":
+        if m <= _PALLAS_MAX_ROWS:
+            lu_packed, perm = panel_lu_pallas(panel)
+            return lu_packed[:v], perm[:v]
+        return tournament_winners(panel, chunk=_PALLAS_MAX_ROWS, use_pallas=True)
+    if algo == "partial":
+        lu_f, _, perm = lax.linalg.lu(panel)
+        return lu_f[:v], perm[:v]
+    return tournament_winners(panel)
 
 
 def panel_lu_tournament(panel: jax.Array, chunk: int | None = None,

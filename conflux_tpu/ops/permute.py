@@ -39,6 +39,44 @@ def prepend_column(A: jax.Array, col: jax.Array) -> jax.Array:
     return jnp.concatenate([col[:, None].astype(A.dtype), A], axis=1)
 
 
+def swap_minimal_perm(gpiv: jax.Array, m: int) -> jax.Array:
+    """Length-m permutation placing winner j at slot j with <= 2v moves.
+
+    LAPACK's getrf reorders rows by pairwise swaps, so at most 2v rows change
+    position; a compaction permutation ("winners, then the rest in order")
+    moves O(m) rows and costs a full-matrix gather per superstep. This builds
+    the swap-flavoured permutation instead: slots [0, v) take the winners in
+    pivot order, top-slot occupants displaced by an incoming winner drop into
+    the slots those winners vacated (in ascending order), and every other row
+    stays put.
+
+    gpiv entries outside [0, m) (tournament pad ids from a rank-deficient
+    panel, see `blas.tournament_winners`) are replaced by the lowest unused
+    row ids so the result is always a valid permutation — the factor values
+    for such panels are garbage either way (zero pivots), but downstream
+    gathers/scatters never alias rows.
+    """
+    v = gpiv.shape[0]
+    pos = jnp.arange(m, dtype=gpiv.dtype)
+    valid = (gpiv >= 0) & (gpiv < m)
+    is_w = jnp.zeros((m,), bool).at[jnp.where(valid, gpiv, m)].set(
+        valid, mode="drop"
+    )
+    # lowest unused rows, ascending, to stand in for invalid winner ids
+    unused = jnp.sort(jnp.where(is_w, m, pos))
+    bad_rank = jnp.cumsum(~valid) - 1
+    gpiv = jnp.where(valid, gpiv, unused[jnp.clip(bad_rank, 0, m - 1)])
+    is_w = jnp.zeros((m,), bool).at[gpiv].set(True, mode="drop")
+    # non-winner rows currently sitting in the top v slots, ascending (padded
+    # with m, which clip keeps in range; the pad entries are never selected
+    # because #vacant-slots == #displaced-rows)
+    disp = jnp.sort(jnp.where((pos < v) & ~is_w, pos, m))
+    vac = (pos >= v) & is_w
+    rank = jnp.cumsum(vac) - 1
+    sperm = jnp.where(vac, disp[jnp.clip(rank, 0, m - 1)], pos)
+    return sperm.at[:v].set(gpiv)
+
+
 def push_pivots_up(A: jax.Array, pivot_mask: jax.Array) -> tuple[jax.Array, jax.Array]:
     """Stable partition: rows with pivot_mask True move to the top, others
     keep their relative order below (the role of `push_pivots_up`,

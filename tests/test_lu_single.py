@@ -59,3 +59,28 @@ def test_lu_f32():
     LU, perm = lu_factor_blocked(jnp.asarray(A), v=16)
     assert LU.dtype == jnp.float32
     assert lu_residual(A, LU, perm) < residual_bound(N, np.float32)
+
+
+def test_lu_full_gather_path_matches():
+    """The large-M full-gather branch must agree with the swap-minimal one
+    (thresholds shrunk so both run at test size)."""
+    from conflux_tpu.lu import single as lu_single
+
+    N, v = 128, 16
+    A = make_test_matrix(N, N, seed=21)
+    LU_small, perm_small = lu_factor_blocked(jnp.asarray(A), v=v)
+    old = lu_single._SWAP_SCATTER_MAX
+    lu_single._SWAP_SCATTER_MAX = 0  # force the full-gather branch
+    try:
+        lu_single._lu_factor_blocked.clear_cache()
+        LU_big, perm_big = lu_factor_blocked(jnp.asarray(A), v=v)
+    finally:
+        lu_single._SWAP_SCATTER_MAX = old
+        lu_single._lu_factor_blocked.clear_cache()
+    assert lu_residual(A, LU_big, perm_big) < residual_bound(N, np.float64)
+    # same pivots elected, same factors (row order of ties may differ)
+    np.testing.assert_allclose(
+        np.asarray(LU_small)[np.argsort(np.asarray(perm_small))],
+        np.asarray(LU_big)[np.argsort(np.asarray(perm_big))],
+        atol=1e-12,
+    )

@@ -68,3 +68,45 @@ def test_prepend_column():
     out = prepend_column(A, col)
     assert out.shape == (3, 3)
     assert out[:, 0].tolist() == [5.0, 6.0, 7.0]
+
+
+def test_swap_minimal_perm_basic():
+    import jax.numpy as jnp
+    import numpy as np
+
+    from conflux_tpu.ops.permute import swap_minimal_perm
+
+    # winners 5, 1 of m=8: slot0<-5, slot1<-1 (already there), row0 drops
+    # into the slot row 5 vacated; everything else stays put
+    sperm = np.asarray(swap_minimal_perm(jnp.array([5, 1]), 8))
+    assert sorted(sperm.tolist()) == list(range(8))
+    assert sperm[0] == 5 and sperm[1] == 1
+    assert (sperm != np.arange(8)).sum() <= 4
+
+
+def test_swap_minimal_perm_random_is_permutation():
+    import jax.numpy as jnp
+    import numpy as np
+
+    from conflux_tpu.ops.permute import swap_minimal_perm
+
+    rng = np.random.default_rng(0)
+    for m, v in [(16, 4), (64, 8), (256, 32)]:
+        for _ in range(20):
+            gpiv = rng.choice(m, size=v, replace=False)
+            sperm = np.asarray(swap_minimal_perm(jnp.asarray(gpiv), m))
+            assert sorted(sperm.tolist()) == list(range(m)), (m, v, gpiv)
+            np.testing.assert_array_equal(sperm[:v], gpiv)
+            assert (sperm != np.arange(m)).sum() <= 2 * v
+
+
+def test_swap_minimal_perm_sanitizes_out_of_range():
+    import jax.numpy as jnp
+    import numpy as np
+
+    from conflux_tpu.ops.permute import swap_minimal_perm
+
+    # pad ids >= m (rank-deficient tournament) must still yield a permutation
+    sperm = np.asarray(swap_minimal_perm(jnp.array([10, 3]), 8))
+    assert sorted(sperm.tolist()) == list(range(8))
+    assert sperm[1] == 3
