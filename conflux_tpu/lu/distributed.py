@@ -25,7 +25,11 @@ static, rows never move, and pivoting is *value-level*:
 
 Per superstep: 3 collectives (panel psum, candidate all_gather, pivot-row
 psum), two small duplicated factorizations (local panel LU, stacked LU), two
-duplicated v-row TRSMs, and one (Ml x nlayr) @ (nlayr x Nl) MXU GEMM.
+duplicated v-row TRSMs, and (Ml x nlayr) @ (nlayr x seg) MXU GEMMs over the
+live column segments — the local width is cut into up to 8 segments and
+fully-factored segments are skipped via `lax.cond`, keeping total GEMM work
+near the true 2/3 N^3 / P instead of the 3x a full-width masked update
+would spend.
 
 Factors are stored LAPACK-packed *in original row positions*; `pivots` gives
 the global row index factored at each (step, slot), from which the row
@@ -65,6 +69,16 @@ def _build(geom: LUGeometry, mesh_key, precision, backend: str):
     nlayr = geom.nlayr
     n_steps = geom.n_steps
     v_pad = Pz * nlayr  # inner dim padded so every z layer gets a full slab
+    # trailing-update segmentation: ceil-divide the local tiles into up to 8
+    # segments (last one ragged) so every Ntl gets the flop bound of at most
+    # one extra segment width per superstep
+    n_seg = min(8, geom.Ntl)
+    tiles_per_seg = -(-geom.Ntl // n_seg)
+    seg_bounds = [
+        (g * tiles_per_seg * v, min((g + 1) * tiles_per_seg, geom.Ntl) * v)
+        for g in range(n_seg)
+        if g * tiles_per_seg < geom.Ntl
+    ]
 
     def device_fn(blk):
         x = lax.axis_index(AXIS_X)
@@ -136,9 +150,25 @@ def _build(geom: LUGeometry, mesh_key, precision, backend: str):
             U01p = jnp.pad(U01.astype(dtype), ((0, v_pad - v), (0, 0)))
             L10s = lax.dynamic_slice(L10p, (i0, (z * nlayr).astype(jnp.int32)), (Ml, nlayr))
             U01s = lax.dynamic_slice(U01p, ((z * nlayr).astype(jnp.int32), i0), (nlayr, Nl))
-            upd = blas.gemm(L10s, U01s, precision=precision, backend=backend)
             col_trail = ctile > k  # (Nl,)
-            Anew = Aloc - jnp.where(col_trail[None, :], upd, jnp.zeros((), dtype))
+            # Static shapes force a full-local-width GEMM every superstep,
+            # which would spend 3x the optimal 2/3 N^3/P flops. Local column
+            # tiles finish in ascending local order (tile lt has global tile
+            # id lt*Py + y), so the live region is a contiguous suffix: cut
+            # the width into segments and skip fully-finished ones with
+            # lax.cond — flop waste drops to <= segw extra columns per step.
+            def seg_update(a_seg, u_seg, m_seg):
+                upd = blas.gemm(L10s, u_seg, precision=precision, backend=backend)
+                return a_seg - jnp.where(m_seg[None, :], upd, jnp.zeros((), dtype))
+
+            pieces = []
+            for lo, hi in seg_bounds:
+                sl = slice(lo, hi)
+                pieces.append(lax.cond(
+                    col_trail[sl].any(), seg_update, lambda a, u, mm: a,
+                    Aloc[:, sl], U01s[:, sl], col_trail[sl],
+                ))
+            Anew = jnp.concatenate(pieces, axis=1) if len(pieces) > 1 else pieces[0]
 
             # ---- factor writes (z==0 carries factors, z!=0 zeroed) -------- #
             z0 = z == 0
