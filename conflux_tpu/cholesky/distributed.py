@@ -32,7 +32,7 @@ import numpy as np
 from jax import lax
 from jax.sharding import PartitionSpec as P
 
-from conflux_tpu.geometry import CholeskyGeometry, Grid3
+from conflux_tpu.geometry import CholeskyGeometry, Grid3, ragged_segments
 from conflux_tpu.ops import blas
 from conflux_tpu.parallel.mesh import (
     AXIS_X,
@@ -53,6 +53,15 @@ def _build(geom: CholeskyGeometry, mesh_key, precision, backend: str):
     nlayr = geom.nlayr
     n_steps = geom.Kappa
     v_pad = Pz * nlayr
+
+    # trailing-update segmentation (same idea as lu.distributed): both the
+    # live rows (rtile > k) and live columns (ctile > k) are contiguous
+    # local suffixes under the block-cyclic map, so ceil-divide each axis
+    # into up to 4 ragged segments and skip dead (row, col) blocks with
+    # lax.cond — GEMM work stays near the true N^3/3P instead of the 3x a
+    # full-local-shape masked update would spend
+    row_bounds = ragged_segments(Ml // v, v, 4)
+    col_bounds = ragged_segments(Nl // v, v, 4)
 
     def device_fn(blk):
         x = lax.axis_index(AXIS_X)
@@ -122,11 +131,33 @@ def _build(geom: CholeskyGeometry, mesh_key, precision, backend: str):
             zoff = (z * nlayr).astype(jnp.int32)
             L10s = lax.dynamic_slice(L10p, (i0, zoff), (Ml, nlayr))
             Lcs = lax.dynamic_slice(Lcp, (i0, zoff), (Nl, nlayr))
-            upd = blas.gemm(L10s, Lcs.T, precision=precision, backend=backend)
             col_trail = ctile > k
-            Anew = Aloc - jnp.where(
-                below[:, None] & col_trail[None, :], upd, jnp.zeros((), dtype)
-            )
+
+            def seg_update(a_seg, l_seg, c_seg, mrow, mcol):
+                upd = blas.gemm(l_seg, c_seg.T, precision=precision,
+                                backend=backend)
+                return a_seg - jnp.where(
+                    mrow[:, None] & mcol[None, :], upd, jnp.zeros((), dtype)
+                )
+
+            row_pieces = []
+            for rlo, rhi in row_bounds:
+                rsl = slice(rlo, rhi)
+                col_pieces = []
+                for clo, chi in col_bounds:
+                    csl = slice(clo, chi)
+                    live = below[rsl].any() & col_trail[csl].any()
+                    col_pieces.append(lax.cond(
+                        live, seg_update, lambda a, l, c, mr, mc: a,
+                        Aloc[rsl, csl], L10s[rsl], Lcs[csl],
+                        below[rsl], col_trail[csl],
+                    ))
+                row_pieces.append(
+                    jnp.concatenate(col_pieces, axis=1)
+                    if len(col_pieces) > 1 else col_pieces[0]
+                )
+            Anew = (jnp.concatenate(row_pieces, axis=0)
+                    if len(row_pieces) > 1 else row_pieces[0])
 
             # ---- factor writes: panel column on layer z==0 ---------------- #
             on_diag = rtile == k

@@ -115,11 +115,16 @@ def _factor(args) -> int:
     grid = Grid3.parse(args.grid) if args.grid else choose_cholesky_grid(n_devices)
     v = args.tile or choose_cholesky_tile(N, grid.P)
     geom = CholeskyGeometry.create(N, v, grid)
-    mesh = make_mesh(grid, devices=jax.devices()[: grid.P])
 
-    shards = jnp.asarray(geom.scatter(A))
-    out = cholesky_factor_distributed(shards, geom, mesh)
-    L = np.tril(geom.gather(np.asarray(out)))[:N, :N]
+    if grid.P == 1 and geom.N == N and geom.Kappa <= 64:
+        from conflux_tpu.cholesky.single import cholesky_blocked
+
+        L = np.asarray(cholesky_blocked(jnp.asarray(A), v=geom.v))
+    else:
+        mesh = make_mesh(grid, devices=jax.devices()[: grid.P])
+        shards = jnp.asarray(geom.scatter(A))
+        out = cholesky_factor_distributed(shards, geom, mesh)
+        L = np.tril(geom.gather(np.asarray(out)))[:N, :N]
     save_matrix(args.outfile, L.astype(A.dtype))
     print(f"wrote {args.outfile}: lower factor of {args.infile} "
           f"(grid {grid}, tile {geom.v})")

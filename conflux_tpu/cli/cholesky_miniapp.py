@@ -56,20 +56,29 @@ def main(argv=None) -> int:
 
     dtype = np_dtype(args.dtype)
     geom = CholeskyGeometry.create(args.dim, v, grid)
-    mesh = make_mesh(grid, devices=jax.devices()[: grid.P])
 
+    # dedicated single-device path (true 1/3 N^3 flops); it unrolls Kappa
+    # supersteps at trace time, so fall back to the distributed program (O(1)
+    # compile on a 1x1x1 mesh) for very deep factorizations
+    single = grid.P == 1 and geom.Kappa <= 64
+    mesh = None if single else make_mesh(grid, devices=jax.devices()[: grid.P])
     with profiler.region("init_matrix"):
         A = make_spd_matrix(geom.N, dtype=dtype)
-        shards = jnp.asarray(geom.scatter(A))
+        dev = jnp.asarray(A) if single else jnp.asarray(geom.scatter(A))
         if args.dtype == "bfloat16":
-            shards = shards.astype(jnp.bfloat16)
-        sync(shards)
+            dev = dev.astype(jnp.bfloat16)
+        sync(dev)
 
     times = []
     for rep in range(args.run + 1):
         with WallTimer() as t:
             with profiler.region("cholesky_factorization"):
-                out = cholesky_factor_distributed(shards, geom, mesh)
+                if single:
+                    from conflux_tpu.cholesky.single import cholesky_blocked
+
+                    out = cholesky_blocked(dev, v=geom.v)
+                else:
+                    out = cholesky_factor_distributed(dev, geom, mesh)
                 sync(out)
         if rep > 0:
             times.append(t.ms)
@@ -93,7 +102,8 @@ def main(argv=None) -> int:
 
     if args.validate:
         with profiler.region("validation"):
-            L = np.tril(geom.gather(np.asarray(out)))
+            L = (np.asarray(out) if single
+                 else np.tril(geom.gather(np.asarray(out))))
             res = cholesky_residual(np.asarray(A, np.float64), L)
         print(f"_residual_ {res:.3e}")
 

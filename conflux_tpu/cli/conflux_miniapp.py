@@ -65,20 +65,31 @@ def main(argv=None) -> int:
 
     dtype = np_dtype(args.dtype)
     geom = LUGeometry.create(M, args.N, args.block_size, grid)
-    mesh = make_mesh(grid, devices=jax.devices()[: grid.P])
 
+    # Dedicated single-device path: exact shrinking shapes per superstep
+    # (true 2/3 N^3 flops) instead of the masked fixed-shape distributed
+    # program. It unrolls the superstep loop at trace time, so cap the step
+    # count — beyond that the distributed program on a 1x1x1 mesh compiles
+    # in O(1) (see conflux_tpu/lu/single.py docstring).
+    single = grid.P == 1 and geom.n_steps <= 64
+    mesh = None if single else make_mesh(grid, devices=jax.devices()[: grid.P])
     with profiler.region("init_matrix"):
         A = make_test_matrix(geom.M, geom.N, dtype=dtype)
-        shards = jnp.asarray(geom.scatter(A))
+        dev = jnp.asarray(A) if single else jnp.asarray(geom.scatter(A))
         if args.dtype == "bfloat16":
-            shards = shards.astype(jnp.bfloat16)
-        sync(shards)
+            dev = dev.astype(jnp.bfloat16)
+        sync(dev)
 
     times = []
     for rep in range(args.n_rep + 1):  # rep 0 is the mandatory warm-up
         with WallTimer() as t:
             with profiler.region("lu_factorization"):
-                out, pivots = lu_factor_distributed(shards, geom, mesh)
+                if single:
+                    from conflux_tpu.lu.single import lu_factor_blocked
+
+                    out, perm_dev = lu_factor_blocked(dev, v=geom.v)
+                else:
+                    out, pivots = lu_factor_distributed(dev, geom, mesh)
                 sync(out)
         if rep > 0:
             times.append(t.ms)
@@ -91,9 +102,14 @@ def main(argv=None) -> int:
 
     if args.validate:
         with profiler.region("validation"):
-            LU = geom.gather(np.asarray(out))
-            perm = full_permutation(np.asarray(pivots), geom.M)
-            res = lu_residual(np.asarray(A, np.float64), LU[perm], perm)
+            if single:
+                LU_perm = np.asarray(out)
+                perm = np.asarray(perm_dev)
+                res = lu_residual(np.asarray(A, np.float64), LU_perm, perm)
+            else:
+                LU = geom.gather(np.asarray(out))
+                perm = full_permutation(np.asarray(pivots), geom.M)
+                res = lu_residual(np.asarray(A, np.float64), LU[perm], perm)
         print(f"_residual_ {res:.3e}")
 
     if args.profile:

@@ -149,6 +149,16 @@ def local_row_indices(p: int, Ml: int, v: int, Pdim: int) -> np.ndarray:
     return (lr // v * Pdim + p) * v + lr % v
 
 
+def ragged_segments(n_tiles: int, v: int, max_seg: int) -> list[tuple[int, int]]:
+    """Ceil-divide n_tiles tiles of width v into at most max_seg contiguous
+    (start, stop) element ranges, the last one ragged. Used by the
+    distributed trailing updates to skip fully-factored column/row blocks."""
+    n = min(max_seg, n_tiles)
+    per = -(-n_tiles // n)
+    return [(g * per * v, min((g + 1) * per, n_tiles) * v)
+            for g in range(n) if g * per < n_tiles]
+
+
 # --------------------------------------------------------------------------- #
 # LU geometry
 # --------------------------------------------------------------------------- #

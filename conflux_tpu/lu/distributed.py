@@ -46,7 +46,7 @@ import numpy as np
 from jax import lax
 from jax.sharding import PartitionSpec as P
 
-from conflux_tpu.geometry import Grid3, LUGeometry
+from conflux_tpu.geometry import Grid3, LUGeometry, ragged_segments
 from conflux_tpu.ops import blas
 from conflux_tpu.parallel.mesh import (
     AXIS_X,
@@ -69,16 +69,9 @@ def _build(geom: LUGeometry, mesh_key, precision, backend: str):
     nlayr = geom.nlayr
     n_steps = geom.n_steps
     v_pad = Pz * nlayr  # inner dim padded so every z layer gets a full slab
-    # trailing-update segmentation: ceil-divide the local tiles into up to 8
-    # segments (last one ragged) so every Ntl gets the flop bound of at most
-    # one extra segment width per superstep
-    n_seg = min(8, geom.Ntl)
-    tiles_per_seg = -(-geom.Ntl // n_seg)
-    seg_bounds = [
-        (g * tiles_per_seg * v, min((g + 1) * tiles_per_seg, geom.Ntl) * v)
-        for g in range(n_seg)
-        if g * tiles_per_seg < geom.Ntl
-    ]
+    # trailing-update segmentation: up to 8 ragged segments bound the flop
+    # overshoot at one segment width per superstep
+    seg_bounds = ragged_segments(geom.Ntl, v, 8)
 
     def device_fn(blk):
         x = lax.axis_index(AXIS_X)
