@@ -91,38 +91,43 @@ def _build(geom: CholeskyGeometry, mesh_key, precision, backend: str):
             ldiag = ((k // Px) * v).astype(jnp.int32)
 
             # ---- panel column k: z-reduce + y-broadcast ------------------- #
-            panel_loc = lax.dynamic_slice(Aloc, (i0, lj), (Ml, v))
-            panel = lax.psum(
-                jnp.where(y == yo, panel_loc, jnp.zeros((), dtype)),
-                (AXIS_Y, AXIS_Z),
-            )
+            with jax.named_scope("reduceA11"):
+                panel_loc = lax.dynamic_slice(Aloc, (i0, lj), (Ml, v))
+                panel = lax.psum(
+                    jnp.where(y == yo, panel_loc, jnp.zeros((), dtype)),
+                    (AXIS_Y, AXIS_Z),
+                )
 
             # panel math in the compute dtype (f32 when storage is bf16)
             cdtype = blas.compute_dtype(dtype)
             panel = panel.astype(cdtype)
 
             # ---- diagonal tile: x-broadcast + potrf ----------------------- #
-            diag_slice = lax.dynamic_slice(panel, (ldiag, i0), (v, v))
-            Akk = lax.psum(
-                jnp.where(x == xo, diag_slice, jnp.zeros((), cdtype)), AXIS_X
-            )
-            L00 = blas.potrf(Akk)
+            with jax.named_scope("choleskyA00"):
+                diag_slice = lax.dynamic_slice(panel, (ldiag, i0), (v, v))
+                Akk = lax.psum(
+                    jnp.where(x == xo, diag_slice, jnp.zeros((), cdtype)), AXIS_X
+                )
+                L00 = blas.potrf(Akk)
 
             # ---- L10 for rows below the diagonal -------------------------- #
-            below = rtile > k
-            act_panel = jnp.where(below[:, None], panel, jnp.zeros((), cdtype))
-            L10 = blas.trsm_right_lower_t(L00, act_panel)  # (Ml, v)
+            with jax.named_scope("updateA10"):
+                below = rtile > k
+                act_panel = jnp.where(below[:, None], panel, jnp.zeros((), cdtype))
+                L10 = blas.trsm_right_lower_t(L00, act_panel)  # (Ml, v)
 
             # ---- L10^T redistribution to column owners over 'x' ----------- #
             # row g of the global panel -> every device whose columns include
             # g; diag-tile columns take L00 rows
-            from_L10 = jnp.where(
-                (col_owner_x == x)[:, None], L10[col_local_row], jnp.zeros((), cdtype)
-            )
-            Lc = lax.psum(from_L10, AXIS_X)  # (Nl, v) = L10 rows for my cols
-            diag_cols = ctile == k
-            L00_rows = L00[gcol % v]  # (Nl, v), valid where diag_cols
-            Lc = jnp.where(diag_cols[:, None], L00_rows, Lc)
+            with jax.named_scope("scatterA11"):
+                from_L10 = jnp.where(
+                    (col_owner_x == x)[:, None], L10[col_local_row],
+                    jnp.zeros((), cdtype)
+                )
+                Lc = lax.psum(from_L10, AXIS_X)  # (Nl, v) = L10 rows for my cols
+                diag_cols = ctile == k
+                L00_rows = L00[gcol % v]  # (Nl, v), valid where diag_cols
+                Lc = jnp.where(diag_cols[:, None], L00_rows, Lc)
 
             # ---- trailing syrk-style update on this layer's slab ---------- #
             # GEMM rides the storage dtype (bf16 fast path when selected)
@@ -141,6 +146,7 @@ def _build(geom: CholeskyGeometry, mesh_key, precision, backend: str):
                 )
 
             row_pieces = []
+            # (reference computeA11 phase)
             for rlo, rhi in row_bounds:
                 rsl = slice(rlo, rhi)
                 col_pieces = []

@@ -96,46 +96,52 @@ def _build(geom: LUGeometry, mesh_key, precision, backend: str):
             lj = (k // Py) * v  # local col offset of panel tile on owner
 
             # ---- panel: z-reduce + y-broadcast in one psum (ref step 0) --- #
-            i0 = jnp.zeros((), jnp.int32)
-            lj = lj.astype(jnp.int32)
-            panel_loc = lax.dynamic_slice(Aloc, (i0, lj), (Ml, v))
-            panel = lax.psum(
-                jnp.where(y == j_owner, panel_loc, jnp.zeros((), dtype)),
-                (AXIS_Y, AXIS_Z),
-            )
+            with jax.named_scope("step0_reduce"):
+                i0 = jnp.zeros((), jnp.int32)
+                lj = lj.astype(jnp.int32)
+                panel_loc = lax.dynamic_slice(Aloc, (i0, lj), (Ml, v))
+                panel = lax.psum(
+                    jnp.where(y == j_owner, panel_loc, jnp.zeros((), dtype)),
+                    (AXIS_Y, AXIS_Z),
+                )
 
             # ---- tournament pivoting over x (ref step 1) ------------------ #
             # panel math runs in the compute dtype (f32 when storage is bf16)
-            cdtype = blas.compute_dtype(dtype)
-            panel = panel.astype(cdtype)
-            cand = jnp.where(done[:, None], jnp.zeros((), cdtype), panel)
-            gri_m = jnp.where(done, _GRI_SENTINEL, gri)
-            _, _, perm_l = lax.linalg.lu(cand)
-            top = perm_l[:v]
-            blks = lax.all_gather(cand[top], AXIS_X)  # (Px, v, v)
-            gris = lax.all_gather(gri_m[top], AXIS_X)  # (Px, v)
-            lu_f, _, perm_f = lax.linalg.lu(blks.reshape(Px * v, v))
-            gpiv = gris.reshape(Px * v)[perm_f[:v]]  # winners, in pivot order
-            lu00 = lu_f[:v]  # packed L00\U00 of the winners
-            U00 = jnp.triu(lu00)
-            L00 = blas.unit_lower(lu00)
+            with jax.named_scope("step1_pivoting"):
+                cdtype = blas.compute_dtype(dtype)
+                panel = panel.astype(cdtype)
+                cand = jnp.where(done[:, None], jnp.zeros((), cdtype), panel)
+                gri_m = jnp.where(done, _GRI_SENTINEL, gri)
+                _, _, perm_l = lax.linalg.lu(cand)
+                top = perm_l[:v]
+                blks = lax.all_gather(cand[top], AXIS_X)  # (Px, v, v)
+                gris = lax.all_gather(gri_m[top], AXIS_X)  # (Px, v)
+                lu_f, _, perm_f = lax.linalg.lu(blks.reshape(Px * v, v))
+                gpiv = gris.reshape(Px * v)[perm_f[:v]]  # winners, in pivot order
+                lu00 = lu_f[:v]  # packed L00\U00 of the winners
+                U00 = jnp.triu(lu00)
+                L00 = blas.unit_lower(lu00)
 
             # ---- pivot masks (ref g2lnoTile/analyze_pivots) --------------- #
-            match = gri[:, None] == gpiv[None, :]  # (Ml, v)
-            is_piv = match.any(axis=1)
-            piv_pos = jnp.argmax(match, axis=1)  # pivot order of local rows
-            done_new = done | is_piv
+            with jax.named_scope("step2_pivotrows"):
+                match = gri[:, None] == gpiv[None, :]  # (Ml, v)
+                is_piv = match.any(axis=1)
+                piv_pos = jnp.argmax(match, axis=1)  # pivot order of local rows
+                done_new = done | is_piv
 
             # ---- L10 for all still-active rows (ref step 4 TRSM) ---------- #
-            act_panel = jnp.where(done_new[:, None], jnp.zeros((), cdtype), panel)
-            L10 = blas.trsm_right_upper(U00, act_panel)  # (Ml, v)
+            with jax.named_scope("step4_dtrsm"):
+                act_panel = jnp.where(done_new[:, None], jnp.zeros((), cdtype), panel)
+                L10 = blas.trsm_right_upper(U00, act_panel)  # (Ml, v)
 
             # ---- pivot rows: gather + reduce over (x, z) (ref steps 2-3) -- #
-            owned = match.any(axis=0)  # (v,) is pivot q local?
-            li = jnp.argmax(match, axis=0)  # (v,) its local row
-            prow_part = jnp.where(owned[:, None], Aloc[li], jnp.zeros((), dtype))
-            Prows = lax.psum(prow_part, (AXIS_X, AXIS_Z))  # (v, Nl)
-            U01 = blas.trsm_left_lower_unit(L00, Prows.astype(cdtype))  # ref step 5
+            with jax.named_scope("step3_distribute"):
+                owned = match.any(axis=0)  # (v,) is pivot q local?
+                li = jnp.argmax(match, axis=0)  # (v,) its local row
+                prow_part = jnp.where(owned[:, None], Aloc[li], jnp.zeros((), dtype))
+                Prows = lax.psum(prow_part, (AXIS_X, AXIS_Z))  # (v, Nl)
+            with jax.named_scope("step5_dtrsm"):
+                U01 = blas.trsm_left_lower_unit(L00, Prows.astype(cdtype))  # ref step 5
 
             # ---- trailing update on this layer's slab (ref step 6) -------- #
             # GEMM rides the storage dtype (bf16 fast path when selected)
@@ -154,14 +160,16 @@ def _build(geom: LUGeometry, mesh_key, precision, backend: str):
                 upd = blas.gemm(L10s, u_seg, precision=precision, backend=backend)
                 return a_seg - jnp.where(m_seg[None, :], upd, jnp.zeros((), dtype))
 
-            pieces = []
-            for lo, hi in seg_bounds:
-                sl = slice(lo, hi)
-                pieces.append(lax.cond(
-                    col_trail[sl].any(), seg_update, lambda a, u, mm: a,
-                    Aloc[:, sl], U01s[:, sl], col_trail[sl],
-                ))
-            Anew = jnp.concatenate(pieces, axis=1) if len(pieces) > 1 else pieces[0]
+            with jax.named_scope("step6_dgemm"):
+                pieces = []
+                for lo, hi in seg_bounds:
+                    sl = slice(lo, hi)
+                    pieces.append(lax.cond(
+                        col_trail[sl].any(), seg_update, lambda a, u, mm: a,
+                        Aloc[:, sl], U01s[:, sl], col_trail[sl],
+                    ))
+                Anew = (jnp.concatenate(pieces, axis=1)
+                        if len(pieces) > 1 else pieces[0])
 
             # ---- factor writes (z==0 carries factors, z!=0 zeroed) -------- #
             z0 = z == 0

@@ -60,6 +60,58 @@ def lookup_mesh(key) -> jax.sharding.Mesh:
     return _MESH_REGISTRY[key]
 
 
+def initialize_multihost(coordinator_address: str | None = None,
+                         num_processes: int | None = None,
+                         process_id: int | None = None, **kwargs) -> None:
+    """Bring up the multi-host runtime — the role of `MPI_Init`
+    (`examples/conflux_miniapp.cpp:90`) for TPU pods.
+
+    Call once per host process before any mesh/array work;
+    `jax.distributed.initialize` discovers the coordinator automatically on
+    Cloud TPU (all arguments optional there). After this, `jax.devices()`
+    spans every host's chips and `make_mesh` builds pod-wide meshes; the
+    collectives in the factorization loops ride ICI within a slice and DCN
+    across slices without code changes.
+    """
+    jax.distributed.initialize(coordinator_address, num_processes,
+                               process_id, **kwargs)
+
+
+def distribute_shards(shards, mesh: jax.sharding.Mesh, *,
+                      shape: tuple | None = None, dtype=None) -> jax.Array:
+    """Build the (Px, Py, Ml, Nl) device-sharded global array from host data.
+
+    Two forms:
+
+    - `shards` is the full (Px, Py, Ml, Nl) host array: single-host
+      convenience, equivalent to a device_put with the block-cyclic sharding
+      (every process must hold the whole thing — fine on one host).
+    - `shards` is a callable `(px, py) -> (Ml, Nl) ndarray` and
+      `shape`/`dtype` give the global spec: it is invoked only for the
+      shards owned by THIS process's addressable devices, so on a multi-host
+      pod no host ever materializes the global matrix — the role of the
+      reference's per-rank `InitMatrix` fill (`lu_params.hpp:141-376`).
+    """
+    from jax.sharding import PartitionSpec
+
+    sharding = jax.sharding.NamedSharding(
+        mesh, PartitionSpec(AXIS_X, AXIS_Y, None, None)
+    )
+    if callable(shards):
+        if shape is None or dtype is None:
+            raise ValueError("callable form requires shape= and dtype=")
+
+        def cb(idx):
+            px, py = idx[0].start or 0, idx[1].start or 0
+            return np.asarray(shards(px, py), dtype=dtype)[None, None]
+
+        return jax.make_array_from_callback(tuple(shape), sharding, cb)
+    shards = np.asarray(shards)
+    return jax.make_array_from_callback(
+        shards.shape, sharding, lambda idx: shards[idx]
+    )
+
+
 def make_mesh(grid: Grid3, devices=None) -> jax.sharding.Mesh:
     """Build the ('x', 'y', 'z') mesh for a Grid3.
 

@@ -105,3 +105,57 @@ def test_lu_distributed_bf16():
     res = lu_residual(A, LU[perm], perm)
     assert res < 0.3, res  # bf16 eps is ~8e-3; loose sanity bound
     assert res > 1e-6  # and it genuinely ran in bf16, not f32
+
+
+def test_distribute_shards_multihost_entry():
+    """`distribute_shards` (the multi-host array-construction entry point)
+    must produce shards the factorization consumes identically to a plain
+    device_put — single-host semantics of jax.make_array_from_callback."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from conflux_tpu.geometry import Grid3, LUGeometry
+    from conflux_tpu.lu.distributed import lu_factor_distributed
+    from conflux_tpu.parallel.mesh import distribute_shards, make_mesh
+    from conflux_tpu.validation import make_test_matrix
+
+    grid = Grid3(2, 2, 2)
+    geom = LUGeometry.create(32, 32, 8, grid)
+    mesh = make_mesh(grid, devices=jax.devices()[:8])
+    A = make_test_matrix(32, 32, seed=3)
+    shards = geom.scatter(A)
+
+    arr = distribute_shards(shards, mesh)
+    assert isinstance(arr, jax.Array)
+    out_a, piv_a = lu_factor_distributed(arr, geom, mesh)
+    out_b, piv_b = lu_factor_distributed(jnp.asarray(shards), geom, mesh)
+    np.testing.assert_array_equal(np.asarray(piv_a), np.asarray(piv_b))
+    np.testing.assert_allclose(np.asarray(out_a), np.asarray(out_b), rtol=0, atol=0)
+
+
+def test_distribute_shards_callable_form():
+    """Callable form: only per-shard data is requested (the multi-host
+    per-rank fill); result must equal the full-array form."""
+    import jax
+    import numpy as np
+
+    from conflux_tpu.geometry import Grid3, LUGeometry
+    from conflux_tpu.parallel.mesh import distribute_shards, make_mesh
+    from conflux_tpu.validation import make_test_matrix
+
+    grid = Grid3(2, 2, 2)
+    geom = LUGeometry.create(32, 32, 8, grid)
+    mesh = make_mesh(grid, devices=jax.devices()[:8])
+    A = make_test_matrix(32, 32, seed=4)
+    shards = geom.scatter(A)
+
+    calls = []
+
+    def fill(px, py):
+        calls.append((px, py))
+        return shards[px, py]
+
+    arr = distribute_shards(fill, mesh, shape=shards.shape, dtype=shards.dtype)
+    np.testing.assert_array_equal(np.asarray(arr), shards)
+    assert set(calls) <= {(px, py) for px in range(2) for py in range(2)}
