@@ -136,6 +136,27 @@ def trsm_right_lower_t(L: jax.Array, B: jax.Array) -> jax.Array:
     )
 
 
+def trsm_left_upper(U: jax.Array, B: jax.Array) -> jax.Array:
+    """Solve U X = B with U upper triangular (LU back-substitution)."""
+    return lax.linalg.triangular_solve(
+        U, B, left_side=True, lower=False, unit_diagonal=False
+    )
+
+
+def trsm_left_lower(L: jax.Array, B: jax.Array) -> jax.Array:
+    """Solve L X = B with L lower triangular (Cholesky forward solve)."""
+    return lax.linalg.triangular_solve(
+        L, B, left_side=True, lower=True, unit_diagonal=False
+    )
+
+
+def trsm_left_lower_t(L: jax.Array, B: jax.Array) -> jax.Array:
+    """Solve L^T X = B with L lower triangular (Cholesky back solve)."""
+    return lax.linalg.triangular_solve(
+        L, B, left_side=True, lower=True, transpose_a=True, unit_diagonal=False
+    )
+
+
 # --------------------------------------------------------------------------- #
 # Panel factorizations
 # --------------------------------------------------------------------------- #
