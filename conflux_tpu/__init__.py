@@ -20,6 +20,29 @@ from conflux_tpu.geometry import (
     choose_cholesky_grid,
 )
 
+
+def __getattr__(name):
+    # lazy top-level API: keep `import conflux_tpu` light (no jax import)
+    _lazy = {
+        "lu_factor_blocked": ("conflux_tpu.lu.single", "lu_factor_blocked"),
+        "lu_distributed_host": ("conflux_tpu.lu.distributed", "lu_distributed_host"),
+        "cholesky_blocked": ("conflux_tpu.cholesky.single", "cholesky_blocked"),
+        "cholesky_distributed_host": (
+            "conflux_tpu.cholesky.distributed", "cholesky_distributed_host"),
+        "solve": ("conflux_tpu.solvers", "solve"),
+        "lu_solve": ("conflux_tpu.solvers", "lu_solve"),
+        "cholesky_solve": ("conflux_tpu.solvers", "cholesky_solve"),
+        "make_mesh": ("conflux_tpu.parallel.mesh", "make_mesh"),
+        "initialize_multihost": ("conflux_tpu.parallel.mesh", "initialize_multihost"),
+    }
+    if name in _lazy:
+        import importlib
+
+        mod, attr = _lazy[name]
+        return getattr(importlib.import_module(mod), attr)
+    raise AttributeError(f"module 'conflux_tpu' has no attribute {name!r}")
+
+
 __version__ = "0.1.0"
 
 __all__ = [
@@ -28,4 +51,13 @@ __all__ = [
     "CholeskyGeometry",
     "choose_grid",
     "choose_cholesky_grid",
+    "lu_factor_blocked",
+    "lu_distributed_host",
+    "cholesky_blocked",
+    "cholesky_distributed_host",
+    "solve",
+    "lu_solve",
+    "cholesky_solve",
+    "make_mesh",
+    "initialize_multihost",
 ]

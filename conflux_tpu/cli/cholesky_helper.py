@@ -44,6 +44,12 @@ def parse_args(argv=None):
     g.add_argument("--out", required=True, help="input matrix path")
     g.add_argument("--result", default=None,
                    help="also write the reference lower factor here (host LAPACK)")
+    g.add_argument("--stream", action="store_true",
+                   help="tile-strip streaming writer: the matrix never exists "
+                   "in RAM (very large N; uses the tile-replicated SPD "
+                   "construction, incompatible with --result)")
+    g.add_argument("--tile", type=int, default=256,
+                   help="strip height for --stream (default 256)")
     add_common_args(g)
 
     c = sub.add_parser("compare", help="relative Frobenius distance of two files")
@@ -66,6 +72,17 @@ def parse_args(argv=None):
 def _generate(args) -> int:
     setup_platform(args)
     dtype = np_dtype(args.dtype)
+    if args.stream:
+        if args.result:
+            raise SystemExit("--stream cannot also write --result "
+                             "(the factor would need the full matrix)")
+        from conflux_tpu.io import generate_spd_file
+
+        generate_spd_file(args.out, args.dim, v=args.tile, seed=args.seed,
+                          dtype=dtype)
+        print(f"wrote {args.out}: SPD {args.dim}x{args.dim} "
+              f"{np.dtype(dtype).name} (streamed)")
+        return 0
     A = make_spd_matrix(args.dim, seed=args.seed, dtype=dtype)
     save_matrix(args.out, A)
     print(f"wrote {args.out}: SPD {args.dim}x{args.dim} {np.dtype(dtype).name}")

@@ -57,6 +57,34 @@ def _read_header(path: str) -> tuple[int, int, np.dtype]:
     return M, N, _DTYPES[code]
 
 
+def generate_spd_file(path: str, N: int, v: int = 256, seed: int = 7,
+                      dtype=np.float64) -> None:
+    """Stream a deterministic SPD matrix to disk one tile-strip at a time.
+
+    The role of the reference's offline `cholesky_helper` generator for very
+    large N (`examples/cholesky_helper.cpp`): the matrix never exists in
+    RAM. Same construction as the in-memory generators (`CholeskyIO.cpp:
+    100-172` scheme): one seeded symmetric v x v tile replicated everywhere
+    plus an N-scaled diagonal boost.
+    """
+    if N % v:
+        raise ValueError(f"N={N} must be a multiple of the tile size {v}")
+    rng = np.random.default_rng(seed)
+    tile = rng.uniform(-1.0, 1.0, size=(v, v)).astype(dtype)
+    sym = ((tile + tile.T) / 2).astype(dtype)
+    strip = np.tile(sym, (1, N // v))  # (v, N), identical for every tile row
+    r = np.arange(v)
+    with open(path, "wb") as f:
+        _write_header(f, N, N, dtype)
+        for ti in range(N // v):
+            # boost this strip's diagonal in place, write, restore the saved
+            # v entries (a strip copy would double peak RAM at very large N)
+            saved = strip[r, ti * v + r].copy()
+            strip[r, ti * v + r] += N
+            strip.tofile(f)
+            strip[r, ti * v + r] = saved
+
+
 def save_matrix(path: str, A: np.ndarray) -> None:
     """Row-major binary dump. Same spirit as the reference's
     `data/output_N.bin` debug dumps."""

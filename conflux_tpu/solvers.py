@@ -82,6 +82,9 @@ def solve(A: jax.Array, b: jax.Array, *, v: int = 256,
     low-precision factors.
     spd: use Cholesky instead of LU (A must be SPD).
     """
+    N = A.shape[0]
+    if N % v:  # largest divisor of N not exceeding the requested tile size
+        v = max(d for d in range(1, min(v, N) + 1) if N % d == 0)
     fdtype = A.dtype if factor_dtype is None else factor_dtype
     Af = A.astype(fdtype)
     if spd:

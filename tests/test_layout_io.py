@@ -99,3 +99,28 @@ def test_read_header_rejects_corrupt_file(tmp_path):
     short.write_bytes(b"\x01\x02")
     with pytest.raises(ValueError):
         load_matrix(str(short))
+
+
+def test_generate_spd_file_streaming(tmp_path):
+    """Streamed SPD file: loadable, SPD, and factorizable; never holds the
+    matrix in RAM during generation."""
+    import numpy as np
+    import scipy.linalg
+
+    from conflux_tpu.io import generate_spd_file, load_matrix
+
+    path = str(tmp_path / "spd.bin")
+    generate_spd_file(path, 64, v=16, seed=3)
+    A = load_matrix(path)
+    assert A.shape == (64, 64)
+    np.testing.assert_allclose(A, A.T)
+    scipy.linalg.cholesky(A, lower=True)  # SPD or raises
+
+
+def test_generate_spd_file_rejects_bad_tile(tmp_path):
+    import pytest
+
+    from conflux_tpu.io import generate_spd_file
+
+    with pytest.raises(ValueError):
+        generate_spd_file(str(tmp_path / "x.bin"), 100, v=16)

@@ -4,7 +4,7 @@ import jax.numpy as jnp
 import numpy as np
 import pytest
 
-from conflux_tpu.solve import cholesky_solve, lu_solve, solve
+from conflux_tpu.solvers import cholesky_solve, lu_solve, solve
 from conflux_tpu.validation import make_spd_matrix, make_test_matrix
 
 
@@ -94,3 +94,21 @@ def test_lu_solve_rejects_rectangular():
         lu_solve(LU, perm, jnp.zeros(32))
     with pytest.raises(ValueError):
         lu_solve(jnp.zeros((32, 32)), jnp.arange(32), jnp.zeros(16))
+
+
+def test_solve_clamps_tile_size():
+    # N=100 is no multiple of the default v: solve picks a divisor
+    N = 100
+    A = make_test_matrix(N, N, seed=9)
+    b = np.ones(N)
+    x = solve(jnp.asarray(A), jnp.asarray(b))
+    assert _relerr(A, x, b) < 1e-10
+
+
+def test_top_level_solve_is_callable_twice():
+    # the lazy package attribute must not be shadowed by the solvers module
+    import conflux_tpu
+
+    for _ in range(2):
+        fn = conflux_tpu.solve
+        assert callable(fn) and not hasattr(fn, "__path__"), fn
