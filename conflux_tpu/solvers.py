@@ -16,11 +16,14 @@ usable with factors from `lu_factor_blocked` / `cholesky_blocked`.
 
 from __future__ import annotations
 
+import functools
+
 import jax
 import jax.numpy as jnp
 from jax import lax
 
 from conflux_tpu.ops import blas
+from conflux_tpu.parallel.mesh import mesh_cache_key
 
 
 def _as_2d(b: jax.Array) -> tuple[jax.Array, bool]:
@@ -62,6 +65,109 @@ def cholesky_solve(L: jax.Array, b: jax.Array) -> jax.Array:
         y = blas.trsm_left_lower(Lc, b2)
         x = blas.trsm_left_lower_t(Lc, y)
     return x[:, 0] if squeeze else x
+
+
+def lu_solve_distributed(shards, pivots, geom, mesh, b) -> jax.Array:
+    """Solve A x = b on the mesh, from `lu_factor_distributed`'s outputs.
+
+    The factors stay value-level and block-cyclic (rows at original
+    positions); the solve is block forward/back substitution in elimination
+    order: per tile-step, the v pivot rows are assembled with a masked psum
+    over 'x' (the same pattern as the factorization's pivot-row reduction),
+    each device dots them against its already-solved column entries, and a
+    psum over 'y' completes the inner products. O(N^2/P) flops over
+    2*n_steps latency-bound steps — triangular solves are sequential by
+    nature; the reference has no distributed solve at all.
+
+    Returns x (N,), replicated.
+    """
+    fn = _build_lu_solve(geom, mesh_cache_key(mesh))
+    return fn(shards, jnp.asarray(pivots, jnp.int32),
+              jnp.asarray(b, jnp.float32 if shards.dtype == jnp.bfloat16
+                          else shards.dtype))
+
+
+@functools.lru_cache(maxsize=16)
+def _build_lu_solve(geom, mesh_key):
+    from jax.sharding import PartitionSpec as P
+
+    from conflux_tpu.parallel.mesh import (
+        AXIS_X, AXIS_Y, AXIS_Z, lookup_mesh,
+    )
+
+    mesh = lookup_mesh(mesh_key)
+    if geom.M != geom.N:
+        raise ValueError("distributed solve needs a square factorization")
+    v, Px, Py = geom.v, geom.grid.Px, geom.grid.Py
+    Ml, Nl, n = geom.Ml, geom.Nl, geom.n_steps
+
+    def device_fn(blk, pivots, b):
+        x_ = lax.axis_index(AXIS_X)
+        y_ = lax.axis_index(AXIS_Y)
+        dtype = blas.compute_dtype(blk.dtype)
+        Aloc = blk[0, 0].astype(dtype)  # z-replicated factors
+        b = b.astype(dtype)
+
+        lr = jnp.arange(Ml, dtype=jnp.int32)
+        gri = ((lr // v) * Px + x_) * v + (lr % v)
+        lc = jnp.arange(Nl, dtype=jnp.int32)
+        gcol = ((lc // v) * Py + y_) * v + (lc % v)
+
+        def pivot_rows(k):
+            """(v, Nl) local columns of step k's pivot rows + (v, v) diag
+            block, both completed by collectives."""
+            k = jnp.asarray(k, jnp.int32)
+            pivk = lax.dynamic_slice(pivots, (k, jnp.zeros((), jnp.int32)),
+                                     (1, v))[0]
+            match = gri[:, None] == pivk[None, :]  # (Ml, v)
+            owned = match.any(axis=0)
+            li = jnp.argmax(match, axis=0)
+            part = jnp.where(owned[:, None], Aloc[li], jnp.zeros((), dtype))
+            rows = lax.psum(part, AXIS_X)  # (v, Nl): my cols of those rows
+            idx = jnp.where((gcol >= k * v) & (gcol < (k + 1) * v),
+                            gcol - k * v, v)
+            diag = jnp.zeros((v, v), dtype).at[:, idx].add(
+                jnp.where(idx[None, :] < v, rows, 0.0), mode="drop"
+            )
+            diag = lax.psum(diag, AXIS_Y)
+            return pivk, rows, diag
+
+        def fwd(k, yv):
+            pivk, rows, diag = pivot_rows(k)
+            solved = gcol < k * v
+            s = jnp.matmul(rows, jnp.where(solved, yv[gcol], 0.0),
+                           precision=lax.Precision.HIGHEST)
+            s = lax.psum(s, AXIS_Y)
+            yk = blas.trsm_left_lower_unit(
+                blas.unit_lower(diag), (b[pivk] - s)[:, None]
+            )[:, 0]
+            return lax.dynamic_update_slice(yv, yk, (k * v,))
+
+        yv = lax.fori_loop(0, n, fwd, jnp.zeros((geom.N,), dtype))
+
+        def bwd(i, xv):
+            k = n - 1 - i
+            pivk, rows, diag = pivot_rows(k)
+            ahead = gcol >= (k + 1) * v
+            s = jnp.matmul(rows, jnp.where(ahead, xv[gcol], 0.0),
+                           precision=lax.Precision.HIGHEST)
+            s = lax.psum(s, AXIS_Y)
+            yk = lax.dynamic_slice(yv, (k * v,), (v,))
+            xk = blas.trsm_left_upper(jnp.triu(diag), (yk - s)[:, None])[:, 0]
+            return lax.dynamic_update_slice(xv, xk, (k * v,))
+
+        xv = lax.fori_loop(0, n, bwd, jnp.zeros((geom.N,), dtype))
+        # replicated by construction (pure collectives); pmax satisfies the
+        # out_spec's replication check
+        return lax.pmax(xv, (AXIS_X, AXIS_Y, AXIS_Z))
+
+    fn = jax.shard_map(
+        device_fn,
+        mesh=mesh,
+        in_specs=(P(AXIS_X, AXIS_Y, None, None), P(), P()),
+        out_specs=P(),
+    )
+    return jax.jit(fn)
 
 
 def solve(A: jax.Array, b: jax.Array, *, v: int = 256,
