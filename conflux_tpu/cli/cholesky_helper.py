@@ -12,7 +12,10 @@ Subcommands:
               write the lower factor — produces the file `compare` consumes
 
 Files use the framework's binary format (`conflux_tpu.io`): int64 header
-(M, N, dtype code) + row-major data.
+(M, N, dtype code) + row-major data. This is NOT the reference helper's raw
+headerless format (dim*dim doubles); feeding such a file here is detected by
+a header/size consistency check and rejected with a clear error — convert by
+prepending the 24-byte header.
 
 Examples:
     python -m conflux_tpu.cli.cholesky_helper generate --dim 4096 \

@@ -41,19 +41,37 @@ _DTYPES = [np.dtype(np.float32), np.dtype(np.float64)]
 
 
 def _write_header(f, M: int, N: int, dtype) -> None:
-    code = _DTYPES.index(np.dtype(dtype))
-    np.array([M, N, code], dtype=np.int64).tofile(f)
+    dtype = np.dtype(dtype)
+    if dtype not in _DTYPES:
+        names = ", ".join(d.name for d in _DTYPES)
+        raise ValueError(
+            f"matrix files store {names} only, got {dtype.name}; "
+            "cast narrow storage dtypes (e.g. bfloat16) to float32 first"
+        )
+    np.array([M, N, _DTYPES.index(dtype)], dtype=np.int64).tofile(f)
 
 
 def _read_header(path: str) -> tuple[int, int, np.dtype]:
+    import os
+
     with open(path, "rb") as f:
         header = np.fromfile(f, dtype=np.int64, count=3)
     if header.size != 3:
         raise ValueError(f"{path!r} is too short to hold a matrix header")
     M, N, code = (int(x) for x in header)
-    if M < 0 or N < 0 or not 0 <= code < len(_DTYPES):
-        raise ValueError(f"{path!r} has an invalid matrix header "
-                         f"(M={M}, N={N}, dtype code={code})")
+    size = os.path.getsize(path)
+    if (M < 0 or N < 0 or not 0 <= code < len(_DTYPES)
+            or size != _HEADER_BYTES + M * N * _DTYPES[code].itemsize):
+        # A raw headerless dump (the reference's cholesky_helper format:
+        # dim*dim doubles, no header) misparses its first doubles as header
+        # fields; the size check catches the rare bit patterns that would
+        # otherwise look valid.
+        raise ValueError(
+            f"{path!r} is not a conflux_tpu matrix file (header reads "
+            f"M={M}, N={N}, dtype code={code}, file size {size}); raw "
+            "headerless dumps (e.g. the reference cholesky_helper format) "
+            "must be converted by prepending the int64 (M, N, dtype) header"
+        )
     return M, N, _DTYPES[code]
 
 

@@ -269,6 +269,12 @@ def tournament_winners(panel: jax.Array, chunk: int | None = None,
     panels break exact partial pivoting too — zero pivots).
     """
     m, v = panel.shape
+    if m < v:
+        raise ValueError(
+            f"tournament_winners needs m >= v, got ({m}, {v}): a shorter "
+            "panel would elect zero-pad rows with out-of-range ids even at "
+            "full rank"
+        )
     c = chunk if chunk is not None else _PANEL_CHUNK
     c = min(c, -(-m // v) * v)  # never taller than the (tile-rounded) panel
     c = max(v, c // v * v)  # multiple of v, at least one tile tall

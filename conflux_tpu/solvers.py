@@ -189,8 +189,24 @@ def solve(A: jax.Array, b: jax.Array, *, v: int = 256,
     spd: use Cholesky instead of LU (A must be SPD).
     """
     N = A.shape[0]
-    if N % v:  # largest divisor of N not exceeding the requested tile size
-        v = max(d for d in range(1, min(v, N) + 1) if N % d == 0)
+    v = min(v, N)
+    pad = (-N) % v
+    if pad:
+        # Pad to the next multiple of v with an identity-extended diagonal
+        # (the same trick LUGeometry.scatter uses): the extra rows/cols are
+        # decoupled unit pivots, so factors and solution are unchanged and
+        # the blocked loops keep a bounded number of supersteps (a divisor
+        # fallback here can degenerate to v=1 for prime N, unrolling N
+        # supersteps at trace time).
+        Np = N + pad
+        Ap = jnp.zeros((Np, Np), A.dtype)
+        Ap = Ap.at[:N, :N].set(A)
+        Ap = Ap.at[jnp.arange(N, Np), jnp.arange(N, Np)].set(1)
+        A = Ap
+        b2, squeezed = _as_2d(b)
+        b = jnp.pad(b2, ((0, pad), (0, 0)))
+        if squeezed:
+            b = b[:, 0]
     fdtype = A.dtype if factor_dtype is None else factor_dtype
     Af = A.astype(fdtype)
     if spd:
@@ -211,4 +227,4 @@ def solve(A: jax.Array, b: jax.Array, *, v: int = 256,
     for _ in range(refine):
         r = bc - jnp.matmul(Ac, x, precision=lax.Precision.HIGHEST)
         x = x + solve_corr(r).astype(cdtype)
-    return x
+    return x[:N] if pad else x

@@ -101,6 +101,32 @@ def test_read_header_rejects_corrupt_file(tmp_path):
         load_matrix(str(short))
 
 
+def test_read_header_rejects_headerless_raw_dump(tmp_path):
+    # reference cholesky_helper format: raw dim*dim doubles, no header —
+    # rejected by the header/file-size consistency check
+    import numpy as np
+    import pytest
+
+    from conflux_tpu.io import load_matrix
+
+    raw = tmp_path / "input_8.bin"
+    np.random.default_rng(0).standard_normal((8, 8)).tofile(str(raw))
+    with pytest.raises(ValueError, match="not a conflux_tpu matrix file"):
+        load_matrix(str(raw))
+
+
+def test_save_matrix_rejects_bfloat16(tmp_path):
+    import jax.numpy as jnp
+    import numpy as np
+    import pytest
+
+    from conflux_tpu.io import save_matrix
+
+    A = np.asarray(jnp.zeros((4, 4), jnp.bfloat16))
+    with pytest.raises(ValueError, match="float32"):
+        save_matrix(str(tmp_path / "m.bin"), A)
+
+
 def test_generate_spd_file_streaming(tmp_path):
     """Streamed SPD file: loadable, SPD, and factorizable; never holds the
     matrix in RAM during generation."""

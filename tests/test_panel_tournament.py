@@ -41,6 +41,15 @@ def test_tournament_single_chunk_matches_partial_pivots():
     assert _panel_residual(panel, lu_t, perm_t) < residual_bound(32, np.float64)
 
 
+def test_tournament_rejects_short_panel():
+    import pytest
+
+    from conflux_tpu.ops import blas
+
+    with pytest.raises(ValueError, match="m >= v"):
+        blas.tournament_winners(jnp.eye(8, 16, dtype=jnp.float32))
+
+
 def test_tournament_picks_large_pivots():
     # a panel whose top chunk is tiny: winners must come from the bottom
     rng = np.random.default_rng(7)

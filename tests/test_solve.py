@@ -97,11 +97,38 @@ def test_lu_solve_rejects_rectangular():
 
 
 def test_solve_clamps_tile_size():
-    # N=100 is no multiple of the default v: solve picks a divisor
+    # N=100 is no multiple of the default v: solve identity-pads to 256
     N = 100
     A = make_test_matrix(N, N, seed=9)
     b = np.ones(N)
     x = solve(jnp.asarray(A), jnp.asarray(b))
+    assert x.shape == (N,)
+    assert _relerr(A, x, b) < 1e-10
+
+
+def test_solve_prime_dim_pads_not_unrolls():
+    # prime N used to fall back to v=1 (N unrolled supersteps at trace
+    # time); identity padding keeps the superstep count bounded
+    N = 211
+    A = make_test_matrix(N, N, seed=12)
+    b = np.ones(N)
+    x = solve(jnp.asarray(A), jnp.asarray(b), v=64)
+    assert x.shape == (N,)
+    assert _relerr(A, x, b) < 1e-10
+    B = np.stack([b, 2 * b], axis=1)
+    X = solve(jnp.asarray(A), jnp.asarray(B), v=64, spd=False)
+    assert X.shape == (N, 2)
+    assert _relerr(A, X[:, 1], 2 * b) < 1e-10
+
+
+def test_solve_prime_dim_spd():
+    from conflux_tpu.validation import make_spd_matrix
+
+    N = 127
+    A = make_spd_matrix(N, seed=3)
+    b = np.ones(N)
+    x = solve(jnp.asarray(A), jnp.asarray(b), v=64, spd=True)
+    assert x.shape == (N,)
     assert _relerr(A, x, b) < 1e-10
 
 
