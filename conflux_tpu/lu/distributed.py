@@ -61,7 +61,8 @@ _GRI_SENTINEL = np.iinfo(np.int32).max
 
 
 @functools.lru_cache(maxsize=32)
-def _build(geom: LUGeometry, mesh_key, precision, backend: str):
+def _build(geom: LUGeometry, mesh_key, precision, backend: str,
+           panel_chunk: int, donate: bool = False):
     mesh = lookup_mesh(mesh_key)
     v = geom.v
     Px, Py, Pz = geom.grid.Px, geom.grid.Py, geom.grid.Pz
@@ -112,13 +113,26 @@ def _build(geom: LUGeometry, mesh_key, precision, backend: str):
                 panel = panel.astype(cdtype)
                 cand = jnp.where(done[:, None], jnp.zeros((), cdtype), panel)
                 gri_m = jnp.where(done, _GRI_SENTINEL, gri)
-                _, _, perm_l = lax.linalg.lu(cand)
-                top = perm_l[:v]
-                blks = lax.all_gather(cand[top], AXIS_X)  # (Px, v, v)
-                gris = lax.all_gather(gri_m[top], AXIS_X)  # (Px, v)
-                lu_f, _, perm_f = lax.linalg.lu(blks.reshape(Px * v, v))
-                gpiv = gris.reshape(Px * v)[perm_f[:v]]  # winners, in pivot order
-                lu00 = lu_f[:v]  # packed L00\U00 of the winners
+                # local nomination: chunked tournament (CALU) — every LU call
+                # is height-bounded by max(panel_chunk, 2v), never the raw
+                # (Ml, v), which overflows the TPU LU custom call's scoped
+                # VMEM once Ml reaches ~16384 (see ops/blas._PANEL_CHUNK)
+                _, top = blas.tournament_winners(cand, chunk=panel_chunk)
+                nom = jnp.take(cand, top, axis=0, mode="fill", fill_value=0)
+                nid = jnp.take(gri_m, top, mode="fill",
+                               fill_value=_GRI_SENTINEL)
+                blks = lax.all_gather(nom, AXIS_X)  # (Px, v, v)
+                gris = lax.all_gather(nid, AXIS_X)  # (Px, v)
+                # election: the same chunked reduction tree over the Px·v
+                # gathered nominees (log-depth stacks of (2v, v) LUs, the
+                # role of the reference butterfly `tournament_rounds`,
+                # conflux_opt.hpp:220-336) — computed identically on every
+                # device, so the result needs no broadcast
+                lu00, wid = blas.tournament_winners(
+                    blks.reshape(Px * v, v), chunk=panel_chunk
+                )
+                gpiv = jnp.take(gris.reshape(Px * v), wid, mode="fill",
+                                fill_value=_GRI_SENTINEL)
                 U00 = jnp.triu(lu00)
                 L00 = blas.unit_lower(lu00)
 
@@ -126,7 +140,6 @@ def _build(geom: LUGeometry, mesh_key, precision, backend: str):
             with jax.named_scope("step2_pivotrows"):
                 match = gri[:, None] == gpiv[None, :]  # (Ml, v)
                 is_piv = match.any(axis=1)
-                piv_pos = jnp.argmax(match, axis=1)  # pivot order of local rows
                 done_new = done | is_piv
 
             # ---- L10 for all still-active rows (ref step 4 TRSM) ---------- #
@@ -172,22 +185,23 @@ def _build(geom: LUGeometry, mesh_key, precision, backend: str):
                         if len(pieces) > 1 else pieces[0])
 
             # ---- factor writes (z==0 carries factors, z!=0 zeroed) -------- #
+            # v-row scatters, not (Ml, Nl) gathers/selects: `U01[piv_pos]`
+            # materializes a full-matrix temp per step, which OOMs HBM at
+            # N=32768 on one chip (2 x 4 GB temps); scattering the v pivot
+            # rows in place costs (v, Nl) instead
             z0 = z == 0
-            # pivot rows' trailing columns become U
-            U01_rows = U01[piv_pos].astype(dtype)  # (Ml, Nl), valid where is_piv
-            U01_rows = jnp.where(z0, U01_rows, jnp.zeros((), dtype))
-            Anew = jnp.where(
-                is_piv[:, None] & col_trail[None, :], U01_rows, Anew
-            )
+            li_safe = jnp.where(owned, li, Ml)  # unowned slots drop
+            cur_rows = jnp.take(Anew, li_safe, axis=0, mode="fill",
+                                fill_value=0)  # (v, Nl)
+            urow = jnp.where(z0, U01.astype(dtype), jnp.zeros((), dtype))
+            new_rows = jnp.where(col_trail[None, :], urow, cur_rows)
+            Anew = Anew.at[li_safe].set(new_rows, mode="drop")
             # panel column: packed LU00 on pivot rows, L10 on active rows,
             # untouched on earlier-done rows
             pcol_cur = lax.dynamic_slice(Anew, (i0, lj), (Ml, v))
-            lu00_rows = lu00[piv_pos].astype(dtype)  # (Ml, v)
-            pcol_new = jnp.where(
-                is_piv[:, None],
-                lu00_rows,
-                jnp.where(done[:, None], pcol_cur, L10.astype(dtype)),
-            )
+            pcol_new = jnp.where(done[:, None], pcol_cur, L10.astype(dtype))
+            pcol_new = pcol_new.at[li_safe].set(lu00.astype(dtype),
+                                                mode="drop")
             pcol_new = jnp.where(z0, pcol_new, jnp.zeros((), dtype))
             Anew = jnp.where(
                 y == j_owner,
@@ -214,25 +228,38 @@ def _build(geom: LUGeometry, mesh_key, precision, backend: str):
         in_specs=P(AXIS_X, AXIS_Y, None, None),
         out_specs=(P(AXIS_X, AXIS_Y, None, None), P()),
     )
-    return jax.jit(fn)
+    return jax.jit(fn, donate_argnums=(0,) if donate else ())
 
 
 
 def lu_factor_distributed(shards, geom: LUGeometry, mesh,
-                          precision=None, backend: str | None = None):
+                          precision=None, backend: str | None = None,
+                          panel_chunk: int | None = None,
+                          donate: bool = False):
     """Factor block-cyclic shards (Px, Py, Ml, Nl) in place on a mesh.
 
     Returns (shards_out, pivots) where pivots is (n_steps, v) global row
-    indices in elimination order.
+    indices in elimination order. `panel_chunk` bounds the height of every
+    LU call inside the pivot election (default: ops/blas's measured TPU
+    VMEM-safe chunk). `donate=True` aliases the input shards into the
+    output (the caller's array is invalidated) — at N=32768 f32 on a 16 GB
+    chip this saves the 4 GB that makes the difference between fitting and
+    OOM.
     """
     precision = blas.matmul_precision() if precision is None else precision
     backend = blas.get_backend() if backend is None else backend
-    fn = _build(geom, mesh_cache_key(mesh), precision, backend)
+    if panel_chunk is None:
+        panel_chunk = blas._PANEL_CHUNK
+    if donate and next(iter(mesh.devices.flat)).platform == "cpu":
+        donate = False  # CPU PJRT has no buffer donation (warns per call)
+    fn = _build(geom, mesh_cache_key(mesh), precision, backend, panel_chunk,
+                donate)
     return fn(shards)
 
 
 def lu_distributed_host(A: np.ndarray, grid: Grid3, v: int, mesh=None,
-                        precision=None, backend: str | None = None):
+                        precision=None, backend: str | None = None,
+                        panel_chunk: int | None = None):
     """Host-level convenience: scatter a global matrix, factor on the mesh,
     gather back. Returns (LU_packed (M, N) in original row order, perm (M,)).
 
@@ -243,8 +270,11 @@ def lu_distributed_host(A: np.ndarray, grid: Grid3, v: int, mesh=None,
     if mesh is None:
         mesh = make_mesh(grid)
     shards = geom.scatter(A)
+    # the device shards are a single-use temp: donate them so the jitted
+    # program aliases input into output (frees a full matrix of HBM)
     out, pivots = lu_factor_distributed(
-        jnp.asarray(shards), geom, mesh, precision=precision, backend=backend
+        jnp.asarray(shards), geom, mesh, precision=precision, backend=backend,
+        panel_chunk=panel_chunk, donate=True,
     )
     LU = geom.gather(np.asarray(out))
     perm = full_permutation(np.asarray(pivots), geom.M)

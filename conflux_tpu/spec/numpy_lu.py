@@ -34,21 +34,85 @@ def _lu_packed(A: np.ndarray):
     return lu, perm
 
 
-def _select_tournament(cand: np.ndarray, gri_m: np.ndarray, Px: int, v: int):
-    """Local LU picks v candidates per x-rank; one stacked LU elects winners."""
-    blocks, gris = [], []
+_ID_SENTINEL = np.iinfo(np.int64).max
+
+
+def _take_fill(a: np.ndarray, idx: np.ndarray, fill):
+    """NumPy mirror of `jnp.take(..., mode='fill')`: out-of-range ids give
+    `fill` instead of clamping (the implementation relies on this to keep
+    tournament pad ids from aliasing real rows)."""
+    out = np.full((len(idx),) + a.shape[1:], fill, dtype=a.dtype)
+    ok = idx < a.shape[0]
+    out[ok] = a[idx[ok]]
+    return out
+
+
+def _tournament_winners_np(panel: np.ndarray, v: int, chunk: int):
+    """NumPy mirror of `ops/blas.tournament_winners`: chunked nomination +
+    binary reduction tree of (2v, v) LUs. Same chunk rounding, same pad-id
+    convention, same return contract (packed winner LU, winner row ids)."""
+    m = panel.shape[0]
+    c = min(chunk, -(-m // v) * v)
+    c = max(v, c // v * v)
+    nch = -(-m // c)
+    mp = nch * c
+    if mp != m:
+        panel = np.pad(panel, ((0, mp - m), (0, 0)))
+    cand = panel.reshape(nch, c, v)
+    cid = np.arange(mp).reshape(nch, c)
+
+    win, wid, lu0 = [], [], None
+    for i in range(nch):
+        lu_c, perm_c = _lu_packed(cand[i])
+        if i == 0:
+            lu0 = lu_c[:v]
+        top = perm_c[:v]
+        win.append(cand[i][top])
+        wid.append(cid[i][top])
+    win, wid = np.stack(win), np.stack(wid)
+
+    n = 1 << (nch - 1).bit_length()
+    if n != nch:
+        win = np.pad(win, ((0, n - nch), (0, 0), (0, 0)))
+        wid = np.pad(wid, ((0, n - nch), (0, 0)), constant_values=mp)
+    if n == 1:
+        return lu0, wid[0]
+
+    lu_top = None
+    while n > 1:
+        stacked = win.reshape(n // 2, 2 * v, v)
+        sid = wid.reshape(n // 2, 2 * v)
+        lus, wins, wids = [], [], []
+        for i in range(n // 2):
+            lu_r, perm_r = _lu_packed(stacked[i])
+            top = perm_r[:v]
+            lus.append(lu_r[:v])
+            wins.append(stacked[i][top])
+            wids.append(sid[i][top])
+        lu_top, win, wid = np.stack(lus), np.stack(wins), np.stack(wids)
+        n //= 2
+    return lu_top[0], wid[0]
+
+
+def _select_tournament(cand: np.ndarray, gri_m: np.ndarray, Px: int, v: int,
+                       chunk: int):
+    """Chunked CALU: per-x-rank chunked nomination, then the same chunked
+    reduction tree elects winners from the Px*v gathered nominees — mirrors
+    the shard_map implementation's step-1 exactly (height-bounded LUs)."""
+    noms, nids = [], []
     for px in range(Px):
-        _, perm_l = _lu_packed(cand[px])
-        top = perm_l[:v]
-        blocks.append(cand[px][top])
-        gris.append(gri_m[px][top])
-    stacked = np.concatenate(blocks, axis=0)
-    sgri = np.concatenate(gris, axis=0)
-    lu_f, perm_f = _lu_packed(stacked)
-    return sgri[perm_f[:v]], lu_f[:v]
+        _, top = _tournament_winners_np(cand[px], v, chunk)
+        noms.append(_take_fill(cand[px], top, 0.0))
+        nids.append(_take_fill(gri_m[px], top, _ID_SENTINEL))
+    stack = np.concatenate(noms, axis=0)
+    sids = np.concatenate(nids, axis=0)
+    lu00, wid = _tournament_winners_np(stack, v, chunk)
+    gpiv = _take_fill(sids, wid, _ID_SENTINEL)
+    return gpiv, lu00
 
 
-def _select_partial(cand: np.ndarray, gri_m: np.ndarray, Px: int, v: int):
+def _select_partial(cand: np.ndarray, gri_m: np.ndarray, Px: int, v: int,
+                    chunk: int):
     """Global partial pivoting: eliminate column by column over the full
     stacked candidate set (the quality oracle the tournament approximates)."""
     stacked = np.concatenate(list(cand), axis=0).copy()
@@ -66,7 +130,8 @@ def _select_partial(cand: np.ndarray, gri_m: np.ndarray, Px: int, v: int):
     return sgri[order[:v]], stacked[:v]
 
 
-def _select_none(cand: np.ndarray, gri_m: np.ndarray, Px: int, v: int):
+def _select_none(cand: np.ndarray, gri_m: np.ndarray, Px: int, v: int,
+                 chunk: int):
     """Take the v lowest-numbered active rows, in global row order."""
     sgri = np.concatenate(list(gri_m), axis=0)
     stacked = np.concatenate(list(cand), axis=0)
@@ -91,12 +156,16 @@ PIVOTING_STRATEGIES = {
 }
 
 
-def simulate_lu(A: np.ndarray, grid: Grid3, v: int, pivoting: str = "tournament"):
+def simulate_lu(A: np.ndarray, grid: Grid3, v: int, pivoting: str = "tournament",
+                panel_chunk: int = 4096):
     """Run the full distributed algorithm on simulated devices.
 
     Returns (LU (M, N) packed factors in original row order, pivots
     (n_steps, v) global rows in elimination order), matching the outputs of
     `conflux_tpu.lu.distributed.lu_factor_distributed` exactly.
+    `panel_chunk` defaults to the implementation's TPU VMEM-safe chunk
+    (`ops/blas._PANEL_CHUNK`); pass the same value used there for
+    buffer-exact cross-validation in the chunked regime.
     """
     select = PIVOTING_STRATEGIES[pivoting]
     geom = LUGeometry.create(A.shape[0], A.shape[1], v, grid)
@@ -126,7 +195,7 @@ def simulate_lu(A: np.ndarray, grid: Grid3, v: int, pivoting: str = "tournament"
         # pivot selection over the x axis                     [collective]
         cand = np.where(done[:, :, None], 0.0, panel)
         gri_m = np.where(done, np.iinfo(np.int64).max, gri)
-        gpiv, lu00 = select(cand, gri_m, Px, v)
+        gpiv, lu00 = select(cand, gri_m, Px, v, panel_chunk)
         pivots[k] = gpiv
         U00 = np.triu(lu00)
         L00 = np.tril(lu00, -1) + np.eye(v)

@@ -58,6 +58,74 @@ def test_lu_distributed_padding():
     assert res < residual_bound(geom.M, np.float64)
 
 
+def test_lu_distributed_chunked_election():
+    """Ml larger than the panel chunk: the local nomination must run the
+    chunked tournament (multiple chunks + reduction tree), and the cross-x
+    election tree must handle Px·v taller than one chunk — the scaling
+    regime the production grids in BASELINE.md hit (Ml = N/Px >> chunk)."""
+    N, v = 128, 8
+    A = make_test_matrix(N, N, seed=31)
+    for grid in (Grid3(2, 2, 1), Grid3(4, 2, 1)):
+        # Ml = N/Px = 64 or 32; chunk=16 forces 4+/2+ chunks locally and a
+        # (Px*v=32 or 16, v) election through the same chunked tree
+        LU, perm, _ = lu_distributed_host(A, grid, v, panel_chunk=16)
+        res = lu_residual(A, LU[perm], perm)
+        assert res < residual_bound(N, np.float64), (grid, res)
+        assert sorted(perm.tolist()) == list(range(N))
+
+
+def test_lu_distributed_election_height_bound():
+    """Structural guarantee: NO lu primitive in the traced distributed
+    program is taller than max(panel_chunk, 2v) — the scoped-VMEM safety
+    contract of the TPU LU custom call (ops/blas.py). This is what the
+    reference's log-depth butterfly provides (`conflux_opt.hpp:220-336`:
+    every factorization is at most 2v rows)."""
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+
+    from conflux_tpu.geometry import LUGeometry
+    from conflux_tpu.lu.distributed import _build
+    from conflux_tpu.parallel.mesh import make_mesh, mesh_cache_key
+
+    grid = Grid3(4, 2, 1)
+    v, chunk = 8, 16
+    geom = LUGeometry.create(256, 256, v, grid)
+    mesh = make_mesh(grid, devices=jax.devices()[: grid.P])
+    fn = _build(geom, mesh_cache_key(mesh), lax.Precision.HIGHEST, "xla",
+                chunk)
+    jaxpr = jax.make_jaxpr(fn)(jnp.zeros((4, 2, geom.Ml, geom.Nl)))
+
+    heights = []
+
+    def walk(jx):
+        for eqn in jx.eqns:
+            if eqn.primitive.name == "lu":
+                heights.append(eqn.invars[0].aval.shape[-2])
+            for p in eqn.params.values():
+                for q in (p if isinstance(p, (list, tuple)) else [p]):
+                    if hasattr(q, "eqns"):
+                        walk(q)
+                    elif hasattr(q, "jaxpr"):
+                        walk(q.jaxpr)
+
+    walk(jaxpr.jaxpr)
+    assert heights, "expected lu primitives in the traced program"
+    assert max(heights) <= max(chunk, 2 * v), heights
+
+
+def test_lu_distributed_chunked_matches_unchunked():
+    """Chunk size changes pivot *order* only within tournament ties; the
+    factorization must stay residual-correct and a pure permutation."""
+    N, v = 64, 8
+    A = make_test_matrix(N, N, seed=41)
+    for chunk in (8, 16, 4096):
+        LU, perm, _ = lu_distributed_host(A, Grid3(2, 1, 1), v,
+                                          panel_chunk=chunk)
+        res = lu_residual(A, LU[perm], perm)
+        assert res < residual_bound(N, np.float64), (chunk, res)
+
+
 def test_lu_distributed_pivots_are_permutation():
     N, v = 64, 8
     A = make_test_matrix(N, N, seed=9)

@@ -44,3 +44,19 @@ def test_spec_matches_shard_map_implementation(grid):
     piv_impl = perm_impl[: piv_spec.size].reshape(piv_spec.shape)
     np.testing.assert_array_equal(piv_spec, piv_impl)
     np.testing.assert_allclose(LU_spec, LU_impl, atol=1e-10)
+
+
+def test_spec_matches_implementation_chunked():
+    """Cross-validation must hold in the *chunked* election regime too
+    (Ml > chunk locally, Px*v > chunk in the election) — the production
+    regime of BASELINE.md's grids."""
+    N, v, chunk = 64, 8, 16
+    A = make_test_matrix(N, N, seed=101)
+    for grid in (Grid3(2, 1, 1), Grid3(2, 2, 1)):
+        LU_spec, piv_spec = simulate_lu(A, grid, v, pivoting="tournament",
+                                        panel_chunk=chunk)
+        LU_impl, perm_impl, _ = lu_distributed_host(A, grid, v,
+                                                    panel_chunk=chunk)
+        piv_impl = perm_impl[: piv_spec.size].reshape(piv_spec.shape)
+        np.testing.assert_array_equal(piv_spec, piv_impl)
+        np.testing.assert_allclose(LU_spec, LU_impl, atol=1e-10)
