@@ -50,10 +50,7 @@ def main(argv=None) -> int:
 
     from conflux_tpu import profiler
     from conflux_tpu.geometry import Grid3, LUGeometry, choose_grid
-    from conflux_tpu.lu.distributed import (
-        full_permutation,
-        lu_factor_distributed,
-    )
+    from conflux_tpu.lu.distributed import lu_factor_distributed
     from conflux_tpu.parallel.mesh import make_mesh
     from conflux_tpu.validation import lu_residual, make_test_matrix
 
@@ -89,7 +86,7 @@ def main(argv=None) -> int:
 
                     out, perm_dev = lu_factor_blocked(dev, v=geom.v)
                 else:
-                    out, pivots = lu_factor_distributed(dev, geom, mesh)
+                    out, perm_dev = lu_factor_distributed(dev, geom, mesh)
                 sync(out)
         if rep > 0:
             times.append(t.ms)
@@ -107,9 +104,10 @@ def main(argv=None) -> int:
                 perm = np.asarray(perm_dev)
                 res = lu_residual(np.asarray(A, np.float64), LU_perm, perm)
             else:
-                LU = geom.gather(np.asarray(out))
-                perm = full_permutation(np.asarray(pivots), geom.M)
-                res = lu_residual(np.asarray(A, np.float64), LU[perm], perm)
+                # factors come back already in pivoted row order
+                LUp = geom.gather(np.asarray(out))
+                perm = np.asarray(perm_dev)
+                res = lu_residual(np.asarray(A, np.float64), LUp, perm)
         print(f"_residual_ {res:.3e}")
 
     if args.profile:

@@ -5,35 +5,43 @@ TPU-native re-design of the reference's `LU_rep` superstep loop
 MPI rank owns block-cyclic tiles, physically compacts pivot rows upward
 (`push_pivots_up`, `conflux_opt.hpp:176-218`), and moves panels with
 Reduce/Iscatterv/Sendrecv. Here the whole factorization is ONE jitted
-`shard_map` program with a `lax.fori_loop` over supersteps; all shapes are
-static, rows never move, and pivoting is *value-level*:
+`shard_map` program with a `lax.fori_loop` over supersteps and static
+shapes throughout:
 
- - "active rows" (reference P6 row compaction) -> a boolean `done` mask;
+ - the matrix lives in *currently-pivoted global row order* (LAPACK getrf
+   layout): after step k, global positions < k*v hold frozen factor rows
+   and positions >= k*v are active. Each step performs LAPACK-style row
+   swaps — elected pivot rows move into the step's diagonal block, the
+   displaced occupants move to the vacated slots — expressed as two
+   (v, Nl) psums plus value-level scatters. This is the TPU answer to the
+   reference's `push_pivots_up` row compaction (P6): because eliminated
+   rows now occupy a tile-aligned *prefix* of every device's local rows,
+   row liveness (like column liveness) is monotone in the local tile
+   index, and the hot ops shrink with k instead of paying full-height
+   masked work every superstep;
  - rotating owner roles (P5) -> `axis_index` comparisons inside the loop;
  - the z-layer 2.5D replication (P3) -> each device holds a *partial sum*
    shard; sum over the z axis is the true matrix. Panel reads are `psum`s
    over ('y','z'); factor writes land on layer z==0 only;
- - tournament pivoting (P4) -> local panel LU selects v candidate rows,
-   `all_gather` over 'x' + one stacked LU elects the winners (the butterfly's
-   fixed point, computed identically on every device so no broadcast of the
-   result is needed);
- - pivot-row reduction + distribution (reference steps 2-3, Igatherv/Isend
-   mesh) -> one `psum` over ('x','z') of a v-row gather;
+ - tournament pivoting (P4) -> chunked CALU nomination per x-rank,
+   `all_gather` over 'x' + the same chunked reduction tree elects winners
+   (every LU call height-bounded by max(chunk, 2v), the role of the
+   reference's log-depth butterfly), computed identically on every device
+   so the result needs no broadcast;
  - the trailing update (step 6) runs on each device's nlayr = v/Pz slab of
    the panel, so z layers share the O(N^2 v) GEMM flops exactly like the
-   reference's 2.5D scheme.
+   reference's 2.5D scheme. The update is cut into row x column segments
+   (ragged, tile-aligned); segments with no live rows or columns are
+   skipped via `lax.cond`, keeping total GEMM/TRSM work near the true
+   2/3 N^3 / P.
 
-Per superstep: 3 collectives (panel psum, candidate all_gather, pivot-row
-psum), two small duplicated factorizations (local panel LU, stacked LU), two
-duplicated v-row TRSMs, and (Ml x nlayr) @ (nlayr x seg) MXU GEMMs over the
-live column segments — the local width is cut into up to 8 segments and
-fully-factored segments are skipped via `lax.cond`, keeping total GEMM work
-near the true 2/3 N^3 / P instead of the 3x a full-width masked update
-would spend.
+Per superstep: 5 collectives (panel psum over (y,z), nominee all_gather
+over x, pivot-row psum over (x,z), displaced-row psum over (x,z), small
+bookkeeping psums), two chunked tournament factorizations, two TRSMs over
+live segments, and the segmented trailing GEMMs.
 
-Factors are stored LAPACK-packed *in original row positions*; `pivots` gives
-the global row index factored at each (step, slot), from which the row
-permutation is reconstructed (see `full_permutation`).
+Factors come back in *pivoted row order* together with `perm` (M,), the
+original row index at each global position: A[perm] == L @ U.
 """
 
 from __future__ import annotations
@@ -59,6 +67,13 @@ from conflux_tpu.parallel.mesh import (
 
 _GRI_SENTINEL = np.iinfo(np.int32).max
 
+# Default nomination chunk. Unlike ops/blas._PANEL_CHUNK (4096, the safe
+# height for *batched* LU custom calls — batch x height shares one scoped
+# VMEM budget), the chunk_live nomination runs each chunk as a separate
+# cond'd call, so a single 8192-row call is VMEM-safe and measured faster
+# (10.5 vs 9.8 TFLOP/s at N=32768/v=1024 on a v5e).
+_DEFAULT_PANEL_CHUNK = 8192
+
 
 @functools.lru_cache(maxsize=32)
 def _build(geom: LUGeometry, mesh_key, precision, backend: str,
@@ -69,158 +84,285 @@ def _build(geom: LUGeometry, mesh_key, precision, backend: str,
     Ml, Nl = geom.Ml, geom.Nl
     nlayr = geom.nlayr
     n_steps = geom.n_steps
+    Mcap = geom.M  # positions are < Mcap; sentinel values exceed it
     v_pad = Pz * nlayr  # inner dim padded so every z layer gets a full slab
-    # trailing-update segmentation: up to 8 ragged segments bound the flop
-    # overshoot at one segment width per superstep
-    seg_bounds = ragged_segments(geom.Ntl, v, 8)
+    # trailing-update segmentation: row and column liveness are both
+    # monotone in local tile index (rows because of the LAPACK-order swaps,
+    # columns because tile lt has global id lt*P + coord), so the live
+    # region is a contiguous (row-suffix x col-suffix) block; ragged
+    # segments + lax.cond skip dead blocks, bounding flop overshoot at one
+    # segment of width/height per superstep
+    col_segs = ragged_segments(geom.Ntl, v, 8)
+    row_segs = ragged_segments(geom.Mtl, v, 4)
 
     def device_fn(blk):
         x = lax.axis_index(AXIS_X)
         y = lax.axis_index(AXIS_Y)
         z = lax.axis_index(AXIS_Z)
         dtype = blk.dtype
+        cdtype = blas.compute_dtype(dtype)
 
         # z-partial invariant: sum over z == true matrix; data enters on z=0
         Aloc = jnp.where(z == 0, blk[0, 0], jnp.zeros((), dtype))
 
         lr = jnp.arange(Ml, dtype=jnp.int32)
-        gri = ((lr // v) * Px + x) * v + (lr % v)  # global row id per local row
+        rtile = (lr // v) * Px + x  # global row-tile at each local row
+        gp = rtile * v + (lr % v)  # global POSITION of each local row
         lc = jnp.arange(Nl, dtype=jnp.int32)
         ctile = (lc // v) * Py + y  # global col-tile id per local col
 
-        done0 = lax.pcast(jnp.zeros((Ml,), bool), (AXIS_X, AXIS_Y, AXIS_Z), to='varying')
-        piv0 = lax.pcast(jnp.zeros((n_steps, v), jnp.int32), (AXIS_X, AXIS_Y, AXIS_Z), to='varying')
+        # original row id currently at each local position (rows start in
+        # original order, so position == original id at step 0)
+        orig0 = gp
+
+        def loc_of(pos):
+            """Local row index of a (v,) vector of global positions; Ml
+            (out of range -> scatter/gather drop) when not owned in x or
+            when the entry is a sentinel."""
+            tile = pos // v
+            owned = (tile % Px == x) & (pos < Mcap)
+            return jnp.where(owned, (tile // Px) * v + pos % v, Ml)
 
         def body(k, carry):
-            Aloc, done, pivrec = carry
+            Aloc, orig = carry
             j_owner = k % Py
-            lj = (k // Py) * v  # local col offset of panel tile on owner
+            lj = ((k // Py) * v).astype(jnp.int32)
+            i_owner = k % Px
+            li = ((k // Px) * v).astype(jnp.int32)
+            i0 = jnp.zeros((), jnp.int32)
+            z0 = z == 0
 
-            # ---- panel: z-reduce + y-broadcast in one psum (ref step 0) --- #
+            # ---- panel: z-reduce + y-broadcast in one psum (ref step 0) -- #
             with jax.named_scope("step0_reduce"):
-                i0 = jnp.zeros((), jnp.int32)
-                lj = lj.astype(jnp.int32)
                 panel_loc = lax.dynamic_slice(Aloc, (i0, lj), (Ml, v))
                 panel = lax.psum(
                     jnp.where(y == j_owner, panel_loc, jnp.zeros((), dtype)),
                     (AXIS_Y, AXIS_Z),
-                )
+                ).astype(cdtype)
 
-            # ---- tournament pivoting over x (ref step 1) ------------------ #
-            # panel math runs in the compute dtype (f32 when storage is bf16)
+            # ---- tournament pivoting over x (ref step 1) ----------------- #
+            # candidates are identified by their global position; the
+            # nomination and the cross-x election both run the chunked CALU
+            # tournament, so every LU call is height-bounded by
+            # max(panel_chunk, 2v) — the reference butterfly's role
+            # (`conflux_opt.hpp:220-336`)
             with jax.named_scope("step1_pivoting"):
-                cdtype = blas.compute_dtype(dtype)
-                panel = panel.astype(cdtype)
-                cand = jnp.where(done[:, None], jnp.zeros((), cdtype), panel)
-                gri_m = jnp.where(done, _GRI_SENTINEL, gri)
-                # local nomination: chunked tournament (CALU) — every LU call
-                # is height-bounded by max(panel_chunk, 2v), never the raw
-                # (Ml, v), which overflows the TPU LU custom call's scoped
-                # VMEM once Ml reaches ~16384 (see ops/blas._PANEL_CHUNK)
-                _, top = blas.tournament_winners(cand, chunk=panel_chunk)
-                nom = jnp.take(cand, top, axis=0, mode="fill", fill_value=0)
-                nid = jnp.take(gri_m, top, mode="fill",
-                               fill_value=_GRI_SENTINEL)
-                blks = lax.all_gather(nom, AXIS_X)  # (Px, v, v)
-                gris = lax.all_gather(nid, AXIS_X)  # (Px, v)
-                # election: the same chunked reduction tree over the Px·v
-                # gathered nominees (log-depth stacks of (2v, v) LUs, the
-                # role of the reference butterfly `tournament_rounds`,
-                # conflux_opt.hpp:220-336) — computed identically on every
-                # device, so the result needs no broadcast
-                lu00, wid = blas.tournament_winners(
-                    blks.reshape(Px * v, v), chunk=panel_chunk
-                )
-                gpiv = jnp.take(gris.reshape(Px * v), wid, mode="fill",
-                                fill_value=_GRI_SENTINEL)
+                live = gp >= k * v
+                cand = jnp.where(live[:, None], panel, jnp.zeros((), cdtype))
+                pos_m = jnp.where(live, gp, _GRI_SENTINEL)
+                # dead rows form a tile-aligned prefix (LAPACK-order
+                # layout), so whole chunks die as k advances: a chunk is
+                # live iff its last row's position is still active
+                c_h, nch = blas.chunk_layout(Ml, v, panel_chunk)
+                chunk_live = jnp.stack([
+                    gp[min((i + 1) * c_h, Ml) - 1] >= k * v
+                    for i in range(nch)
+                ])
+                if Px == 1:
+                    # single x-rank: the local nomination IS the election
+                    lu00, top = blas.tournament_winners(
+                        cand, chunk=panel_chunk, chunk_live=chunk_live)
+                    wpos = jnp.take(pos_m, top, mode="fill",
+                                    fill_value=_GRI_SENTINEL)
+                else:
+                    _, top = blas.tournament_winners(
+                        cand, chunk=panel_chunk, chunk_live=chunk_live)
+                    nom = jnp.take(cand, top, axis=0, mode="fill",
+                                   fill_value=0)
+                    nid = jnp.take(pos_m, top, mode="fill",
+                                   fill_value=_GRI_SENTINEL)
+                    blks = lax.all_gather(nom, AXIS_X)  # (Px, v, v)
+                    poss = lax.all_gather(nid, AXIS_X)  # (Px, v)
+                    flat = blks.reshape(Px * v, v)
+                    # the election tournament is batched (no liveness
+                    # structure), so its chunk stays within the batched
+                    # VMEM-safe bound
+                    lu00, wid = blas.tournament_winners(
+                        flat, chunk=min(panel_chunk, blas._PANEL_CHUNK))
+                    # winners' positions in pivot order — replicated on
+                    # every device, no broadcast needed
+                    wpos = jnp.take(poss.reshape(Px * v), wid, mode="fill",
+                                    fill_value=_GRI_SENTINEL)
                 U00 = jnp.triu(lu00)
                 L00 = blas.unit_lower(lu00)
 
-            # ---- pivot masks (ref g2lnoTile/analyze_pivots) --------------- #
+            # ---- LAPACK-style row swaps (ref push_pivots_up, step 2) ----- #
+            # winners move into the step's diagonal block (positions
+            # k*v..(k+1)*v); the non-winner occupants move to the slots
+            # vacated by external winners (i-th displaced occupant -> i-th
+            # vacated position, both ascending — a canonical matching)
             with jax.named_scope("step2_pivotrows"):
-                match = gri[:, None] == gpiv[None, :]  # (Ml, v)
-                is_piv = match.any(axis=1)
-                done_new = done | is_piv
+                slots = k * v + jnp.arange(v, dtype=jnp.int32)
+                occ_is_winner = (wpos[None, :] == slots[:, None]).any(1)
+                is_ext = wpos >= (k + 1) * v
+                # ascending order of the external winners' positions by
+                # comparison ranking — a (v, v) compare + tiny scatter; a
+                # jnp.sort here costs ~13 ms/step on TPU (bitonic)
+                both = is_ext[None, :] & is_ext[:, None]
+                rank = jnp.sum(both & (wpos[None, :] < wpos[:, None]),
+                               axis=1).astype(jnp.int32)
+                ext_sorted = jnp.full((v,), _GRI_SENTINEL, jnp.int32).at[
+                    jnp.where(is_ext, rank, v)
+                ].set(wpos, mode="drop")
+                disp_rank = jnp.cumsum((~occ_is_winner).astype(jnp.int32)) - 1
+                dest_disp = jnp.where(~occ_is_winner, ext_sorted[disp_rank],
+                                      _GRI_SENTINEL)
 
-            # ---- L10 for all still-active rows (ref step 4 TRSM) ---------- #
+                # winners' full rows + ids, reduced over (x, z) (ref step 3)
+                wloc = loc_of(wpos)
+                Prows = lax.psum(
+                    jnp.take(Aloc, wloc, axis=0, mode="fill", fill_value=0),
+                    (AXIS_X, AXIS_Z))  # (v, Nl)
+                worig = lax.psum(
+                    jnp.take(orig, wloc, mode="fill", fill_value=0), AXIS_X)
+                # displaced occupants' full rows + ids + panel rows
+                own_d = x == i_owner
+                Drows = lax.psum(
+                    jnp.where(own_d,
+                              lax.dynamic_slice(Aloc, (li, i0), (v, Nl)),
+                              jnp.zeros((), dtype)),
+                    (AXIS_X, AXIS_Z))  # (v, Nl)
+                dorig = lax.psum(
+                    jnp.where(own_d, lax.dynamic_slice(orig, (li,), (v,)), 0),
+                    AXIS_X)
+                diag_panel = lax.psum(
+                    jnp.where(own_d,
+                              lax.dynamic_slice(panel, (li, i0), (v, v)),
+                              jnp.zeros((), cdtype)),
+                    AXIS_X)  # (v, v)
+
+                # swap writes: vacated positions get the displaced rows now
+                # (they stay active and take the trailing update); diagonal
+                # rows are fully rewritten after the GEMM. Swapped rows
+                # carry their z-summed value on layer 0, zeros elsewhere.
+                # The diagonal block is one contiguous local tile on its
+                # x-owner, so its writes are masked dynamic_update_slices —
+                # a (v,)-index row scatter lowers to a serial per-row loop
+                # on TPU (~10 ms/step at v=1024), the DUS does not.
+                didx = loc_of(dest_disp)
+                Aloc = Aloc.at[didx].set(
+                    jnp.where(z0, Drows.astype(dtype), jnp.zeros((), dtype)),
+                    mode="drop")
+                orig = jnp.where(
+                    own_d, lax.dynamic_update_slice(orig, worig, (li,)), orig)
+                orig = orig.at[didx].set(dorig, mode="drop")
+                # the panel after the swap, for the L10 solve. Only the
+                # displaced rows matter: the diagonal rows (winners) are
+                # masked out of the TRSM by row_live, so their panel values
+                # are never written back here.
+                panel_post = panel.at[didx].set(diag_panel, mode="drop")
+
+            # ---- L10 for the live row suffix (ref step 4 TRSM) ----------- #
+            row_live = rtile > k  # whole tiles: diag tile k is done now
             with jax.named_scope("step4_dtrsm"):
-                act_panel = jnp.where(done_new[:, None], jnp.zeros((), cdtype), panel)
-                L10 = blas.trsm_right_upper(U00, act_panel)  # (Ml, v)
+                pieces = []
+                for rlo, rhi in row_segs:
+                    rm = row_live[rlo:rhi]
+                    pieces.append(lax.cond(
+                        rm.any(),
+                        lambda p, m: blas.trsm_right_upper(
+                            U00, jnp.where(m[:, None], p,
+                                           jnp.zeros((), cdtype))),
+                        lambda p, m: jnp.zeros_like(p),
+                        panel_post[rlo:rhi], rm,
+                    ))
+                L10 = (jnp.concatenate(pieces, axis=0)
+                       if len(pieces) > 1 else pieces[0])  # (Ml, v)
 
-            # ---- pivot rows: gather + reduce over (x, z) (ref steps 2-3) -- #
-            with jax.named_scope("step3_distribute"):
-                owned = match.any(axis=0)  # (v,) is pivot q local?
-                li = jnp.argmax(match, axis=0)  # (v,) its local row
-                prow_part = jnp.where(owned[:, None], Aloc[li], jnp.zeros((), dtype))
-                Prows = lax.psum(prow_part, (AXIS_X, AXIS_Z))  # (v, Nl)
+            # ---- U01 on the live column suffix (ref step 5 TRSM) --------- #
+            col_trail = ctile > k  # (Nl,)
+            Prows_c = Prows.astype(cdtype)
             with jax.named_scope("step5_dtrsm"):
-                U01 = blas.trsm_left_lower_unit(L00, Prows.astype(cdtype))  # ref step 5
+                pieces = []
+                for clo, chi in col_segs:
+                    cm = col_trail[clo:chi]
+                    pieces.append(lax.cond(
+                        cm.any(),
+                        lambda p: blas.trsm_left_lower_unit(L00, p),
+                        # pcast matches the solve branch's varying axes
+                        # (L00 varies over x) for the cond output type
+                        lambda p: lax.pcast(p, AXIS_X, to="varying"),
+                        Prows_c[:, clo:chi],
+                    ))
+                U01 = (jnp.concatenate(pieces, axis=1)
+                       if len(pieces) > 1 else pieces[0])  # (v, Nl)
 
-            # ---- trailing update on this layer's slab (ref step 6) -------- #
-            # GEMM rides the storage dtype (bf16 fast path when selected)
+            # ---- trailing update on this layer's slab (ref step 6) ------- #
+            # GEMM rides the storage dtype (bf16 fast path when selected);
+            # the (row-suffix x col-suffix) live block is covered by
+            # row x col segments, dead blocks skipped via lax.cond
             L10p = jnp.pad(L10.astype(dtype), ((0, 0), (0, v_pad - v)))
             U01p = jnp.pad(U01.astype(dtype), ((0, v_pad - v), (0, 0)))
-            L10s = lax.dynamic_slice(L10p, (i0, (z * nlayr).astype(jnp.int32)), (Ml, nlayr))
-            U01s = lax.dynamic_slice(U01p, ((z * nlayr).astype(jnp.int32), i0), (nlayr, Nl))
-            col_trail = ctile > k  # (Nl,)
-            # Static shapes force a full-local-width GEMM every superstep,
-            # which would spend 3x the optimal 2/3 N^3/P flops. Local column
-            # tiles finish in ascending local order (tile lt has global tile
-            # id lt*Py + y), so the live region is a contiguous suffix: cut
-            # the width into segments and skip fully-finished ones with
-            # lax.cond — flop waste drops to <= segw extra columns per step.
-            def seg_update(a_seg, u_seg, m_seg):
-                upd = blas.gemm(L10s, u_seg, precision=precision, backend=backend)
-                return a_seg - jnp.where(m_seg[None, :], upd, jnp.zeros((), dtype))
+            zoff = (z * nlayr).astype(jnp.int32)
+            L10s = lax.dynamic_slice(L10p, (i0, zoff), (Ml, nlayr))
+            U01s = lax.dynamic_slice(U01p, (zoff, i0), (nlayr, Nl))
 
             with jax.named_scope("step6_dgemm"):
-                pieces = []
-                for lo, hi in seg_bounds:
-                    sl = slice(lo, hi)
-                    pieces.append(lax.cond(
-                        col_trail[sl].any(), seg_update, lambda a, u, mm: a,
-                        Aloc[:, sl], U01s[:, sl], col_trail[sl],
-                    ))
-                Anew = (jnp.concatenate(pieces, axis=1)
-                        if len(pieces) > 1 else pieces[0])
+                # in-place cond'd DUS per live segment: a slice->concat
+                # formulation materializes the full local matrix every step
+                # (~26 ms/step of pure copies at N=32768)
+                Anew = Aloc
+                for rlo, rhi in row_segs:
+                    rm = row_live[rlo:rhi]
+                    for clo, chi in col_segs:
+                        cm = col_trail[clo:chi]
 
-            # ---- factor writes (z==0 carries factors, z!=0 zeroed) -------- #
-            # v-row scatters, not (Ml, Nl) gathers/selects: `U01[piv_pos]`
-            # materializes a full-matrix temp per step, which OOMs HBM at
-            # N=32768 on one chip (2 x 4 GB temps); scattering the v pivot
-            # rows in place costs (v, Nl) instead
-            z0 = z == 0
-            li_safe = jnp.where(owned, li, Ml)  # unowned slots drop
-            cur_rows = jnp.take(Anew, li_safe, axis=0, mode="fill",
-                                fill_value=0)  # (v, Nl)
-            urow = jnp.where(z0, U01.astype(dtype), jnp.zeros((), dtype))
-            new_rows = jnp.where(col_trail[None, :], urow, cur_rows)
-            Anew = Anew.at[li_safe].set(new_rows, mode="drop")
-            # panel column: packed LU00 on pivot rows, L10 on active rows,
-            # untouched on earlier-done rows
+                        def seg_update(A, rlo=rlo, rhi=rhi, clo=clo, chi=chi,
+                                       rm=rm, cm=cm):
+                            a_seg = lax.slice(A, (rlo, clo), (rhi, chi))
+                            upd = blas.gemm(
+                                L10s[rlo:rhi], U01s[:, clo:chi],
+                                precision=precision, backend=backend)
+                            keep = rm[:, None] & cm[None, :]
+                            new = a_seg - jnp.where(keep, upd,
+                                                    jnp.zeros((), dtype))
+                            return lax.dynamic_update_slice(A, new,
+                                                            (rlo, clo))
+
+                        Anew = lax.cond(rm.any() & cm.any(), seg_update,
+                                        lambda A: A, Anew)
+
+            # ---- factor writes (z==0 carries factors, z!=0 zeroed) ------- #
+            # diagonal block rows: leading columns keep the winners' frozen
+            # L prefix (they ride along in Prows), trailing columns take
+            # U01; the panel tile itself is overwritten with packed lu00 by
+            # the panel-column write below
+            drow_vals = jnp.where(col_trail[None, :], U01.astype(dtype),
+                                  Prows.astype(dtype))
+            Anew = jnp.where(
+                own_d,
+                lax.dynamic_update_slice(
+                    Anew, jnp.where(z0, drow_vals, jnp.zeros((), dtype)),
+                    (li, i0)),
+                Anew)
+            # panel column: packed LU00 on the diagonal rows, L10 on live
+            # rows, untouched on frozen rows; zeroed on z != 0 layers
             pcol_cur = lax.dynamic_slice(Anew, (i0, lj), (Ml, v))
-            pcol_new = jnp.where(done[:, None], pcol_cur, L10.astype(dtype))
-            pcol_new = pcol_new.at[li_safe].set(lu00.astype(dtype),
-                                                mode="drop")
+            pcol_new = jnp.where(row_live[:, None], L10.astype(dtype),
+                                 pcol_cur)
+            pcol_new = jnp.where(
+                own_d,
+                lax.dynamic_update_slice(pcol_new, lu00.astype(dtype),
+                                         (li, i0)),
+                pcol_new)
             pcol_new = jnp.where(z0, pcol_new, jnp.zeros((), dtype))
             Anew = jnp.where(
                 y == j_owner,
                 lax.dynamic_update_slice(Anew, pcol_new, (i0, lj)),
                 Anew,
             )
+            return Anew, orig
 
-            pivrec = lax.dynamic_update_slice(
-                pivrec, gpiv.astype(jnp.int32)[None], (jnp.asarray(k, jnp.int32), i0)
-            )
-            return Anew, done_new, pivrec
-
-        Aloc, done, pivrec = lax.fori_loop(0, n_steps, body, (Aloc, done0, piv0))
+        Aloc, orig = lax.fori_loop(0, n_steps, body, (Aloc, orig0))
         # all factors live on layer 0; psum makes the output z-replicated
         Aout = lax.psum(Aloc, AXIS_Z)
-        # pivrec is numerically identical on every device (it comes from
-        # collectives); pmax re-establishes replication for the out_spec
-        pivrec = lax.pmax(pivrec, (AXIS_X, AXIS_Y, AXIS_Z))
-        return Aout[None, None], pivrec
+        # assemble the permutation: original row id at every global position
+        perm = jnp.zeros((Mcap,), jnp.int32).at[gp].set(orig)
+        perm = lax.psum(perm, AXIS_X)
+        # identical on every device already; pmax re-establishes replication
+        # for the out_spec
+        perm = lax.pmax(perm, (AXIS_Y, AXIS_Z))
+        return Aout[None, None], perm
 
     fn = jax.shard_map(
         device_fn,
@@ -238,18 +380,25 @@ def lu_factor_distributed(shards, geom: LUGeometry, mesh,
                           donate: bool = False):
     """Factor block-cyclic shards (Px, Py, Ml, Nl) in place on a mesh.
 
-    Returns (shards_out, pivots) where pivots is (n_steps, v) global row
-    indices in elimination order. `panel_chunk` bounds the height of every
-    LU call inside the pivot election (default: ops/blas's measured TPU
-    VMEM-safe chunk). `donate=True` aliases the input shards into the
-    output (the caller's array is invalidated) — at N=32768 f32 on a 16 GB
-    chip this saves the 4 GB that makes the difference between fitting and
-    OOM.
+    Returns (shards_out, perm): shards_out holds the packed factors in
+    *pivoted row order* (LAPACK getrf layout — global position p holds the
+    factor row of original row perm[p], so gathered(shards_out) == the
+    packed LU of A[perm]); perm is (M,) int32, replicated. Rows eliminated
+    at step k occupy positions k*v..(k+1)*v, so perm[:n_steps*v] reshaped
+    to (n_steps, v) is the elimination record (the old `pivots` output).
+
+    `panel_chunk` bounds the height of every LU call inside the pivot
+    election (default: `_DEFAULT_PANEL_CHUNK` — 8192, safe for the
+    unbatched cond'd nomination calls; the batched election stack is
+    additionally capped at ops/blas._PANEL_CHUNK).
+    `donate=True` aliases the input shards into the output (the caller's
+    array is invalidated) — at N=32768 f32 on a 16 GB chip this saves the
+    4 GB that makes the difference between fitting and OOM.
     """
     precision = blas.matmul_precision() if precision is None else precision
     backend = blas.get_backend() if backend is None else backend
     if panel_chunk is None:
-        panel_chunk = blas._PANEL_CHUNK
+        panel_chunk = _DEFAULT_PANEL_CHUNK
     if donate and next(iter(mesh.devices.flat)).platform == "cpu":
         donate = False  # CPU PJRT has no buffer donation (warns per call)
     fn = _build(geom, mesh_cache_key(mesh), precision, backend, panel_chunk,
@@ -272,12 +421,14 @@ def lu_distributed_host(A: np.ndarray, grid: Grid3, v: int, mesh=None,
     shards = geom.scatter(A)
     # the device shards are a single-use temp: donate them so the jitted
     # program aliases input into output (frees a full matrix of HBM)
-    out, pivots = lu_factor_distributed(
+    out, perm = lu_factor_distributed(
         jnp.asarray(shards), geom, mesh, precision=precision, backend=backend,
         panel_chunk=panel_chunk, donate=True,
     )
-    LU = geom.gather(np.asarray(out))
-    perm = full_permutation(np.asarray(pivots), geom.M)
+    perm = np.asarray(perm)
+    LUp = geom.gather(np.asarray(out))  # factors in pivoted order
+    LU = np.empty_like(LUp)
+    LU[perm] = LUp  # back to original row order (LU[perm] == L@U packing)
     return LU, perm, geom
 
 

@@ -251,8 +251,18 @@ def _resolve_panel_algo(dtype, m: int, v: int, algo: str) -> str:
     return algo
 
 
+def chunk_layout(m: int, v: int, chunk: int | None = None) -> tuple[int, int]:
+    """(chunk height c, chunk count nch) used by :func:`tournament_winners`
+    for an (m, v) panel — exposed so callers can build per-chunk liveness
+    predicates with the same rounding."""
+    c = chunk if chunk is not None else _PANEL_CHUNK
+    c = min(c, -(-m // v) * v)  # never taller than the (tile-rounded) panel
+    c = max(v, c // v * v)  # multiple of v, at least one tile tall
+    return c, -(-m // c)
+
+
 def tournament_winners(panel: jax.Array, chunk: int | None = None,
-                       use_pallas: bool = False):
+                       use_pallas: bool = False, chunk_live=None):
     """Elect v pivot rows of an (m, v) panel by tournament (CALU).
 
     Single-device analogue of the reference's butterfly tournament
@@ -261,6 +271,15 @@ def tournament_winners(panel: jax.Array, chunk: int | None = None,
     and a binary reduction tree of stacked (2v, v) LUs elects the winners.
     All LU calls are height-bounded (chunk or 2v rows) and the chunk round
     is batched, so this scales to arbitrarily tall panels.
+
+    `chunk_live`, if given, is a (nch,)-shaped traced bool vector (see
+    :func:`chunk_layout`): chunk i's LU is skipped via `lax.cond` when
+    chunk_live[i] is False, nominating zero rows instead (which lose every
+    contest). Callers whose dead rows form a prefix (the distributed LU's
+    LAPACK-order layout) use this to shrink the election with the active
+    window. With chunk_live, lu00 is only meaningful if the winners went
+    through a live path (guaranteed when any live row exists and nch == 1,
+    or via the reduction tree when nch > 1).
 
     Returns (lu00, gpiv): lu00 is the packed (v, v) LU of the winning rows in
     pivot order; gpiv gives their row indices in `panel`. Requires the panel
@@ -275,10 +294,7 @@ def tournament_winners(panel: jax.Array, chunk: int | None = None,
             "panel would elect zero-pad rows with out-of-range ids even at "
             "full rank"
         )
-    c = chunk if chunk is not None else _PANEL_CHUNK
-    c = min(c, -(-m // v) * v)  # never taller than the (tile-rounded) panel
-    c = max(v, c // v * v)  # multiple of v, at least one tile tall
-    nch = -(-m // c)
+    c, nch = chunk_layout(m, v, chunk)
     mp = nch * c
     if mp != m:  # zero rows lose every pivot contest against real rows
         panel = jnp.pad(panel, ((0, mp - m), (0, 0)))
@@ -288,6 +304,22 @@ def tournament_winners(panel: jax.Array, chunk: int | None = None,
     cid = ids.reshape(nch, c)
     if use_pallas and _pallas_panel_ok(panel.dtype, c, v):
         outs = [panel_lu_pallas(cand[i]) for i in range(nch)]
+        perm_c = jnp.stack([o[1] for o in outs])
+        lu0 = outs[0][0][:v]
+    elif chunk_live is not None:
+
+        def chunk_lu(ci):
+            lu_i, _, perm_i = lax.linalg.lu(ci)
+            return lu_i, perm_i
+
+        def chunk_dead(ci):
+            # zero nominees (lose every contest); identity order
+            perm_i = jnp.arange(c, dtype=jnp.int32) + jnp.zeros_like(
+                ci[:, 0], jnp.int32)
+            return jnp.zeros_like(ci), perm_i
+
+        outs = [lax.cond(chunk_live[i], chunk_lu, chunk_dead, cand[i])
+                for i in range(nch)]
         perm_c = jnp.stack([o[1] for o in outs])
         lu0 = outs[0][0][:v]
     else:

@@ -67,22 +67,21 @@ def cholesky_solve(L: jax.Array, b: jax.Array) -> jax.Array:
     return x[:, 0] if squeeze else x
 
 
-def lu_solve_distributed(shards, pivots, geom, mesh, b) -> jax.Array:
+def lu_solve_distributed(shards, perm, geom, mesh, b) -> jax.Array:
     """Solve A x = b on the mesh, from `lu_factor_distributed`'s outputs.
 
-    The factors stay value-level and block-cyclic (rows at original
-    positions); the solve is block forward/back substitution in elimination
-    order: per tile-step, the v pivot rows are assembled with a masked psum
-    over 'x' (the same pattern as the factorization's pivot-row reduction),
-    each device dots them against its already-solved column entries, and a
-    psum over 'y' completes the inner products. O(N^2/P) flops over
-    2*n_steps latency-bound steps — triangular solves are sequential by
-    nature; the reference has no distributed solve at all.
+    The factors are block-cyclic in *pivoted row order* (LAPACK layout), so
+    the solve is plain block forward/back substitution over tile steps: per
+    step, the diagonal tile's v rows are assembled with one masked psum
+    over 'x', each device dots them against its already-solved column
+    entries, and a psum over 'y' completes the inner products. O(N^2/P)
+    flops over 2*n_steps latency-bound steps — triangular solves are
+    sequential by nature; the reference has no distributed solve at all.
 
     Returns x (N,), replicated.
     """
     fn = _build_lu_solve(geom, mesh_cache_key(mesh))
-    return fn(shards, jnp.asarray(pivots, jnp.int32),
+    return fn(shards, jnp.asarray(perm, jnp.int32),
               jnp.asarray(b, jnp.float32 if shards.dtype == jnp.bfloat16
                           else shards.dtype))
 
@@ -101,28 +100,25 @@ def _build_lu_solve(geom, mesh_key):
     v, Px, Py = geom.v, geom.grid.Px, geom.grid.Py
     Ml, Nl, n = geom.Ml, geom.Nl, geom.n_steps
 
-    def device_fn(blk, pivots, b):
+    def device_fn(blk, perm, b):
         x_ = lax.axis_index(AXIS_X)
         y_ = lax.axis_index(AXIS_Y)
         dtype = blas.compute_dtype(blk.dtype)
-        Aloc = blk[0, 0].astype(dtype)  # z-replicated factors
-        b = b.astype(dtype)
+        Aloc = blk[0, 0].astype(dtype)  # z-replicated factors, pivoted order
+        bp = b.astype(dtype)[perm]  # rhs in pivoted row order
 
-        lr = jnp.arange(Ml, dtype=jnp.int32)
-        gri = ((lr // v) * Px + x_) * v + (lr % v)
         lc = jnp.arange(Nl, dtype=jnp.int32)
         gcol = ((lc // v) * Py + y_) * v + (lc % v)
 
-        def pivot_rows(k):
-            """(v, Nl) local columns of step k's pivot rows + (v, v) diag
-            block, both completed by collectives."""
-            k = jnp.asarray(k, jnp.int32)
-            pivk = lax.dynamic_slice(pivots, (k, jnp.zeros((), jnp.int32)),
-                                     (1, v))[0]
-            match = gri[:, None] == pivk[None, :]  # (Ml, v)
-            owned = match.any(axis=0)
-            li = jnp.argmax(match, axis=0)
-            part = jnp.where(owned[:, None], Aloc[li], jnp.zeros((), dtype))
+        def diag_tile_rows(k):
+            """(v, Nl) local columns of step k's diagonal-tile rows + the
+            (v, v) diagonal block, both completed by collectives."""
+            li = ((k // Px) * v).astype(jnp.int32)
+            part = jnp.where(
+                x_ == k % Px,
+                lax.dynamic_slice(Aloc, (li, jnp.zeros((), jnp.int32)),
+                                  (v, Nl)),
+                jnp.zeros((), dtype))
             rows = lax.psum(part, AXIS_X)  # (v, Nl): my cols of those rows
             idx = jnp.where((gcol >= k * v) & (gcol < (k + 1) * v),
                             gcol - k * v, v)
@@ -130,16 +126,17 @@ def _build_lu_solve(geom, mesh_key):
                 jnp.where(idx[None, :] < v, rows, 0.0), mode="drop"
             )
             diag = lax.psum(diag, AXIS_Y)
-            return pivk, rows, diag
+            return rows, diag
 
         def fwd(k, yv):
-            pivk, rows, diag = pivot_rows(k)
+            rows, diag = diag_tile_rows(k)
             solved = gcol < k * v
             s = jnp.matmul(rows, jnp.where(solved, yv[gcol], 0.0),
                            precision=lax.Precision.HIGHEST)
             s = lax.psum(s, AXIS_Y)
+            bk = lax.dynamic_slice(bp, (k * v,), (v,))
             yk = blas.trsm_left_lower_unit(
-                blas.unit_lower(diag), (b[pivk] - s)[:, None]
+                blas.unit_lower(diag), (bk - s)[:, None]
             )[:, 0]
             return lax.dynamic_update_slice(yv, yk, (k * v,))
 
@@ -147,7 +144,7 @@ def _build_lu_solve(geom, mesh_key):
 
         def bwd(i, xv):
             k = n - 1 - i
-            pivk, rows, diag = pivot_rows(k)
+            rows, diag = diag_tile_rows(k)
             ahead = gcol >= (k + 1) * v
             s = jnp.matmul(rows, jnp.where(ahead, xv[gcol], 0.0),
                            precision=lax.Precision.HIGHEST)

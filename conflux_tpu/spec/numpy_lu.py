@@ -98,7 +98,13 @@ def _select_tournament(cand: np.ndarray, gri_m: np.ndarray, Px: int, v: int,
                        chunk: int):
     """Chunked CALU: per-x-rank chunked nomination, then the same chunked
     reduction tree elects winners from the Px*v gathered nominees — mirrors
-    the shard_map implementation's step-1 exactly (height-bounded LUs)."""
+    the shard_map implementation's step-1 exactly (height-bounded LUs).
+    With a single x-rank the nomination IS the election (the implementation
+    skips the second tournament; so does the spec, keeping pivot order
+    identical)."""
+    if Px == 1:
+        lu00, top = _tournament_winners_np(cand[0], v, chunk)
+        return _take_fill(gri_m[0], top, _ID_SENTINEL), lu00
     noms, nids = [], []
     for px in range(Px):
         _, top = _tournament_winners_np(cand[px], v, chunk)
@@ -106,7 +112,12 @@ def _select_tournament(cand: np.ndarray, gri_m: np.ndarray, Px: int, v: int,
         nids.append(_take_fill(gri_m[px], top, _ID_SENTINEL))
     stack = np.concatenate(noms, axis=0)
     sids = np.concatenate(nids, axis=0)
-    lu00, wid = _tournament_winners_np(stack, v, chunk)
+    # the implementation's election tournament is batched, so its chunk is
+    # capped at the batched VMEM-safe bound; the constant is imported (not
+    # duplicated) so retuning it cannot desynchronize spec and impl
+    from conflux_tpu.ops.blas import _PANEL_CHUNK
+
+    lu00, wid = _tournament_winners_np(stack, v, min(chunk, _PANEL_CHUNK))
     gpiv = _take_fill(sids, wid, _ID_SENTINEL)
     return gpiv, lu00
 
@@ -157,16 +168,27 @@ PIVOTING_STRATEGIES = {
 
 
 def simulate_lu(A: np.ndarray, grid: Grid3, v: int, pivoting: str = "tournament",
-                panel_chunk: int = 4096):
+                panel_chunk: int | None = None):
     """Run the full distributed algorithm on simulated devices.
 
+    Mirrors the implementation's LAPACK-order layout: rows live at their
+    *currently-pivoted global position*; each step swaps the elected pivot
+    rows into the step's diagonal block and the displaced occupants into
+    the vacated slots (the implementation's value-level answer to the
+    reference's `push_pivots_up` compaction, `conflux_opt.hpp:176-218`).
+
     Returns (LU (M, N) packed factors in original row order, pivots
-    (n_steps, v) global rows in elimination order), matching the outputs of
-    `conflux_tpu.lu.distributed.lu_factor_distributed` exactly.
-    `panel_chunk` defaults to the implementation's TPU VMEM-safe chunk
-    (`ops/blas._PANEL_CHUNK`); pass the same value used there for
-    buffer-exact cross-validation in the chunked regime.
+    (n_steps, v) original global rows in elimination order), matching
+    `conflux_tpu.lu.distributed.lu_factor_distributed` (whose shards come
+    back pivoted; its `perm[:n_steps*v]` reshaped is this `pivots`).
+    `panel_chunk` defaults to the implementation's default
+    (`lu/distributed._DEFAULT_PANEL_CHUNK`); pass the same value used there
+    for buffer-exact cross-validation in the chunked regime.
     """
+    if panel_chunk is None:
+        from conflux_tpu.lu.distributed import _DEFAULT_PANEL_CHUNK
+
+        panel_chunk = _DEFAULT_PANEL_CHUNK
     select = PIVOTING_STRATEGIES[pivoting]
     geom = LUGeometry.create(A.shape[0], A.shape[1], v, grid)
     Px, Py, Pz = grid.Px, grid.Py, grid.Pz
@@ -177,86 +199,113 @@ def simulate_lu(A: np.ndarray, grid: Grid3, v: int, pivoting: str = "tournament"
     shards = geom.scatter(A).astype(np.float64)
     Aloc = np.zeros((Px, Py, Pz, Ml, Nl))
     Aloc[:, :, 0] = shards  # data enters on layer z=0
-    done = np.zeros((Px, Ml), bool)
 
-    gri = geom.global_row_index()  # single source of truth for the row map
-    ctile = np.stack(
-        [(np.arange(Nl) // v) * Py + y for y in range(Py)]
-    )
+    gp = geom.global_row_index()  # (Px, Ml): global POSITION of local rows
+    orig = gp.copy()  # original row id currently at each position
+    ctile = np.stack([(np.arange(Nl) // v) * Py + y for y in range(Py)])
+
+    def loc(pos):
+        """(x, local row) owning global position pos."""
+        t = pos // v
+        return t % Px, (t // Px) * v + pos % v
 
     pivots = np.zeros((geom.n_steps, v), np.int64)
 
     for k in range(geom.n_steps):
         yo, lj = k % Py, (k // Py) * v
+        io, li = k % Px, (k // Px) * v
 
         # panel = psum over (y, z) of the owner column        [collective]
         panel = Aloc[:, yo, :, :, lj : lj + v].sum(axis=1)  # (Px, Ml, v)
 
         # pivot selection over the x axis                     [collective]
-        cand = np.where(done[:, :, None], 0.0, panel)
-        gri_m = np.where(done, np.iinfo(np.int64).max, gri)
-        gpiv, lu00 = select(cand, gri_m, Px, v, panel_chunk)
-        pivots[k] = gpiv
+        live = gp >= k * v
+        cand = np.where(live[:, :, None], panel, 0.0)
+        pos_m = np.where(live, gp, _ID_SENTINEL)
+        wpos, lu00 = select(cand, pos_m, Px, v, panel_chunk)
         U00 = np.triu(lu00)
         L00 = np.tril(lu00, -1) + np.eye(v)
 
-        match = gri[:, :, None] == gpiv[None, None, :]  # (Px, Ml, v)
-        is_piv = match.any(axis=2)
-        done_new = done | is_piv
+        # swap bookkeeping: winners -> diagonal slots; displaced occupants
+        # -> slots vacated by external winners (both ascending)
+        slots = k * v + np.arange(v)
+        occ_is_winner = np.isin(slots, wpos)
+        ext = np.sort(wpos[wpos >= (k + 1) * v])
+        disp = np.nonzero(~occ_is_winner)[0]
+        assert len(ext) == len(disp)
 
-        # L10 for active rows (duplicated compute)
-        act = np.where(done_new[:, :, None], 0.0, panel)
+        # winners' full rows + ids + panel rows (psum over (x, z))
+        Prows = np.zeros((Py, v, Nl))
+        worig = np.zeros(v, np.int64)
+        for j, p in enumerate(wpos):
+            xw, lw = loc(p)
+            Prows[:, j, :] = Aloc[xw, :, :, lw, :].sum(axis=1)
+            worig[j] = orig[xw, lw]
+        pivots[k] = worig
+        # displaced occupants' rows + ids
+        Drows = Aloc[io, :, :, li : li + v, :].sum(axis=1)  # (Py, v, Nl)
+        dorig = orig[io, li : li + v].copy()
+        diag_panel = panel[io, li : li + v].copy()  # (v, v)
+
+        # swap writes: vacated slots take the displaced rows (full value on
+        # z0, zero elsewhere); diagonal rows are rewritten after the GEMM
+        panel_post = panel.copy()
+        for i, j in enumerate(disp):
+            xd, ld = loc(ext[i])
+            for y in range(Py):
+                Aloc[xd, y, 0, ld] = Drows[y, j]
+                Aloc[xd, y, 1:, ld] = 0.0
+            orig[xd, ld] = dorig[j]
+            panel_post[xd, ld] = diag_panel[j]
+        orig[io, li : li + v] = worig
+
+        # L10 on the live row suffix (duplicated compute)
+        row_live = gp >= (k + 1) * v
+        act = np.where(row_live[:, :, None], panel_post, 0.0)
         # X U00 = act  =>  U00^T X^T = act^T
         L10 = scipy.linalg.solve_triangular(
             U00, act.reshape(-1, v).T, trans="T", lower=False
         ).T.reshape(Px, Ml, v)
 
-        # pivot rows: gather + psum over (x, z)               [collective]
-        Prows = np.zeros((Py, v, Nl))
-        for x in range(Px):
-            for q in range(v):
-                hits = np.nonzero(match[x, :, q])[0]
-                if hits.size:
-                    Prows[:, q, :] += Aloc[x, :, :, hits[0], :].sum(axis=1)
         U01 = np.stack(
             [scipy.linalg.solve_triangular(L00, Prows[y], lower=True, unit_diagonal=True)
              for y in range(Py)]
         )  # (Py, v, Nl)
 
-        # trailing update: each z layer applies its slab
+        # trailing update on the (row-suffix x col-suffix) live block
         for x in range(Px):
+            rl = row_live[x]
             for y in range(Py):
                 trail = ctile[y] > k
                 for z in range(Pz):
                     s0, s1 = z * nlayr, min((z + 1) * nlayr, v)
                     upd = L10[x][:, s0:s1] @ U01[y][s0:s1, :]
-                    Aloc[x, y, z][:, trail] -= upd[:, trail]
+                    Aloc[x, y, z][np.ix_(rl, trail)] -= upd[np.ix_(rl, trail)]
 
-        # factor writes on layer 0; pivot rows zeroed elsewhere
+        # factor writes on layer 0: diagonal rows keep the winners' frozen
+        # L prefix (leading cols), take packed lu00 (panel tile) and U01
+        # (trailing cols)
+        for y in range(Py):
+            trail = ctile[y] > k
+            Aloc[io, y, 0, li : li + v] = np.where(trail[None, :], U01[y], Prows[y])
+            Aloc[io, y, 1:, li : li + v] = 0.0
+        # panel column on the owner y
         for x in range(Px):
-            piv_rows = np.nonzero(is_piv[x])[0]
-            pos = np.argmax(match[x][piv_rows], axis=1)
-            for y in range(Py):
-                trail = ctile[y] > k
-                for z in range(Pz):
-                    if z == 0:
-                        Aloc[x, y, z][np.ix_(piv_rows, trail)] = U01[y][pos][:, trail]
-                    else:
-                        Aloc[x, y, z][np.ix_(piv_rows, trail)] = 0.0
-            # panel column on the owner y
             for z in range(Pz):
                 col = Aloc[x, yo, z][:, lj : lj + v]
                 if z == 0:
-                    col[piv_rows] = lu00[pos]
-                    active = ~done_new[x]
-                    col[active] = L10[x][active]
+                    col[row_live[x]] = L10[x][row_live[x]]
+                    if x == io:
+                        col[li : li + v] = lu00
                 else:
-                    # pivot + active rows zeroed; earlier-done rows are
-                    # already zero on z != 0 from their own step
-                    col[~done[x]] = 0.0
+                    col[:] = 0.0
                 Aloc[x, yo, z][:, lj : lj + v] = col
 
-        done = done_new
-
-    LU = geom.gather(Aloc.sum(axis=2))
+    LUp = geom.gather(Aloc.sum(axis=2))  # factors in pivoted order
+    # permutation: original row id at each global position
+    perm = np.empty(geom.M, np.int64)
+    for x in range(Px):
+        perm[gp[x]] = orig[x]
+    LU = np.empty_like(LUp)
+    LU[perm] = LUp  # original row order, matching the host wrapper
     return LU, pivots

@@ -156,7 +156,7 @@ def test_lu_distributed_bf16():
     """bf16 storage with f32 panel math: residual at bf16-eps scale."""
     import jax.numpy as jnp
     from conflux_tpu.geometry import LUGeometry
-    from conflux_tpu.lu.distributed import full_permutation, lu_factor_distributed
+    from conflux_tpu.lu.distributed import lu_factor_distributed
     from conflux_tpu.parallel.mesh import make_mesh
     import jax
 
@@ -166,12 +166,15 @@ def test_lu_distributed_bf16():
     geom = LUGeometry.create(N, N, v, grid)
     mesh = make_mesh(grid, devices=jax.devices()[: grid.P])
     shards = jnp.asarray(geom.scatter(A)).astype(jnp.bfloat16)
-    out, pivots = lu_factor_distributed(shards, geom, mesh)
+    out, perm = lu_factor_distributed(shards, geom, mesh)
     assert out.dtype == jnp.bfloat16
-    LU = geom.gather(np.asarray(out, dtype=np.float64))
-    perm = full_permutation(np.asarray(pivots), N)
-    res = lu_residual(A, LU[perm], perm)
-    assert res < 0.3, res  # bf16 eps is ~8e-3; loose sanity bound
+    LUp = geom.gather(np.asarray(out, dtype=np.float64))
+    perm = np.asarray(perm)
+    res = lu_residual(A, LUp, perm)
+    # bf16 eps is ~7.8e-3: accept c*eps*sqrt(N) with modest pivot-growth
+    # headroom, reject the f32 regime from below
+    eps = 2.0 ** -7
+    assert res < 0.5 * eps * np.sqrt(N), res
     assert res > 1e-6  # and it genuinely ran in bf16, not f32
 
 

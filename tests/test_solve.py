@@ -156,10 +156,10 @@ def test_lu_solve_distributed_matches_single():
     A = make_test_matrix(N, N, seed=12)
     b = np.linspace(-1, 1, N)
 
-    shards, pivots = lu_factor_distributed(
+    shards, perm = lu_factor_distributed(
         jnp.asarray(geom.scatter(A)), geom, mesh
     )
-    x = lu_solve_distributed(shards, pivots, geom, mesh, jnp.asarray(b))
+    x = lu_solve_distributed(shards, perm, geom, mesh, jnp.asarray(b))
     assert x.shape == (N,)
     assert _relerr(A, x, b) < 1e-10
 
@@ -179,8 +179,8 @@ def test_lu_solve_distributed_asymmetric_grid():
     A = make_test_matrix(geom.M, geom.N, seed=13)
     b = np.cos(np.arange(geom.M))
 
-    shards, pivots = lu_factor_distributed(
+    shards, perm = lu_factor_distributed(
         jnp.asarray(geom.scatter(A)), geom, mesh
     )
-    x = lu_solve_distributed(shards, pivots, geom, mesh, jnp.asarray(b))
+    x = lu_solve_distributed(shards, perm, geom, mesh, jnp.asarray(b))
     assert _relerr(A, x, b) < 1e-10
