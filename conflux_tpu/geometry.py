@@ -251,12 +251,18 @@ class LUGeometry:
         fast = native.scatter(A, v, Px, Py)
         if fast is not None:
             return fast
-        # (Mt, v, Nt, v) -> (Px, Mtl, v, Py, Ntl, v) -> (Px, Py, Ml, Nl)
-        T = A.reshape(self.Mt, v, self.Nt, v)
-        T = T.reshape(self.Mtl, Px, v, self.Ntl, Py, v)
-        # tile index i = lt*Px + px  => axis order (lt, px)
-        out = np.transpose(T, (1, 4, 0, 2, 3, 5)).reshape(Px, Py, self.Ml, self.Nl)
-        return np.ascontiguousarray(out)
+        return np.ascontiguousarray(self.scatter_blocks(A))
+
+    def scatter_blocks(self, A):
+        """Pure reshape/transpose core of :meth:`scatter` — the single
+        source of the block-cyclic layout convention (tile index
+        i = lt*Px + px). Works on numpy and jax arrays alike, so it can run
+        inside jit for device-side scattering (no exact-shape check or
+        padding here; `A` must already be (M, N))."""
+        Px, Py, v = self.grid.Px, self.grid.Py, self.v
+        # (M, N) -> (Mtl, Px, v, Ntl, Py, v) -> (Px, Py, Ml, Nl)
+        T = A.reshape(self.Mtl, Px, v, self.Ntl, Py, v)
+        return T.transpose(1, 4, 0, 2, 3, 5).reshape(Px, Py, self.Ml, self.Nl)
 
     def gather(self, shards: np.ndarray) -> np.ndarray:
         """Inverse of :meth:`scatter`: (Px, Py, Ml, Nl) -> (M, N)."""

@@ -164,6 +164,55 @@ def test_lu_solve_distributed_matches_single():
     assert _relerr(A, x, b) < 1e-10
 
 
+def test_solve_distributed_refined():
+    """Full at-scale solve path: distributed factor + mesh solve + IR with
+    an f64 residual must reach f64-grade accuracy from f32 factors."""
+    import jax
+
+    from conflux_tpu.geometry import Grid3
+    from conflux_tpu.solvers import solve_distributed
+
+    N = 128
+    A = make_test_matrix(N, N, seed=17, dtype=np.float32)
+    b = np.linspace(-1, 1, N).astype(np.float32)
+    x = solve_distributed(jnp.asarray(A), jnp.asarray(b), grid=Grid3(2, 2, 1),
+                          v=16, mesh=None, refine=3)
+    assert _relerr(A, np.asarray(x, np.float64), b) < 1e-9
+
+
+def test_solve_distributed_bf16_factors():
+    from conflux_tpu.geometry import Grid3
+    from conflux_tpu.solvers import solve_distributed
+
+    N = 128
+    A = make_test_matrix(N, N, seed=18, dtype=np.float32)
+    # IR with bf16 factors converges only while cond(A)*eps_bf16 << 1
+    # (eps_bf16 ~ 8e-3): boost the diagonal well past the random part's
+    # spectral norm (~13 at N=128)
+    A += 32 * np.eye(N, dtype=np.float32)
+    b = np.ones(N, np.float32)
+    x = solve_distributed(jnp.asarray(A), jnp.asarray(b), grid=Grid3(2, 1, 1),
+                          v=16, refine=6, factor_dtype=jnp.bfloat16)
+    assert _relerr(A, np.asarray(x, np.float64), b) < 1e-7
+
+
+def test_solve_distributed_rejects_padding():
+    import pytest
+
+    from conflux_tpu.geometry import Grid3
+    from conflux_tpu.solvers import solve_distributed
+
+    A = make_test_matrix(100, 100, dtype=np.float32)
+    with pytest.raises(ValueError, match="multiple"):
+        solve_distributed(jnp.asarray(A), jnp.ones(100), grid=Grid3(2, 2, 1),
+                          v=16)
+    # column-only padding (M fits, N doesn't) must hit the same guard
+    B = make_test_matrix(64, 64, dtype=np.float32)
+    with pytest.raises(ValueError, match="multiple"):
+        solve_distributed(jnp.asarray(B), jnp.ones(64), grid=Grid3(1, 3, 1),
+                          v=16)
+
+
 def test_lu_solve_distributed_asymmetric_grid():
     import jax
 
