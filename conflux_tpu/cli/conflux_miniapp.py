@@ -52,7 +52,11 @@ def main(argv=None) -> int:
     from conflux_tpu.geometry import Grid3, LUGeometry, choose_grid
     from conflux_tpu.lu.distributed import lu_factor_distributed
     from conflux_tpu.parallel.mesh import make_mesh
-    from conflux_tpu.validation import lu_residual, make_test_matrix
+    from conflux_tpu.validation import (
+        lu_residual,
+        lu_residual_distributed,
+        make_test_matrix,
+    )
 
     M = args.M or args.N
     n_devices = len(jax.devices())
@@ -104,10 +108,11 @@ def main(argv=None) -> int:
                 perm = np.asarray(perm_dev)
                 res = lu_residual(np.asarray(A, np.float64), LU_perm, perm)
             else:
-                # factors come back already in pivoted row order
-                LUp = geom.gather(np.asarray(out))
-                perm = np.asarray(perm_dev)
-                res = lu_residual(np.asarray(A, np.float64), LUp, perm)
+                # gather-free on-mesh oracle (the reference's ScaLAPACK
+                # pdgemm validation role): nothing (M, N)-sized leaves the
+                # mesh; `dev` still holds the original shards (the timed
+                # runs do not donate them)
+                res = lu_residual_distributed(dev, out, perm_dev, geom, mesh)
         print(f"_residual_ {res:.3e}")
 
     if args.profile:

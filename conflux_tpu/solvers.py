@@ -216,8 +216,8 @@ def solve_distributed(A, b, *, grid=None, v: int = 1024, mesh=None,
         residual_dtype = (jnp.float64 if jax.config.jax_enable_x64
                           else cdtype)
 
-    shards = _build_scatter(geom, mesh_cache_key(mesh))(
-        jnp.asarray(A, fdtype))
+    shards = _build_scatter(geom, mesh_cache_key(mesh),
+                            jnp.dtype(fdtype).name)(jnp.asarray(A))
     out, perm = lu_factor_distributed(shards, geom, mesh,
                                       panel_chunk=panel_chunk, donate=True)
 
@@ -235,20 +235,24 @@ def solve_distributed(A, b, *, grid=None, v: int = 1024, mesh=None,
 
 
 @functools.lru_cache(maxsize=16)
-def _build_scatter(geom, mesh_key):
+def _build_scatter(geom, mesh_key, dtype_name: str):
     """Jitted device-side scatter with a sharded output: (M, N) -> block-
     cyclic (Px, Py, Ml, Nl) placed directly with the mesh sharding — no
     single-device staging of the scattered array, no host round trip (the
-    host `geom.scatter` costs a full transfer at scale). The layout math is
-    `LUGeometry.scatter_blocks`, the single source of the tile convention.
-    """
+    host `geom.scatter` costs a full transfer at scale). The factor-dtype
+    cast happens inside the same program for the same reason. The layout
+    math is `LUGeometry.scatter_blocks`, the single source of the tile
+    convention."""
     from jax.sharding import NamedSharding, PartitionSpec as P
 
     from conflux_tpu.parallel.mesh import AXIS_X, AXIS_Y, lookup_mesh
 
     mesh = lookup_mesh(mesh_key)
     sharding = NamedSharding(mesh, P(AXIS_X, AXIS_Y, None, None))
-    return jax.jit(geom.scatter_blocks, out_shardings=sharding)
+    return jax.jit(
+        lambda A: geom.scatter_blocks(A.astype(dtype_name)),
+        out_shardings=sharding,
+    )
 
 
 @functools.partial(jax.jit, static_argnames=("rdtype",))
