@@ -387,6 +387,11 @@ def lu_factor_distributed(shards, geom: LUGeometry, mesh,
     at step k occupy positions k*v..(k+1)*v, so perm[:n_steps*v] reshaped
     to (n_steps, v) is the elimination record (the old `pivots` output).
 
+    Rank-deficient inputs: supersteps whose candidates are exactly zero
+    elect no valid rows, leaving that block's perm entries unspecified and
+    its factor rows garbage (the getrf `info > 0` situation); everything
+    eliminated before the degeneracy is correct and frozen.
+
     `panel_chunk` bounds the height of every LU call inside the pivot
     election (default: `_DEFAULT_PANEL_CHUNK` — 8192, safe for the
     unbatched cond'd nomination calls; the batched election stack is

@@ -18,13 +18,17 @@ from jax import lax
 
 
 def lu_residual(A, LU, perm) -> float:
-    """Normalized ||A[perm] - L U||_F / ||A||_F for packed LU factors."""
+    """Normalized ||A[perm] - L U||_F / ||A||_F for packed LU factors.
+
+    Handles rectangular factorizations both ways: L is (M, K) unit-lower
+    and U (K, N) upper with K = min(M, N)."""
     A = np.asarray(A)
     LU = np.asarray(LU)
     perm = np.asarray(perm)
     M, N = LU.shape
-    L = np.tril(LU, -1)[:, :N] + np.eye(M, N, dtype=LU.dtype)
-    U = np.triu(LU[:N, :])
+    K = min(M, N)
+    L = np.tril(LU, -1)[:, :K] + np.eye(M, K, dtype=LU.dtype)
+    U = np.triu(LU[:K, :])
     R = A[perm, :] - L @ U
     return float(np.linalg.norm(R) / max(np.linalg.norm(A), 1e-30))
 

@@ -126,6 +126,37 @@ def test_lu_distributed_chunked_matches_unchunked():
         assert res < residual_bound(N, np.float64), (chunk, res)
 
 
+@pytest.mark.parametrize("grid", [Grid3(2, 2, 1), Grid3(4, 2, 1)], ids=str)
+@pytest.mark.parametrize("shape", [(64, 32), (32, 64)], ids=["tall", "wide"])
+def test_lu_distributed_rectangular(shape, grid):
+    """M = 2N and N = 2M (reference `lu_params.hpp:21-47` supports ratio-
+    driven rectangular problems; round 1 never tested them distributed)."""
+    M, N = shape
+    A = make_test_matrix(M, N, seed=M + grid.Px)
+    LU, perm, geom = lu_distributed_host(A, grid, 8)
+    assert (geom.M, geom.N) == (M, N)
+    assert sorted(perm.tolist()) == list(range(M))
+    res = lu_residual(A, LU[perm], perm)
+    assert res < residual_bound(max(M, N), np.float64), (shape, grid, res)
+
+
+def test_choose_grid_ratio():
+    """Grid auto-pick follows the reference's semantics
+    (`lu_params.hpp:21-47`): the 2D plane is stretched toward the matrix
+    aspect ratio max(M,N)/min(M,N), orientation-agnostic, Px >= Py >= Pz."""
+    from conflux_tpu.geometry import choose_grid
+
+    g = choose_grid(8, 2048, 1024)  # ratio 2
+    assert (g.Px, g.Py) == (4, 2), g
+    assert choose_grid(8, 1024, 2048) == g  # max/min, like the reference
+    g16 = choose_grid(16, 4096, 1024)  # ratio 4
+    assert (g16.Px, g16.Py) == (8, 2), g16
+    sq = choose_grid(16, 4096, 4096)
+    assert sq.Px == sq.Py, sq
+    for g in (choose_grid(P, 4096, 1024) for P in (2, 4, 8, 12, 24)):
+        assert g.Px >= g.Py >= g.Pz, g
+
+
 def test_lu_distributed_pivots_are_permutation():
     N, v = 64, 8
     A = make_test_matrix(N, N, seed=9)
