@@ -67,6 +67,37 @@ def cholesky_solve(L: jax.Array, b: jax.Array) -> jax.Array:
     return x[:, 0] if squeeze else x
 
 
+def _check_solve_rhs(geom, b) -> None:
+    """Both mesh solves read b by padded global position: a shorter rhs
+    would be silently clamp-read in the padded tiles and the solution
+    returned at padded length — reject instead (pad A and b with an
+    identity extension first, like `solve` does)."""
+    n = geom.N
+    if b.shape[0] != n:
+        raise ValueError(
+            f"rhs has {b.shape[0]} rows, the (padded) factorization needs "
+            f"{n}; pad the system identity-extended before factoring")
+
+
+def _diag_tile_rows(Aloc, k, x_, gcol, v, Px, Nl, dtype):
+    """Shared by the LU and Cholesky mesh solves: step k's diagonal row
+    tile — (v, Nl) local columns via a masked psum over 'x', and the
+    (v, v) diagonal block via an index scatter + psum over 'y'."""
+    from conflux_tpu.parallel.mesh import AXIS_X, AXIS_Y
+
+    li = ((k // Px) * v).astype(jnp.int32)
+    part = jnp.where(
+        x_ == k % Px,
+        lax.dynamic_slice(Aloc, (li, jnp.zeros((), jnp.int32)), (v, Nl)),
+        jnp.zeros((), dtype))
+    rows = lax.psum(part, AXIS_X)  # (v, Nl): my cols of those rows
+    idx = jnp.where((gcol >= k * v) & (gcol < (k + 1) * v), gcol - k * v, v)
+    diag = jnp.zeros((v, v), dtype).at[:, idx].add(
+        jnp.where(idx[None, :] < v, rows, 0.0), mode="drop")
+    diag = lax.psum(diag, AXIS_Y)
+    return rows, diag
+
+
 def lu_solve_distributed(shards, perm, geom, mesh, b) -> jax.Array:
     """Solve A x = b on the mesh, from `lu_factor_distributed`'s outputs.
 
@@ -80,6 +111,7 @@ def lu_solve_distributed(shards, perm, geom, mesh, b) -> jax.Array:
 
     Returns x (N,), replicated.
     """
+    _check_solve_rhs(geom, b)
     fn = _build_lu_solve(geom, mesh_cache_key(mesh))
     return fn(shards, jnp.asarray(perm, jnp.int32),
               jnp.asarray(b, jnp.float32 if shards.dtype == jnp.bfloat16
@@ -110,26 +142,8 @@ def _build_lu_solve(geom, mesh_key):
         lc = jnp.arange(Nl, dtype=jnp.int32)
         gcol = ((lc // v) * Py + y_) * v + (lc % v)
 
-        def diag_tile_rows(k):
-            """(v, Nl) local columns of step k's diagonal-tile rows + the
-            (v, v) diagonal block, both completed by collectives."""
-            li = ((k // Px) * v).astype(jnp.int32)
-            part = jnp.where(
-                x_ == k % Px,
-                lax.dynamic_slice(Aloc, (li, jnp.zeros((), jnp.int32)),
-                                  (v, Nl)),
-                jnp.zeros((), dtype))
-            rows = lax.psum(part, AXIS_X)  # (v, Nl): my cols of those rows
-            idx = jnp.where((gcol >= k * v) & (gcol < (k + 1) * v),
-                            gcol - k * v, v)
-            diag = jnp.zeros((v, v), dtype).at[:, idx].add(
-                jnp.where(idx[None, :] < v, rows, 0.0), mode="drop"
-            )
-            diag = lax.psum(diag, AXIS_Y)
-            return rows, diag
-
         def fwd(k, yv):
-            rows, diag = diag_tile_rows(k)
+            rows, diag = _diag_tile_rows(Aloc, k, x_, gcol, v, Px, Nl, dtype)
             solved = gcol < k * v
             s = jnp.matmul(rows, jnp.where(solved, yv[gcol], 0.0),
                            precision=lax.Precision.HIGHEST)
@@ -144,7 +158,7 @@ def _build_lu_solve(geom, mesh_key):
 
         def bwd(i, xv):
             k = n - 1 - i
-            rows, diag = diag_tile_rows(k)
+            rows, diag = _diag_tile_rows(Aloc, k, x_, gcol, v, Px, Nl, dtype)
             ahead = gcol >= (k + 1) * v
             s = jnp.matmul(rows, jnp.where(ahead, xv[gcol], 0.0),
                            precision=lax.Precision.HIGHEST)
@@ -162,6 +176,92 @@ def _build_lu_solve(geom, mesh_key):
         device_fn,
         mesh=mesh,
         in_specs=(P(AXIS_X, AXIS_Y, None, None), P(), P()),
+        out_specs=P(),
+    )
+    return jax.jit(fn)
+
+
+def cholesky_solve_distributed(shards, geom, mesh, b) -> jax.Array:
+    """Solve A x = b on the mesh from `cholesky_factor_distributed` shards
+    (lower triangle = L): block forward substitution with L, then block
+    back substitution with L^T. Mirrors `lu_solve_distributed` (which the
+    reference lacks entirely); no permutation is involved since Cholesky
+    does not pivot.
+
+    Returns x (N,), replicated.
+    """
+    _check_solve_rhs(geom, b)
+    fn = _build_cholesky_solve(geom, mesh_cache_key(mesh))
+    return fn(shards, jnp.asarray(b, jnp.float32
+                                  if shards.dtype == jnp.bfloat16
+                                  else shards.dtype))
+
+
+@functools.lru_cache(maxsize=16)
+def _build_cholesky_solve(geom, mesh_key):
+    from jax.sharding import PartitionSpec as P
+
+    from conflux_tpu.parallel.mesh import (
+        AXIS_X, AXIS_Y, AXIS_Z, lookup_mesh,
+    )
+
+    mesh = lookup_mesh(mesh_key)
+    v, Px, Py = geom.v, geom.grid.Px, geom.grid.Py
+    Ml, Nl, n = geom.Ml, geom.Nl, geom.Kappa
+
+    def device_fn(blk, b):
+        x_ = lax.axis_index(AXIS_X)
+        y_ = lax.axis_index(AXIS_Y)
+        dtype = blas.compute_dtype(blk.dtype)
+        Aloc = blk[0, 0].astype(dtype)  # z-replicated factors, lower = L
+        b = b.astype(dtype)
+
+        lr = jnp.arange(Ml, dtype=jnp.int32)
+        grow = ((lr // v) * Px + x_) * v + (lr % v)
+        lc = jnp.arange(Nl, dtype=jnp.int32)
+        gcol = ((lc // v) * Py + y_) * v + (lc % v)
+
+        def fwd(k, yv):
+            rows, diag = _diag_tile_rows(Aloc, k, x_, gcol, v, Px, Nl, dtype)
+            solved = gcol < k * v
+            s = jnp.matmul(rows, jnp.where(solved, yv[gcol], 0.0),
+                           precision=lax.Precision.HIGHEST)
+            s = lax.psum(s, AXIS_Y)
+            bk = lax.dynamic_slice(b, (k * v,), (v,))
+            yk = blas.trsm_left_lower(jnp.tril(diag), (bk - s)[:, None])[:, 0]
+            return lax.dynamic_update_slice(yv, yk, (k * v,))
+
+        yv = lax.fori_loop(0, n, fwd, jnp.zeros((geom.N,), dtype))
+
+        def bwd(i, xv):
+            k = n - 1 - i
+            # column tile k of L: my rows of those v columns
+            lj = ((k // Py) * v).astype(jnp.int32)
+            cols = lax.psum(
+                jnp.where(y_ == k % Py,
+                          lax.dynamic_slice(Aloc, (jnp.zeros((), jnp.int32), lj),
+                                            (Ml, v)),
+                          jnp.zeros((), dtype)), AXIS_Y)
+            ahead = grow >= (k + 1) * v
+            s = jnp.matmul(jnp.where(ahead, xv[grow], 0.0), cols,
+                           precision=lax.Precision.HIGHEST)
+            s = lax.psum(s, AXIS_X)
+            idx = jnp.where((grow >= k * v) & (grow < (k + 1) * v),
+                            grow - k * v, v)
+            diag = jnp.zeros((v, v), dtype).at[idx].add(
+                jnp.where(idx[:, None] < v, cols, 0.0), mode="drop")
+            diag = lax.psum(diag, AXIS_X)
+            yk = lax.dynamic_slice(yv, (k * v,), (v,))
+            xk = blas.trsm_left_lower_t(jnp.tril(diag), (yk - s)[:, None])[:, 0]
+            return lax.dynamic_update_slice(xv, xk, (k * v,))
+
+        xv = lax.fori_loop(0, n, bwd, jnp.zeros((geom.N,), dtype))
+        return lax.pmax(xv, (AXIS_X, AXIS_Y, AXIS_Z))
+
+    fn = jax.shard_map(
+        device_fn,
+        mesh=mesh,
+        in_specs=(P(AXIS_X, AXIS_Y, None, None), P()),
         out_specs=P(),
     )
     return jax.jit(fn)
