@@ -293,13 +293,39 @@ class LUGeometry:
 # --------------------------------------------------------------------------- #
 
 
-def choose_cholesky_tile(N: int, P: int) -> int:
-    """Tile-size heuristic for Cholesky (reference `Cholesky.cpp:116-134`):
-    grow v until the per-device panel memory is a small fraction of the
-    matrix share; cap to keep at least a few tiles per device."""
+def choose_cholesky_tile(N: int, P: int, *, itemsize: int = 4,
+                         hbm_bytes: int = 16 << 30) -> int:
+    """Tile-size heuristic for Cholesky.
+
+    The reference derives v from a memory ratio: it grows the tile until
+    the per-rank tile buffers reach a target fraction of the rank's memory
+    (`Cholesky.cpp:116-134`). Same principle here, with TPU constants: the
+    per-device working set is the local matrix share (~N^2/P elements) plus
+    the step's panel slab (Ml x v) and its z-replicated copies, so v is
+    grown while (a) the panel slab stays under ~1/8 of the local share —
+    keeping the working set within HBM headroom — and (b) at least two
+    tile columns per device axis remain (the loop needs >= 2 supersteps to
+    pipeline). v is further capped at 1024: the potrf/LU panel custom
+    calls overflow scoped VMEM on tall tiles (see ops/blas.py), and 1024
+    measured fastest on a v5e for the GEMM-dominated regime anyway.
+    """
+    if N <= 0:
+        return max(1, N)
+    px = max(1, _isqrt(P))
+    local_share = max(1, N * N // max(1, P)) * itemsize
+    if local_share > hbm_bytes:
+        # out-of-memory configs still get a well-formed answer; the caller's
+        # scatter will fail with a clear message if it truly cannot fit
+        local_share = hbm_bytes
     v = 128
-    while v * 2 <= 1024 and N // (v * 2) >= 2 * _isqrt(P):
-        v *= 2
+    while v * 2 <= 1024:
+        nv = v * 2
+        ml = -(-N // (nv * px)) * nv  # local panel height at tile nv
+        if N // (nv * px) < 2:  # (b) keep >= 2 tile cols per device
+            break
+        if ml * nv * itemsize * 8 > local_share:  # (a) slab <= 1/8 share
+            break
+        v = nv
     return min(v, max(1, N))
 
 

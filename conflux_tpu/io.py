@@ -15,23 +15,56 @@ import numpy as np
 from conflux_tpu.geometry import CholeskyGeometry, LUGeometry
 
 
-def generate_spd_tiles(geom: CholeskyGeometry, seed: int = 2020,
-                       dtype=np.float64) -> np.ndarray:
-    """Distributed-convention SPD input, built tile-locally.
+def _spd_base_tile(geom: CholeskyGeometry, seed: int, dtype) -> np.ndarray:
+    rng = np.random.default_rng(seed)
+    tile = rng.uniform(-1.0, 1.0, size=(geom.v, geom.v)).astype(dtype)
+    return (tile + tile.T) / 2
+
+
+def generate_spd_local(geom: CholeskyGeometry, px: int, py: int,
+                       seed: int = 2020, dtype=np.float64) -> np.ndarray:
+    """ONE device's (Ml, Nl) SPD shard, built tile-locally.
 
     Same scheme as the reference generator (`CholeskyIO.cpp:100-172`): every
-    off-diagonal tile is the *same* seeded v x v block (so any rank can
-    materialize its tiles without communication), the matrix is symmetrized,
-    and the diagonal gets an N-scaled boost for positive definiteness.
-    Returns the full (N, N) matrix; use `geom.scatter` for shards.
+    off-diagonal tile is the *same* seeded v x v symmetrized block (so any
+    rank materializes its tiles without communication) and diagonal tiles
+    get an N-scaled identity boost for positive definiteness. Peak memory
+    is this shard plus one tile — the reference's ability to generate
+    inputs far larger than any single rank's memory lives here (and in the
+    streaming :func:`generate_spd_file`), not in the all-shards helpers.
     """
     N, v = geom.N, geom.v
-    rng = np.random.default_rng(seed)
-    tile = rng.uniform(-1.0, 1.0, size=(v, v)).astype(dtype)
-    sym = (tile + tile.T) / 2
-    A = np.tile(sym, (N // v, N // v))
-    A[np.arange(N), np.arange(N)] += N
-    return A
+    Px, Py = geom.grid.Px, geom.grid.Py
+    sym = _spd_base_tile(geom, seed, dtype)
+    boost = N * np.eye(v, dtype=dtype)
+    loc = np.tile(sym, (geom.Mtl, geom.Ntl))
+    # global-diagonal tiles owned here: i*Px+px == j*Py+py
+    for i in range(geom.Mtl):
+        gt = i * Px + px
+        j, rem = divmod(gt - py, Py)
+        if rem == 0 and 0 <= j < geom.Ntl:
+            loc[i * v:(i + 1) * v, j * v:(j + 1) * v] += boost
+    return loc
+
+
+def generate_spd_shards(geom: CholeskyGeometry, seed: int = 2020,
+                        dtype=np.float64) -> np.ndarray:
+    """All shards (Px, Py, Ml, Nl) in `CholeskyGeometry.scatter` convention
+    — a host-side convenience that necessarily holds N^2 elements; use
+    :func:`generate_spd_local` per device coordinate to stay tile-local."""
+    shards = np.empty((geom.grid.Px, geom.grid.Py, geom.Ml, geom.Nl), dtype)
+    for px in range(geom.grid.Px):
+        for py in range(geom.grid.Py):
+            shards[px, py] = generate_spd_local(geom, px, py, seed, dtype)
+    return shards
+
+
+def generate_spd_tiles(geom: CholeskyGeometry, seed: int = 2020,
+                       dtype=np.float64) -> np.ndarray:
+    """Full (N, N) SPD input — host-side convenience over
+    :func:`generate_spd_shards` (which is the scalable tile-local path).
+    Gathers the shard construction so both agree bit-for-bit."""
+    return geom.gather(generate_spd_shards(geom, seed=seed, dtype=dtype))
 
 
 # Binary file format: int64 header (M, N, dtype code) + row-major data.

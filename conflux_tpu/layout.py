@@ -119,8 +119,56 @@ def gather(shards: list[list[np.ndarray]], layout: BlockCyclicLayout) -> np.ndar
 def transform(shards: list[list[np.ndarray]], src: BlockCyclicLayout,
               dst: BlockCyclicLayout) -> list[list[np.ndarray]]:
     """Redistribute between two block-cyclic layouts (the `costa::transform`
-    role, `examples/conflux_miniapp.cpp:349-353`): src shards -> global ->
-    dst shards. Shapes must agree; tile sizes and grids may differ."""
+    role, `examples/conflux_miniapp.cpp:349-353`). Tile sizes and grids may
+    differ; shapes must agree.
+
+    Streams tile intersections directly from source local buffers into each
+    destination local buffer — COSTA's whole reason to exist is moving
+    between layouts *without* materializing the global matrix
+    (`src/conflux/lu/layout.cpp:48`), so peak extra memory here is one
+    destination-coordinate buffer, never (M, N).
+    """
     if (src.M, src.N) != (dst.M, dst.N):
         raise ValueError(f"layout shapes differ: {(src.M, src.N)} vs {(dst.M, dst.N)}")
-    return scatter(gather(shards, src), dst)
+    return [
+        [_build_local(shards, src, dst, p, q) for q in range(dst.Pcols)]
+        for p in range(dst.Prows)
+    ]
+
+
+def _build_local(shards: list[list[np.ndarray]], src: BlockCyclicLayout,
+                 dst: BlockCyclicLayout, p: int, q: int) -> np.ndarray:
+    """One destination coordinate's local buffer, assembled from the source
+    tiles intersecting each of its tiles. Short trailing tiles are safe on
+    both sides: a block-cyclic owner's short tile is always its LAST local
+    tile, so full-tile local offsets (li*vr, lj*vc) are exact."""
+    Mt, Nt = dst.tile_counts()
+    row_tiles = range(p, Mt, dst.Prows)
+    col_tiles = range(q, Nt, dst.Pcols)
+    dtype = shards[0][0].dtype
+    if not len(row_tiles) or not len(col_tiles):
+        return np.zeros((0, 0), dtype)
+    loc = np.zeros(dst.local_shape(p, q), dtype)
+    for li, ti in enumerate(row_tiles):
+        r0, r1 = ti * dst.vr, min((ti + 1) * dst.vr, dst.M)
+        for lj, tj in enumerate(col_tiles):
+            c0, c1 = tj * dst.vc, min((tj + 1) * dst.vc, dst.N)
+            r = r0
+            while r < r1:  # walk the source tiles covering [r0:r1, c0:c1]
+                sti = r // src.vr
+                r_end = min((sti + 1) * src.vr, r1)
+                c = c0
+                while c < c1:
+                    stj = c // src.vc
+                    c_end = min((stj + 1) * src.vc, c1)
+                    sp, sq = src.owner(sti, stj)
+                    sbuf = shards[sp][sq]
+                    sr = ((sti - sp) // src.Prows) * src.vr + (r - sti * src.vr)
+                    sc = ((stj - sq) // src.Pcols) * src.vc + (c - stj * src.vc)
+                    loc[
+                        li * dst.vr + (r - r0) : li * dst.vr + (r - r0) + (r_end - r),
+                        lj * dst.vc + (c - c0) : lj * dst.vc + (c - c0) + (c_end - c),
+                    ] = sbuf[sr : sr + (r_end - r), sc : sc + (c_end - c)]
+                    c = c_end
+                r = r_end
+    return loc

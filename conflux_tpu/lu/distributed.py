@@ -13,12 +13,14 @@ shapes throughout:
    and positions >= k*v are active. Each step performs LAPACK-style row
    swaps — elected pivot rows move into the step's diagonal block, the
    displaced occupants move to the vacated slots — expressed as two
-   (v, Nl) psums plus value-level scatters. This is the TPU answer to the
-   reference's `push_pivots_up` row compaction (P6): because eliminated
-   rows now occupy a tile-aligned *prefix* of every device's local rows,
-   row liveness (like column liveness) is monotone in the local tile
-   index, and the hot ops shrink with k instead of paying full-height
-   masked work every superstep;
+   (v, Nl) psums plus per-row hit/src maps whose writes ride the step-6
+   segment updates as gather+selects (an explicit row scatter lowers to a
+   serial per-row loop on TPU, ~15% of the factorization). This is the
+   TPU answer to the reference's `push_pivots_up` row compaction (P6):
+   because eliminated rows now occupy a tile-aligned *prefix* of every
+   device's local rows, row liveness (like column liveness) is monotone
+   in the local tile index, and the hot ops shrink with k instead of
+   paying full-height masked work every superstep;
  - rotating owner roles (P5) -> `axis_index` comparisons inside the loop;
  - the z-layer 2.5D replication (P3) -> each device holds a *partial sum*
    shard; sum over the z axis is the true matrix. Panel reads are `psum`s
@@ -190,23 +192,39 @@ def _build(geom: LUGeometry, mesh_key, precision, backend: str,
             # winners move into the step's diagonal block (positions
             # k*v..(k+1)*v); the non-winner occupants move to the slots
             # vacated by external winners (i-th displaced occupant -> i-th
-            # vacated position, both ascending — a canonical matching)
+            # vacated position, both ascending — a canonical matching).
+            # No (Ml, Nl) row scatter is ever issued: XLA lowers one to a
+            # serial per-row while loop (~10 ms/step at v=1024, 15% of the
+            # whole factorization). Instead this block only computes the
+            # swap's row-level maps (hit/src below); the actual writes ride
+            # the step-6 segment updates as gather+selects that fuse into
+            # the GEMM epilogue.
             with jax.named_scope("step2_pivotrows"):
                 slots = k * v + jnp.arange(v, dtype=jnp.int32)
+                jv = jnp.arange(v, dtype=jnp.int32)
                 occ_is_winner = (wpos[None, :] == slots[:, None]).any(1)
                 is_ext = wpos >= (k + 1) * v
                 # ascending order of the external winners' positions by
-                # comparison ranking — a (v, v) compare + tiny scatter; a
-                # jnp.sort here costs ~13 ms/step on TPU (bitonic)
+                # comparison ranking — (v, v) compares; jnp.sort costs
+                # ~13 ms/step on TPU (bitonic) and a (v,) scatter lowers to
+                # a 1024-iteration serial loop, so neither is used
                 both = is_ext[None, :] & is_ext[:, None]
                 rank = jnp.sum(both & (wpos[None, :] < wpos[:, None]),
                                axis=1).astype(jnp.int32)
-                ext_sorted = jnp.full((v,), _GRI_SENTINEL, jnp.int32).at[
-                    jnp.where(is_ext, rank, v)
-                ].set(wpos, mode="drop")
+                # ext_sorted[r] = r-th smallest external winner position
+                # (sentinel tail), via vectorized rank inversion
+                rank_eq = is_ext[None, :] & (rank[None, :] == jv[:, None])
+                ext_sorted = jnp.where(
+                    rank_eq.any(1),
+                    jnp.sum(jnp.where(rank_eq, wpos[None, :], 0), axis=1),
+                    _GRI_SENTINEL)
                 disp_rank = jnp.cumsum((~occ_is_winner).astype(jnp.int32)) - 1
-                dest_disp = jnp.where(~occ_is_winner, ext_sorted[disp_rank],
-                                      _GRI_SENTINEL)
+                # src_of_rank[r] = which diagonal-block occupant (j) moves
+                # to the r-th vacated position
+                src_eq = (~occ_is_winner)[None, :] & (
+                    disp_rank[None, :] == jv[:, None])
+                src_of_rank = jnp.sum(
+                    jnp.where(src_eq, jv[None, :], 0), axis=1)
 
                 # winners' full rows + ids, reduced over (x, z) (ref step 3)
                 wloc = loc_of(wpos)
@@ -231,26 +249,26 @@ def _build(geom: LUGeometry, mesh_key, precision, backend: str,
                               jnp.zeros((), cdtype)),
                     AXIS_X)  # (v, v)
 
-                # swap writes: vacated positions get the displaced rows now
-                # (they stay active and take the trailing update); diagonal
-                # rows are fully rewritten after the GEMM. Swapped rows
-                # carry their z-summed value on layer 0, zeros elsewhere.
-                # The diagonal block is one contiguous local tile on its
-                # x-owner, so its writes are masked dynamic_update_slices —
-                # a (v,)-index row scatter lowers to a serial per-row loop
-                # on TPU (~10 ms/step at v=1024), the DUS does not.
-                didx = loc_of(dest_disp)
-                Aloc = Aloc.at[didx].set(
-                    jnp.where(z0, Drows.astype(dtype), jnp.zeros((), dtype)),
-                    mode="drop")
+                # row-level swap maps: hit[r] = a displaced occupant lands
+                # at local row r; src[r] = which one. ext_sorted is
+                # ascending, so searchsorted gives each row its rank in
+                # O(log v) vectorized compares.
+                q = jnp.searchsorted(ext_sorted, gp).astype(jnp.int32)
+                qc = jnp.minimum(q, v - 1)
+                hit = jnp.take(ext_sorted, qc) == gp  # sentinel never hits
+                src = jnp.take(src_of_rank, qc)  # (Ml,) occupant index
+
+                # bookkeeping swaps (vector-width, cheap): diagonal block
+                # takes the winners' ids; vacated rows take the displaced
+                # occupants' ids
                 orig = jnp.where(
                     own_d, lax.dynamic_update_slice(orig, worig, (li,)), orig)
-                orig = orig.at[didx].set(dorig, mode="drop")
-                # the panel after the swap, for the L10 solve. Only the
-                # displaced rows matter: the diagonal rows (winners) are
-                # masked out of the TRSM by row_live, so their panel values
-                # are never written back here.
-                panel_post = panel.at[didx].set(diag_panel, mode="drop")
+                orig = jnp.where(hit, jnp.take(dorig, src), orig)
+                # the panel after the swap, for the L10 solve: displaced
+                # rows read their diagonal-block panel values (winner rows
+                # are masked out of the TRSM by row_live)
+                panel_post = jnp.where(
+                    hit[:, None], jnp.take(diag_panel, src, axis=0), panel)
 
             # ---- L10 for the live row suffix (ref step 4 TRSM) ----------- #
             row_live = rtile > k  # whole tiles: diag tile k is done now
@@ -300,16 +318,35 @@ def _build(geom: LUGeometry, mesh_key, precision, backend: str,
             with jax.named_scope("step6_dgemm"):
                 # in-place cond'd DUS per live segment: a slice->concat
                 # formulation materializes the full local matrix every step
-                # (~26 ms/step of pure copies at N=32768)
+                # (~26 ms/step of pure copies at N=32768).
+                # The step-2 row swaps are folded in here as gather+selects
+                # (`hit`/`src` row maps): live-column segments apply them
+                # inside the GEMM epilogue fusion; dead-column segments
+                # (the frozen L region, whose columns displaced rows carry
+                # with them) get a select-only write. This bounds the
+                # swap's cost by one masked pass over the live rows instead
+                # of XLA's serial per-row scatter loop.
                 Anew = Aloc
+
+                def seg_swapped(A, rlo, rhi, clo, chi, hseg, sseg):
+                    a_seg = lax.slice(A, (rlo, clo), (rhi, chi))
+                    moved = jnp.take(Drows[:, clo:chi], sseg, axis=0)
+                    return jnp.where(
+                        hseg[:, None],
+                        jnp.where(z0, moved, jnp.zeros((), dtype)),
+                        a_seg)
+
                 for rlo, rhi in row_segs:
                     rm = row_live[rlo:rhi]
+                    hseg = hit[rlo:rhi]
+                    sseg = src[rlo:rhi]
                     for clo, chi in col_segs:
                         cm = col_trail[clo:chi]
 
                         def seg_update(A, rlo=rlo, rhi=rhi, clo=clo, chi=chi,
-                                       rm=rm, cm=cm):
-                            a_seg = lax.slice(A, (rlo, clo), (rhi, chi))
+                                       rm=rm, cm=cm, hseg=hseg, sseg=sseg):
+                            a_seg = seg_swapped(A, rlo, rhi, clo, chi,
+                                                hseg, sseg)
                             upd = blas.gemm(
                                 L10s[rlo:rhi], U01s[:, clo:chi],
                                 precision=precision, backend=backend)
@@ -319,38 +356,49 @@ def _build(geom: LUGeometry, mesh_key, precision, backend: str,
                             return lax.dynamic_update_slice(A, new,
                                                             (rlo, clo))
 
+                        def seg_swap_only(A, rlo=rlo, rhi=rhi, clo=clo,
+                                          chi=chi, hseg=hseg, sseg=sseg):
+                            return lax.dynamic_update_slice(
+                                A, seg_swapped(A, rlo, rhi, clo, chi,
+                                               hseg, sseg), (rlo, clo))
+
+                        def seg_else(A, hseg=hseg, swap=seg_swap_only):
+                            return lax.cond(hseg.any(), swap,
+                                            lambda A_: A_, A)
+
                         Anew = lax.cond(rm.any() & cm.any(), seg_update,
-                                        lambda A: A, Anew)
+                                        seg_else, Anew)
 
             # ---- factor writes (z==0 carries factors, z!=0 zeroed) ------- #
             # diagonal block rows: leading columns keep the winners' frozen
             # L prefix (they ride along in Prows), trailing columns take
             # U01; the panel tile itself is overwritten with packed lu00 by
             # the panel-column write below
-            drow_vals = jnp.where(col_trail[None, :], U01.astype(dtype),
-                                  Prows.astype(dtype))
-            Anew = jnp.where(
-                own_d,
-                lax.dynamic_update_slice(
-                    Anew, jnp.where(z0, drow_vals, jnp.zeros((), dtype)),
-                    (li, i0)),
-                Anew)
-            # panel column: packed LU00 on the diagonal rows, L10 on live
-            # rows, untouched on frozen rows; zeroed on z != 0 layers
-            pcol_cur = lax.dynamic_slice(Anew, (i0, lj), (Ml, v))
-            pcol_new = jnp.where(row_live[:, None], L10.astype(dtype),
-                                 pcol_cur)
-            pcol_new = jnp.where(
-                own_d,
-                lax.dynamic_update_slice(pcol_new, lu00.astype(dtype),
-                                         (li, i0)),
-                pcol_new)
-            pcol_new = jnp.where(z0, pcol_new, jnp.zeros((), dtype))
-            Anew = jnp.where(
-                y == j_owner,
-                lax.dynamic_update_slice(Anew, pcol_new, (i0, lj)),
-                Anew,
-            )
+            with jax.named_scope("step7_writes"):
+                drow_vals = jnp.where(col_trail[None, :], U01.astype(dtype),
+                                      Prows.astype(dtype))
+                Anew = jnp.where(
+                    own_d,
+                    lax.dynamic_update_slice(
+                        Anew, jnp.where(z0, drow_vals, jnp.zeros((), dtype)),
+                        (li, i0)),
+                    Anew)
+                # panel column: packed LU00 on the diagonal rows, L10 on live
+                # rows, untouched on frozen rows; zeroed on z != 0 layers
+                pcol_cur = lax.dynamic_slice(Anew, (i0, lj), (Ml, v))
+                pcol_new = jnp.where(row_live[:, None], L10.astype(dtype),
+                                     pcol_cur)
+                pcol_new = jnp.where(
+                    own_d,
+                    lax.dynamic_update_slice(pcol_new, lu00.astype(dtype),
+                                             (li, i0)),
+                    pcol_new)
+                pcol_new = jnp.where(z0, pcol_new, jnp.zeros((), dtype))
+                Anew = jnp.where(
+                    y == j_owner,
+                    lax.dynamic_update_slice(Anew, pcol_new, (i0, lj)),
+                    Anew,
+                )
             return Anew, orig
 
         Aloc, orig = lax.fori_loop(0, n_steps, body, (Aloc, orig0))

@@ -18,6 +18,8 @@ from __future__ import annotations
 
 import functools
 
+import numpy as np
+
 import jax
 import jax.numpy as jnp
 from jax import lax
@@ -73,9 +75,10 @@ def _check_solve_rhs(geom, b) -> None:
     returned at padded length — reject instead (pad A and b with an
     identity extension first, like `solve` does)."""
     n = geom.N
-    if b.shape[0] != n:
+    rows = np.shape(b)[0] if np.ndim(b) else 0  # list rhs is fine
+    if rows != n:
         raise ValueError(
-            f"rhs has {b.shape[0]} rows, the (padded) factorization needs "
+            f"rhs has {rows} rows, the (padded) factorization needs "
             f"{n}; pad the system identity-extended before factoring")
 
 
