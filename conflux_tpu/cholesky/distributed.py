@@ -154,24 +154,27 @@ def _build(geom: CholeskyGeometry, mesh_key, precision, backend: str,
             # segment; a slice->concat formulation materializes the full
             # local matrix every step (measured ~26 ms/step of pure copies
             # in the LU loop at N=32768 before the same change)
-            Anew = Aloc
-            for rlo, rhi in row_bounds:
-                rm = below[rlo:rhi]
-                for clo, chi in col_bounds:
-                    cm = col_trail[clo:chi]
+            with jax.named_scope("computeA11"):
+                Anew = Aloc
+                for rlo, rhi in row_bounds:
+                    rm = below[rlo:rhi]
+                    for clo, chi in col_bounds:
+                        cm = col_trail[clo:chi]
 
-                    def seg_update(A, rlo=rlo, rhi=rhi, clo=clo, chi=chi,
-                                   rm=rm, cm=cm):
-                        a_seg = lax.slice(A, (rlo, clo), (rhi, chi))
-                        upd = blas.gemm(L10s[rlo:rhi], Lcs[clo:chi].T,
-                                        precision=precision, backend=backend)
-                        keep = rm[:, None] & cm[None, :]
-                        new = a_seg - jnp.where(keep, upd,
-                                                jnp.zeros((), dtype))
-                        return lax.dynamic_update_slice(A, new, (rlo, clo))
+                        def seg_update(A, rlo=rlo, rhi=rhi, clo=clo, chi=chi,
+                                       rm=rm, cm=cm):
+                            a_seg = lax.slice(A, (rlo, clo), (rhi, chi))
+                            upd = blas.gemm(L10s[rlo:rhi], Lcs[clo:chi].T,
+                                            precision=precision,
+                                            backend=backend)
+                            keep = rm[:, None] & cm[None, :]
+                            new = a_seg - jnp.where(keep, upd,
+                                                    jnp.zeros((), dtype))
+                            return lax.dynamic_update_slice(A, new,
+                                                            (rlo, clo))
 
-                    Anew = lax.cond(rm.any() & cm.any(), seg_update,
-                                    lambda A: A, Anew)
+                        Anew = lax.cond(rm.any() & cm.any(), seg_update,
+                                        lambda A: A, Anew)
 
             # ---- factor writes: panel column on layer z==0 ---------------- #
             on_diag = rtile == k
@@ -204,6 +207,16 @@ def _build(geom: CholeskyGeometry, mesh_key, precision, backend: str,
         out_specs=P(AXIS_X, AXIS_Y, None, None),
     )
     return jax.jit(fn, donate_argnums=(0,) if donate else ())
+
+
+def build_program(geom: CholeskyGeometry, mesh, precision=None,
+                  backend: str | None = None, donate: bool = False):
+    """The jitted distributed-Cholesky program (cached per config), for
+    callers needing compile artifacts — e.g. the miniapp's `--profile`
+    per-phase device table (see `lu.distributed.build_program`)."""
+    precision = blas.matmul_precision() if precision is None else precision
+    backend = blas.get_backend() if backend is None else backend
+    return _build(geom, mesh_cache_key(mesh), precision, backend, donate)
 
 
 def cholesky_factor_distributed(shards, geom: CholeskyGeometry, mesh,

@@ -108,6 +108,11 @@ def main(argv=None) -> int:
         print(f"_residual_ {res:.3e}")
 
     if args.profile:
+        if not single:
+            from conflux_tpu.cholesky.distributed import build_program
+            from conflux_tpu.cli.common import phase_profile
+
+            phase_profile(build_program(geom, mesh), dev)
         profiler.report()
     return 0
 

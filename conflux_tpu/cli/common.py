@@ -63,3 +63,25 @@ class WallTimer:
 
     def __exit__(self, *exc):
         self.ms = (time.perf_counter() - self.t0) * 1e3
+
+
+def phase_profile(program, dev) -> None:
+    """Per-phase device-time table for a distributed hot loop (the
+    reference's per-step semiprof table, `README.md:120-165`): one extra
+    run under `jax.profiler.trace`, joined with the compiled program's
+    named-scope metadata by `profiler.phase_table`."""
+    import tempfile
+
+    from conflux_tpu import profiler
+
+    comp = program.lower(dev).compile()
+    trace_dir = tempfile.mkdtemp(prefix="conflux-phases-")
+    with profiler.trace(trace_dir):
+        out = comp(dev)
+        sync(out[0] if isinstance(out, tuple) else out)
+    try:
+        profiler.phase_table(trace_dir, comp.as_text())
+    except (ImportError, FileNotFoundError, ValueError) as e:
+        # CPU runs have no device plane; the proto reader needs the baked
+        # tensorflow package — the host-region report still prints
+        print(f"(no device phase table: {e})")

@@ -116,6 +116,11 @@ def main(argv=None) -> int:
         print(f"_residual_ {res:.3e}")
 
     if args.profile:
+        if not single:
+            from conflux_tpu.cli.common import phase_profile
+            from conflux_tpu.lu.distributed import build_program
+
+            phase_profile(build_program(geom, mesh), dev)
         profiler.report()
     return 0
 

@@ -71,6 +71,38 @@ class BlockCyclicLayout:
         )
 
 
+def numroc(n: int, nb: int, iproc: int, isrcproc: int, nprocs: int) -> int:
+    """NUMber of Rows Or Columns: ScaLAPACK's exact `numroc` formula
+    (the reference links it via `examples/utils.hpp` local-size math).
+    Rows/cols of a block-cyclically distributed dimension owned by
+    process `iproc` when the first block lives on `isrcproc`."""
+    mydist = (nprocs + iproc - isrcproc) % nprocs
+    nblocks = n // nb
+    num = (nblocks // nprocs) * nb
+    extrablks = nblocks % nprocs
+    if mydist < extrablks:
+        num += nb
+    elif mydist == extrablks:
+        num += n % nb
+    return num
+
+
+def scalapack_desc(layout: BlockCyclicLayout, p: int = 0, q: int = 0,
+                   ctxt: int = 0) -> np.ndarray:
+    """The 9-integer ScaLAPACK array descriptor for this layout, as the
+    calling coordinate (p, q) would pass to p?gemm/descinit_
+    (`examples/conflux_miniapp.cpp:404-500` builds these for the pdgemm
+    validation). Entries: [DTYPE_, CTXT_, M_, N_, MB_, NB_, RSRC_, CSRC_,
+    LLD_]; LLD_ is the caller's local leading dimension (column-major,
+    ScaLAPACK convention), i.e. its numroc row count.
+    """
+    lld = max(1, numroc(layout.M, layout.vr, p, 0, layout.Prows))
+    return np.array(
+        [1, ctxt, layout.M, layout.N, layout.vr, layout.vc, 0, 0, lld],
+        dtype=np.int64,
+    )
+
+
 def scatter(A: np.ndarray, layout: BlockCyclicLayout) -> list[list[np.ndarray]]:
     """Split a global matrix into per-coordinate local buffers (tiles in
     local block-cyclic order, row-major within)."""

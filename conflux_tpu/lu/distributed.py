@@ -422,6 +422,24 @@ def _build(geom: LUGeometry, mesh_key, precision, backend: str,
 
 
 
+def build_program(geom: LUGeometry, mesh, precision=None,
+                  backend: str | None = None, panel_chunk: int | None = None,
+                  donate: bool = False):
+    """The jitted distributed-LU program itself (cached per config).
+
+    For callers that need the compile artifacts — e.g. the miniapp's
+    `--profile`, which joins an XPlane trace with the optimized HLO's
+    named-scope metadata (`profiler.phase_table`) to print the per-phase
+    device-time table.
+    """
+    precision = blas.matmul_precision() if precision is None else precision
+    backend = blas.get_backend() if backend is None else backend
+    if panel_chunk is None:
+        panel_chunk = _DEFAULT_PANEL_CHUNK
+    return _build(geom, mesh_cache_key(mesh), precision, backend,
+                  panel_chunk, donate)
+
+
 def lu_factor_distributed(shards, geom: LUGeometry, mesh,
                           precision=None, backend: str | None = None,
                           panel_chunk: int | None = None,

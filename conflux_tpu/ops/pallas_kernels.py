@@ -219,10 +219,14 @@ def _scatter_rows_kernel(N, v, M, idx_ref, rows_ref, a_ref, out_ref,
         )
 
     def body(i, carry):
-        # retire the store that used this slot, then refill it
-        @pl.when((i >= W) & (idx_ref[i - W] < M))
+        # retire the store that used this slot, then refill it. The index
+        # read is clamped because Mosaic does not bounds-check dynamic SMEM
+        # indexing — the (i >= W) conjunct gates execution, not the read.
+        prev = jnp.maximum(i - W, 0)
+
+        @pl.when((i >= W) & (idx_ref[prev] < M))
         def _():
-            store(i - W).wait()
+            store(prev).wait()
 
         load(i).start()
         load(i).wait()

@@ -92,7 +92,9 @@ def trace(logdir: str):
 # Device-side per-phase timing (the reference's per-step semiprof table)
 # --------------------------------------------------------------------------- #
 
-_PHASE_RE = r"(step\d+_[a-z0-9]+)"
+# LU loop scopes (step0_reduce .. step7_writes) + Cholesky loop scopes
+# (reference vocabulary: reduceA11/choleskyA00/updateA10/scatterA11/computeA11)
+_PHASE_RE = r"(step\d+_[a-z0-9]+|(?:reduce|cholesky|update|compute|scatter)A\d\d)"
 
 
 def _scope_map(hlo_text: str, phase_re: str) -> dict[str, str]:
@@ -165,6 +167,10 @@ def _trace_durations(trace_dir: str) -> dict[str, float]:
                 stack.append(i)
             for (_off, _end, tok), s in zip(evs, self_ps):
                 durs[tok] += s / 1e9
+    if not durs:
+        raise ValueError(
+            "trace has no device op events (CPU runs have no device "
+            "plane; the phase table needs a TPU execution)")
     return dict(durs)
 
 

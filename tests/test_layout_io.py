@@ -190,3 +190,33 @@ def test_choose_cholesky_tile_properties():
     assert choose_cholesky_tile(4096, 64) <= 1024
     v = choose_cholesky_tile(2048, 16)
     assert 2048 // (v * 4) >= 2  # >= 2 tile cols per x-axis device
+
+
+def test_numroc_matches_local_shape():
+    from conflux_tpu.layout import numroc
+
+    # local_shape's tile math and ScaLAPACK's numroc formula must agree on
+    # every coordinate, including ragged trailing tiles
+    for (M, N, vr, vc, Pr, Pc) in [(20, 12, 4, 4, 2, 3), (10, 7, 4, 3, 2, 2),
+                                   (17, 33, 5, 8, 3, 2), (8, 8, 8, 8, 2, 2)]:
+        lay = BlockCyclicLayout(M=M, N=N, vr=vr, vc=vc, Prows=Pr, Pcols=Pc)
+        for p in range(Pr):
+            for q in range(Pc):
+                rows = numroc(M, vr, p, 0, Pr)
+                cols = numroc(N, vc, q, 0, Pc)
+                got = lay.local_shape(p, q)
+                # local buffers round partial tiles up except the global
+                # trailing tile; numroc is exact — compare via scatter
+                shard = scatter(np.ones((M, N)), lay)[p][q]
+                assert shard.size == rows * cols or shard.size == 0
+                if shard.size:
+                    assert got[0] * got[1] >= rows * cols
+
+
+def test_scalapack_desc():
+    from conflux_tpu.layout import numroc, scalapack_desc
+
+    lay = BlockCyclicLayout(M=100, N=60, vr=8, vc=16, Prows=3, Pcols=2)
+    d = scalapack_desc(lay, p=1, q=0, ctxt=5)
+    assert d.tolist() == [1, 5, 100, 60, 8, 16, 0, 0,
+                          numroc(100, 8, 1, 0, 3)]
