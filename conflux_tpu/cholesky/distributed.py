@@ -211,11 +211,15 @@ def _build(geom: CholeskyGeometry, mesh_key, precision, backend: str,
 
 def build_program(geom: CholeskyGeometry, mesh, precision=None,
                   backend: str | None = None, donate: bool = False):
-    """The jitted distributed-Cholesky program (cached per config), for
+    """The jitted distributed-Cholesky program (cached per config) — the
+    single point resolving trace-time defaults and the CPU donate guard;
+    `cholesky_factor_distributed` goes through here. Direct use is for
     callers needing compile artifacts — e.g. the miniapp's `--profile`
     per-phase device table (see `lu.distributed.build_program`)."""
     precision = blas.matmul_precision() if precision is None else precision
     backend = blas.get_backend() if backend is None else backend
+    if donate and next(iter(mesh.devices.flat)).platform == "cpu":
+        donate = False  # CPU PJRT has no buffer donation (warns per call)
     return _build(geom, mesh_cache_key(mesh), precision, backend, donate)
 
 
@@ -227,11 +231,8 @@ def cholesky_factor_distributed(shards, geom: CholeskyGeometry, mesh,
     aliases the input into the output — without it the superstep loop
     cannot update in place (an immutable input forces a full-buffer copy
     per step, measured ~6 ms/step at N=16384 on a v5e)."""
-    precision = blas.matmul_precision() if precision is None else precision
-    backend = blas.get_backend() if backend is None else backend
-    if donate and next(iter(mesh.devices.flat)).platform == "cpu":
-        donate = False  # CPU PJRT has no buffer donation (warns per call)
-    fn = _build(geom, mesh_cache_key(mesh), precision, backend, donate)
+    fn = build_program(geom, mesh, precision=precision, backend=backend,
+                       donate=donate)
     return fn(shards)
 
 

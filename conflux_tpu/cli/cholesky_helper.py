@@ -11,11 +11,11 @@ Subcommands:
     factor    read an input file, factor it on the current JAX platform,
               write the lower factor — produces the file `compare` consumes
 
-Files use the framework's binary format (`conflux_tpu.io`): int64 header
-(M, N, dtype code) + row-major data. This is NOT the reference helper's raw
-headerless format (dim*dim doubles); feeding such a file here is detected by
-a header/size consistency check and rejected with a clear error — convert by
-prepending the 24-byte header.
+Files are written in the framework's binary format (`conflux_tpu.io`):
+int64 header (M, N, dtype code) + row-major data. READING also accepts the
+reference helper's raw headerless format (dim*dim float64, detected by exact
+file size — `examples/cholesky_helper.cpp` writes these), so `factor` and
+`compare` consume reference-produced input_N.bin / result_N.bin directly.
 
 Examples:
     python -m conflux_tpu.cli.cholesky_helper generate --dim 4096 \
@@ -33,7 +33,7 @@ import argparse
 import numpy as np
 
 from conflux_tpu.cli.common import add_common_args, np_dtype, setup_platform
-from conflux_tpu.io import load_matrix, save_matrix
+from conflux_tpu.io import load_matrix_auto, save_matrix
 from conflux_tpu.validation import make_spd_matrix
 
 
@@ -99,8 +99,8 @@ def _generate(args) -> int:
 
 
 def _compare(args) -> int:
-    A = load_matrix(args.a).astype(np.float64)
-    B = load_matrix(args.b).astype(np.float64)
+    A = load_matrix_auto(args.a).astype(np.float64)
+    B = load_matrix_auto(args.b).astype(np.float64)
     if A.shape != B.shape:
         print(f"shape mismatch: {A.shape} vs {B.shape}")
         return 1
@@ -129,7 +129,7 @@ def _factor(args) -> int:
     )
     from conflux_tpu.parallel.mesh import make_mesh
 
-    A = load_matrix(args.infile)
+    A = load_matrix_auto(args.infile)
     N = A.shape[0]
     n_devices = len(jax.devices())
     grid = Grid3.parse(args.grid) if args.grid else choose_cholesky_grid(n_devices)

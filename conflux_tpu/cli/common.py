@@ -75,13 +75,13 @@ def phase_profile(program, dev) -> None:
     from conflux_tpu import profiler
 
     comp = program.lower(dev).compile()
-    trace_dir = tempfile.mkdtemp(prefix="conflux-phases-")
-    with profiler.trace(trace_dir):
-        out = comp(dev)
-        sync(out[0] if isinstance(out, tuple) else out)
-    try:
-        profiler.phase_table(trace_dir, comp.as_text())
-    except (ImportError, FileNotFoundError, ValueError) as e:
-        # CPU runs have no device plane; the proto reader needs the baked
-        # tensorflow package — the host-region report still prints
-        print(f"(no device phase table: {e})")
+    with tempfile.TemporaryDirectory(prefix="conflux-phases-") as trace_dir:
+        with profiler.trace(trace_dir):
+            out = comp(dev)
+            sync(out[0] if isinstance(out, tuple) else out)
+        try:
+            profiler.phase_table(trace_dir, comp.as_text())
+        except (ImportError, FileNotFoundError, ValueError) as e:
+            # CPU runs have no device plane; the proto reader needs the
+            # baked tensorflow package — the host-region report still prints
+            print(f"(no device phase table: {e})")

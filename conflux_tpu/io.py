@@ -111,6 +111,32 @@ def _read_header(path: str) -> tuple[int, int, np.dtype]:
     return M, N, _DTYPES[code]
 
 
+def load_matrix_auto(path: str) -> np.ndarray:
+    """Load a matrix from either format: the framework's headered file, or
+    the reference cholesky_helper's raw headerless dump of dim*dim float64
+    (`examples/cholesky_helper.cpp` writes these) — detected by exact file
+    size. Raw float32 squares are accepted too. Ambiguity is impossible:
+    a valid header demands size == 24 + M*N*itemsize, a raw square demands
+    size == dim^2*itemsize, and the loader only falls back on rejection.
+    """
+    import math
+    import os
+
+    try:
+        return load_matrix(path)
+    except ValueError as header_err:
+        size = os.path.getsize(path)
+        for np_t in (np.float64, np.float32):
+            n2, rem = divmod(size, np.dtype(np_t).itemsize)
+            dim = math.isqrt(n2)
+            if rem == 0 and dim * dim == n2 and dim > 0:
+                return np.fromfile(path, dtype=np_t).reshape(dim, dim)
+        raise ValueError(
+            f"{path!r} is neither a conflux_tpu matrix file nor a raw "
+            f"square float64/float32 dump ({size} bytes)"
+        ) from header_err
+
+
 def generate_spd_file(path: str, N: int, v: int = 256, seed: int = 7,
                       dtype=np.float64) -> None:
     """Stream a deterministic SPD matrix to disk one tile-strip at a time.

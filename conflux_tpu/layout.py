@@ -87,14 +87,15 @@ def numroc(n: int, nb: int, iproc: int, isrcproc: int, nprocs: int) -> int:
     return num
 
 
-def scalapack_desc(layout: BlockCyclicLayout, p: int = 0, q: int = 0,
+def scalapack_desc(layout: BlockCyclicLayout, p: int = 0,
                    ctxt: int = 0) -> np.ndarray:
-    """The 9-integer ScaLAPACK array descriptor for this layout, as the
-    calling coordinate (p, q) would pass to p?gemm/descinit_
+    """The 9-integer ScaLAPACK array descriptor for this layout, as a
+    caller in process row p would pass to p?gemm/descinit_
     (`examples/conflux_miniapp.cpp:404-500` builds these for the pdgemm
     validation). Entries: [DTYPE_, CTXT_, M_, N_, MB_, NB_, RSRC_, CSRC_,
     LLD_]; LLD_ is the caller's local leading dimension (column-major,
-    ScaLAPACK convention), i.e. its numroc row count.
+    ScaLAPACK convention), i.e. its numroc row count — it depends only on
+    the process ROW, so no column coordinate is taken.
     """
     lld = max(1, numroc(layout.M, layout.vr, p, 0, layout.Prows))
     return np.array(

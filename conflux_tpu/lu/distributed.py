@@ -427,15 +427,19 @@ def build_program(geom: LUGeometry, mesh, precision=None,
                   donate: bool = False):
     """The jitted distributed-LU program itself (cached per config).
 
-    For callers that need the compile artifacts — e.g. the miniapp's
-    `--profile`, which joins an XPlane trace with the optimized HLO's
-    named-scope metadata (`profiler.phase_table`) to print the per-phase
-    device-time table.
+    The single point resolving the trace-time defaults (precision/backend/
+    panel_chunk, CPU donate guard); `lu_factor_distributed` goes through
+    here too. Direct use is for callers that need the compile artifacts —
+    e.g. the miniapp's `--profile`, which joins an XPlane trace with the
+    optimized HLO's named-scope metadata (`profiler.phase_table`) to print
+    the per-phase device-time table.
     """
     precision = blas.matmul_precision() if precision is None else precision
     backend = blas.get_backend() if backend is None else backend
     if panel_chunk is None:
         panel_chunk = _DEFAULT_PANEL_CHUNK
+    if donate and next(iter(mesh.devices.flat)).platform == "cpu":
+        donate = False  # CPU PJRT has no buffer donation (warns per call)
     return _build(geom, mesh_cache_key(mesh), precision, backend,
                   panel_chunk, donate)
 
@@ -466,14 +470,8 @@ def lu_factor_distributed(shards, geom: LUGeometry, mesh,
     array is invalidated) — at N=32768 f32 on a 16 GB chip this saves the
     4 GB that makes the difference between fitting and OOM.
     """
-    precision = blas.matmul_precision() if precision is None else precision
-    backend = blas.get_backend() if backend is None else backend
-    if panel_chunk is None:
-        panel_chunk = _DEFAULT_PANEL_CHUNK
-    if donate and next(iter(mesh.devices.flat)).platform == "cpu":
-        donate = False  # CPU PJRT has no buffer donation (warns per call)
-    fn = _build(geom, mesh_cache_key(mesh), precision, backend, panel_chunk,
-                donate)
+    fn = build_program(geom, mesh, precision=precision, backend=backend,
+                       panel_chunk=panel_chunk, donate=donate)
     return fn(shards)
 
 

@@ -1,0 +1,68 @@
+"""Worker for the two-process multihost test (`test_multihost.py`).
+
+Each process runs this script with (process_id, num_processes, port): it
+brings up `jax.distributed` over localhost (the `MPI_Init` role,
+reference `examples/conflux_miniapp.cpp:90`), contributes 4 virtual CPU
+devices to an 8-device global mesh, materializes ONLY its own block-cyclic
+shards — from a position formula, so no process ever holds the global
+matrix (the reference's per-rank `InitMatrix` fill, `lu_params.hpp:141-376`)
+— factors, and validates gather-free on the mesh.
+"""
+
+import os
+import sys
+
+pid, nproc, port = int(sys.argv[1]), int(sys.argv[2]), sys.argv[3]
+os.environ["XLA_FLAGS"] = ("--xla_force_host_platform_device_count=4 "
+                           + os.environ.get("XLA_FLAGS", ""))
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+from conflux_tpu.parallel.mesh import (  # noqa: E402
+    distribute_shards,
+    initialize_multihost,
+    make_mesh,
+)
+
+initialize_multihost(f"localhost:{port}", nproc, pid)
+
+import numpy as np  # noqa: E402
+
+from conflux_tpu.geometry import Grid3, LUGeometry  # noqa: E402
+from conflux_tpu.lu.distributed import lu_factor_distributed  # noqa: E402
+from conflux_tpu.validation import lu_residual_distributed  # noqa: E402
+
+assert len(jax.devices()) == 8, jax.devices()
+grid = Grid3(4, 2, 1)
+v = 8
+geom = LUGeometry.create(v * 8, v * 8, v, grid)
+mesh = make_mesh(grid, devices=jax.devices()[: grid.P])
+
+calls: list[tuple[int, int]] = []
+
+
+def local_shard(px, py):
+    """(Ml, Nl) shard straight from global indices — tile-local, the whole
+    point of the callable `distribute_shards` form: a position-formula
+    fill (diagonally dominant) evaluated only on owned coordinates."""
+    calls.append((px, py))
+    li = np.arange(geom.Ml)
+    lj = np.arange(geom.Nl)
+    gi = ((li // v) * grid.Px + px) * v + li % v  # global rows here
+    gj = ((lj // v) * grid.Py + py) * v + lj % v
+    G = np.sin(0.37 * gi[:, None] + 1.31 * gj[None, :]).astype(np.float32)
+    return G + geom.M * (gi[:, None] == gj[None, :])
+
+
+shards = distribute_shards(
+    local_shard, mesh, shape=(grid.Px, grid.Py, geom.Ml, geom.Nl),
+    dtype=np.float32)
+out, perm = lu_factor_distributed(shards, geom, mesh)
+res = float(lu_residual_distributed(shards, out, perm, geom, mesh))
+n_local = len(set(calls))
+print(f"proc {pid}: local_shards={n_local} residual={res:.3e}", flush=True)
+# the callable form must touch only this process's addressable shards
+assert n_local == grid.P // nproc, (pid, sorted(set(calls)))
+assert res < 1e-4, res

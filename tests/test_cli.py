@@ -107,3 +107,35 @@ def test_cholesky_helper_compare_fails_above_tol(tmp_path, capsys):
     save_matrix(a, np.eye(8))
     save_matrix(b, 2 * np.eye(8))
     assert cholesky_helper.main(["compare", a, b, "--tol", "1e-3"]) == 1
+
+
+def test_cholesky_helper_reads_reference_raw_format(tmp_path):
+    """The reference cholesky_helper writes raw headerless dim*dim doubles;
+    factor + compare must consume them directly."""
+    import numpy as np
+
+    from conflux_tpu.cli import cholesky_helper
+    from conflux_tpu.io import load_matrix_auto
+
+    dim = 32
+    rng = np.random.default_rng(0)
+    B = rng.standard_normal((dim, dim))
+    A = B @ B.T + dim * np.eye(dim)
+    raw = tmp_path / "input_32.bin"
+    A.astype(np.float64).tofile(str(raw))  # reference format: no header
+
+    np.testing.assert_array_equal(load_matrix_auto(str(raw)), A)
+
+    out = tmp_path / "mine_32.bin"
+    rc = cholesky_helper.main(
+        ["factor", str(raw), str(out), "--tile", "8", "--platform", "cpu",
+         "--devices", "1", "--dtype", "float64"])
+    assert rc == 0
+    import scipy.linalg
+
+    ref = tmp_path / "result_32.bin"
+    L = scipy.linalg.cholesky(A, lower=True)
+    L.astype(np.float64).tofile(str(ref))  # raw reference result file
+    rc = cholesky_helper.main(
+        ["compare", str(out), str(ref), "--lower", "--tol", "1e-10"])
+    assert rc == 0

@@ -1,0 +1,52 @@
+"""True multi-process execution of the distributed LU.
+
+The reference's multi-rank path is MPI SPMD; the TPU equivalent is
+`jax.distributed` — multiple host processes, each owning a slice of the
+global device set, running the SAME jitted shard_map program. The CPU-mesh
+tests in this suite simulate 8 devices in ONE process; this test runs the
+real thing: two OS processes x 4 virtual CPU devices each, gloo
+collectives between them, block-cyclic shards materialized per process
+from a position formula (never the global matrix), and the gather-free
+on-mesh residual check.
+"""
+
+import os
+import socket
+import subprocess
+import sys
+
+import pytest
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("localhost", 0))
+        return s.getsockname()[1]
+
+
+@pytest.mark.slow
+def test_two_process_multihost_lu():
+    worker = os.path.join(os.path.dirname(__file__), "multihost_worker.py")
+    port = str(_free_port())
+    env = {k: v for k, v in os.environ.items() if k != "XLA_FLAGS"}
+    procs = [
+        subprocess.Popen(
+            [sys.executable, worker, str(pid), "2", port],
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+            env=env, cwd=os.path.dirname(worker),
+        )
+        for pid in range(2)
+    ]
+    outs = []
+    try:
+        for p in procs:
+            out, _ = p.communicate(timeout=240)
+            outs.append(out)
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+                p.wait()
+    for pid, (p, out) in enumerate(zip(procs, outs)):
+        assert p.returncode == 0, f"proc {pid} failed:\n{out[-3000:]}"
+        assert f"proc {pid}: local_shards=4 residual=" in out
