@@ -16,7 +16,15 @@ import argparse
 
 import numpy as np
 
-from conflux_tpu.cli.common import WallTimer, add_common_args, np_dtype, setup_platform, sync
+from conflux_tpu.cli.common import (
+    WallTimer,
+    add_common_args,
+    add_experiment_type_arg,
+    np_dtype,
+    result_line,
+    setup_platform,
+    sync,
+)
 
 
 def parse_args(argv=None):
@@ -26,6 +34,7 @@ def parse_args(argv=None):
     p.add_argument("--grid", default=None, help="Px,Py,Pz (default: auto)")
     p.add_argument("--run", type=int, default=2, help="timed repetitions")
     p.add_argument("--validate", action="store_true", help="residual ||A-LL^T||_F check")
+    add_experiment_type_arg(p)
     add_common_args(p)
     return p.parse_args(argv)
 
@@ -94,11 +103,11 @@ def main(argv=None) -> int:
     for ms in times:
         print(f"       {ms:.3f}")
     print("==========================================")
+    # our extension (the reference cholesky_miniapp prints only the
+    # timings block) — same field shape as the LU line for one parser
     for ms in times:
-        print(
-            f"_result_ cholesky,conflux_tpu,{geom.N},{args.dim},{grid.P},"
-            f"{grid},time,{args.dtype},{ms:.3f},{geom.v}"
-        )
+        print(result_line("cholesky", geom.N, grid.P, grid, args.type, ms,
+                          geom.v, args.dtype))
 
     if args.validate:
         with profiler.region("validation"):

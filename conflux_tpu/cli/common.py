@@ -85,3 +85,26 @@ def phase_profile(program, dev) -> None:
             # CPU runs have no device plane; the proto reader needs the
             # baked tensorflow package — the host-region report still prints
             print(f"(no device phase table: {e})")
+
+
+def add_experiment_type_arg(p) -> None:
+    """The reference's -t vocabulary (`examples/conflux_miniapp.cpp:63-66`)."""
+    p.add_argument(
+        "-t", "--type", default="weak", choices=["weak", "strong"],
+        help="experiment type: sets the reported N_base (reference "
+        "convention: N / int(sqrt(P)) for weak scaling, N for strong)",
+    )
+
+
+def result_line(algo: str, N: int, P: int, grid, exp_type: str,
+                ms: float, v: int, dtype: str) -> str:
+    """Reference line shape (`examples/conflux_miniapp.cpp:136-165`):
+    `_result_ <algo>,<impl>,<N>,<N_base>,<P>,<grid>,time,<weak|strong>,<ms>,<v>`
+    with N_base = N // int(sqrt(P)) under weak scaling (the reference
+    truncates the sqrt — NOT a rounded float division), and the dtype
+    appended as an 11th field fixed-width parsers ignore."""
+    import math
+
+    n_base = N // math.isqrt(P) if exp_type == "weak" else N
+    return (f"_result_ {algo},conflux_tpu,{N},{n_base},{P},"
+            f"{grid},time,{exp_type},{ms:.3f},{v},{dtype}")

@@ -20,7 +20,15 @@ import argparse
 
 import numpy as np
 
-from conflux_tpu.cli.common import WallTimer, add_common_args, np_dtype, setup_platform, sync
+from conflux_tpu.cli.common import (
+    WallTimer,
+    add_common_args,
+    add_experiment_type_arg,
+    np_dtype,
+    result_line,
+    setup_platform,
+    sync,
+)
 
 
 def parse_args(argv=None):
@@ -33,10 +41,8 @@ def parse_args(argv=None):
         help="Px,Py,Pz (default: auto-pick over all available devices)",
     )
     p.add_argument("-r", "--n_rep", type=int, default=2, help="timed repetitions")
-    p.add_argument(
-        "-t", "--type", default="lu", choices=["lu"], help="benchmark type tag"
-    )
     p.add_argument("--validate", action="store_true", help="residual ||PA-LU||_F check")
+    add_experiment_type_arg(p)
     add_common_args(p)
     return p.parse_args(argv)
 
@@ -96,10 +102,8 @@ def main(argv=None) -> int:
             times.append(t.ms)
 
     for ms in times:
-        print(
-            f"_result_ lu,conflux_tpu,{geom.N},{args.N},{grid.P},"
-            f"{grid},time,{args.dtype},{ms:.3f},{geom.v}"
-        )
+        print(result_line("lu", geom.N, grid.P, grid, args.type, ms, geom.v,
+                          args.dtype))
 
     if args.validate:
         with profiler.region("validation"):

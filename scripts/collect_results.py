@@ -19,15 +19,22 @@ FLOPS = {"lu": 2.0 / 3.0, "cholesky": 1.0 / 3.0}
 
 
 def parse_line(line: str):
-    # _result_ lu,conflux_tpu,<N>,<Nbase>,<P>,<grid>,time,<dtype>,<ms>,<v>
+    # current (reference-shape + trailing dtype):
+    #   _result_ lu,<impl>,<N>,<Nbase>,<P>,<grid>,time,<weak|strong>,<ms>,<v>,<dtype>
+    # legacy (round-1 logs, dtype in the type slot):
+    #   _result_ lu,<impl>,<N>,<Nbase>,<P>,<grid>,time,<dtype>,<ms>,<v>
     parts = line.split()[1].split(",")
-    algo, _, N, Nbase, P, grid, _, dtype, ms, v = parts
+    if len(parts) == 11:
+        algo, _, N, Nbase, P, grid, _, exp, ms, v, dtype = parts
+    else:
+        algo, _, N, Nbase, P, grid, _, dtype, ms, v = parts
+        exp = ""
     N, ms = int(N), float(ms)
     gflops = FLOPS[algo] * N**3 / (ms * 1e-3) / 1e9
     return {
         "algorithm": algo, "N": N, "N_base": int(Nbase), "P": int(P),
-        "grid": grid, "dtype": dtype, "time_ms": ms, "tile": int(v),
-        "gflops": round(gflops, 2),
+        "grid": grid, "type": exp, "dtype": dtype, "time_ms": ms,
+        "tile": int(v), "gflops": round(gflops, 2),
     }
 
 

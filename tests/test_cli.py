@@ -25,7 +25,8 @@ def test_conflux_miniapp_result_line(capsys):
     lines = [l for l in out.splitlines() if l.startswith("_result_")]
     assert len(lines) == 2
     m = re.match(
-        r"_result_ lu,conflux_tpu,64,64,4,2x2x1,time,float64,([\d.]+),16", lines[0]
+        r"_result_ lu,conflux_tpu,64,32,4,2x2x1,time,weak,([\d.]+),16,float64",
+        lines[0]
     )
     assert m, lines[0]
     res = [l for l in out.splitlines() if l.startswith("_residual_")]
@@ -52,7 +53,7 @@ def test_cholesky_miniapp(capsys):
     assert "PROBLEM PARAMETERS" in out
     lines = [l for l in out.splitlines() if l.startswith("_result_")]
     assert len(lines) == 2
-    assert lines[0].startswith("_result_ cholesky,conflux_tpu,64,64,8,2x2x2,time,")
+    assert lines[0].startswith("_result_ cholesky,conflux_tpu,64,32,8,2x2x2,time,weak,")
     res = [l for l in out.splitlines() if l.startswith("_residual_")]
     assert float(res[0].split()[1]) < 1e-4
 
