@@ -46,13 +46,14 @@ from conflux_tpu.parallel.mesh import (
 
 @functools.lru_cache(maxsize=32)
 def _build(geom: CholeskyGeometry, mesh_key, precision, backend: str,
-           donate: bool = False):
+           donate: bool = False, step_range: tuple[int, int] | None = None):
     mesh = lookup_mesh(mesh_key)
     v = geom.v
     Px, Py, Pz = geom.grid.Px, geom.grid.Py, geom.grid.Pz
     Ml, Nl = geom.Ml, geom.Nl
     nlayr = geom.nlayr
     n_steps = geom.Kappa
+    k0, k_end = step_range if step_range is not None else (0, n_steps)
     v_pad = Pz * nlayr
 
     # trailing-update segmentation (same idea as lu.distributed): both the
@@ -196,7 +197,7 @@ def _build(geom: CholeskyGeometry, mesh_key, precision, backend: str,
             )
             return Anew
 
-        Aloc = lax.fori_loop(0, n_steps, body, Aloc)
+        Aloc = lax.fori_loop(k0, k_end, body, Aloc)
         Aout = lax.psum(Aloc, AXIS_Z)
         return Aout[None, None]
 
@@ -221,6 +222,28 @@ def build_program(geom: CholeskyGeometry, mesh, precision=None,
     if donate and next(iter(mesh.devices.flat)).platform == "cpu":
         donate = False  # CPU PJRT has no buffer donation (warns per call)
     return _build(geom, mesh_cache_key(mesh), precision, backend, donate)
+
+
+def cholesky_factor_steps(shards, geom: CholeskyGeometry, mesh,
+                          k0: int, k1: int, precision=None,
+                          backend: str | None = None, donate: bool = False):
+    """Factor supersteps [k0, k1) only — checkpoint/restart for Cholesky
+    (no pivot state to carry, unlike `lu.distributed.lu_factor_steps`):
+    feed each call's output shards into the next; after the last call the
+    lower triangle holds L as `cholesky_factor_distributed` computes it —
+    bit-identically when Pz == 1; with Pz > 1 the checkpoint consolidates
+    the 2.5D z-partial sums, so a resumed run is numerically equivalent
+    but re-associates f32 additions (same caveat as `lu_factor_steps`).
+    """
+    if not (0 <= k0 < k1 <= geom.Kappa):
+        raise ValueError(f"step range [{k0}, {k1}) outside [0, {geom.Kappa})")
+    precision = blas.matmul_precision() if precision is None else precision
+    backend = blas.get_backend() if backend is None else backend
+    if donate and next(iter(mesh.devices.flat)).platform == "cpu":
+        donate = False
+    fn = _build(geom, mesh_cache_key(mesh), precision, backend, donate,
+                step_range=(k0, k1))
+    return fn(shards)
 
 
 def cholesky_factor_distributed(shards, geom: CholeskyGeometry, mesh,

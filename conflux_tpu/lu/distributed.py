@@ -79,13 +79,18 @@ _DEFAULT_PANEL_CHUNK = 8192
 
 @functools.lru_cache(maxsize=32)
 def _build(geom: LUGeometry, mesh_key, precision, backend: str,
-           panel_chunk: int, donate: bool = False):
+           panel_chunk: int, donate: bool = False,
+           step_range: tuple[int, int] | None = None):
+    """step_range=(k0, k1) builds the RESUMABLE form: factor supersteps
+    k0..k1 only, with the row-origin state as an explicit input/output —
+    the basis of checkpoint/restart (`lu_factor_steps`)."""
     mesh = lookup_mesh(mesh_key)
     v = geom.v
     Px, Py, Pz = geom.grid.Px, geom.grid.Py, geom.grid.Pz
     Ml, Nl = geom.Ml, geom.Nl
     nlayr = geom.nlayr
     n_steps = geom.n_steps
+    k0, k_end = step_range if step_range is not None else (0, n_steps)
     Mcap = geom.M  # positions are < Mcap; sentinel values exceed it
     v_pad = Pz * nlayr  # inner dim padded so every z layer gets a full slab
     # trailing-update segmentation: row and column liveness are both
@@ -97,14 +102,16 @@ def _build(geom: LUGeometry, mesh_key, precision, backend: str,
     col_segs = ragged_segments(geom.Ntl, v, 8)
     row_segs = ragged_segments(geom.Mtl, v, 4)
 
-    def device_fn(blk):
+    def device_fn(blk, orig_blk=None):
         x = lax.axis_index(AXIS_X)
         y = lax.axis_index(AXIS_Y)
         z = lax.axis_index(AXIS_Z)
         dtype = blk.dtype
         cdtype = blas.compute_dtype(dtype)
 
-        # z-partial invariant: sum over z == true matrix; data enters on z=0
+        # z-partial invariant: sum over z == true matrix; data enters on
+        # z=0. A resumed state round-trips through the same line: outputs
+        # are z-replicated, so taking layer 0 restores the invariant.
         Aloc = jnp.where(z == 0, blk[0, 0], jnp.zeros((), dtype))
 
         lr = jnp.arange(Ml, dtype=jnp.int32)
@@ -114,8 +121,9 @@ def _build(geom: LUGeometry, mesh_key, precision, backend: str,
         ctile = (lc // v) * Py + y  # global col-tile id per local col
 
         # original row id currently at each local position (rows start in
-        # original order, so position == original id at step 0)
-        orig0 = gp
+        # original order, so position == original id at step 0); resumed
+        # runs carry it in as explicit state
+        orig0 = gp if orig_blk is None else orig_blk[0]
 
         def loc_of(pos):
             """Local row index of a (v,) vector of global positions; Ml
@@ -401,7 +409,7 @@ def _build(geom: LUGeometry, mesh_key, precision, backend: str,
                 )
             return Anew, orig
 
-        Aloc, orig = lax.fori_loop(0, n_steps, body, (Aloc, orig0))
+        Aloc, orig = lax.fori_loop(k0, k_end, body, (Aloc, orig0))
         # all factors live on layer 0; psum makes the output z-replicated
         Aout = lax.psum(Aloc, AXIS_Z)
         # assemble the permutation: original row id at every global position
@@ -410,13 +418,26 @@ def _build(geom: LUGeometry, mesh_key, precision, backend: str,
         # identical on every device already; pmax re-establishes replication
         # for the out_spec
         perm = lax.pmax(perm, (AXIS_Y, AXIS_Z))
-        return Aout[None, None], perm
+        if orig_blk is None:
+            return Aout[None, None], perm
+        # resumable form: the row-origin state rides along (replicated over
+        # y/z by pmax — every y/z holds the same x-row's state)
+        orig_out = lax.pmax(orig, (AXIS_Y, AXIS_Z))
+        return Aout[None, None], orig_out[None], perm
 
+    if step_range is None:
+        fn = jax.shard_map(
+            device_fn,
+            mesh=mesh,
+            in_specs=P(AXIS_X, AXIS_Y, None, None),
+            out_specs=(P(AXIS_X, AXIS_Y, None, None), P()),
+        )
+        return jax.jit(fn, donate_argnums=(0,) if donate else ())
     fn = jax.shard_map(
         device_fn,
         mesh=mesh,
-        in_specs=P(AXIS_X, AXIS_Y, None, None),
-        out_specs=(P(AXIS_X, AXIS_Y, None, None), P()),
+        in_specs=(P(AXIS_X, AXIS_Y, None, None), P(AXIS_X, None)),
+        out_specs=(P(AXIS_X, AXIS_Y, None, None), P(AXIS_X, None), P()),
     )
     return jax.jit(fn, donate_argnums=(0,) if donate else ())
 
@@ -473,6 +494,57 @@ def lu_factor_distributed(shards, geom: LUGeometry, mesh,
     fn = build_program(geom, mesh, precision=precision, backend=backend,
                        panel_chunk=panel_chunk, donate=donate)
     return fn(shards)
+
+
+def lu_factor_steps(shards, geom: LUGeometry, mesh, k0: int, k1: int,
+                    orig=None, precision=None, backend: str | None = None,
+                    panel_chunk: int | None = None, donate: bool = False):
+    """Factor supersteps [k0, k1) only — the checkpoint/restart primitive.
+
+    The reference has no notion of resuming a partial factorization
+    (SURVEY §5: any rank failure kills the job and the work); here the
+    mid-factorization state is first-class because the matrix lives in
+    LAPACK-order positions: after k steps, global positions < k*v hold
+    frozen factor rows and the rest is the updated trailing problem.
+
+    State = (shards, orig): `orig` is the (Px, Ml) row-origin map
+    (original row id at each local position). Pass orig=None when k0 == 0
+    (rows start in original order); feed each call's outputs to the next.
+    Both arrays are plain host-saveable values (`io.save_matrix` works on
+    gathered shards), so a long factorization can checkpoint every few
+    supersteps and restart after preemption — run the same call sequence
+    with the loaded state.
+
+    Returns (shards_out, orig_out, perm). perm is only the FINAL
+    permutation once k1 == geom.n_steps; at intermediate k1 its entries
+    beyond position k1*v still name unfactored rows.
+
+    Bitwise caveat: the state output consolidates the 2.5D z-partial sums
+    into one z-replicated copy (that is what makes the checkpoint compact
+    — one matrix, not Pz layers). With Pz > 1 a resumed run therefore
+    re-associates those sums and is numerically equivalent to, but not
+    bit-identical with, the uninterrupted factorization (f32 rounding at
+    partial-sum granularity). Pz == 1 round-trips exactly.
+    """
+    if not (0 <= k0 < k1 <= geom.n_steps):
+        raise ValueError(
+            f"step range [{k0}, {k1}) outside [0, {geom.n_steps})")
+    precision = blas.matmul_precision() if precision is None else precision
+    backend = blas.get_backend() if backend is None else backend
+    if panel_chunk is None:
+        panel_chunk = _DEFAULT_PANEL_CHUNK
+    if donate and next(iter(mesh.devices.flat)).platform == "cpu":
+        donate = False
+    if orig is None:
+        if k0 != 0:
+            raise ValueError("resuming at k0 > 0 requires the orig state "
+                             "returned by the previous lu_factor_steps call")
+        # rows start in original order: origin == global row index (the
+        # same gri map the geometry exposes)
+        orig = jnp.asarray(geom.global_row_index(), jnp.int32)
+    fn = _build(geom, mesh_cache_key(mesh), precision, backend, panel_chunk,
+                donate, step_range=(k0, k1))
+    return fn(shards, orig)
 
 
 def lu_distributed_host(A: np.ndarray, grid: Grid3, v: int, mesh=None,

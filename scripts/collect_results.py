@@ -26,6 +26,10 @@ def parse_line(line: str):
     parts = line.split()[1].split(",")
     if len(parts) == 11:
         algo, _, N, Nbase, P, grid, _, exp, ms, v, dtype = parts
+    elif parts[7] in ("weak", "strong"):
+        # genuine reference-format line: 10 fields, type in slot 8, no dtype
+        algo, _, N, Nbase, P, grid, _, exp, ms, v = parts
+        dtype = ""
     else:
         algo, _, N, Nbase, P, grid, _, dtype, ms, v = parts
         exp = ""
