@@ -144,7 +144,32 @@ def cpu_gflops() -> float:
     return (2 / 3) * CPU_N**3 / dt / 1e9
 
 
+def _probe_worker(q):  # module-level: the spawn context pickles it by name
+    q.put(float(jnp.ones((8,)).sum()))
+
+
+def _probe_device(timeout_s: int = 180) -> None:
+    """Fail fast (rc 1) when the chip is unresponsive instead of hanging
+    the whole harness: a wedged TPU program (e.g. a stuck DMA from an
+    earlier crashed client) blocks every later op indefinitely, and
+    block_until_ready through the tunnel cannot time out on its own."""
+    import multiprocessing as mp
+
+    ctx = mp.get_context("spawn")
+    q = ctx.Queue()
+    p = ctx.Process(target=_probe_worker, args=(q,), daemon=True)
+    p.start()
+    p.join(timeout_s)
+    if p.is_alive():
+        p.terminate()
+        p.join(5)
+        raise SystemExit(
+            f"bench: device unresponsive after {timeout_s}s "
+            "(wedged TPU program?); aborting instead of hanging")
+
+
 def main():
+    _probe_device()
     tpu, res = tpu_bench()
     try:
         cpu = cpu_gflops()

@@ -46,14 +46,13 @@ from conflux_tpu.parallel.mesh import (
 
 @functools.lru_cache(maxsize=32)
 def _build(geom: CholeskyGeometry, mesh_key, precision, backend: str,
-           donate: bool = False, step_range: tuple[int, int] | None = None):
+           donate: bool = False, resumable: bool = False):
     mesh = lookup_mesh(mesh_key)
     v = geom.v
     Px, Py, Pz = geom.grid.Px, geom.grid.Py, geom.grid.Pz
     Ml, Nl = geom.Ml, geom.Nl
     nlayr = geom.nlayr
     n_steps = geom.Kappa
-    k0, k_end = step_range if step_range is not None else (0, n_steps)
     v_pad = Pz * nlayr
 
     # trailing-update segmentation (same idea as lu.distributed): both the
@@ -65,7 +64,7 @@ def _build(geom: CholeskyGeometry, mesh_key, precision, backend: str,
     row_bounds = ragged_segments(Ml // v, v, 4)
     col_bounds = ragged_segments(Nl // v, v, 4)
 
-    def device_fn(blk):
+    def device_fn(blk, k0=0, k_end=n_steps):
         x = lax.axis_index(AXIS_X)
         y = lax.axis_index(AXIS_Y)
         z = lax.axis_index(AXIS_Z)
@@ -201,17 +200,19 @@ def _build(geom: CholeskyGeometry, mesh_key, precision, backend: str,
         Aout = lax.psum(Aloc, AXIS_Z)
         return Aout[None, None]
 
-    fn = jax.shard_map(
-        device_fn,
-        mesh=mesh,
-        in_specs=P(AXIS_X, AXIS_Y, None, None),
-        out_specs=P(AXIS_X, AXIS_Y, None, None),
-    )
+    shard_spec = P(AXIS_X, AXIS_Y, None, None)
+    if resumable:
+        in_specs, out_specs = (shard_spec, P(), P()), shard_spec
+    else:
+        in_specs, out_specs = shard_spec, shard_spec
+    fn = jax.shard_map(device_fn, mesh=mesh, in_specs=in_specs,
+                       out_specs=out_specs)
     return jax.jit(fn, donate_argnums=(0,) if donate else ())
 
 
 def build_program(geom: CholeskyGeometry, mesh, precision=None,
-                  backend: str | None = None, donate: bool = False):
+                  backend: str | None = None, donate: bool = False,
+                  resumable: bool = False):
     """The jitted distributed-Cholesky program (cached per config) — the
     single point resolving trace-time defaults and the CPU donate guard;
     `cholesky_factor_distributed` goes through here. Direct use is for
@@ -221,7 +222,8 @@ def build_program(geom: CholeskyGeometry, mesh, precision=None,
     backend = blas.get_backend() if backend is None else backend
     if donate and next(iter(mesh.devices.flat)).platform == "cpu":
         donate = False  # CPU PJRT has no buffer donation (warns per call)
-    return _build(geom, mesh_cache_key(mesh), precision, backend, donate)
+    return _build(geom, mesh_cache_key(mesh), precision, backend, donate,
+                  resumable)
 
 
 def cholesky_factor_steps(shards, geom: CholeskyGeometry, mesh,
@@ -237,13 +239,10 @@ def cholesky_factor_steps(shards, geom: CholeskyGeometry, mesh,
     """
     if not (0 <= k0 < k1 <= geom.Kappa):
         raise ValueError(f"step range [{k0}, {k1}) outside [0, {geom.Kappa})")
-    precision = blas.matmul_precision() if precision is None else precision
-    backend = blas.get_backend() if backend is None else backend
-    if donate and next(iter(mesh.devices.flat)).platform == "cpu":
-        donate = False
-    fn = _build(geom, mesh_cache_key(mesh), precision, backend, donate,
-                step_range=(k0, k1))
-    return fn(shards)
+    # traced step bounds: one compiled program serves every segment
+    fn = build_program(geom, mesh, precision=precision, backend=backend,
+                       donate=donate, resumable=True)
+    return fn(shards, jnp.int32(k0), jnp.int32(k1))
 
 
 def cholesky_factor_distributed(shards, geom: CholeskyGeometry, mesh,

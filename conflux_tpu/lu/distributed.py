@@ -79,18 +79,17 @@ _DEFAULT_PANEL_CHUNK = 8192
 
 @functools.lru_cache(maxsize=32)
 def _build(geom: LUGeometry, mesh_key, precision, backend: str,
-           panel_chunk: int, donate: bool = False,
-           step_range: tuple[int, int] | None = None):
-    """step_range=(k0, k1) builds the RESUMABLE form: factor supersteps
-    k0..k1 only, with the row-origin state as an explicit input/output —
-    the basis of checkpoint/restart (`lu_factor_steps`)."""
+           panel_chunk: int, donate: bool = False, resumable: bool = False):
+    """resumable=True builds the checkpoint/restart form: factor supersteps
+    [k0, k1) given as TRACED scalars — one compile serves every segment of
+    a checkpointed run — with the row-origin state as an explicit
+    input/output (`lu_factor_steps`)."""
     mesh = lookup_mesh(mesh_key)
     v = geom.v
     Px, Py, Pz = geom.grid.Px, geom.grid.Py, geom.grid.Pz
     Ml, Nl = geom.Ml, geom.Nl
     nlayr = geom.nlayr
     n_steps = geom.n_steps
-    k0, k_end = step_range if step_range is not None else (0, n_steps)
     Mcap = geom.M  # positions are < Mcap; sentinel values exceed it
     v_pad = Pz * nlayr  # inner dim padded so every z layer gets a full slab
     # trailing-update segmentation: row and column liveness are both
@@ -102,7 +101,7 @@ def _build(geom: LUGeometry, mesh_key, precision, backend: str,
     col_segs = ragged_segments(geom.Ntl, v, 8)
     row_segs = ragged_segments(geom.Mtl, v, 4)
 
-    def device_fn(blk, orig_blk=None):
+    def device_fn(blk, orig_blk=None, k0=0, k_end=n_steps):
         x = lax.axis_index(AXIS_X)
         y = lax.axis_index(AXIS_Y)
         z = lax.axis_index(AXIS_Z)
@@ -425,27 +424,21 @@ def _build(geom: LUGeometry, mesh_key, precision, backend: str,
         orig_out = lax.pmax(orig, (AXIS_Y, AXIS_Z))
         return Aout[None, None], orig_out[None], perm
 
-    if step_range is None:
-        fn = jax.shard_map(
-            device_fn,
-            mesh=mesh,
-            in_specs=P(AXIS_X, AXIS_Y, None, None),
-            out_specs=(P(AXIS_X, AXIS_Y, None, None), P()),
-        )
-        return jax.jit(fn, donate_argnums=(0,) if donate else ())
-    fn = jax.shard_map(
-        device_fn,
-        mesh=mesh,
-        in_specs=(P(AXIS_X, AXIS_Y, None, None), P(AXIS_X, None)),
-        out_specs=(P(AXIS_X, AXIS_Y, None, None), P(AXIS_X, None), P()),
-    )
+    shard_spec = P(AXIS_X, AXIS_Y, None, None)
+    if resumable:
+        in_specs = (shard_spec, P(AXIS_X, None), P(), P())
+        out_specs = (shard_spec, P(AXIS_X, None), P())
+    else:
+        in_specs, out_specs = shard_spec, (shard_spec, P())
+    fn = jax.shard_map(device_fn, mesh=mesh, in_specs=in_specs,
+                       out_specs=out_specs)
     return jax.jit(fn, donate_argnums=(0,) if donate else ())
 
 
 
 def build_program(geom: LUGeometry, mesh, precision=None,
                   backend: str | None = None, panel_chunk: int | None = None,
-                  donate: bool = False):
+                  donate: bool = False, resumable: bool = False):
     """The jitted distributed-LU program itself (cached per config).
 
     The single point resolving the trace-time defaults (precision/backend/
@@ -462,7 +455,7 @@ def build_program(geom: LUGeometry, mesh, precision=None,
     if donate and next(iter(mesh.devices.flat)).platform == "cpu":
         donate = False  # CPU PJRT has no buffer donation (warns per call)
     return _build(geom, mesh_cache_key(mesh), precision, backend,
-                  panel_chunk, donate)
+                  panel_chunk, donate, resumable)
 
 
 def lu_factor_distributed(shards, geom: LUGeometry, mesh,
@@ -529,12 +522,6 @@ def lu_factor_steps(shards, geom: LUGeometry, mesh, k0: int, k1: int,
     if not (0 <= k0 < k1 <= geom.n_steps):
         raise ValueError(
             f"step range [{k0}, {k1}) outside [0, {geom.n_steps})")
-    precision = blas.matmul_precision() if precision is None else precision
-    backend = blas.get_backend() if backend is None else backend
-    if panel_chunk is None:
-        panel_chunk = _DEFAULT_PANEL_CHUNK
-    if donate and next(iter(mesh.devices.flat)).platform == "cpu":
-        donate = False
     if orig is None:
         if k0 != 0:
             raise ValueError("resuming at k0 > 0 requires the orig state "
@@ -542,9 +529,12 @@ def lu_factor_steps(shards, geom: LUGeometry, mesh, k0: int, k1: int,
         # rows start in original order: origin == global row index (the
         # same gri map the geometry exposes)
         orig = jnp.asarray(geom.global_row_index(), jnp.int32)
-    fn = _build(geom, mesh_cache_key(mesh), precision, backend, panel_chunk,
-                donate, step_range=(k0, k1))
-    return fn(shards, orig)
+    # the step bounds are traced scalars: every segment of a checkpointed
+    # run reuses ONE compiled program
+    fn = build_program(geom, mesh, precision=precision, backend=backend,
+                       panel_chunk=panel_chunk, donate=donate,
+                       resumable=True)
+    return fn(shards, orig, jnp.int32(k0), jnp.int32(k1))
 
 
 def lu_distributed_host(A: np.ndarray, grid: Grid3, v: int, mesh=None,

@@ -32,7 +32,7 @@ def parse_line(line: str):
         dtype = ""
     else:
         algo, _, N, Nbase, P, grid, _, dtype, ms, v = parts
-        exp = ""
+        exp = "weak"  # legacy logs were all weak sweeps; keep keys merged
     N, ms = int(N), float(ms)
     gflops = FLOPS[algo] * N**3 / (ms * 1e-3) / 1e9
     return {
@@ -47,18 +47,20 @@ def to_markdown(rows) -> str:
     experiment table (`/root/reference/README.md:96-106`)."""
     best: dict[tuple, dict] = {}
     for r in rows:
-        key = (r["algorithm"], r["P"], r["grid"], r["N"], r["dtype"])
+        key = (r["algorithm"], r["type"], r["P"], r["grid"], r["N"],
+               r["dtype"])
         if key not in best or r["time_ms"] < best[key]["time_ms"]:
             best[key] = r
     lines = [
-        "| algorithm | P | grid | N | tile | time [ms] | GFLOP/s |",
-        "|---|---|---|---|---|---|---|",
+        "| algorithm | type | P | grid | N | tile | time [ms] | GFLOP/s |",
+        "|---|---|---|---|---|---|---|---|",
     ]
     for key in sorted(best):
         r = best[key]
         lines.append(
-            f"| {r['algorithm']} | {r['P']} | {r['grid']} | {r['N']} "
-            f"| {r['tile']} | {r['time_ms']:.0f} | {r['gflops']:.1f} |"
+            f"| {r['algorithm']} | {r['type'] or 'weak'} | {r['P']} "
+            f"| {r['grid']} | {r['N']} | {r['tile']} | {r['time_ms']:.0f} "
+            f"| {r['gflops']:.1f} |"
         )
     return "\n".join(lines)
 

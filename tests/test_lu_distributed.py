@@ -346,3 +346,36 @@ def test_lu_residual_distributed_at_scale():
     out, perm = lu_factor_distributed(A_shards, geom, mesh)
     res = lu_residual_distributed(A_shards, out, perm, geom, mesh)
     assert res < 1e-3, res
+
+
+def test_lu_distributed_rank_deficient_leading_block_valid():
+    """The documented degenerate contract (`lu_factor_distributed`): once a
+    superstep's candidates are exactly zero, that block's outputs are
+    unspecified — but everything eliminated BEFORE the degeneracy must be
+    correct and frozen. A = blockdiag(B, 0) goes degenerate exactly at
+    step r/v; the first r positions must still reconstruct A's rows."""
+    import jax
+
+    from conflux_tpu.geometry import LUGeometry
+    from conflux_tpu.lu.distributed import lu_factor_distributed
+    from conflux_tpu.parallel.mesh import make_mesh
+    import jax.numpy as jnp
+
+    grid = Grid3(2, 2, 1)
+    v, r, N = 8, 16, 32  # B is (r, r); trailing (N-r) block is zero
+    rng = np.random.default_rng(11)
+    A = np.zeros((N, N), np.float32)
+    A[:r, :r] = (rng.standard_normal((r, r)) + 2 * np.eye(r)).astype(np.float32)
+    geom = LUGeometry.create(N, N, v, grid)
+    mesh = make_mesh(grid, devices=jax.devices()[: grid.P])
+    out, perm = lu_factor_distributed(jnp.asarray(geom.scatter(A)), geom, mesh)
+    LUp = geom.gather(np.asarray(out))
+    p = np.asarray(perm)
+    # valid prefix: positions < r hold frozen factor rows of A[p[:r]]
+    L = np.tril(LUp, -1) + np.eye(N, dtype=np.float64)
+    U = np.triu(LUp).astype(np.float64)
+    lead = (L[:r, :r] @ U[:r, :]).astype(np.float64)
+    num = np.linalg.norm(A[p[:r]] - lead)
+    assert num / np.linalg.norm(A) < 1e-5, num
+    # and those perm entries name distinct rows of the nonzero block
+    assert sorted(p[:r]) == list(range(r))
