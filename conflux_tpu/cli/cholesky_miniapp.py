@@ -55,7 +55,11 @@ def main(argv=None) -> int:
         choose_cholesky_tile,
     )
     from conflux_tpu.parallel.mesh import make_mesh
-    from conflux_tpu.validation import cholesky_residual, make_spd_matrix
+    from conflux_tpu.validation import (
+        cholesky_residual,
+        cholesky_residual_distributed,
+        make_spd_matrix,
+    )
 
     n_devices = len(jax.devices())
     grid = Grid3.parse(args.grid) if args.grid else choose_cholesky_grid(n_devices)
@@ -111,9 +115,13 @@ def main(argv=None) -> int:
 
     if args.validate:
         with profiler.region("validation"):
-            L = (np.asarray(out) if single
-                 else np.tril(geom.gather(np.asarray(out))))
-            res = cholesky_residual(np.asarray(A, np.float64), L)
+            if single:
+                res = cholesky_residual(np.asarray(A, np.float64),
+                                        np.asarray(out))
+            else:
+                # gather-free on-mesh oracle (pdgemm validation role):
+                # nothing (N, N)-sized leaves the mesh
+                res = cholesky_residual_distributed(dev, out, geom, mesh)
         print(f"_residual_ {res:.3e}")
 
     if args.profile:

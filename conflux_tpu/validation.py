@@ -155,6 +155,89 @@ def cholesky_residual(A, L) -> float:
     return float(np.linalg.norm(R) / max(np.linalg.norm(A), 1e-30))
 
 
+def cholesky_residual_distributed(A_shards, L_shards, geom, mesh) -> float:
+    """Gather-free ||A - L L^T||_F / ||A||_F on the mesh — the Cholesky
+    counterpart of :func:`lu_residual_distributed` (reference pdgemm
+    validation role). One SUMMA pass: for each column tile t, the lower-
+    triangular column slab of L is y-broadcast and its transpose-rows are
+    delivered to column owners by the same masked-psum exchange the
+    factorization's scatterA11 uses; every device accumulates its share of
+    L L^T. No (N, N) array exists anywhere.
+    """
+    from conflux_tpu.parallel.mesh import mesh_cache_key
+
+    fn = _build_cholesky_residual(geom, mesh_cache_key(mesh))
+    rss, ass = fn(A_shards, L_shards)
+    return float(np.sqrt(float(rss)) / max(np.sqrt(float(ass)), 1e-30))
+
+
+@functools.lru_cache(maxsize=16)
+def _build_cholesky_residual(geom, mesh_key):
+    from jax.sharding import PartitionSpec as P
+
+    from conflux_tpu.parallel.mesh import (
+        AXIS_X, AXIS_Y, AXIS_Z, lookup_mesh,
+    )
+
+    mesh = lookup_mesh(mesh_key)
+    v = geom.v
+    Px, Py = geom.grid.Px, geom.grid.Py
+    Ml, Nl = geom.Ml, geom.Nl
+    Nt = geom.Kappa  # tile columns == supersteps
+
+    def device_fn(Ablk, Lblk):
+        x = lax.axis_index(AXIS_X)
+        y = lax.axis_index(AXIS_Y)
+        Aloc = Ablk[0, 0]
+        dtype = jnp.float32 if Aloc.dtype == jnp.bfloat16 else Aloc.dtype
+        Aloc = Aloc.astype(dtype)
+        Lloc = Lblk[0, 0].astype(dtype)
+
+        lr = jnp.arange(Ml, dtype=jnp.int32)
+        gp = ((lr // v) * Px + x) * v + (lr % v)  # global row index
+        lc = jnp.arange(Nl, dtype=jnp.int32)
+        gcol = ((lc // v) * Py + y) * v + (lc % v)
+        col_owner_x = (gcol // v) % Px
+        col_local_row = ((gcol // v) // Px) * v + gcol % v
+        i0 = jnp.zeros((), jnp.int32)
+
+        def summa(t, acc):
+            colt = t * v + jnp.arange(v, dtype=jnp.int32)
+            ly = ((t // Py) * v).astype(jnp.int32)
+            Lcol = lax.dynamic_slice(Lloc, (i0, ly), (Ml, v))
+            Lcol = jnp.where(gp[:, None] >= colt[None, :], Lcol, 0.0)
+            Lcol = lax.psum(
+                jnp.where(y == t % Py, Lcol, jnp.zeros((), dtype)), AXIS_Y)
+            # rows of L^T for my columns: L[gcol, t-block], delivered from
+            # each row's x-owner (the scatterA11 exchange pattern)
+            from_L = jnp.where(
+                (col_owner_x == x)[:, None],
+                jnp.take(Lcol, col_local_row, axis=0, mode="fill",
+                         fill_value=0),
+                jnp.zeros((), dtype))
+            LrowT = lax.psum(from_L, AXIS_X).T  # (v, Nl)
+            return acc + jnp.matmul(Lcol, LrowT,
+                                    precision=lax.Precision.HIGHEST)
+
+        zero0 = lax.pcast(jnp.zeros((Ml, Nl), dtype),
+                          (AXIS_X, AXIS_Y, AXIS_Z), to="varying")
+        prod = lax.fori_loop(0, Nt, summa, zero0)
+
+        R = Aloc - prod
+        rss = lax.psum(jnp.sum(R * R), (AXIS_X, AXIS_Y))
+        ass = lax.psum(jnp.sum(Aloc * Aloc), (AXIS_X, AXIS_Y))
+        return (lax.pmax(rss, AXIS_Z), lax.pmax(ass, AXIS_Z))
+
+    fn = jax.shard_map(
+        device_fn,
+        mesh=mesh,
+        in_specs=(P(AXIS_X, AXIS_Y, None, None),
+                  P(AXIS_X, AXIS_Y, None, None)),
+        out_specs=(P(), P()),
+    )
+    return jax.jit(fn)
+
+
 def residual_bound(n: int, dtype) -> float:
     """Acceptance threshold: c * sqrt(n) * eps, with headroom for pivot growth."""
     eps = float(jnp.finfo(dtype).eps)

@@ -112,3 +112,33 @@ def test_cholesky_solve_distributed():
         x = cholesky_solve_distributed(out, geom, mesh, jnp.asarray(b))
         relerr = np.linalg.norm(A @ np.asarray(x, np.float64) - b) / np.linalg.norm(b)
         assert relerr < 1e-10, (grid, relerr)
+
+
+@pytest.mark.parametrize("gridspec", [(2, 2, 1), (2, 2, 2), (4, 2, 1)])
+def test_cholesky_residual_distributed_matches_host(gridspec):
+    """The on-mesh ||A - L L^T|| oracle must agree with the host oracle."""
+    import jax
+
+    from conflux_tpu.validation import (
+        cholesky_residual,
+        cholesky_residual_distributed,
+    )
+
+    from conflux_tpu.cholesky.distributed import cholesky_factor_distributed
+    from conflux_tpu.geometry import CholeskyGeometry
+    from conflux_tpu.parallel.mesh import make_mesh
+
+    grid = Grid3(*gridspec)
+    v = 8
+    N = v * 8
+    geom = CholeskyGeometry.create(N, v, grid)
+    mesh = make_mesh(grid, devices=jax.devices()[: grid.P])
+    A = make_spd_matrix(geom.N, dtype=np.float32)
+    shards = jnp.asarray(geom.scatter(A))
+    out = cholesky_factor_distributed(shards, geom, mesh)
+
+    on_mesh = cholesky_residual_distributed(shards, out, geom, mesh)
+    host = cholesky_residual(np.asarray(A, np.float64),
+                             np.tril(geom.gather(np.asarray(out))))
+    assert on_mesh < 1e-5
+    np.testing.assert_allclose(on_mesh, host, rtol=0.3)
