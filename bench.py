@@ -28,6 +28,7 @@ rate is measured at N=8192 (getrf GFLOP/s plateaus there; running N=32768 on
 the host would take minutes for the same number).
 """
 
+import functools
 import json
 import time
 
@@ -58,10 +59,14 @@ def _setup():
     return geom, mesh, sharding
 
 
-@jax.jit
+@functools.partial(jax.jit, static_argnums=0)
+def _make_n(n):
+    a = jax.random.normal(jax.random.PRNGKey(0), (n, n), jnp.float32)
+    return (a + 2 * jnp.eye(n, dtype=jnp.float32))[None, None]
+
+
 def _make():
-    a = jax.random.normal(jax.random.PRNGKey(0), (N, N), jnp.float32)
-    return (a + 2 * jnp.eye(N, dtype=jnp.float32))[None, None]
+    return _make_n(N)
 
 
 def tpu_bench():
@@ -94,28 +99,35 @@ def tpu_bench():
 def _residual_on_device(LU, perm):
     """||A[perm] - L U||_F / ||A||_F, blockwise on the chip.
 
-    The full product is 2 N^3 flops (~3 s); (RES_BLOCK, N) strips of L and
-    (N, RES_BLOCK) strips of U keep peak HBM at A + LU + O(block) instead of
-    materializing L, U and the product."""
+    The full product is 2 n^3 flops (~3 s at n=32768); (RES_BLOCK, n)
+    strips of L and (n, RES_BLOCK) strips of U keep peak HBM at
+    A + LU + O(block) instead of materializing L, U and the product.
+    n is taken from LU itself so tuning sweeps at other sizes work."""
+    n = LU.shape[0]
+    blk = min(RES_BLOCK, n)
+    if n % blk:
+        # strips are uniform; geometry pads N to tile multiples, so any
+        # bench/tune size is a multiple of 4096 or smaller than it
+        raise ValueError(f"residual check needs n % {blk} == 0, got {n}")
 
     @jax.jit
     def ssq_blocks(LU, perm):
-        A = _make()[0, 0]
-        rows = jnp.arange(N, dtype=jnp.int32)
+        A = _make_n(n)[0, 0]
+        rows = jnp.arange(n, dtype=jnp.int32)
         total = jnp.zeros((), jnp.float32)
-        for i in range(0, N, RES_BLOCK):
+        for i in range(0, n, blk):
             # permuted rows gathered per strip: a full A[perm] is a third
             # 4 GB buffer and exhausts HBM next to A and LU
-            Ap_i = jnp.take(A, perm[i : i + RES_BLOCK], axis=0)
+            Ap_i = jnp.take(A, perm[i : i + blk], axis=0)
             Li = jnp.where(
-                rows[i : i + RES_BLOCK, None] > rows[None, :],
-                LU[i : i + RES_BLOCK], 0.0,
-            ) + jnp.eye(RES_BLOCK, N, i, dtype=LU.dtype)
-            acc = jnp.zeros((RES_BLOCK, N), jnp.float32)
-            for j in range(0, N, RES_BLOCK):
+                rows[i : i + blk, None] > rows[None, :],
+                LU[i : i + blk], 0.0,
+            ) + jnp.eye(blk, n, i, dtype=LU.dtype)
+            acc = jnp.zeros((blk, n), jnp.float32)
+            for j in range(0, n, blk):
                 Uj = jnp.where(
-                    rows[:, None] <= rows[None, j : j + RES_BLOCK],
-                    LU[:, j : j + RES_BLOCK], 0.0,
+                    rows[:, None] <= rows[None, j : j + blk],
+                    LU[:, j : j + blk], 0.0,
                 )
                 acc = lax.dynamic_update_slice(
                     acc,

@@ -1,0 +1,106 @@
+"""Single-chip tuning sweep for the distributed LU (run on real TPU).
+
+Times `lu_factor_distributed` at bench scale across the knobs that the
+phase table (scripts/step_profile.py) identified as the levers:
+
+  - matmul precision: HIGHEST (6-pass f32) vs HIGH (bf16x3) for the
+    trailing GEMMs — ~40% of device time; HIGH roughly halves it at some
+    residual cost (the IR solve absorbs factor-quality loss, solvers.py);
+  - panel_chunk: the nomination chunk height (VMEM-bounded);
+  - v: tile size (election work ~ N^2 v; GEMM efficiency grows with v).
+
+Prints one line per config: GFLOP/s + on-device residual. Skips instead
+of hanging when the chip is unresponsive (see bench.py).
+
+Usage: python scripts/tpu_tune.py [-N 32768] [--reps 2]
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("-N", type=int, default=32768)
+    ap.add_argument("--reps", type=int, default=2)
+    ap.add_argument("--configs", default=None,
+                    help="comma list precision:chunk:v, e.g. "
+                    "highest:8192:1024,high:8192:1024")
+    args = ap.parse_args()
+
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    import bench as bench_mod
+    from conflux_tpu.geometry import Grid3, LUGeometry
+    from conflux_tpu.lu.distributed import lu_factor_distributed
+    from conflux_tpu.parallel.mesh import AXIS_X, AXIS_Y, make_mesh
+
+    bench_mod._probe_device()
+
+    N = args.N
+    grid = Grid3(1, 1, 1)
+    mesh = make_mesh(grid, devices=jax.devices()[:1])
+    sharding = NamedSharding(mesh, P(AXIS_X, AXIS_Y, None, None))
+    prec = {"highest": lax.Precision.HIGHEST, "high": lax.Precision.HIGH}
+
+    if args.configs:
+        configs = []
+        for c in args.configs.split(","):
+            p, chunk, v = c.split(":")
+            configs.append((p, int(chunk), int(v)))
+    else:
+        configs = [
+            ("highest", 8192, 1024),
+            ("high", 8192, 1024),
+            ("highest", 12288, 1024),
+            ("highest", 4096, 1024),
+            ("highest", 8192, 2048),
+            ("high", 8192, 2048),
+            ("highest", 8192, 512),
+        ]
+
+    for pname, chunk, v in configs:
+        geom = LUGeometry.create(N, N, v, grid)
+
+        def make():
+            # bench's generator, not a copy: the residual oracle
+            # regenerates A through the same function, so the two must
+            # produce the bit-identical matrix
+            return bench_mod._make_n(geom.M)
+
+        try:
+            def factor(s):
+                return lu_factor_distributed(
+                    s, geom, mesh, precision=prec[pname],
+                    panel_chunk=chunk, donate=True)
+
+            out, perm = factor(jax.device_put(make(), sharding))  # warm-up
+            float(out[0, 0, 0, 0])
+            times = []
+            for _ in range(args.reps):
+                s = jax.device_put(make(), sharding)
+                float(s[0, 0, 0, 0])
+                t0 = time.time()
+                out, perm = factor(s)
+                float(out[0, 0, 0, 0])
+                times.append(time.time() - t0)
+            gflops = (2 / 3) * geom.M**3 / (sum(times) / len(times)) / 1e9
+            res = bench_mod._residual_on_device(out[0, 0], perm)
+            print(f"precision={pname} chunk={chunk} v={v}: "
+                  f"{gflops:.1f} GFLOP/s residual={res:.3e}", flush=True)
+        except Exception as e:  # OOM / VMEM overflow at some configs
+            print(f"precision={pname} chunk={chunk} v={v}: FAILED {e}",
+                  flush=True)
+
+
+if __name__ == "__main__":
+    main()
