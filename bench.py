@@ -30,6 +30,7 @@ the host would take minutes for the same number).
 
 import functools
 import json
+import math
 import time
 
 import numpy as np
@@ -96,19 +97,11 @@ def tpu_bench():
     return gflops, res
 
 
-def _residual_on_device(LU, perm):
-    """||A[perm] - L U||_F / ||A||_F, blockwise on the chip.
-
-    The full product is 2 n^3 flops (~3 s at n=32768); (RES_BLOCK, n)
-    strips of L and (n, RES_BLOCK) strips of U keep peak HBM at
-    A + LU + O(block) instead of materializing L, U and the product.
-    n is taken from LU itself so tuning sweeps at other sizes work."""
-    n = LU.shape[0]
-    blk = min(RES_BLOCK, n)
-    if n % blk:
-        # strips are uniform; geometry pads N to tile multiples, so any
-        # bench/tune size is a multiple of 4096 or smaller than it
-        raise ValueError(f"residual check needs n % {blk} == 0, got {n}")
+@functools.lru_cache(maxsize=8)
+def _ssq_blocks(n: int, blk: int, dtype_name: str):
+    """Compiled strip-wise sum-of-squares program, cached per size so a
+    tuning sweep of many configs at one N compiles this once."""
+    dtype = jnp.dtype(dtype_name)
 
     @jax.jit
     def ssq_blocks(LU, perm):
@@ -122,7 +115,7 @@ def _residual_on_device(LU, perm):
             Li = jnp.where(
                 rows[i : i + blk, None] > rows[None, :],
                 LU[i : i + blk], 0.0,
-            ) + jnp.eye(blk, n, i, dtype=LU.dtype)
+            ) + jnp.eye(blk, n, i, dtype=dtype)
             acc = jnp.zeros((blk, n), jnp.float32)
             for j in range(0, n, blk):
                 Uj = jnp.where(
@@ -138,7 +131,25 @@ def _residual_on_device(LU, perm):
             total = total + jnp.sum(R * R)
         return total, jnp.sum(A * A)
 
-    rss, ass = ssq_blocks(LU, perm)
+    return ssq_blocks
+
+
+def _residual_on_device(LU, perm):
+    """||A[perm] - L U||_F / ||A||_F, blockwise on the chip.
+
+    The full product is 2 n^3 flops (~3 s at n=32768); (blk, n) strips of
+    L and (n, blk) strips of U keep peak HBM at A + LU + O(block) instead
+    of materializing L, U and the product. n is taken from LU itself so
+    tuning sweeps at other sizes work; the strip height is the largest
+    divisor of n within RES_BLOCK (sizes with no usable divisor — which
+    would unroll into hundreds of strips — are rejected)."""
+    n = LU.shape[0]
+    blk = math.gcd(n, RES_BLOCK)
+    if n // blk > 64:
+        raise ValueError(
+            f"residual check needs a strip height dividing n={n} and "
+            f"{RES_BLOCK}; gcd {blk} would unroll {n // blk} strips")
+    rss, ass = _ssq_blocks(n, blk, LU.dtype.name)(LU, perm)
     return float(jnp.sqrt(rss) / jnp.sqrt(ass))
 
 
