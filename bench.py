@@ -140,9 +140,10 @@ def _residual_on_device(LU, perm):
     The full product is 2 n^3 flops (~3 s at n=32768); (blk, n) strips of
     L and (n, blk) strips of U keep peak HBM at A + LU + O(block) instead
     of materializing L, U and the product. n is taken from LU itself so
-    tuning sweeps at other sizes work; the strip height is the largest
-    divisor of n within RES_BLOCK (sizes with no usable divisor — which
-    would unroll into hundreds of strips — are rejected)."""
+    tuning sweeps at other sizes work; the strip height is
+    gcd(n, RES_BLOCK) — exact for every power-of-two-padded bench/tune
+    size — and sizes whose gcd would unroll into many strips are
+    rejected."""
     n = LU.shape[0]
     blk = math.gcd(n, RES_BLOCK)
     if n // blk > 64:
