@@ -13,6 +13,7 @@ import os
 import sys
 
 pid, nproc, port = int(sys.argv[1]), int(sys.argv[2]), sys.argv[3]
+grid_arg = sys.argv[4] if len(sys.argv) > 4 else "4,2,1"
 os.environ["XLA_FLAGS"] = ("--xla_force_host_platform_device_count=4 "
                            + os.environ.get("XLA_FLAGS", ""))
 sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
@@ -35,7 +36,7 @@ from conflux_tpu.lu.distributed import lu_factor_distributed  # noqa: E402
 from conflux_tpu.validation import lu_residual_distributed  # noqa: E402
 
 assert len(jax.devices()) == 8, jax.devices()
-grid = Grid3(4, 2, 1)
+grid = Grid3.parse(grid_arg)
 v = 8
 geom = LUGeometry.create(v * 8, v * 8, v, grid)
 mesh = make_mesh(grid, devices=jax.devices()[: grid.P])
@@ -62,7 +63,14 @@ shards = distribute_shards(
 out, perm = lu_factor_distributed(shards, geom, mesh)
 res = float(lu_residual_distributed(shards, out, perm, geom, mesh))
 n_local = len(set(calls))
+# expected: the distinct (x, y) shard coordinates among THIS process's
+# devices (z-replication means a shard can live on several local devices)
+mine = {
+    (ix, iy)
+    for (ix, iy, iz), d in np.ndenumerate(mesh.devices)
+    if d.process_index == jax.process_index()
+}
 print(f"proc {pid}: local_shards={n_local} residual={res:.3e}", flush=True)
 # the callable form must touch only this process's addressable shards
-assert n_local == grid.P // nproc, (pid, sorted(set(calls)))
+assert n_local == len(mine), (pid, sorted(set(calls)), sorted(mine))
 assert res < 1e-4, res

@@ -25,13 +25,17 @@ def _free_port() -> int:
 
 
 @pytest.mark.slow
-def test_two_process_multihost_lu():
+@pytest.mark.parametrize("gridspec,shards_per_proc", [
+    ("4,2,1", 4),   # x axis split across the two processes
+    ("2,2,2", 2),   # z-replication spans processes: 2 shards x 2 layers
+])
+def test_two_process_multihost_lu(gridspec, shards_per_proc):
     worker = os.path.join(os.path.dirname(__file__), "multihost_worker.py")
     port = str(_free_port())
     env = {k: v for k, v in os.environ.items() if k != "XLA_FLAGS"}
     procs = [
         subprocess.Popen(
-            [sys.executable, worker, str(pid), "2", port],
+            [sys.executable, worker, str(pid), "2", port, gridspec],
             stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
             env=env, cwd=os.path.dirname(worker),
         )
@@ -49,4 +53,5 @@ def test_two_process_multihost_lu():
                 p.wait()
     for pid, (p, out) in enumerate(zip(procs, outs)):
         assert p.returncode == 0, f"proc {pid} failed:\n{out[-3000:]}"
-        assert f"proc {pid}: local_shards=4 residual=" in out
+        assert (f"proc {pid}: local_shards={shards_per_proc} residual="
+                in out)
