@@ -79,11 +79,14 @@ _DEFAULT_PANEL_CHUNK = 8192
 
 @functools.lru_cache(maxsize=32)
 def _build(geom: LUGeometry, mesh_key, precision, backend: str,
-           panel_chunk: int, donate: bool = False, resumable: bool = False):
+           panel_chunk: int, donate: bool = False, resumable: bool = False,
+           lookahead: bool = False):
     """resumable=True builds the checkpoint/restart form: factor supersteps
     [k0, k1) given as TRACED scalars — one compile serves every segment of
     a checkpointed run — with the row-origin state as an explicit
-    input/output (`lu_factor_steps`)."""
+    input/output (`lu_factor_steps`). lookahead=True builds the
+    software-pipelined loop (panel + election carried one step ahead; see
+    body_la)."""
     mesh = lookup_mesh(mesh_key)
     v = geom.v
     Px, Py, Pz = geom.grid.Px, geom.grid.Py, geom.grid.Pz
@@ -132,68 +135,71 @@ def _build(geom: LUGeometry, mesh_key, precision, backend: str,
             owned = (tile % Px == x) & (pos < Mcap)
             return jnp.where(owned, (tile // Px) * v + pos % v, Ml)
 
-        def body(k, carry):
-            Aloc, orig = carry
+        def panel_reduce(Aloc, k):
+            """Panel column k: z-reduce + y-broadcast in one psum (ref
+            step 0)."""
+            j_owner = k % Py
+            lj = jnp.asarray((k // Py) * v, jnp.int32)  # k may be a py int
+            panel_loc = lax.dynamic_slice(
+                Aloc, (jnp.zeros((), jnp.int32), lj), (Ml, v))
+            return lax.psum(
+                jnp.where(y == j_owner, panel_loc, jnp.zeros((), dtype)),
+                (AXIS_Y, AXIS_Z),
+            ).astype(cdtype)
+
+        def elect(panel, k):
+            """Tournament pivoting over x (ref step 1): candidates are
+            identified by their global position; the nomination and the
+            cross-x election both run the chunked CALU tournament, so every
+            LU call is height-bounded by max(panel_chunk, 2v) — the
+            reference butterfly's role (`conflux_opt.hpp:220-336`)."""
+            live = gp >= k * v
+            cand = jnp.where(live[:, None], panel, jnp.zeros((), cdtype))
+            pos_m = jnp.where(live, gp, _GRI_SENTINEL)
+            # dead rows form a tile-aligned prefix (LAPACK-order layout),
+            # so whole chunks die as k advances: a chunk is live iff its
+            # last row's position is still active
+            c_h, nch = blas.chunk_layout(Ml, v, panel_chunk)
+            chunk_live = jnp.stack([
+                gp[min((i + 1) * c_h, Ml) - 1] >= k * v
+                for i in range(nch)
+            ])
+            if Px == 1:
+                # single x-rank: the local nomination IS the election
+                lu00, top = blas.tournament_winners(
+                    cand, chunk=panel_chunk, chunk_live=chunk_live)
+                wpos = jnp.take(pos_m, top, mode="fill",
+                                fill_value=_GRI_SENTINEL)
+            else:
+                _, top = blas.tournament_winners(
+                    cand, chunk=panel_chunk, chunk_live=chunk_live)
+                nom = jnp.take(cand, top, axis=0, mode="fill",
+                               fill_value=0)
+                nid = jnp.take(pos_m, top, mode="fill",
+                               fill_value=_GRI_SENTINEL)
+                blks = lax.all_gather(nom, AXIS_X)  # (Px, v, v)
+                poss = lax.all_gather(nid, AXIS_X)  # (Px, v)
+                flat = blks.reshape(Px * v, v)
+                # the election tournament is batched (no liveness
+                # structure), so its chunk stays within the batched
+                # VMEM-safe bound
+                lu00, wid = blas.tournament_winners(
+                    flat, chunk=min(panel_chunk, blas._PANEL_CHUNK))
+                # winners' positions in pivot order — replicated on
+                # every device, no broadcast needed
+                wpos = jnp.take(poss.reshape(Px * v), wid, mode="fill",
+                                fill_value=_GRI_SENTINEL)
+            return lu00, wpos
+
+        def body_core(k, Aloc, orig, panel, lu00, wpos):
             j_owner = k % Py
             lj = ((k // Py) * v).astype(jnp.int32)
             i_owner = k % Px
             li = ((k // Px) * v).astype(jnp.int32)
             i0 = jnp.zeros((), jnp.int32)
             z0 = z == 0
-
-            # ---- panel: z-reduce + y-broadcast in one psum (ref step 0) -- #
-            with jax.named_scope("step0_reduce"):
-                panel_loc = lax.dynamic_slice(Aloc, (i0, lj), (Ml, v))
-                panel = lax.psum(
-                    jnp.where(y == j_owner, panel_loc, jnp.zeros((), dtype)),
-                    (AXIS_Y, AXIS_Z),
-                ).astype(cdtype)
-
-            # ---- tournament pivoting over x (ref step 1) ----------------- #
-            # candidates are identified by their global position; the
-            # nomination and the cross-x election both run the chunked CALU
-            # tournament, so every LU call is height-bounded by
-            # max(panel_chunk, 2v) — the reference butterfly's role
-            # (`conflux_opt.hpp:220-336`)
-            with jax.named_scope("step1_pivoting"):
-                live = gp >= k * v
-                cand = jnp.where(live[:, None], panel, jnp.zeros((), cdtype))
-                pos_m = jnp.where(live, gp, _GRI_SENTINEL)
-                # dead rows form a tile-aligned prefix (LAPACK-order
-                # layout), so whole chunks die as k advances: a chunk is
-                # live iff its last row's position is still active
-                c_h, nch = blas.chunk_layout(Ml, v, panel_chunk)
-                chunk_live = jnp.stack([
-                    gp[min((i + 1) * c_h, Ml) - 1] >= k * v
-                    for i in range(nch)
-                ])
-                if Px == 1:
-                    # single x-rank: the local nomination IS the election
-                    lu00, top = blas.tournament_winners(
-                        cand, chunk=panel_chunk, chunk_live=chunk_live)
-                    wpos = jnp.take(pos_m, top, mode="fill",
-                                    fill_value=_GRI_SENTINEL)
-                else:
-                    _, top = blas.tournament_winners(
-                        cand, chunk=panel_chunk, chunk_live=chunk_live)
-                    nom = jnp.take(cand, top, axis=0, mode="fill",
-                                   fill_value=0)
-                    nid = jnp.take(pos_m, top, mode="fill",
-                                   fill_value=_GRI_SENTINEL)
-                    blks = lax.all_gather(nom, AXIS_X)  # (Px, v, v)
-                    poss = lax.all_gather(nid, AXIS_X)  # (Px, v)
-                    flat = blks.reshape(Px * v, v)
-                    # the election tournament is batched (no liveness
-                    # structure), so its chunk stays within the batched
-                    # VMEM-safe bound
-                    lu00, wid = blas.tournament_winners(
-                        flat, chunk=min(panel_chunk, blas._PANEL_CHUNK))
-                    # winners' positions in pivot order — replicated on
-                    # every device, no broadcast needed
-                    wpos = jnp.take(poss.reshape(Px * v), wid, mode="fill",
-                                    fill_value=_GRI_SENTINEL)
-                U00 = jnp.triu(lu00)
-                L00 = blas.unit_lower(lu00)
+            U00 = jnp.triu(lu00)
+            L00 = blas.unit_lower(lu00)
 
             # ---- LAPACK-style row swaps (ref push_pivots_up, step 2) ----- #
             # winners move into the step's diagonal block (positions
@@ -406,9 +412,84 @@ def _build(geom: LUGeometry, mesh_key, precision, backend: str,
                     lax.dynamic_update_slice(Anew, pcol_new, (i0, lj)),
                     Anew,
                 )
+            art = dict(Drows=Drows, hit=hit, src=src, L10s=L10s, U01s=U01s,
+                       U01=U01, row_live=row_live, own_d=own_d, li=li, z0=z0)
+            return Anew, orig, art
+
+        def body(k, carry):
+            Aloc, orig = carry
+            with jax.named_scope("step0_reduce"):
+                panel = panel_reduce(Aloc, k)
+            with jax.named_scope("step1_pivoting"):
+                lu00, wpos = elect(panel, k)
+            Anew, orig, _ = body_core(k, Aloc, orig, panel, lu00, wpos)
             return Anew, orig
 
-        Aloc, orig = lax.fori_loop(k0, k_end, body, (Aloc, orig0))
+        def body_la(k, carry):
+            # software-pipelined (lookahead) body: the panel and election
+            # for step k arrive in the carry; step k+1's panel is computed
+            # from a separately-updated column slab of the PRE-update
+            # matrix, so its election collectives have no data dependence
+            # on the trailing GEMMs and XLA's scheduler can overlap them on
+            # a mesh (the reference's P8 MPI_Waitany overlap). Slab math
+            # mirrors the segment updates operand-for-operand, so carried
+            # panels are bitwise identical to recomputed ones.
+            Aloc, orig, panel, lu00, wpos = carry
+            Anew, orig, art = body_core(k, Aloc, orig, panel, lu00, wpos)
+            kn = k + 1
+            i0 = jnp.zeros((), jnp.int32)
+
+            def compute_next(_):
+                with jax.named_scope("step0_reduce"):
+                    j1 = kn % Py
+                    lj1 = ((kn // Py) * v).astype(jnp.int32)
+                    slab = lax.dynamic_slice(Aloc, (i0, lj1), (Ml, v))
+                    dslab = lax.dynamic_slice(art["Drows"], (i0, lj1),
+                                              (v, v))
+                    slab = jnp.where(
+                        art["hit"][:, None],
+                        jnp.where(art["z0"],
+                                  jnp.take(dslab, art["src"], axis=0),
+                                  jnp.zeros((), dtype)),
+                        slab)
+                    upd = blas.gemm(art["L10s"],
+                                    lax.dynamic_slice(art["U01s"],
+                                                      (i0, lj1),
+                                                      (nlayr, v)),
+                                    precision=precision, backend=backend)
+                    slab = slab - jnp.where(art["row_live"][:, None], upd,
+                                            jnp.zeros((), dtype))
+                    u01_slab = lax.dynamic_slice(art["U01"], (i0, lj1),
+                                                 (v, v)).astype(dtype)
+                    slab = jnp.where(
+                        art["own_d"],
+                        lax.dynamic_update_slice(
+                            slab, jnp.where(art["z0"], u01_slab,
+                                            jnp.zeros((), dtype)),
+                            (art["li"], i0)),
+                        slab)
+                    panel_next = lax.psum(
+                        jnp.where(y == j1, slab, jnp.zeros((), dtype)),
+                        (AXIS_Y, AXIS_Z)).astype(cdtype)
+                with jax.named_scope("step1_pivoting"):
+                    lu00n, wposn = elect(panel_next, kn)
+                return panel_next, lu00n, wposn
+
+            # the last iteration has no next step: skip the dangling
+            # election (a whole superstep's collectives + tournament)
+            panel_next, lu00n, wposn = lax.cond(
+                kn < k_end, compute_next, lambda _: (panel, lu00, wpos), 0)
+            return Anew, orig, panel_next, lu00n, wposn
+
+        if lookahead:
+            with jax.named_scope("step0_reduce"):
+                panel0 = panel_reduce(Aloc, k0)
+            with jax.named_scope("step1_pivoting"):
+                lu000, wpos0 = elect(panel0, k0)
+            Aloc, orig, _, _, _ = lax.fori_loop(
+                k0, k_end, body_la, (Aloc, orig0, panel0, lu000, wpos0))
+        else:
+            Aloc, orig = lax.fori_loop(k0, k_end, body, (Aloc, orig0))
         # all factors live on layer 0; psum makes the output z-replicated
         Aout = lax.psum(Aloc, AXIS_Z)
         # assemble the permutation: original row id at every global position
@@ -438,7 +519,8 @@ def _build(geom: LUGeometry, mesh_key, precision, backend: str,
 
 def build_program(geom: LUGeometry, mesh, precision=None,
                   backend: str | None = None, panel_chunk: int | None = None,
-                  donate: bool = False, resumable: bool = False):
+                  donate: bool = False, resumable: bool = False,
+                  lookahead: bool = False):
     """The jitted distributed-LU program itself (cached per config).
 
     The single point resolving the trace-time defaults (precision/backend/
@@ -455,13 +537,13 @@ def build_program(geom: LUGeometry, mesh, precision=None,
     if donate and next(iter(mesh.devices.flat)).platform == "cpu":
         donate = False  # CPU PJRT has no buffer donation (warns per call)
     return _build(geom, mesh_cache_key(mesh), precision, backend,
-                  panel_chunk, donate, resumable)
+                  panel_chunk, donate, resumable, lookahead)
 
 
 def lu_factor_distributed(shards, geom: LUGeometry, mesh,
                           precision=None, backend: str | None = None,
                           panel_chunk: int | None = None,
-                          donate: bool = False):
+                          donate: bool = False, lookahead: bool = False):
     """Factor block-cyclic shards (Px, Py, Ml, Nl) in place on a mesh.
 
     Returns (shards_out, perm): shards_out holds the packed factors in
@@ -483,9 +565,15 @@ def lu_factor_distributed(shards, geom: LUGeometry, mesh,
     `donate=True` aliases the input shards into the output (the caller's
     array is invalidated) — at N=32768 f32 on a 16 GB chip this saves the
     4 GB that makes the difference between fitting and OOM.
+    `lookahead=True` selects the software-pipelined loop: the next step's
+    panel reduce + pivot election are dataflow-independent of the current
+    trailing GEMMs, letting XLA overlap the election collectives with
+    compute on a mesh (P8; bitwise-identical results, ~one extra
+    (Ml, v)-slab GEMM per superstep of redundant work).
     """
     fn = build_program(geom, mesh, precision=precision, backend=backend,
-                       panel_chunk=panel_chunk, donate=donate)
+                       panel_chunk=panel_chunk, donate=donate,
+                       lookahead=lookahead)
     return fn(shards)
 
 
