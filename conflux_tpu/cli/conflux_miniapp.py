@@ -42,6 +42,11 @@ def parse_args(argv=None):
     )
     p.add_argument("-r", "--n_rep", type=int, default=2, help="timed repetitions")
     p.add_argument("--validate", action="store_true", help="residual ||PA-LU||_F check")
+    p.add_argument(
+        "--lookahead", action="store_true",
+        help="software-pipelined loop: overlap the next step's pivot "
+        "election with the trailing update (multi-chip meshes; P8)",
+    )
     add_experiment_type_arg(p)
     add_common_args(p)
     return p.parse_args(argv)
@@ -96,7 +101,8 @@ def main(argv=None) -> int:
 
                     out, perm_dev = lu_factor_blocked(dev, v=geom.v)
                 else:
-                    out, perm_dev = lu_factor_distributed(dev, geom, mesh)
+                    out, perm_dev = lu_factor_distributed(
+                        dev, geom, mesh, lookahead=args.lookahead)
                 sync(out)
         if rep > 0:
             times.append(t.ms)
