@@ -1,0 +1,43 @@
+"""Worker for the failure-detection test (`test_multihost.py`).
+
+role=die: exit hard right after joining — the simulated rank failure.
+role=survive: keep running collectives; the coordination service's
+heartbeat watchdog must abort this process in bounded time once the
+peer dies (the reference has no failure detection at all — a lost rank
+hangs the MPI job until the scheduler's walltime kills it).
+"""
+
+import os
+import sys
+import time
+
+pid, nproc, port, role = (int(sys.argv[1]), int(sys.argv[2]), sys.argv[3],
+                          sys.argv[4])
+os.environ["XLA_FLAGS"] = ("--xla_force_host_platform_device_count=4 "
+                           + os.environ.get("XLA_FLAGS", ""))
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+from conflux_tpu.parallel.mesh import initialize_multihost  # noqa: E402
+
+initialize_multihost(f"localhost:{port}", nproc, pid,
+                     initialization_timeout=60,
+                     heartbeat_timeout_seconds=10)
+print(f"proc {pid} joined", flush=True)
+
+if role == "die":
+    os._exit(17)
+
+import jax.numpy as jnp  # noqa: E402
+
+x = jnp.ones((64,))
+deadline = time.time() + 120
+while time.time() < deadline:
+    # keep the runtime active; the heartbeat watchdog terminates this
+    # process once the peer is declared dead
+    float(x.sum())
+    time.sleep(1)
+print("survivor was never aborted", flush=True)
+sys.exit(3)

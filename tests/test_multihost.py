@@ -55,3 +55,44 @@ def test_two_process_multihost_lu(gridspec, shards_per_proc):
         assert p.returncode == 0, f"proc {pid} failed:\n{out[-3000:]}"
         assert (f"proc {pid}: local_shards={shards_per_proc} residual="
                 in out)
+
+
+@pytest.mark.slow
+def test_peer_failure_detected_in_bounded_time():
+    """Failure detection (beyond the reference, which has none: a lost MPI
+    rank hangs the job): when one process dies, the coordination service's
+    heartbeat watchdog must terminate the survivor in bounded time instead
+    of letting it hang on the next collective."""
+    import time
+
+    worker = os.path.join(os.path.dirname(__file__),
+                          "multihost_failure_worker.py")
+    port = str(_free_port())
+    env = {k: v for k, v in os.environ.items() if k != "XLA_FLAGS"}
+
+    def spawn(pid, role):
+        return subprocess.Popen(
+            [sys.executable, worker, str(pid), "2", port, role],
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+            env=env, cwd=os.path.dirname(worker),
+        )
+
+    t0 = time.time()
+    survivor, dier = spawn(0, "survive"), spawn(1, "die")
+    try:
+        out_d, _ = dier.communicate(timeout=120)
+        assert dier.returncode == 17, out_d[-2000:]
+        # worker gives up (exit 3, "never aborted") at 120s; communicate's
+        # timeout sits above that so the clear assertion below fires
+        # rather than an opaque TimeoutExpired
+        out_s, _ = survivor.communicate(timeout=150)
+    finally:
+        for p in (survivor, dier):
+            if p.poll() is None:
+                p.kill()
+                p.wait()
+    elapsed = time.time() - t0
+    # aborted by the watchdog: nonzero (and not the worker's own exit 3)
+    assert survivor.returncode not in (0, 3), out_s[-2000:]
+    assert "survivor was never aborted" not in out_s
+    assert elapsed < 110, elapsed
