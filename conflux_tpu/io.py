@@ -72,8 +72,11 @@ def generate_spd_tiles(geom: CholeskyGeometry, seed: int = 2020,
 
 # Binary file format: int64 header (M, N, dtype code) + row-major data.
 # The header helpers below are the single source of truth for the format.
+# int32 is a first-class code so integer state (the LU row-origin map,
+# `lu_factor_steps` checkpoints) round-trips exactly at any scale — a
+# float32 detour would corrupt row ids above 2^24.
 _HEADER_BYTES = 3 * 8
-_DTYPES = [np.dtype(np.float32), np.dtype(np.float64)]
+_DTYPES = [np.dtype(np.float32), np.dtype(np.float64), np.dtype(np.int32)]
 
 
 def _write_header(f, M: int, N: int, dtype) -> None:

@@ -1,0 +1,43 @@
+"""Shared preamble + fixtures for the multihost worker scripts.
+
+Import this FIRST in a worker: it forces the 4-virtual-device CPU
+platform before any jax backend initializes (the conftest pattern — env
+vars alone are too late in this image) and puts the repo on sys.path.
+"""
+
+import os
+import sys
+
+os.environ["XLA_FLAGS"] = ("--xla_force_host_platform_device_count=4 "
+                           + os.environ.get("XLA_FLAGS", ""))
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as np  # noqa: E402
+
+
+def pos_fill(geom, grid, px, py):
+    """Deterministic (Ml, Nl) shard straight from global indices — the
+    tile-local position-formula fill every multihost worker uses (the
+    reference's per-rank `InitMatrix` role, `lu_params.hpp:141-376`).
+    The single definition keeps phase-2 validation and phase-1 input
+    generation on the same matrix by construction."""
+    v = geom.v
+    li = np.arange(geom.Ml)
+    lj = np.arange(geom.Nl)
+    gi = ((li // v) * grid.Px + px) * v + li % v
+    gj = ((lj // v) * grid.Py + py) * v + lj % v
+    G = np.sin(0.37 * gi[:, None] + 1.31 * gj[None, :]).astype(np.float32)
+    return G + geom.M * (gi[:, None] == gj[None, :])
+
+
+def my_shard_coords(mesh):
+    """Distinct (x, y) shard coordinates among THIS process's devices
+    (z-replication can place one shard on several local devices)."""
+    return sorted({
+        (ix, iy)
+        for (ix, iy, iz), d in np.ndenumerate(mesh.devices)
+        if d.process_index == jax.process_index()
+    })

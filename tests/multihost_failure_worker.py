@@ -11,14 +11,11 @@ import os
 import sys
 import time
 
+sys.path.insert(0, os.path.dirname(__file__))
+import mh_common  # noqa: F401  (must precede jax backend init)
+
 pid, nproc, port, role = (int(sys.argv[1]), int(sys.argv[2]), sys.argv[3],
                           sys.argv[4])
-os.environ["XLA_FLAGS"] = ("--xla_force_host_platform_device_count=4 "
-                           + os.environ.get("XLA_FLAGS", ""))
-sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
-import jax
-
-jax.config.update("jax_platforms", "cpu")
 
 from conflux_tpu.parallel.mesh import initialize_multihost  # noqa: E402
 

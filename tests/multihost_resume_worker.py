@@ -1,0 +1,87 @@
+"""Worker for the failure-recovery test (`test_multihost.py`).
+
+phase=1: both processes factor supersteps [0, half), checkpoint the
+state to a shared directory (each process writes only its own shards —
+no global matrix anywhere), and exit: the simulated job loss.
+phase=2: a NEW process pair loads the checkpoint and finishes
+[half, n_steps), then validates on the mesh. The reference cannot do any
+of this — a lost rank loses the whole factorization.
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(__file__))
+import mh_common  # noqa: F401  (must precede jax backend init)
+
+pid, nproc, port, phase, ckpt = (int(sys.argv[1]), int(sys.argv[2]),
+                                 sys.argv[3], int(sys.argv[4]), sys.argv[5])
+
+import jax  # noqa: E402
+import numpy as np  # noqa: E402
+import jax.numpy as jnp  # noqa: E402
+
+from conflux_tpu.geometry import Grid3, LUGeometry  # noqa: E402
+from conflux_tpu.io import load_matrix, save_matrix  # noqa: E402
+from conflux_tpu.lu.distributed import lu_factor_steps  # noqa: E402
+from conflux_tpu.parallel.mesh import (  # noqa: E402
+    distribute_shards,
+    initialize_multihost,
+    make_mesh,
+)
+from conflux_tpu.validation import lu_residual_distributed  # noqa: E402
+
+initialize_multihost(f"localhost:{port}", nproc, pid)
+
+grid = Grid3(4, 2, 1)
+v = 8
+geom = LUGeometry.create(v * 8, v * 8, v, grid)
+mesh = make_mesh(grid, devices=jax.devices()[: grid.P])
+half = geom.n_steps // 2
+
+
+def fill(px, py):
+    return mh_common.pos_fill(geom, grid, px, py)
+
+
+def shard_path(px, py, name):
+    return os.path.join(ckpt, f"{name}_{px}_{py}.bin")
+
+
+if phase == 1:
+    shards = distribute_shards(
+        fill, mesh, shape=(grid.Px, grid.Py, geom.Ml, geom.Nl),
+        dtype=np.float32)
+    s, o, _ = lu_factor_steps(shards, geom, mesh, 0, half)
+    # checkpoint: every process saves ONLY its addressable shards + the
+    # x-rows of the origin state it owns (int32 round-trips exactly)
+    for px, py in mh_common.my_shard_coords(mesh):
+        for sh in s.addressable_shards:
+            if tuple(idx.start or 0 for idx in sh.index[:2]) == (px, py):
+                save_matrix(shard_path(px, py, "A"), np.asarray(sh.data)[0, 0])
+                break
+    for sh in o.addressable_shards:
+        px = sh.index[0].start or 0
+        save_matrix(os.path.join(ckpt, f"orig_{px}.bin"), np.asarray(sh.data))
+    print(f"proc {pid}: phase1 checkpointed "
+          f"{len(mh_common.my_shard_coords(mesh))} shards", flush=True)
+    sys.exit(0)
+
+# phase 2: a fresh process pair resumes from the checkpoint (the test
+# runs the phases strictly in sequence, so every file already exists)
+shards = distribute_shards(
+    lambda px, py: load_matrix(shard_path(px, py, "A")), mesh,
+    shape=(grid.Px, grid.Py, geom.Ml, geom.Nl), dtype=np.float32)
+orig = jnp.asarray(np.concatenate([
+    load_matrix(os.path.join(ckpt, f"orig_{px}.bin"))
+    for px in range(grid.Px)
+], axis=0))
+s, o, perm = lu_factor_steps(shards, geom, mesh, half, geom.n_steps,
+                             orig=orig)
+# validate against the ORIGINAL input, rebuilt from the position formula
+orig_shards = distribute_shards(
+    fill, mesh, shape=(grid.Px, grid.Py, geom.Ml, geom.Nl),
+    dtype=np.float32)
+res = float(lu_residual_distributed(orig_shards, s, perm, geom, mesh))
+print(f"proc {pid}: phase2 residual={res:.3e}", flush=True)
+assert res < 1e-4, res

@@ -1,41 +1,38 @@
 """Worker for the two-process multihost test (`test_multihost.py`).
 
-Each process runs this script with (process_id, num_processes, port): it
-brings up `jax.distributed` over localhost (the `MPI_Init` role,
-reference `examples/conflux_miniapp.cpp:90`), contributes 4 virtual CPU
-devices to an 8-device global mesh, materializes ONLY its own block-cyclic
-shards — from a position formula, so no process ever holds the global
-matrix (the reference's per-rank `InitMatrix` fill, `lu_params.hpp:141-376`)
-— factors, and validates gather-free on the mesh.
+Each process runs this script with (process_id, num_processes, port,
+grid): it brings up `jax.distributed` over localhost (the `MPI_Init`
+role, reference `examples/conflux_miniapp.cpp:90`), contributes 4
+virtual CPU devices to an 8-device global mesh, materializes ONLY its
+own block-cyclic shards — from a position formula, so no process ever
+holds the global matrix — factors, and validates gather-free on the
+mesh.
 """
 
 import os
 import sys
 
+sys.path.insert(0, os.path.dirname(__file__))
+import mh_common  # noqa: F401  (must precede jax backend init)
+
 pid, nproc, port = int(sys.argv[1]), int(sys.argv[2]), sys.argv[3]
 grid_arg = sys.argv[4] if len(sys.argv) > 4 else "4,2,1"
-os.environ["XLA_FLAGS"] = ("--xla_force_host_platform_device_count=4 "
-                           + os.environ.get("XLA_FLAGS", ""))
-sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
-import jax
 
-jax.config.update("jax_platforms", "cpu")
+import jax  # noqa: E402
+import numpy as np  # noqa: E402
 
+from conflux_tpu.geometry import Grid3, LUGeometry  # noqa: E402
+from conflux_tpu.lu.distributed import lu_factor_distributed  # noqa: E402
 from conflux_tpu.parallel.mesh import (  # noqa: E402
     distribute_shards,
     initialize_multihost,
     make_mesh,
 )
-
-initialize_multihost(f"localhost:{port}", nproc, pid)
-
-import numpy as np  # noqa: E402
-
-from conflux_tpu.geometry import Grid3, LUGeometry  # noqa: E402
-from conflux_tpu.lu.distributed import lu_factor_distributed  # noqa: E402
 from conflux_tpu.validation import lu_residual_distributed  # noqa: E402
 
+initialize_multihost(f"localhost:{port}", nproc, pid)
 assert len(jax.devices()) == 8, jax.devices()
+
 grid = Grid3.parse(grid_arg)
 v = 8
 geom = LUGeometry.create(v * 8, v * 8, v, grid)
@@ -45,16 +42,8 @@ calls: list[tuple[int, int]] = []
 
 
 def local_shard(px, py):
-    """(Ml, Nl) shard straight from global indices — tile-local, the whole
-    point of the callable `distribute_shards` form: a position-formula
-    fill (diagonally dominant) evaluated only on owned coordinates."""
     calls.append((px, py))
-    li = np.arange(geom.Ml)
-    lj = np.arange(geom.Nl)
-    gi = ((li // v) * grid.Px + px) * v + li % v  # global rows here
-    gj = ((lj // v) * grid.Py + py) * v + lj % v
-    G = np.sin(0.37 * gi[:, None] + 1.31 * gj[None, :]).astype(np.float32)
-    return G + geom.M * (gi[:, None] == gj[None, :])
+    return mh_common.pos_fill(geom, grid, px, py)
 
 
 shards = distribute_shards(
@@ -63,14 +52,8 @@ shards = distribute_shards(
 out, perm = lu_factor_distributed(shards, geom, mesh)
 res = float(lu_residual_distributed(shards, out, perm, geom, mesh))
 n_local = len(set(calls))
-# expected: the distinct (x, y) shard coordinates among THIS process's
-# devices (z-replication means a shard can live on several local devices)
-mine = {
-    (ix, iy)
-    for (ix, iy, iz), d in np.ndenumerate(mesh.devices)
-    if d.process_index == jax.process_index()
-}
+mine = mh_common.my_shard_coords(mesh)
 print(f"proc {pid}: local_shards={n_local} residual={res:.3e}", flush=True)
 # the callable form must touch only this process's addressable shards
-assert n_local == len(mine), (pid, sorted(set(calls)), sorted(mine))
+assert n_local == len(mine), (pid, sorted(set(calls)), mine)
 assert res < 1e-4, res

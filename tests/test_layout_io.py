@@ -215,3 +215,16 @@ def test_scalapack_desc():
     d = scalapack_desc(lay, p=1, ctxt=5)
     assert d.tolist() == [1, 5, 100, 60, 8, 16, 0, 0,
                           numroc(100, 8, 1, 0, 3)]
+
+
+def test_matrix_file_int32_roundtrip(tmp_path):
+    # int32 is a first-class format code: integer state (the LU row-origin
+    # checkpoint) must round-trip exactly at any scale
+    from conflux_tpu.io import load_matrix, save_matrix
+
+    big = np.array([[2**24 + 1, -5], [7, 2**30]], np.int32)
+    p = str(tmp_path / "ints.bin")
+    save_matrix(p, big)
+    back = load_matrix(p)
+    assert back.dtype == np.int32
+    np.testing.assert_array_equal(back, big)

@@ -3,17 +3,19 @@
 The reference's multi-rank path is MPI SPMD; the TPU equivalent is
 `jax.distributed` — multiple host processes, each owning a slice of the
 global device set, running the SAME jitted shard_map program. The CPU-mesh
-tests in this suite simulate 8 devices in ONE process; this test runs the
+tests in this suite simulate 8 devices in ONE process; these tests run the
 real thing: two OS processes x 4 virtual CPU devices each, gloo
 collectives between them, block-cyclic shards materialized per process
-from a position formula (never the global matrix), and the gather-free
-on-mesh residual check.
+from a position formula (never the global matrix), gather-free on-mesh
+validation, bounded-time failure detection, and checkpoint-based recovery
+with a fresh process set.
 """
 
 import os
 import socket
 import subprocess
 import sys
+import time
 
 import pytest
 
@@ -24,35 +26,43 @@ def _free_port() -> int:
         return s.getsockname()[1]
 
 
+def _run_workers(worker: str, extra_args: list[str], nproc: int = 2,
+                 timeout: int = 240):
+    """Spawn one worker process per pid, collect (returncode, output) for
+    each, killing stragglers on the way out."""
+    port = str(_free_port())
+    env = {k: v for k, v in os.environ.items() if k != "XLA_FLAGS"}
+    path = os.path.join(os.path.dirname(__file__), worker)
+    procs = [
+        subprocess.Popen(
+            [sys.executable, path, str(pid), str(nproc), port, *extra_args],
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+            env=env, cwd=os.path.dirname(path),
+        )
+        for pid in range(nproc)
+    ]
+    results = []
+    try:
+        for p in procs:
+            out, _ = p.communicate(timeout=timeout)
+            results.append((p.returncode, out))
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+                p.wait()
+    return results
+
+
 @pytest.mark.slow
 @pytest.mark.parametrize("gridspec,shards_per_proc", [
     ("4,2,1", 4),   # x axis split across the two processes
     ("2,2,2", 2),   # z-replication spans processes: 2 shards x 2 layers
 ])
 def test_two_process_multihost_lu(gridspec, shards_per_proc):
-    worker = os.path.join(os.path.dirname(__file__), "multihost_worker.py")
-    port = str(_free_port())
-    env = {k: v for k, v in os.environ.items() if k != "XLA_FLAGS"}
-    procs = [
-        subprocess.Popen(
-            [sys.executable, worker, str(pid), "2", port, gridspec],
-            stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
-            env=env, cwd=os.path.dirname(worker),
-        )
-        for pid in range(2)
-    ]
-    outs = []
-    try:
-        for p in procs:
-            out, _ = p.communicate(timeout=240)
-            outs.append(out)
-    finally:
-        for p in procs:
-            if p.poll() is None:
-                p.kill()
-                p.wait()
-    for pid, (p, out) in enumerate(zip(procs, outs)):
-        assert p.returncode == 0, f"proc {pid} failed:\n{out[-3000:]}"
+    results = _run_workers("multihost_worker.py", [gridspec])
+    for pid, (rc, out) in enumerate(results):
+        assert rc == 0, f"proc {pid} failed:\n{out[-3000:]}"
         assert (f"proc {pid}: local_shards={shards_per_proc} residual="
                 in out)
 
@@ -63,8 +73,6 @@ def test_peer_failure_detected_in_bounded_time():
     rank hangs the job): when one process dies, the coordination service's
     heartbeat watchdog must terminate the survivor in bounded time instead
     of letting it hang on the next collective."""
-    import time
-
     worker = os.path.join(os.path.dirname(__file__),
                           "multihost_failure_worker.py")
     port = str(_free_port())
@@ -96,3 +104,20 @@ def test_peer_failure_detected_in_bounded_time():
     assert survivor.returncode not in (0, 3), out_s[-2000:]
     assert "survivor was never aborted" not in out_s
     assert elapsed < 110, elapsed
+
+
+@pytest.mark.slow
+def test_failure_recovery_new_processes_resume_from_checkpoint(tmp_path):
+    """Full recovery story (beyond the reference, which loses the run):
+    a process pair factors half the supersteps, checkpoints per-process
+    shards, and exits; a brand-new pair resumes from the checkpoint and
+    finishes with a valid factorization."""
+    ckpt = str(tmp_path)
+    outs1 = _run_workers("multihost_resume_worker.py", ["1", ckpt])
+    for pid, (rc, out) in enumerate(outs1):
+        assert rc == 0, f"phase1 proc {pid}:\n{out[-3000:]}"
+        assert "phase1 checkpointed" in out
+    outs2 = _run_workers("multihost_resume_worker.py", ["2", ckpt])
+    for pid, (rc, out) in enumerate(outs2):
+        assert rc == 0, f"phase2 proc {pid}:\n{out[-3000:]}"
+        assert "phase2 residual=" in out
