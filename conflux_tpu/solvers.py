@@ -112,13 +112,15 @@ def lu_solve_distributed(shards, perm, geom, mesh, b) -> jax.Array:
     flops over 2*n_steps latency-bound steps — triangular solves are
     sequential by nature; the reference has no distributed solve at all.
 
-    Returns x (N,), replicated.
+    b may be (N,) or (N, nrhs) — multi-RHS runs all columns through each
+    substitution step at once (LAPACK getrs semantics). Returns x of b's
+    shape, replicated.
     """
     _check_solve_rhs(geom, b)
+    b2, squeeze = _as_2d(jnp.asarray(b, blas.compute_dtype(shards.dtype)))
     fn = _build_lu_solve(geom, mesh_cache_key(mesh))
-    return fn(shards, jnp.asarray(perm, jnp.int32),
-              jnp.asarray(b, jnp.float32 if shards.dtype == jnp.bfloat16
-                          else shards.dtype))
+    x = fn(shards, jnp.asarray(perm, jnp.int32), b2)
+    return x[:, 0] if squeeze else x
 
 
 @functools.lru_cache(maxsize=16)
@@ -145,32 +147,35 @@ def _build_lu_solve(geom, mesh_key):
         lc = jnp.arange(Nl, dtype=jnp.int32)
         gcol = ((lc // v) * Py + y_) * v + (lc % v)
 
+        nrhs = bp.shape[1]
+        i0 = jnp.zeros((), jnp.int32)
+
         def fwd(k, yv):
             rows, diag = _diag_tile_rows(Aloc, k, x_, gcol, v, Px, Nl, dtype)
             solved = gcol < k * v
-            s = jnp.matmul(rows, jnp.where(solved, yv[gcol], 0.0),
+            s = jnp.matmul(rows, jnp.where(solved[:, None], yv[gcol], 0.0),
                            precision=lax.Precision.HIGHEST)
-            s = lax.psum(s, AXIS_Y)
-            bk = lax.dynamic_slice(bp, (k * v,), (v,))
-            yk = blas.trsm_left_lower_unit(
-                blas.unit_lower(diag), (bk - s)[:, None]
-            )[:, 0]
-            return lax.dynamic_update_slice(yv, yk, (k * v,))
+            s = lax.psum(s, AXIS_Y)  # (v, nrhs)
+            kv = jnp.asarray(k * v, jnp.int32)
+            bk = lax.dynamic_slice(bp, (kv, i0), (v, nrhs))
+            yk = blas.trsm_left_lower_unit(blas.unit_lower(diag), bk - s)
+            return lax.dynamic_update_slice(yv, yk, (kv, i0))
 
-        yv = lax.fori_loop(0, n, fwd, jnp.zeros((geom.N,), dtype))
+        yv = lax.fori_loop(0, n, fwd, jnp.zeros((geom.N, nrhs), dtype))
 
         def bwd(i, xv):
             k = n - 1 - i
             rows, diag = _diag_tile_rows(Aloc, k, x_, gcol, v, Px, Nl, dtype)
             ahead = gcol >= (k + 1) * v
-            s = jnp.matmul(rows, jnp.where(ahead, xv[gcol], 0.0),
+            s = jnp.matmul(rows, jnp.where(ahead[:, None], xv[gcol], 0.0),
                            precision=lax.Precision.HIGHEST)
             s = lax.psum(s, AXIS_Y)
-            yk = lax.dynamic_slice(yv, (k * v,), (v,))
-            xk = blas.trsm_left_upper(jnp.triu(diag), (yk - s)[:, None])[:, 0]
-            return lax.dynamic_update_slice(xv, xk, (k * v,))
+            kv = jnp.asarray(k * v, jnp.int32)
+            yk = lax.dynamic_slice(yv, (kv, i0), (v, nrhs))
+            xk = blas.trsm_left_upper(jnp.triu(diag), yk - s)
+            return lax.dynamic_update_slice(xv, xk, (kv, i0))
 
-        xv = lax.fori_loop(0, n, bwd, jnp.zeros((geom.N,), dtype))
+        xv = lax.fori_loop(0, n, bwd, jnp.zeros((geom.N, nrhs), dtype))
         # replicated by construction (pure collectives); pmax satisfies the
         # out_spec's replication check
         return lax.pmax(xv, (AXIS_X, AXIS_Y, AXIS_Z))
@@ -191,13 +196,14 @@ def cholesky_solve_distributed(shards, geom, mesh, b) -> jax.Array:
     reference lacks entirely); no permutation is involved since Cholesky
     does not pivot.
 
-    Returns x (N,), replicated.
+    b may be (N,) or (N, nrhs) (LAPACK potrs semantics). Returns x of
+    b's shape, replicated.
     """
     _check_solve_rhs(geom, b)
+    b2, squeeze = _as_2d(jnp.asarray(b, blas.compute_dtype(shards.dtype)))
     fn = _build_cholesky_solve(geom, mesh_cache_key(mesh))
-    return fn(shards, jnp.asarray(b, jnp.float32
-                                  if shards.dtype == jnp.bfloat16
-                                  else shards.dtype))
+    x = fn(shards, b2)
+    return x[:, 0] if squeeze else x
 
 
 @functools.lru_cache(maxsize=16)
@@ -224,17 +230,21 @@ def _build_cholesky_solve(geom, mesh_key):
         lc = jnp.arange(Nl, dtype=jnp.int32)
         gcol = ((lc // v) * Py + y_) * v + (lc % v)
 
+        nrhs = b.shape[1]
+        i0 = jnp.zeros((), jnp.int32)
+
         def fwd(k, yv):
             rows, diag = _diag_tile_rows(Aloc, k, x_, gcol, v, Px, Nl, dtype)
             solved = gcol < k * v
-            s = jnp.matmul(rows, jnp.where(solved, yv[gcol], 0.0),
+            s = jnp.matmul(rows, jnp.where(solved[:, None], yv[gcol], 0.0),
                            precision=lax.Precision.HIGHEST)
             s = lax.psum(s, AXIS_Y)
-            bk = lax.dynamic_slice(b, (k * v,), (v,))
-            yk = blas.trsm_left_lower(jnp.tril(diag), (bk - s)[:, None])[:, 0]
-            return lax.dynamic_update_slice(yv, yk, (k * v,))
+            kv = jnp.asarray(k * v, jnp.int32)
+            bk = lax.dynamic_slice(b, (kv, i0), (v, nrhs))
+            yk = blas.trsm_left_lower(jnp.tril(diag), bk - s)
+            return lax.dynamic_update_slice(yv, yk, (kv, i0))
 
-        yv = lax.fori_loop(0, n, fwd, jnp.zeros((geom.N,), dtype))
+        yv = lax.fori_loop(0, n, fwd, jnp.zeros((geom.N, nrhs), dtype))
 
         def bwd(i, xv):
             k = n - 1 - i
@@ -246,19 +256,20 @@ def _build_cholesky_solve(geom, mesh_key):
                                             (Ml, v)),
                           jnp.zeros((), dtype)), AXIS_Y)
             ahead = grow >= (k + 1) * v
-            s = jnp.matmul(jnp.where(ahead, xv[grow], 0.0), cols,
+            s = jnp.matmul(cols.T, jnp.where(ahead[:, None], xv[grow], 0.0),
                            precision=lax.Precision.HIGHEST)
-            s = lax.psum(s, AXIS_X)
+            s = lax.psum(s, AXIS_X)  # (v, nrhs)
             idx = jnp.where((grow >= k * v) & (grow < (k + 1) * v),
                             grow - k * v, v)
             diag = jnp.zeros((v, v), dtype).at[idx].add(
                 jnp.where(idx[:, None] < v, cols, 0.0), mode="drop")
             diag = lax.psum(diag, AXIS_X)
-            yk = lax.dynamic_slice(yv, (k * v,), (v,))
-            xk = blas.trsm_left_lower_t(jnp.tril(diag), (yk - s)[:, None])[:, 0]
-            return lax.dynamic_update_slice(xv, xk, (k * v,))
+            kv = jnp.asarray(k * v, jnp.int32)
+            yk = lax.dynamic_slice(yv, (kv, i0), (v, nrhs))
+            xk = blas.trsm_left_lower_t(jnp.tril(diag), yk - s)
+            return lax.dynamic_update_slice(xv, xk, (kv, i0))
 
-        xv = lax.fori_loop(0, n, bwd, jnp.zeros((geom.N,), dtype))
+        xv = lax.fori_loop(0, n, bwd, jnp.zeros((geom.N, nrhs), dtype))
         return lax.pmax(xv, (AXIS_X, AXIS_Y, AXIS_Z))
 
     fn = jax.shard_map(

@@ -55,16 +55,16 @@ if phase == 1:
     s, o, _ = lu_factor_steps(shards, geom, mesh, 0, half)
     # checkpoint: every process saves ONLY its addressable shards + the
     # x-rows of the origin state it owns (int32 round-trips exactly)
-    for px, py in mh_common.my_shard_coords(mesh):
-        for sh in s.addressable_shards:
-            if tuple(idx.start or 0 for idx in sh.index[:2]) == (px, py):
-                save_matrix(shard_path(px, py, "A"), np.asarray(sh.data)[0, 0])
-                break
+    saved = set()
+    for sh in s.addressable_shards:
+        px, py = (sh.index[0].start or 0, sh.index[1].start or 0)
+        if (px, py) not in saved:  # z-replicas carry identical data
+            save_matrix(shard_path(px, py, "A"), np.asarray(sh.data)[0, 0])
+            saved.add((px, py))
     for sh in o.addressable_shards:
         px = sh.index[0].start or 0
         save_matrix(os.path.join(ckpt, f"orig_{px}.bin"), np.asarray(sh.data))
-    print(f"proc {pid}: phase1 checkpointed "
-          f"{len(mh_common.my_shard_coords(mesh))} shards", flush=True)
+    print(f"proc {pid}: phase1 checkpointed {len(saved)} shards", flush=True)
     sys.exit(0)
 
 # phase 2: a fresh process pair resumes from the checkpoint (the test

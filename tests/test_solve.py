@@ -233,3 +233,47 @@ def test_lu_solve_distributed_asymmetric_grid():
     )
     x = lu_solve_distributed(shards, perm, geom, mesh, jnp.asarray(b))
     assert _relerr(A, x, b) < 1e-10
+
+
+def test_mesh_solves_multi_rhs():
+    """Multi-RHS (LAPACK getrs/potrs semantics): all columns ride each
+    substitution step together and match per-column solves exactly."""
+    import jax
+
+    from conflux_tpu.cholesky.distributed import cholesky_factor_distributed
+    from conflux_tpu.geometry import CholeskyGeometry, Grid3, LUGeometry
+    from conflux_tpu.lu.distributed import lu_factor_distributed
+    from conflux_tpu.parallel.mesh import make_mesh
+    from conflux_tpu.solvers import (
+        cholesky_solve_distributed,
+        lu_solve_distributed,
+    )
+    from conflux_tpu.validation import make_spd_matrix, make_test_matrix
+
+    grid = Grid3(2, 2, 1)
+    N, v, k = 64, 8, 3
+    mesh = make_mesh(grid, devices=jax.devices()[: grid.P])
+    rng = np.random.default_rng(4)
+    B = rng.standard_normal((N, k)).astype(np.float32)
+
+    geom = LUGeometry.create(N, N, v, grid)
+    A = make_test_matrix(N, N, dtype=np.float32)
+    lu_sh, perm = lu_factor_distributed(jnp.asarray(geom.scatter(A)), geom,
+                                        mesh)
+    X = np.asarray(lu_solve_distributed(lu_sh, perm, geom, mesh, B))
+    assert X.shape == (N, k)
+    for j in range(k):
+        xj = np.asarray(lu_solve_distributed(lu_sh, perm, geom, mesh,
+                                             B[:, j]))
+        # the blocked triangular solve's rounding depends on the RHS
+        # count, so agreement is f32-level, not bitwise
+        np.testing.assert_allclose(X[:, j], xj, rtol=2e-4, atol=2e-5)
+    assert np.linalg.norm(A @ X - B) / np.linalg.norm(B) < 1e-4
+
+    cgeom = CholeskyGeometry.create(N, v, grid)
+    S = make_spd_matrix(N, dtype=np.float32)
+    L_sh = cholesky_factor_distributed(jnp.asarray(cgeom.scatter(S)), cgeom,
+                                       mesh)
+    Xc = np.asarray(cholesky_solve_distributed(L_sh, cgeom, mesh, B))
+    assert Xc.shape == (N, k)
+    assert np.linalg.norm(S @ Xc - B) / np.linalg.norm(B) < 1e-4
