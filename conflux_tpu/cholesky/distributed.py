@@ -46,7 +46,8 @@ from conflux_tpu.parallel.mesh import (
 
 @functools.lru_cache(maxsize=32)
 def _build(geom: CholeskyGeometry, mesh_key, precision, backend: str,
-           donate: bool = False, resumable: bool = False):
+           donate: bool = False, resumable: bool = False,
+           lookahead: bool = False):
     mesh = lookup_mesh(mesh_key)
     v = geom.v
     Px, Py, Pz = geom.grid.Px, geom.grid.Py, geom.grid.Pz
@@ -83,25 +84,27 @@ def _build(geom: CholeskyGeometry, mesh_key, precision, backend: str,
         col_owner_x = (gcol // v) % Px
         col_local_row = ((gcol // v) // Px) * v + gcol % v
 
-        def body(k, carry):
-            Aloc = carry
+        cdtype = blas.compute_dtype(dtype)
+
+        def panel_reduce(Aloc, k):
+            """Panel column k: z-reduce + y-broadcast (reference
+            reduceA11 + scatterA11 rolled into one collective); panel
+            math runs in the compute dtype (f32 when storage is bf16)."""
+            yo = k % Py
+            lj = jnp.asarray((k // Py) * v, jnp.int32)  # k may be a py int
+            panel_loc = lax.dynamic_slice(
+                Aloc, (jnp.zeros((), jnp.int32), lj), (Ml, v))
+            return lax.psum(
+                jnp.where(y == yo, panel_loc, jnp.zeros((), dtype)),
+                (AXIS_Y, AXIS_Z),
+            ).astype(cdtype)
+
+        def body_core(k, Aloc, panel):
             i0 = jnp.zeros((), jnp.int32)
             xo = (k % Px).astype(jnp.int32)  # diag tile row owner
             yo = (k % Py).astype(jnp.int32)  # panel column owner
             lj = ((k // Py) * v).astype(jnp.int32)
             ldiag = ((k // Px) * v).astype(jnp.int32)
-
-            # ---- panel column k: z-reduce + y-broadcast ------------------- #
-            with jax.named_scope("reduceA11"):
-                panel_loc = lax.dynamic_slice(Aloc, (i0, lj), (Ml, v))
-                panel = lax.psum(
-                    jnp.where(y == yo, panel_loc, jnp.zeros((), dtype)),
-                    (AXIS_Y, AXIS_Z),
-                )
-
-            # panel math in the compute dtype (f32 when storage is bf16)
-            cdtype = blas.compute_dtype(dtype)
-            panel = panel.astype(cdtype)
 
             # ---- diagonal tile: x-broadcast + potrf ----------------------- #
             with jax.named_scope("choleskyA00"):
@@ -194,9 +197,53 @@ def _build(geom: CholeskyGeometry, mesh_key, precision, backend: str,
                 lax.dynamic_update_slice(Anew, pcol_new, (i0, lj)),
                 Anew,
             )
+            return Anew, dict(L10s=L10s, Lcs=Lcs, below=below)
+
+        def body(k, carry):
+            Aloc = carry
+            with jax.named_scope("reduceA11"):
+                panel = panel_reduce(Aloc, k)
+            Anew, _ = body_core(k, Aloc, panel)
             return Anew
 
-        Aloc = lax.fori_loop(k0, k_end, body, Aloc)
+        def body_la(k, carry):
+            # software-pipelined body (see lu.distributed.body_la): the
+            # panel for step k rides the carry; step k+1's panel comes from
+            # a separately-updated column slab of the PRE-update matrix, so
+            # its reduce has no data dependence on the trailing GEMMs and
+            # can overlap them on a mesh. Slab math mirrors the segment
+            # updates operand-for-operand (bitwise-identical results).
+            Aloc, panel = carry
+            Anew, art = body_core(k, Aloc, panel)
+            kn = k + 1
+            i0 = jnp.zeros((), jnp.int32)
+
+            def compute_next(_):
+                with jax.named_scope("reduceA11"):
+                    lj1 = ((kn // Py) * v).astype(jnp.int32)
+                    slab = lax.dynamic_slice(Aloc, (i0, lj1), (Ml, v))
+                    upd = blas.gemm(
+                        art["L10s"],
+                        lax.dynamic_slice(art["Lcs"], (lj1, i0),
+                                          (v, nlayr)).T,
+                        precision=precision, backend=backend)
+                    slab = slab - jnp.where(art["below"][:, None], upd,
+                                            jnp.zeros((), dtype))
+                    yo1 = (kn % Py).astype(jnp.int32)
+                    return lax.psum(
+                        jnp.where(y == yo1, slab, jnp.zeros((), dtype)),
+                        (AXIS_Y, AXIS_Z)).astype(cdtype)
+
+            panel_next = lax.cond(kn < k_end, compute_next,
+                                  lambda _: panel, 0)
+            return Anew, panel_next
+
+        if lookahead:
+            with jax.named_scope("reduceA11"):
+                panel0 = panel_reduce(Aloc, k0)
+            Aloc, _ = lax.fori_loop(k0, k_end, body_la, (Aloc, panel0))
+        else:
+            Aloc = lax.fori_loop(k0, k_end, body, Aloc)
         Aout = lax.psum(Aloc, AXIS_Z)
         return Aout[None, None]
 
@@ -212,7 +259,7 @@ def _build(geom: CholeskyGeometry, mesh_key, precision, backend: str,
 
 def build_program(geom: CholeskyGeometry, mesh, precision=None,
                   backend: str | None = None, donate: bool = False,
-                  resumable: bool = False):
+                  resumable: bool = False, lookahead: bool = False):
     """The jitted distributed-Cholesky program (cached per config) — the
     single point resolving trace-time defaults and the CPU donate guard;
     `cholesky_factor_distributed` goes through here. Direct use is for
@@ -223,7 +270,7 @@ def build_program(geom: CholeskyGeometry, mesh, precision=None,
     if donate and next(iter(mesh.devices.flat)).platform == "cpu":
         donate = False  # CPU PJRT has no buffer donation (warns per call)
     return _build(geom, mesh_cache_key(mesh), precision, backend, donate,
-                  resumable)
+                  resumable, lookahead)
 
 
 def cholesky_factor_steps(shards, geom: CholeskyGeometry, mesh,
@@ -247,14 +294,15 @@ def cholesky_factor_steps(shards, geom: CholeskyGeometry, mesh,
 
 def cholesky_factor_distributed(shards, geom: CholeskyGeometry, mesh,
                                 precision=None, backend: str | None = None,
-                                donate: bool = False):
+                                donate: bool = False,
+                                lookahead: bool = False):
     """Factor block-cyclic shards of an SPD matrix; returns factored shards
     (lower triangle = L, upper triangle unspecified). `donate=True`
     aliases the input into the output — without it the superstep loop
     cannot update in place (an immutable input forces a full-buffer copy
     per step, measured ~6 ms/step at N=16384 on a v5e)."""
     fn = build_program(geom, mesh, precision=precision, backend=backend,
-                       donate=donate)
+                       donate=donate, lookahead=lookahead)
     return fn(shards)
 
 

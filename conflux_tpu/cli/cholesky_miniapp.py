@@ -34,6 +34,11 @@ def parse_args(argv=None):
     p.add_argument("--grid", default=None, help="Px,Py,Pz (default: auto)")
     p.add_argument("--run", type=int, default=2, help="timed repetitions")
     p.add_argument("--validate", action="store_true", help="residual ||A-LL^T||_F check")
+    p.add_argument(
+        "--lookahead", action="store_true",
+        help="software-pipelined loop: overlap the next panel reduce "
+        "with the trailing update (multi-chip meshes; P8)",
+    )
     add_experiment_type_arg(p)
     add_common_args(p)
     return p.parse_args(argv)
@@ -91,7 +96,8 @@ def main(argv=None) -> int:
 
                     out = cholesky_blocked(dev, v=geom.v)
                 else:
-                    out = cholesky_factor_distributed(dev, geom, mesh)
+                    out = cholesky_factor_distributed(
+                        dev, geom, mesh, lookahead=args.lookahead)
                 sync(out)
         if rep > 0:
             times.append(t.ms)
@@ -129,7 +135,8 @@ def main(argv=None) -> int:
             from conflux_tpu.cholesky.distributed import build_program
             from conflux_tpu.cli.common import phase_profile
 
-            phase_profile(build_program(geom, mesh), dev)
+            phase_profile(
+                build_program(geom, mesh, lookahead=args.lookahead), dev)
         profiler.report()
     return 0
 

@@ -130,7 +130,8 @@ def main(argv=None) -> int:
             from conflux_tpu.cli.common import phase_profile
             from conflux_tpu.lu.distributed import build_program
 
-            phase_profile(build_program(geom, mesh), dev)
+            phase_profile(
+                build_program(geom, mesh, lookahead=args.lookahead), dev)
         profiler.report()
     return 0
 
