@@ -70,6 +70,10 @@ def main() -> None:
     x = np.asarray(lu_solve_distributed(LU_shards, perm, geom, mesh, b))
     print(f"direct solve residual ||Ax-b||/||b|| = "
           f"{np.linalg.norm(A @ x - b) / np.linalg.norm(b):.3e}")
+    B3 = np.stack([b, b * 2, b - 1], axis=1)  # multi-RHS (getrs semantics)
+    X3 = np.asarray(lu_solve_distributed(LU_shards, perm, geom, mesh, B3))
+    print(f"multi-RHS (N, 3) residual = "
+          f"{np.linalg.norm(A @ X3 - B3) / np.linalg.norm(B3):.3e}")
     # the HPL-MxP trade needs cond(A) * eps_bf16 < 1 (DESIGN.md §6): use a
     # well-conditioned system to show bf16 factors + IR reaching f32 grade
     W = make_test_matrix(geom.N, geom.N, dtype=np.float32)
