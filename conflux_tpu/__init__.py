@@ -29,6 +29,20 @@ def __getattr__(name):
         "cholesky_blocked": ("conflux_tpu.cholesky.single", "cholesky_blocked"),
         "cholesky_distributed_host": (
             "conflux_tpu.cholesky.distributed", "cholesky_distributed_host"),
+        "lu_factor_distributed": (
+            "conflux_tpu.lu.distributed", "lu_factor_distributed"),
+        "lu_factor_steps": ("conflux_tpu.lu.distributed", "lu_factor_steps"),
+        "cholesky_factor_distributed": (
+            "conflux_tpu.cholesky.distributed", "cholesky_factor_distributed"),
+        "cholesky_factor_steps": (
+            "conflux_tpu.cholesky.distributed", "cholesky_factor_steps"),
+        "lu_solve_distributed": (
+            "conflux_tpu.solvers", "lu_solve_distributed"),
+        "cholesky_solve_distributed": (
+            "conflux_tpu.solvers", "cholesky_solve_distributed"),
+        "solve_distributed": ("conflux_tpu.solvers", "solve_distributed"),
+        "distribute_shards": (
+            "conflux_tpu.parallel.mesh", "distribute_shards"),
         "solve": ("conflux_tpu.solvers", "solve"),
         "lu_solve": ("conflux_tpu.solvers", "lu_solve"),
         "cholesky_solve": ("conflux_tpu.solvers", "cholesky_solve"),
@@ -58,6 +72,14 @@ __all__ = [
     "solve",
     "lu_solve",
     "cholesky_solve",
+    "lu_factor_distributed",
+    "lu_factor_steps",
+    "cholesky_factor_distributed",
+    "cholesky_factor_steps",
+    "lu_solve_distributed",
+    "cholesky_solve_distributed",
+    "solve_distributed",
+    "distribute_shards",
     "make_mesh",
     "initialize_multihost",
 ]

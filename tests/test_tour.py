@@ -1,0 +1,31 @@
+"""The guided example must keep running end to end (it asserts every
+capability's residual internally)."""
+
+import os
+import subprocess
+import sys
+
+import pytest
+
+
+@pytest.mark.slow
+def test_examples_tour_runs():
+    tour = os.path.join(os.path.dirname(__file__), "..", "examples",
+                        "tour.py")
+    env = {k: v for k, v in os.environ.items() if k != "XLA_FLAGS"}
+    out = subprocess.run(
+        [sys.executable, tour], capture_output=True, text=True, env=env,
+        timeout=480, cwd=os.path.dirname(os.path.dirname(tour)),
+    )
+    assert out.returncode == 0, out.stdout[-2000:] + out.stderr[-2000:]
+    assert "Tour complete." in out.stdout
+
+
+def test_top_level_lazy_api():
+    import conflux_tpu
+
+    # every advertised name must resolve (lazy imports included)
+    for name in conflux_tpu.__all__:
+        assert getattr(conflux_tpu, name) is not None
+    with pytest.raises(AttributeError):
+        conflux_tpu.not_a_real_api
