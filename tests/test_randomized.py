@@ -39,3 +39,27 @@ def test_randomized_configs_against_oracle():
         res = lu_residual(A.astype(np.float64), LUp, np.asarray(perm))
         bound = residual_bound(max(geom.M, geom.N), np.float32)
         assert res < bound, (trial, grid, v, M, N, res, bound)
+
+
+@pytest.mark.slow
+def test_randomized_cholesky_configs():
+    from conflux_tpu.cholesky.distributed import cholesky_factor_distributed
+    from conflux_tpu.geometry import CholeskyGeometry
+    from conflux_tpu.validation import cholesky_residual
+
+    rng = np.random.default_rng(777)
+    for trial in range(8):
+        grid = Grid3(*GRID_POOL[rng.integers(len(GRID_POOL))])
+        v = int(rng.choice([4, 8, 16]))
+        N = int(rng.integers(2, 7)) * v
+        geom = CholeskyGeometry.create(N, v, grid)
+        mesh = make_mesh(grid, devices=jax.devices()[: grid.P])
+        B = rng.standard_normal((geom.N, geom.N)).astype(np.float32)
+        S = (B @ B.T + geom.N * np.eye(geom.N)).astype(np.float32)
+        out = cholesky_factor_distributed(
+            jnp.asarray(geom.scatter(S)), geom, mesh,
+            lookahead=bool(rng.integers(2)))
+        L = np.tril(geom.gather(np.asarray(out)))
+        res = cholesky_residual(S.astype(np.float64), L)
+        bound = residual_bound(geom.N, np.float32)
+        assert res < bound, (trial, grid, v, N, res, bound)
