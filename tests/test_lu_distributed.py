@@ -74,6 +74,21 @@ def test_lu_distributed_chunked_election():
         assert sorted(perm.tolist()) == list(range(N))
 
 
+def test_lu_distributed_bench_ratios():
+    """Structural pin of the headline bench config (bench.py: N=32768,
+    v=1024, chunk=8192 on 1x1x1) at 1/128 scale: the same N/v = 32
+    supersteps and Ml/chunk = 4 nomination chunks, through the same
+    single-device mesh program. Small-N grid tests can't see bugs that
+    need many supersteps of live/dead segment transitions or a
+    multi-chunk nomination on one device; this shape does."""
+    N, v = 256, 8
+    A = make_test_matrix(N, N, seed=2, dtype=np.float32)
+    LU, perm, _ = lu_distributed_host(A, Grid3(1, 1, 1), v, panel_chunk=64)
+    assert sorted(perm.tolist()) == list(range(N))
+    res = lu_residual(A, LU[perm], perm)
+    assert res < residual_bound(N, np.float32), res
+
+
 def test_lu_distributed_election_height_bound():
     """Structural guarantee: NO lu primitive in the traced distributed
     program is taller than max(panel_chunk, 2v) — the scoped-VMEM safety

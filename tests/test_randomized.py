@@ -45,21 +45,31 @@ def test_randomized_configs_against_oracle():
 def test_randomized_cholesky_configs():
     from conflux_tpu.cholesky.distributed import cholesky_factor_distributed
     from conflux_tpu.geometry import CholeskyGeometry
-    from conflux_tpu.validation import cholesky_residual
+    from conflux_tpu.validation import cholesky_residual, make_spd_matrix
 
     rng = np.random.default_rng(777)
+    padded_trials = 0
     for trial in range(8):
         grid = Grid3(*GRID_POOL[rng.integers(len(GRID_POOL))])
         v = int(rng.choice([4, 8, 16]))
-        N = int(rng.integers(2, 7)) * v
+        # ragged draw: S is built at the DRAWN size and identity-padded to
+        # the grid multiple (same recipe as cholesky_distributed_host, which
+        # this bypasses to pass lookahead), so non-divisible sizes test the
+        # padded-geometry factorization instead of silently rounding the
+        # trial up to geom.N
+        N = int(rng.integers(2 * v, 8 * v))
         geom = CholeskyGeometry.create(N, v, grid)
         mesh = make_mesh(grid, devices=jax.devices()[: grid.P])
-        B = rng.standard_normal((geom.N, geom.N)).astype(np.float32)
-        S = (B @ B.T + geom.N * np.eye(geom.N)).astype(np.float32)
+        S = make_spd_matrix(N, seed=int(rng.integers(2**31)),
+                            dtype=np.float32)
+        Sp = np.eye(geom.N, dtype=np.float32)
+        Sp[:N, :N] = S
+        padded_trials += geom.N != N
         out = cholesky_factor_distributed(
-            jnp.asarray(geom.scatter(S)), geom, mesh,
+            jnp.asarray(geom.scatter(Sp)), geom, mesh,
             lookahead=bool(rng.integers(2)))
         L = np.tril(geom.gather(np.asarray(out)))
-        res = cholesky_residual(S.astype(np.float64), L)
+        res = cholesky_residual(Sp.astype(np.float64), L)
         bound = residual_bound(geom.N, np.float32)
         assert res < bound, (trial, grid, v, N, res, bound)
+    assert padded_trials, "no trial exercised the padding path"
