@@ -104,6 +104,53 @@ def scalapack_desc(layout: BlockCyclicLayout, p: int = 0,
     )
 
 
+def indxg2p(ig: int, nb: int, isrcproc: int, nprocs: int) -> int:
+    """Owning process coordinate of global index `ig` (0-based form of
+    ScaLAPACK TOOLS `INDXG2P`, the coordinate half of the
+    `examples/utils.hpp` glue)."""
+    return (isrcproc + ig // nb) % nprocs
+
+
+def indxg2l(ig: int, nb: int, nprocs: int) -> int:
+    """Local index of global index `ig` on its owning process (0-based
+    `INDXG2L`). Together with `indxg2p` this defines ScaLAPACK's local
+    element placement; `to_scalapack` is verified against it."""
+    return (ig // (nb * nprocs)) * nb + ig % nb
+
+
+def to_scalapack(A: np.ndarray, layout: BlockCyclicLayout
+                 ) -> tuple[list[list[np.ndarray]], list[list[np.ndarray]]]:
+    """Distribute a host matrix into ScaLAPACK-convention local buffers.
+
+    Returns (locals, descs): `locals[p][q]` is the column-major
+    (Fortran-order) local matrix process (p, q) would pass to a p?gemm /
+    p?getrf call, `descs[p][q]` its 9-integer array descriptor. The
+    reference hands matrices to ScaLAPACK for its pdgemm-based validation
+    (`examples/conflux_miniapp.cpp:404-500`); this is the equivalent
+    export surface, so factors computed here can be consumed by an
+    existing ScaLAPACK pipeline (and vice versa via `from_scalapack`).
+
+    Element placement: ScaLAPACK's local matrix is the owned blocks
+    packed densely in global order — the same index map as our row-major
+    shard buffers — so the conversion is a memory-order change plus the
+    descriptor, not a re-bucketing.
+    """
+    shards = scatter(A, layout)
+    locals_ = [[np.asfortranarray(shards[p][q])
+                for q in range(layout.Pcols)] for p in range(layout.Prows)]
+    descs = [[scalapack_desc(layout, p=p) for _q in range(layout.Pcols)]
+             for p in range(layout.Prows)]
+    return locals_, descs
+
+
+def from_scalapack(locals_: list[list[np.ndarray]],
+                   layout: BlockCyclicLayout) -> np.ndarray:
+    """Assemble a host matrix from ScaLAPACK-convention local buffers
+    (inverse of :func:`to_scalapack`; accepts any memory order — gather's
+    sliced reads are order-agnostic, so no copy is made)."""
+    return gather(locals_, layout)
+
+
 def scatter(A: np.ndarray, layout: BlockCyclicLayout) -> list[list[np.ndarray]]:
     """Split a global matrix into per-coordinate local buffers (tiles in
     local block-cyclic order, row-major within)."""
@@ -118,8 +165,10 @@ def _gather_tiles(A: np.ndarray, lay: BlockCyclicLayout, p: int, q: int) -> np.n
     row_tiles = range(p, Mt, lay.Prows)
     col_tiles = range(q, Nt, lay.Pcols)
     if not len(row_tiles) or not len(col_tiles):
-        # this coordinate owns no tiles (grid larger than the tile grid)
-        return np.zeros((0, 0), A.dtype)
+        # this coordinate owns no tiles (grid larger than the tile grid);
+        # the empty buffer still carries the one-sided numroc extents so
+        # ScaLAPACK consumers see shape[0] == LLD row count
+        return np.zeros(lay.local_shape(p, q), A.dtype)
     blocks = [
         np.concatenate(
             [r[:, tj * lay.vc : min((tj + 1) * lay.vc, lay.N)] for tj in col_tiles],
@@ -180,7 +229,8 @@ def _build_local(shards: list[list[np.ndarray]], src: BlockCyclicLayout,
     col_tiles = range(q, Nt, dst.Pcols)
     dtype = shards[0][0].dtype
     if not len(row_tiles) or not len(col_tiles):
-        return np.zeros((0, 0), dtype)
+        # same one-sided numroc extents as scatter's empty shards
+        return np.zeros(dst.local_shape(p, q), dtype)
     loc = np.zeros(dst.local_shape(p, q), dtype)
     for li, ti in enumerate(row_tiles):
         r0, r1 = ti * dst.vr, min((ti + 1) * dst.vr, dst.M)

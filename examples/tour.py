@@ -128,7 +128,8 @@ def main() -> None:
     # ---- 6. layout redistribution (COSTA role) ---------------------- #
     step("redistribute between block-cyclic layouts without (N, N)")
     from conflux_tpu.layout import (
-        BlockCyclicLayout, gather, scalapack_desc, scatter, transform,
+        BlockCyclicLayout, from_scalapack, gather, scalapack_desc, scatter,
+        to_scalapack, transform,
     )
 
     src = BlockCyclicLayout.for_grid(N, N, v, grid)
@@ -137,6 +138,18 @@ def main() -> None:
     ok = bool(np.array_equal(gather(moved, dst), A))
     print(f"conflux layout -> ScaLAPACK-style {dst.vr}x{dst.vc} on 4x2: "
           f"round-trip exact = {ok}; desc = {scalapack_desc(dst).tolist()}")
+    assert ok
+
+    # export the computed factors as ScaLAPACK local buffers (column-major
+    # + 9-int descriptors): what an existing pdgetrs/pdgemm pipeline
+    # consumes (the reference validates through exactly that interface,
+    # `examples/conflux_miniapp.cpp:404-500`)
+    LU_host = geom.gather(np.asarray(LU_shards))
+    locals_, descs = to_scalapack(LU_host, dst)
+    ok = bool(np.array_equal(from_scalapack(locals_, dst), LU_host))
+    print(f"LU factors -> ScaLAPACK locals on 4x2: round-trip exact = {ok}; "
+          f"local[0][0] {locals_[0][0].shape} F-order, "
+          f"LLD = {int(descs[0][0][8])}")
     assert ok
 
     print("\nTour complete.")

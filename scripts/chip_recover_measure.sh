@@ -3,7 +3,7 @@
 # queued round-2 measurements once, logging to data/benchmarks/.
 # Probe = tiny reduction with a hard timeout; the tunnel wedge manifests
 # as an indefinite hang on the first device op (see bench._probe_device).
-cd "$(dirname "$0")/.."
+cd "$(dirname "$0")/.." || exit 1
 LOG=data/benchmarks/round2-recovery.txt
 echo "watch start $(date -u +%FT%TZ)" >> "$LOG"
 while true; do

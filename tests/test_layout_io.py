@@ -217,6 +217,50 @@ def test_scalapack_desc():
                           numroc(100, 8, 1, 0, 3)]
 
 
+def test_to_scalapack_placement_matches_indx_formulas():
+    """The exported local buffers must place every element exactly where
+    ScaLAPACK's own index maps (INDXG2P/INDXG2L) say its owner stores it —
+    the contract an external p?getrf/p?gemm consumer relies on."""
+    from conflux_tpu.layout import from_scalapack, indxg2l, indxg2p, to_scalapack
+
+    for (M, N, vr, vc, Pr, Pc) in [(20, 12, 4, 4, 2, 3), (17, 33, 5, 8, 3, 2),
+                                   (8, 8, 8, 8, 2, 2)]:
+        lay = BlockCyclicLayout(M=M, N=N, vr=vr, vc=vc, Prows=Pr, Pcols=Pc)
+        A = np.arange(M * N, dtype=np.float64).reshape(M, N)
+        locals_, descs = to_scalapack(A, lay)
+        for i in range(M):
+            for j in range(N):
+                p, q = indxg2p(i, vr, 0, Pr), indxg2p(j, vc, 0, Pc)
+                buf = locals_[p][q]
+                assert buf.flags.f_contiguous or buf.size <= 1
+                assert buf[indxg2l(i, vr, Pr), indxg2l(j, vc, Pc)] == A[i, j]
+        for p in range(Pr):
+            for q in range(Pc):
+                # LLD_ (desc[8]) is the column stride of the local buffer
+                assert descs[p][q][8] == max(1, locals_[p][q].shape[0])
+        np.testing.assert_array_equal(from_scalapack(locals_, lay), A)
+
+
+def test_scalapack_export_of_computed_factors():
+    """End-to-end interop exercise: factors computed by the distributed LU
+    exported into ScaLAPACK locals reassemble to the same packed LU (the
+    role the reference's COSTA transforms play before pdgemm validation,
+    `examples/conflux_miniapp.cpp:349-353`)."""
+    from conflux_tpu.geometry import Grid3
+    from conflux_tpu.layout import from_scalapack, to_scalapack
+    from conflux_tpu.lu.distributed import lu_distributed_host
+    from conflux_tpu.validation import make_test_matrix
+
+    N, v = 32, 8
+    grid = Grid3(2, 2, 1)
+    A = make_test_matrix(N, N, seed=12)
+    LU, perm, geom = lu_distributed_host(A, grid, v)
+    lay = BlockCyclicLayout.for_grid(N, N, v, grid)
+    locals_, descs = to_scalapack(LU, lay)
+    assert all(d[4] == v and d[5] == v for row in descs for d in row)
+    np.testing.assert_array_equal(from_scalapack(locals_, lay), LU)
+
+
 def test_matrix_file_int32_roundtrip(tmp_path):
     # int32 is a first-class format code: integer state (the LU row-origin
     # checkpoint) must round-trip exactly at any scale
