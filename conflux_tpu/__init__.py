@@ -48,6 +48,13 @@ def __getattr__(name):
         "cholesky_solve": ("conflux_tpu.solvers", "cholesky_solve"),
         "make_mesh": ("conflux_tpu.parallel.mesh", "make_mesh"),
         "initialize_multihost": ("conflux_tpu.parallel.mesh", "initialize_multihost"),
+        "qr_factor_blocked": ("conflux_tpu.qr.single", "qr_factor_blocked"),
+        "tall_qr": ("conflux_tpu.qr.single", "tall_qr"),
+        "tsqr_distributed": ("conflux_tpu.qr.distributed", "tsqr_distributed"),
+        "cholesky_qr2_distributed": (
+            "conflux_tpu.qr.distributed", "cholesky_qr2_distributed"),
+        "qr_distributed_host": (
+            "conflux_tpu.qr.distributed", "qr_distributed_host"),
     }
     if name in _lazy:
         import importlib
@@ -82,4 +89,9 @@ __all__ = [
     "distribute_shards",
     "make_mesh",
     "initialize_multihost",
+    "qr_factor_blocked",
+    "tall_qr",
+    "tsqr_distributed",
+    "cholesky_qr2_distributed",
+    "qr_distributed_host",
 ]

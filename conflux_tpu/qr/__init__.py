@@ -1,0 +1,17 @@
+"""Communication-optimal QR (TSQR tree + CholeskyQR2) — see single.py
+and distributed.py module docstrings."""
+
+from conflux_tpu.qr.distributed import (
+    cholesky_qr2_distributed,
+    qr_distributed_host,
+    tsqr_distributed,
+)
+from conflux_tpu.qr.single import qr_factor_blocked, tall_qr
+
+__all__ = [
+    "cholesky_qr2_distributed",
+    "qr_distributed_host",
+    "qr_factor_blocked",
+    "tall_qr",
+    "tsqr_distributed",
+]

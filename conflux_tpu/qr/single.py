@@ -1,0 +1,132 @@
+"""Single-device QR: height-bounded TSQR tree + blocked panel factorization.
+
+QR is the third member of the communication-optimal dense-factorization
+family this framework covers (LU `lu/`, Cholesky `cholesky/`). The
+reference library stops at LU/Cholesky; its panel machinery, though, is
+exactly a tall-skinny reduction over stacked candidate blocks
+(`src/conflux/lu/conflux_opt.hpp:220-336` reduces (2v, v) stacks down a
+butterfly), and TSQR is the same tree shape with QR as the combiner — so
+the framework's chunked-tree utilities carry over directly.
+
+TPU-first design notes:
+ - every `jnp.linalg.qr` call is height-bounded by `chunk` (the QR
+   custom call shares the scoped-VMEM ceiling the LU call has,
+   `ops/blas.py`); tall panels go through a recursive chunked tree that
+   only ever factors (chunk, n) and (levels * n, n) stacks;
+ - Q is never built by the tree. The tree yields a backward-stable R;
+   Q comes from `A @ R^{-1}` (TRSM) followed by a second tree pass on Q
+   itself (the CholeskyQR2 refinement recipe, with the QR tree instead
+   of a Gram/Cholesky first pass). Two passes give eps-grade
+   orthogonality even for badly conditioned A, while keeping all the
+   O(M n^2) flops in MXU-friendly GEMM/TRSM form instead of Householder
+   applications;
+ - the blocked square factorization is block-Gram-Schmidt over v-wide
+   panels (panel TSQR + GEMM trailing update), the same owner-computes
+   superstep shape as the LU loop.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from conflux_tpu.ops import blas
+
+
+def _tree_r(panel: jax.Array, chunk: int) -> jax.Array:
+    """Upper-triangular R of a tall panel via a chunked QR reduction tree.
+
+    Only the R factors move up the tree (the TSQR half that parallel
+    QR needs); heights are bounded by max(chunk, 2n) at every level.
+    Rows are zero-padded to a whole number of chunks — zero rows do not
+    change R.
+    """
+    m, n = panel.shape
+    ch = max(min(chunk, m), 2 * n)
+    while True:
+        nch = -(-m // ch)
+        if nch * ch != m:
+            panel = jnp.pad(panel, ((0, nch * ch - m), (0, 0)))
+        if nch == 1:
+            return jnp.linalg.qr(panel, mode="r")[:n]
+        rs = jnp.linalg.qr(panel.reshape(nch, ch, n), mode="r")[:, :n]
+        panel = rs.reshape(nch * n, n)
+        m = nch * n
+        if m <= ch:
+            return jnp.linalg.qr(panel, mode="r")[:n]
+
+
+def _positive_diag(Q: jax.Array, R: jax.Array):
+    """Flip signs so diag(R) >= 0 — the unique QR normalization (makes
+    results deterministic across chunkings/grids and comparable to
+    LAPACK's convention up to its own signs)."""
+    s = jnp.where(jnp.diagonal(R) < 0, -1.0, 1.0).astype(R.dtype)
+    return Q * s[None, :], R * s[:, None]
+
+
+def tall_qr(panel: jax.Array, chunk: int | None = None, passes: int = 2):
+    """(Q, R) of a tall-skinny panel (m >= n) — tree R + refined Q.
+
+    Pass 1: R1 = tree_r(A), Q1 = A R1^{-1}. Pass 2 (default): R2 =
+    tree_r(Q1), Q = Q1 R2^{-1}, R = R2 R1 — orthogonality lands at
+    eps-scale independent of cond(A) (the CholeskyQR2 argument: Q1 is
+    already well-conditioned, so the second pass is numerically exact).
+    """
+    m, n = panel.shape
+    if m < n:
+        raise ValueError(f"tall_qr needs m >= n, got {panel.shape}")
+    chunk = blas._PANEL_CHUNK if chunk is None else chunk
+    cdtype = blas.compute_dtype(panel.dtype)
+    prec = blas.matmul_precision()
+    A = panel.astype(cdtype)
+    R = None
+    for _ in range(max(1, passes)):
+        Ri = _tree_r(A, chunk)
+        A = blas.trsm_right_upper(Ri, A)
+        R = Ri if R is None else jnp.matmul(Ri, R, precision=prec)
+    Q, R = _positive_diag(A, R)
+    return Q.astype(panel.dtype), R.astype(panel.dtype)
+
+
+@functools.partial(jax.jit, static_argnums=(1, 2, 3))
+def _qr_blocked(A, v: int, chunk: int, passes: int):
+    M, N = A.shape
+    cdtype = blas.compute_dtype(A.dtype)
+    Ac = A.astype(cdtype)
+    prec = blas.matmul_precision()
+    Q = jnp.zeros((M, N), cdtype)
+    R = jnp.zeros((N, N), cdtype)
+    for j0 in range(0, N, v):
+        j1 = min(j0 + v, N)
+        Qp, Rp = tall_qr(Ac[:, j0:j1], chunk=chunk, passes=passes)
+        Qp, Rp = Qp.astype(cdtype), Rp.astype(cdtype)
+        R = lax.dynamic_update_slice(R, Rp, (j0, j0))
+        if j1 < N:
+            C = jnp.matmul(Qp.T, Ac[:, j1:], precision=prec)
+            R = lax.dynamic_update_slice(R, C, (j0, j1))
+            Ac = lax.dynamic_update_slice(
+                Ac, Ac[:, j1:] - jnp.matmul(Qp, C, precision=prec), (0, j1))
+        Q = lax.dynamic_update_slice(Q, Qp, (0, j0))
+    return Q, R
+
+
+def qr_factor_blocked(A: jax.Array, v: int = 256, chunk: int | None = None,
+                      passes: int = 2):
+    """Blocked (Q, R) of an (M, N) matrix, M >= N.
+
+    Block Gram-Schmidt over v-wide panels: each panel is factored by
+    `tall_qr` (two-pass tree, so panel Qs are orthogonal to eps), then
+    the trailing columns get the rank-v update `A -= Qp (Qp^T A)` — one
+    (M, v) x (v, N-j) GEMM pair per superstep, the same flop layout as
+    the LU trailing update. Returns thin Q (M, N) and R (N, N) with
+    diag(R) >= 0.
+    """
+    M, N = A.shape
+    if M < N:
+        raise ValueError(f"qr_factor_blocked needs M >= N, got {A.shape}")
+    chunk = blas._PANEL_CHUNK if chunk is None else chunk
+    Q, R = _qr_blocked(A, min(v, N), chunk, passes)
+    return Q.astype(A.dtype), jnp.triu(R).astype(A.dtype)

@@ -11,7 +11,9 @@ TPU slice and nothing else changes):
   3. direct solve + HPL-MxP-style mixed-precision iterative refinement
   4. distributed Cholesky + its on-mesh residual
   5. checkpoint mid-factorization, save to disk, restart, finish
-  6. block-cyclic redistribution between layouts (the COSTA role)
+  6. block-cyclic redistribution between layouts (the COSTA role) and
+     ScaLAPACK local-buffer export of the computed factors
+  7. communication-optimal tall-skinny QR (TSQR tree and CholeskyQR2)
 
 Run:  python examples/tour.py
 """
@@ -151,6 +153,19 @@ def main() -> None:
           f"local[0][0] {locals_[0][0].shape} F-order, "
           f"LLD = {int(descs[0][0][8])}")
     assert ok
+
+    # ---- 7. communication-optimal QR (TSQR / CholeskyQR2) ----------- #
+    step("tall-skinny QR over the x axis: only (n, n) R blocks communicate")
+    from conflux_tpu.qr import qr_distributed_host
+
+    T = np.asarray(make_test_matrix(512, 24, dtype=np.float32))
+    for algo in ("tsqr", "cholesky"):
+        Q, R = qr_distributed_host(T, 4, algo=algo)
+        orth = np.linalg.norm(Q.T @ Q - np.eye(24)) / np.sqrt(24)
+        rec = np.linalg.norm(Q @ R - T) / np.linalg.norm(T)
+        print(f"{algo:9s} on 4x1x1: ||Q^T Q - I|| = {orth:.2e}, "
+              f"||A - QR||/||A|| = {rec:.2e}")
+        assert orth < 1e-5 and rec < 1e-5
 
     print("\nTour complete.")
 

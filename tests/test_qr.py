@@ -1,0 +1,135 @@
+"""QR family: blocked single-device, distributed TSQR, CholeskyQR2.
+
+Oracles: A = Q R reconstruction, ||Q^T Q - I|| orthogonality at eps
+scale, and agreement with np.linalg.qr under the positive-diagonal
+normalization (which makes thin QR of a full-rank matrix unique)."""
+
+import numpy as np
+import pytest
+import jax
+import jax.numpy as jnp
+
+from conflux_tpu.geometry import Grid3
+from conflux_tpu.parallel.mesh import make_mesh
+from conflux_tpu.qr import (
+    cholesky_qr2_distributed,
+    qr_distributed_host,
+    qr_factor_blocked,
+    tall_qr,
+    tsqr_distributed,
+)
+
+
+def _orth_err(Q):
+    n = Q.shape[1]
+    return np.linalg.norm(Q.T @ Q - np.eye(n)) / np.sqrt(n)
+
+
+def _check(A, Q, R, eps_mult=50):
+    M, n = A.shape
+    # eps of the COMPUTE dtype (Q/R), not the oracle copy of A
+    eps = np.finfo(np.float32 if np.asarray(Q).dtype == np.float32
+                   else np.float64).eps
+    assert np.allclose(np.tril(R, -1), 0.0), "R not upper-triangular"
+    assert (np.diag(R) >= 0).all(), "R diagonal not normalized positive"
+    assert _orth_err(np.asarray(Q, np.float64)) < eps_mult * eps
+    rec = np.linalg.norm(np.asarray(Q, np.float64) @ R - A)
+    assert rec / np.linalg.norm(A) < eps_mult * eps * np.sqrt(n)
+
+
+def _pos_diag_ref(A):
+    Qr, Rr = np.linalg.qr(A)
+    s = np.sign(np.diag(Rr))
+    s[s == 0] = 1
+    return Qr * s, Rr * s[:, None]
+
+
+@pytest.mark.parametrize("shape", [(96, 96), (192, 64), (64, 50)])
+def test_qr_blocked_single(shape):
+    M, N = shape
+    rng = np.random.default_rng(3)
+    A = rng.standard_normal(shape)
+    Q, R = qr_factor_blocked(jnp.asarray(A), v=16)
+    _check(A, np.asarray(Q), np.asarray(R))
+    Qr, Rr = _pos_diag_ref(A)
+    np.testing.assert_allclose(np.asarray(R), Rr, atol=1e-10 * np.abs(Rr).max())
+
+
+def test_tall_qr_chunked_tree():
+    """Chunked tree (several levels) must agree with the unchunked path."""
+    rng = np.random.default_rng(5)
+    A = rng.standard_normal((640, 24))
+    Q1, R1 = tall_qr(jnp.asarray(A), chunk=64)   # 10 chunks, 2 levels
+    Q2, R2 = tall_qr(jnp.asarray(A), chunk=4096)  # single call
+    _check(A, np.asarray(Q1), np.asarray(R1))
+    np.testing.assert_allclose(np.asarray(R1), np.asarray(R2),
+                               atol=1e-10 * np.abs(R2).max())
+
+
+def test_tall_qr_ill_conditioned():
+    """The tree path must keep eps-grade orthogonality where plain
+    CholeskyQR would have lost it (cond^2 overflows f64 eps^-1 is not
+    reachable here; cond 1e8 squares to 1e16 ~ 1/eps_f64, the classic
+    breakdown)."""
+    rng = np.random.default_rng(7)
+    U, _ = np.linalg.qr(rng.standard_normal((256, 24)))
+    V, _ = np.linalg.qr(rng.standard_normal((24, 24)))
+    s = np.logspace(0, -8, 24)
+    A = (U * s) @ V.T
+    Q, R = tall_qr(jnp.asarray(A), chunk=64)
+    _check(A, np.asarray(Q), np.asarray(R), eps_mult=200)
+
+
+@pytest.mark.parametrize("Px", [1, 2, 4])
+def test_tsqr_distributed(Px):
+    rng = np.random.default_rng(11 + Px)
+    M, n = 64 * Px, 24
+    A = rng.standard_normal((M, n))
+    mesh = make_mesh(Grid3(Px, 1, 1), devices=jax.devices()[:Px])
+    Qs, R = tsqr_distributed(A.reshape(Px, M // Px, n), mesh)
+    Q = np.asarray(Qs).reshape(M, n)
+    _check(A, Q, np.asarray(R))
+
+
+def test_tsqr_matches_across_grids():
+    """Same matrix, Px = 1 vs 4: identical R (replicated reduction is
+    deterministic) and equally-orthogonal Q."""
+    rng = np.random.default_rng(13)
+    A = rng.standard_normal((128, 16))
+    _, R1 = qr_distributed_host(A, 1)
+    _, R4 = qr_distributed_host(A, 4)
+    np.testing.assert_allclose(R1, R4, atol=1e-12 * np.abs(R1).max())
+
+
+def test_cholesky_qr2_distributed():
+    rng = np.random.default_rng(17)
+    Px, Ml, n = 4, 32, 16
+    A = rng.standard_normal((Px * Ml, n))
+    mesh = make_mesh(Grid3(Px, 1, 1), devices=jax.devices()[:Px])
+    Qs, R = cholesky_qr2_distributed(A.reshape(Px, Ml, n), mesh)
+    _check(A, np.asarray(Qs).reshape(-1, n), np.asarray(R))
+
+
+def test_qr_distributed_host_padding():
+    """M not divisible by Px: zero-pad rows, drop them from Q."""
+    rng = np.random.default_rng(19)
+    A = rng.standard_normal((50, 8))
+    Q, R = qr_distributed_host(A, 4)
+    assert Q.shape == (50, 8)
+    _check(A, Q, R)
+
+
+def test_qr_f32():
+    rng = np.random.default_rng(23)
+    A = rng.standard_normal((128, 32)).astype(np.float32)
+    Q, R = qr_factor_blocked(jnp.asarray(A), v=16)
+    assert Q.dtype == np.float32 and R.dtype == np.float32
+    _check(A.astype(np.float64), np.asarray(Q), np.asarray(R),
+           eps_mult=100)
+
+
+def test_qr_rejects_wide():
+    with pytest.raises(ValueError):
+        qr_factor_blocked(jnp.zeros((8, 16)))
+    with pytest.raises(ValueError):
+        tall_qr(jnp.zeros((8, 16)))
