@@ -46,6 +46,8 @@ def __getattr__(name):
         "solve": ("conflux_tpu.solvers", "solve"),
         "lu_solve": ("conflux_tpu.solvers", "lu_solve"),
         "cholesky_solve": ("conflux_tpu.solvers", "cholesky_solve"),
+        "lstsq": ("conflux_tpu.solvers", "lstsq"),
+        "lstsq_distributed": ("conflux_tpu.solvers", "lstsq_distributed"),
         "make_mesh": ("conflux_tpu.parallel.mesh", "make_mesh"),
         "initialize_multihost": ("conflux_tpu.parallel.mesh", "initialize_multihost"),
         "qr_factor_blocked": ("conflux_tpu.qr.single", "qr_factor_blocked"),
@@ -79,6 +81,8 @@ __all__ = [
     "solve",
     "lu_solve",
     "cholesky_solve",
+    "lstsq",
+    "lstsq_distributed",
     "lu_factor_distributed",
     "lu_factor_steps",
     "cholesky_factor_distributed",

@@ -124,6 +124,10 @@ def qr_distributed_host(A: np.ndarray, Px: int, mesh=None,
     from conflux_tpu.geometry import Grid3
 
     M, n = A.shape
+    if M < n:
+        # the padded row count could pass _factor's check while the true
+        # matrix is rank-deficient-by-shape -> silently non-orthogonal Q
+        raise ValueError(f"need M >= n, got {A.shape}")
     Ml = -(-M // Px)
     if mesh is None:
         mesh = make_mesh(Grid3(Px, 1, 1))

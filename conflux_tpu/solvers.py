@@ -443,3 +443,85 @@ def solve(A: jax.Array, b: jax.Array, *, v: int = 256,
         r = bc - jnp.matmul(Ac, x, precision=lax.Precision.HIGHEST)
         x = x + solve_corr(r).astype(cdtype)
     return x[:N] if pad else x
+
+
+def lstsq(A: jax.Array, b: jax.Array, chunk: int | None = None,
+          passes: int = 2) -> jax.Array:
+    """Least-squares min_x ||A x - b|| for tall full-rank A (M >= n).
+
+    QR route (`qr.single.tall_qr`): x = R^{-1} (Q^T b). Completes the
+    solver family (LU for square, Cholesky for SPD, QR for overdetermined)
+    — the reference has no solve API at all; see the module docstring.
+    """
+    M, n = A.shape
+    if b.shape[0] != M:
+        raise ValueError(f"b has {b.shape[0]} rows, A has {M}")
+    from conflux_tpu.qr.single import tall_qr
+
+    Q, R = tall_qr(A, chunk=chunk, passes=passes)
+    cdtype = blas.compute_dtype(A.dtype)
+    b2, squeeze = _as_2d(b.astype(cdtype))
+    with jax.default_matmul_precision("highest"):
+        c = jnp.matmul(Q.astype(cdtype).T, b2,
+                       precision=lax.Precision.HIGHEST)
+        x = blas.trsm_left_upper(R.astype(cdtype), c)
+    return x[:, 0] if squeeze else x
+
+
+@functools.lru_cache(maxsize=32)
+def _build_qtb(mesh_key, cdtype_name: str):
+    """Compiled c = psum_x(Q_loc^T b_loc) program, cached per mesh/dtype
+    (the shapes are traced; rebuilding the shard_map closure per call
+    would force a recompile every invocation)."""
+    from jax.sharding import PartitionSpec as P
+
+    from conflux_tpu.parallel.mesh import AXIS_X, lookup_mesh
+
+    mesh = lookup_mesh(mesh_key)
+    cdtype = jnp.dtype(cdtype_name)
+
+    def device_fn(qblk, bblk):
+        c = lax.psum(
+            jnp.matmul(qblk[0].astype(cdtype).T, bblk[0],
+                       precision=lax.Precision.HIGHEST), AXIS_X)
+        return lax.pmax(c, tuple(mesh.axis_names))
+
+    return jax.jit(jax.shard_map(
+        device_fn, mesh=mesh,
+        in_specs=(P(AXIS_X, None, None), P(AXIS_X, None, None)),
+        out_specs=P()))
+
+
+def lstsq_distributed(shards, mesh, b, algo: str = "tsqr",
+                      chunk: int | None = None, passes: int = 2):
+    """Distributed least squares on x-sharded rows: min_x ||A x - b||.
+
+    shards is (Px, Ml, n) block-row shards (the `qr.distributed` layout),
+    b is (M,) or (M, k) with M = Px*Ml. TSQR (or CholeskyQR2) gives
+    (Q_shards, R); c = Q^T b is one (n, k) psum over 'x' — the only
+    communication beyond the factorization's R reduction — then
+    x = R^{-1} c, replicated.
+    """
+    from conflux_tpu.qr.distributed import (
+        cholesky_qr2_distributed,
+        tsqr_distributed,
+    )
+
+    shards = jnp.asarray(shards)
+    Px, Ml, n = shards.shape
+    cdtype = blas.compute_dtype(shards.dtype)
+    b2, squeeze = _as_2d(jnp.asarray(b, cdtype))
+    if b2.shape[0] != Px * Ml:
+        # before the factorization: the error should be free
+        raise ValueError(f"b has {b2.shape[0]} rows, shards hold {Px * Ml}")
+    if algo == "tsqr":
+        Qs, R = tsqr_distributed(shards, mesh, chunk=chunk, passes=passes)
+    elif algo == "cholesky":
+        Qs, R = cholesky_qr2_distributed(shards, mesh, passes=passes)
+    else:
+        raise ValueError(f"unknown algo {algo!r} (tsqr|cholesky)")
+    bs = b2.reshape(Px, Ml, -1)
+    c = _build_qtb(mesh_cache_key(mesh), cdtype.name)(Qs, bs)
+    with jax.default_matmul_precision("highest"):
+        x = blas.trsm_left_upper(jnp.asarray(R, cdtype), c)
+    return x[:, 0] if squeeze else x

@@ -277,3 +277,38 @@ def test_mesh_solves_multi_rhs():
     Xc = np.asarray(cholesky_solve_distributed(L_sh, cgeom, mesh, B))
     assert Xc.shape == (N, k)
     assert np.linalg.norm(S @ Xc - B) / np.linalg.norm(B) < 1e-4
+
+
+def test_lstsq_single():
+    """QR least squares vs np.linalg.lstsq (well-conditioned, tall)."""
+    import numpy as np
+    from conflux_tpu.solvers import lstsq
+
+    rng = np.random.default_rng(31)
+    A = rng.standard_normal((200, 24))
+    b = rng.standard_normal(200)
+    x = np.asarray(lstsq(jnp.asarray(A), jnp.asarray(b)))
+    x_ref = np.linalg.lstsq(A, b, rcond=None)[0]
+    np.testing.assert_allclose(x, x_ref, atol=1e-9)
+
+
+def test_lstsq_distributed_matches_single():
+    import numpy as np
+    import jax
+    from conflux_tpu.geometry import Grid3
+    from conflux_tpu.parallel.mesh import make_mesh
+    from conflux_tpu.solvers import lstsq, lstsq_distributed
+
+    rng = np.random.default_rng(37)
+    Px, Ml, n = 4, 50, 16
+    A = rng.standard_normal((Px * Ml, n))
+    B = rng.standard_normal((Px * Ml, 3))  # multi-RHS
+    mesh = make_mesh(Grid3(Px, 1, 1), devices=jax.devices()[:Px])
+    for algo in ("tsqr", "cholesky"):
+        X = np.asarray(lstsq_distributed(A.reshape(Px, Ml, n), mesh, B,
+                                         algo=algo))
+        X1 = np.asarray(lstsq(jnp.asarray(A), jnp.asarray(B)))
+        np.testing.assert_allclose(X, X1, atol=1e-9, err_msg=algo)
+        # normal-equations optimality: A^T (A X - B) ~ 0
+        g = A.T @ (A @ X - B)
+        assert np.abs(g).max() < 1e-9 * np.abs(A.T @ B).max() + 1e-8
