@@ -53,6 +53,8 @@ def __getattr__(name):
         "qr_factor_blocked": ("conflux_tpu.qr.single", "qr_factor_blocked"),
         "tall_qr": ("conflux_tpu.qr.single", "tall_qr"),
         "tsqr_distributed": ("conflux_tpu.qr.distributed", "tsqr_distributed"),
+        "qr_factor_distributed": (
+            "conflux_tpu.qr.distributed", "qr_factor_distributed"),
         "cholesky_qr2_distributed": (
             "conflux_tpu.qr.distributed", "cholesky_qr2_distributed"),
         "qr_distributed_host": (
@@ -96,6 +98,7 @@ __all__ = [
     "qr_factor_blocked",
     "tall_qr",
     "tsqr_distributed",
+    "qr_factor_distributed",
     "cholesky_qr2_distributed",
     "qr_distributed_host",
 ]

@@ -3,15 +3,21 @@ and distributed.py module docstrings."""
 
 from conflux_tpu.qr.distributed import (
     cholesky_qr2_distributed,
+    qr_blocked_distributed_host,
     qr_distributed_host,
+    qr_factor_distributed,
+    r_geometry,
     tsqr_distributed,
 )
 from conflux_tpu.qr.single import qr_factor_blocked, tall_qr
 
 __all__ = [
     "cholesky_qr2_distributed",
+    "qr_blocked_distributed_host",
     "qr_distributed_host",
     "qr_factor_blocked",
+    "qr_factor_distributed",
+    "r_geometry",
     "tall_qr",
     "tsqr_distributed",
 ]

@@ -35,9 +35,14 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import PartitionSpec as P
 
+from jax import lax
+
+from conflux_tpu.geometry import ragged_segments
 from conflux_tpu.ops import blas
 from conflux_tpu.parallel.mesh import (
     AXIS_X,
+    AXIS_Y,
+    AXIS_Z,
     lookup_mesh,
     make_mesh,
     mesh_cache_key,
@@ -142,3 +147,303 @@ def qr_distributed_host(A: np.ndarray, Px: int, mesh=None,
         raise ValueError(f"unknown algo {algo!r} (tsqr|cholesky)")
     Q = np.asarray(Qs).reshape(Px * Ml, n)[:M]
     return Q, np.asarray(R)
+
+
+# --------------------------------------------------------------------------- #
+# General (block-cyclic) distributed QR — the CAQR role
+# --------------------------------------------------------------------------- #
+
+
+@functools.lru_cache(maxsize=32)
+def _build_full(geom, mesh_key, precision, backend: str, chunk: int,
+                donate: bool = False):
+    """Blocked distributed QR over the full (x, y, z) mesh.
+
+    The general-matrix companion of `tsqr_distributed`, in the same design
+    language as the LU/Cholesky superstep loops (one jitted shard_map +
+    fori_loop, block-cyclic shards, z-partial-sum invariant):
+
+     - column panel k: psum over ('y','z') -> replicated (Ml, v) panel;
+     - BCGS2 re-projection: one more sweep of P -= Q_done (Q_done^T P)
+       against the already-computed Q columns (the right-looking trailing
+       update below is the first sweep), which is what keeps global
+       orthogonality at eps without a second full factorization pass;
+       the correction W rides into R's rows;
+     - panel factorization: the two-pass TSQR election of
+       `tsqr_distributed` (local chunked tree + all_gather of (v, v) Rs
+       over 'x' + replicated tree reduction — no pivoting, so unlike LU
+       no ids travel with the candidates);
+     - trailing update: C = psum_{x,z}(Qp^T A) then A -= Qp C, with Qp
+       split into nlayr = v/Pz z-slabs so the layers share the GEMM flops
+       exactly like the LU/Cholesky 2.5D scheme; columns retire left to
+       right (rows never retire — Q is full height), so only column
+       segmentation is needed;
+     - R is block-cyclic over its own (N, N) geometry — nothing
+       replicated at scale: the panel's (v, v) R block lands on its
+       (x, y) owner, C lands in R's row-tile k, and W is redistributed
+       from column-owners to R's row-owners by a masked gather + psum
+       over 'y' (the transpose-exchange idiom of the Cholesky loop's
+       L10^T scatter).
+
+    Q comes back thin (M, N) in A's layout; A = Q R with diag(R) >= 0.
+    Rank-deficient panels leave their block's columns/rows unspecified
+    (same contract as the LU loop's degenerate supersteps).
+    """
+    mesh = lookup_mesh(mesh_key)
+    v = geom.v
+    Px, Py, Pz = geom.grid.Px, geom.grid.Py, geom.grid.Pz
+    Ml, Nl = geom.Ml, geom.Nl
+    if geom.M < geom.N:
+        raise ValueError(f"distributed QR needs M >= N, got {geom.M}x{geom.N}")
+    nlayr = -(-v // Pz)
+    v_pad = Pz * nlayr
+    n_steps = geom.Nt
+    # R's own block-cyclic geometry over (N, N): local row count per
+    # x-rank, padded so every x-rank holds whole tiles (r_geometry pads
+    # the global row count the same way; pad tiles are never written)
+    Nlr = (geom.Nt // Px + (1 if geom.Nt % Px else 0)) * v
+    col_segs = ragged_segments(geom.Ntl, v, 8)
+
+    def _vary(val):
+        # mark a literal as varying over every mesh axis so lax.cond
+        # branch output types match the mask-dependent compute branches
+        for ax in (AXIS_X, AXIS_Y, AXIS_Z):
+            val = lax.pcast(val, ax, to="varying")
+        return val
+
+    def device_fn(blk):
+        x = lax.axis_index(AXIS_X)
+        y = lax.axis_index(AXIS_Y)
+        z = lax.axis_index(AXIS_Z)
+        dtype = blk.dtype
+        cdtype = blas.compute_dtype(dtype)
+        prec = precision
+
+        Aloc = jnp.where(z == 0, blk[0, 0], jnp.zeros((), dtype))
+        # R starts as a literal zero block: mark it varying over the mesh
+        # axes so the fori_loop carry type matches the body's outputs
+        Rloc = _vary(jnp.zeros((Nlr, Nl), dtype))
+
+        lc = jnp.arange(Nl, dtype=jnp.int32)
+        ctile = (lc // v) * Py + y  # global col-tile id per local col
+        # R-local rows -> global R row ids (for the W transpose-exchange)
+        lrr = jnp.arange(Nlr, dtype=jnp.int32)
+        grow_r = ((lrr // v) * Px + x) * v + (lrr % v)
+        # source local column (on the y owner) holding R row g
+        wsrc_y = (grow_r // v) % Py
+        wsrc_col = ((grow_r // v) // Py) * v + grow_r % v
+
+        def tsqr_panel(P_):
+            """Two-pass replicated TSQR election on the (Ml, v) panel."""
+            R = None
+            Q = P_
+            for _ in range(2):
+                r_loc = _tree_r(Q, chunk)
+                allr = lax.all_gather(r_loc, AXIS_X).reshape(Px * v, v)
+                Ri = _tree_r(allr, chunk)
+                Q = blas.trsm_right_upper(Ri, Q)
+                R = Ri if R is None else jnp.matmul(Ri, R, precision=prec)
+            return _positive_diag(Q, R)
+
+        def body(k, carry):
+            Aloc, Rloc = carry
+            i0 = jnp.zeros((), jnp.int32)
+            z0 = z == 0
+            yo = k % Py
+            xo = k % Px
+            lj = ((k // Py) * v).astype(jnp.int32)
+            lir = ((k // Px) * v).astype(jnp.int32)  # R-local row slab
+            col_done = ctile < k
+            col_live = ctile > k
+
+            with jax.named_scope("qr_panel_reduce"):
+                panel_loc = lax.dynamic_slice(Aloc, (i0, lj), (Ml, v))
+                P_ = lax.psum(
+                    jnp.where(y == yo, panel_loc, jnp.zeros((), dtype)),
+                    (AXIS_Y, AXIS_Z)).astype(cdtype)
+
+            # ---- BCGS2 re-projection against finished Q columns -------- #
+            with jax.named_scope("qr_reproject"):
+                # W = Q_done^T P, (Nl, v), rows indexed by my local cols;
+                # Q columns live on layer 0 only
+                wparts = []
+                for clo, chi in col_segs:
+                    dm = col_done[clo:chi]
+                    wparts.append(lax.cond(
+                        dm.any(),
+                        lambda a, m: jnp.matmul(
+                            jnp.where(m[:, None], a.T.astype(cdtype), 0.0),
+                            P_, precision=prec),
+                        # pcast matches the compute branch's varying
+                        # axes (a: x/z, m: y) for the cond output type
+                        lambda a, m: _vary(jnp.zeros((a.shape[1], v),
+                                                     cdtype)),
+                        jnp.where(z0, lax.slice(
+                            Aloc, (0, clo), (Ml, chi)), jnp.zeros((), dtype)),
+                        dm,
+                    ))
+                W = lax.psum(
+                    jnp.concatenate(wparts, axis=0) if len(wparts) > 1
+                    else wparts[0],
+                    (AXIS_X, AXIS_Z))  # (Nl, v) replicated over x, z
+                # P -= Q_done W: per-segment local partials (NO
+                # collective inside the cond — divergent predicates across
+                # y would deadlock a psum), one unconditional psum over 'y'
+                # (columns are y-partitioned; rows stay local to x) + 'z'
+                # (Q lives on layer 0) at the end
+                Dacc = _vary(jnp.zeros((Ml, v), cdtype))
+                for clo, chi in col_segs:
+                    dm = col_done[clo:chi]
+
+                    def proj(acc, clo=clo, chi=chi, dm=dm):
+                        Qseg = jnp.where(
+                            dm[:, None].T & z0,
+                            lax.slice(Aloc, (0, clo), (Ml, chi)).astype(cdtype),
+                            0.0)
+                        return acc + jnp.matmul(Qseg, W[clo:chi],
+                                                precision=prec)
+
+                    Dacc = lax.cond(dm.any(), proj, lambda acc: acc, Dacc)
+                P_ = P_ - lax.psum(Dacc, (AXIS_Y, AXIS_Z))
+
+            with jax.named_scope("qr_panel_tsqr"):
+                Qp, Rp = tsqr_panel(P_)
+
+            # ---- trailing projection C = Qp^T A (first GS sweep) ------- #
+            with jax.named_scope("qr_trailing_c"):
+                cparts = []
+                for clo, chi in col_segs:
+                    lm = col_live[clo:chi]
+                    cparts.append(lax.cond(
+                        lm.any(),
+                        lambda a, m: jnp.matmul(
+                            Qp.T, jnp.where(m[None, :], a.astype(cdtype), 0.0),
+                            precision=prec),
+                        lambda a, m: _vary(jnp.zeros((v, a.shape[1]),
+                                                           cdtype)),
+                        lax.slice(Aloc, (0, clo), (Ml, chi)), lm,
+                    ))
+                C = lax.psum(
+                    jnp.concatenate(cparts, axis=1) if len(cparts) > 1
+                    else cparts[0],
+                    (AXIS_X, AXIS_Z))  # (v, Nl)
+
+            # ---- trailing update A -= Qp C on this layer's z-slab ------ #
+            Qpp = jnp.pad(Qp.astype(dtype), ((0, 0), (0, v_pad - v)))
+            Cp = jnp.pad(C.astype(dtype), ((0, v_pad - v), (0, 0)))
+            zoff = (z * nlayr).astype(jnp.int32)
+            Qps = lax.dynamic_slice(Qpp, (i0, zoff), (Ml, nlayr))
+            Cs = lax.dynamic_slice(Cp, (zoff, i0), (nlayr, Nl))
+            with jax.named_scope("qr_trailing_update"):
+                Anew = Aloc
+                for clo, chi in col_segs:
+                    lm = col_live[clo:chi]
+
+                    def seg_update(A, clo=clo, chi=chi, lm=lm):
+                        a_seg = lax.slice(A, (0, clo), (Ml, chi))
+                        upd = blas.gemm(Qps, Cs[:, clo:chi],
+                                        precision=prec, backend=backend)
+                        new = a_seg - jnp.where(lm[None, :], upd,
+                                                jnp.zeros((), dtype))
+                        return lax.dynamic_update_slice(A, new, (0, clo))
+
+                    Anew = lax.cond(lm.any(), seg_update, lambda A: A, Anew)
+
+            # ---- Q panel write (z0, column owner) ---------------------- #
+            with jax.named_scope("qr_writes"):
+                qcol = jnp.where(z0, Qp.astype(dtype), jnp.zeros((), dtype))
+                Anew = jnp.where(
+                    y == yo, lax.dynamic_update_slice(Anew, qcol, (i0, lj)),
+                    Anew)
+
+                # R writes: C into row-tile k (live cols), Rp into the
+                # diagonal block, W into column-panel k (done rows)
+                rrow_cur = lax.dynamic_slice(Rloc, (lir, i0), (v, Nl))
+                rrow_new = jnp.where(
+                    col_live[None, :] & z0, C.astype(dtype), rrow_cur)
+                rrow_new = jnp.where(
+                    (y == yo) & z0,
+                    lax.dynamic_update_slice(rrow_new, Rp.astype(dtype),
+                                             (i0, lj)),
+                    rrow_new)
+                Rnew = jnp.where(
+                    x == xo, lax.dynamic_update_slice(Rloc, rrow_new,
+                                                      (lir, i0)),
+                    Rloc)
+                # W transpose-exchange: my R rows' corrections live on the
+                # y-rank owning that global column; gather + psum over 'y'
+                Wr = lax.psum(
+                    jnp.where((wsrc_y == y)[:, None]
+                              & (grow_r < k * v)[:, None],
+                              jnp.take(W, jnp.minimum(wsrc_col, Nl - 1),
+                                       axis=0, mode="clip"),
+                              jnp.zeros((), cdtype)),
+                    AXIS_Y)  # (Nlr, v) complete on every y
+                wcol = lax.dynamic_slice(Rnew, (i0, lj), (Nlr, v))
+                wcol = wcol + jnp.where(
+                    (y == yo) & z0, Wr.astype(dtype), jnp.zeros((), dtype))
+                Rnew = lax.dynamic_update_slice(Rnew, wcol, (i0, lj))
+            return Anew, Rnew
+
+        Aloc, Rloc = lax.fori_loop(0, n_steps, body, (Aloc, Rloc))
+        Qout = lax.psum(Aloc, AXIS_Z)
+        Rout = lax.psum(Rloc, AXIS_Z)
+        return Qout[None, None], Rout[None, None]
+
+    shard_spec = P(AXIS_X, AXIS_Y, None, None)
+    fn = jax.shard_map(device_fn, mesh=mesh, in_specs=shard_spec,
+                       out_specs=(shard_spec, shard_spec))
+    return jax.jit(fn, donate_argnums=(0,) if donate else ())
+
+
+def qr_factor_distributed(shards, geom, mesh, precision=None,
+                          backend: str | None = None,
+                          chunk: int | None = None, donate: bool = False):
+    """Blocked QR of block-cyclic (Px, Py, Ml, Nl) shards on the mesh.
+
+    Returns (Q_shards, R_shards): Q thin (M, N) in A's layout, R upper-
+    triangular (N, N) block-cyclic over its own geometry (gather it with
+    `r_geometry(geom)`). See `_build_full` for the algorithm.
+    """
+    precision = blas.matmul_precision() if precision is None else precision
+    backend = blas.get_backend() if backend is None else backend
+    chunk = blas._PANEL_CHUNK if chunk is None else chunk
+    if donate and next(iter(mesh.devices.flat)).platform == "cpu":
+        donate = False
+    fn = _build_full(geom, mesh_cache_key(mesh), precision, backend, chunk,
+                     donate)
+    return fn(jnp.asarray(shards))
+
+
+def r_geometry(geom):
+    """The (N, N) block-cyclic geometry R comes back in."""
+    from conflux_tpu.geometry import LUGeometry
+
+    return LUGeometry.create(geom.N, geom.N, geom.v, geom.grid)
+
+
+def qr_blocked_distributed_host(A: np.ndarray, grid, v: int, mesh=None,
+                                precision=None, backend: str | None = None,
+                                chunk: int | None = None):
+    """Host convenience: scatter, factor, gather. Returns (Q (M, N),
+    R (N, N), geom). M, N are padded to grid multiples by the geometry;
+    requires M >= N after padding (pad-with-identity is not meaningful
+    for QR, so sizes should divide evenly or be padded by the caller)."""
+    from conflux_tpu.geometry import LUGeometry
+
+    geom = LUGeometry.create(A.shape[0], A.shape[1], v, grid)
+    if (geom.M, geom.N) != A.shape:
+        raise ValueError(
+            f"shape {A.shape} pads to {(geom.M, geom.N)}; distributed QR "
+            "needs exact grid-multiple sizes (zero-pad rows yourself — "
+            "extra zero rows leave R unchanged)")
+    if mesh is None:
+        mesh = make_mesh(geom.grid)
+    Qs, Rs = qr_factor_distributed(
+        jnp.asarray(geom.scatter(A)), geom, mesh, precision=precision,
+        backend=backend, chunk=chunk)
+    Q = geom.gather(np.asarray(Qs))
+    # r_geometry pads R's rows to a tile multiple of Px; the pad tiles
+    # are never written, so slicing restores the (N, N) contract
+    R = r_geometry(geom).gather(np.asarray(Rs))[: geom.N]
+    return Q, np.triu(R), geom
