@@ -1,0 +1,156 @@
+"""QR miniapp — driver for the third factorization family.
+
+The reference ships miniapps only for its two cores (LU/Cholesky); this
+driver extends the same harness vocabulary (`examples/conflux_miniapp.cpp`
+flag shapes, `_result_` protocol, warm-up + timed reps) to the QR family
+so the sweep/collect tooling covers all three.
+
+Modes:
+  - tall (`--cols` < rows, default): distributed TSQR or CholeskyQR2 on
+    x-block rows (`--algo`);
+  - general block-cyclic (`--full`): `qr_factor_distributed` on the
+    (Px, Py, Pz) mesh, same superstep shape as the LU/Cholesky loops.
+
+Examples:
+    python -m conflux_tpu.cli.qr_miniapp -M 8192 --cols 256 -r 2
+    python -m conflux_tpu.cli.qr_miniapp -M 1024 --cols 1024 --full \
+        --p_grid 2,2,1 --platform cpu --devices 4 --validate
+"""
+
+from __future__ import annotations
+
+import argparse
+
+import numpy as np
+
+from conflux_tpu.cli.common import (
+    WallTimer,
+    add_common_args,
+    add_experiment_type_arg,
+    np_dtype,
+    result_line,
+    setup_platform,
+    sync,
+)
+
+
+def parse_args(argv=None):
+    p = argparse.ArgumentParser("qr_miniapp", description=__doc__)
+    p.add_argument("-M", type=int, default=8192, help="rows")
+    p.add_argument("--cols", type=int, default=256, help="columns (<= rows)")
+    p.add_argument("-b", "--block", type=int, default=None,
+                   help="panel width v for --full (default 256)")
+    p.add_argument("--p_grid", default=None, help="Px,Py,Pz (default: auto)")
+    p.add_argument("--algo", default="tsqr", choices=["tsqr", "cholesky"],
+                   help="tall-mode election (QR tree vs Gram/CholeskyQR2)")
+    p.add_argument("--full", action="store_true",
+                   help="general block-cyclic QR on the (x, y, z) mesh")
+    p.add_argument("-r", "--run", type=int, default=2, help="timed reps")
+    p.add_argument("--validate", action="store_true",
+                   help="orthogonality + reconstruction residuals")
+    add_experiment_type_arg(p)
+    add_common_args(p)
+    return p.parse_args(argv)
+
+
+def main(argv=None) -> int:
+    args = parse_args(argv)
+    setup_platform(args)
+
+    import jax
+    import jax.numpy as jnp
+
+    from conflux_tpu import profiler
+    from conflux_tpu.geometry import Grid3, LUGeometry, choose_grid
+    from conflux_tpu.parallel.mesh import make_mesh
+
+    if args.cols > args.M:
+        raise SystemExit(f"--cols {args.cols} > rows {args.M}: QR needs M >= n")
+    n_devices = len(jax.devices())
+    dtype = np_dtype(args.dtype)
+    rng = np.random.default_rng(42)
+
+    if args.full:
+        from conflux_tpu.qr.distributed import qr_factor_distributed, r_geometry
+
+        v = args.block or 256
+        grid = (Grid3.parse(args.p_grid) if args.p_grid
+                else choose_grid(n_devices, args.M, args.cols))
+        if grid.P > n_devices:
+            raise SystemExit(f"grid {grid} needs {grid.P} devices, have {n_devices}")
+        geom = LUGeometry.create(args.M, args.cols, v, grid)
+        mesh = make_mesh(grid, devices=jax.devices()[: grid.P])
+        with profiler.region("init_matrix"):
+            A = rng.standard_normal((geom.M, geom.N)).astype(dtype)
+            dev = jnp.asarray(geom.scatter(A))
+            sync(dev)
+        algo_name, N_rep, vrep = "qr", geom.N, v
+
+        def factor():
+            return qr_factor_distributed(dev, geom, mesh)
+
+    else:
+        from conflux_tpu.qr.distributed import (
+            cholesky_qr2_distributed,
+            tsqr_distributed,
+        )
+
+        if args.p_grid:
+            g = Grid3.parse(args.p_grid)
+            if (g.Py, g.Pz) != (1, 1):
+                raise SystemExit(
+                    f"tall mode distributes rows over 'x' only; grid {g} "
+                    "has Py/Pz > 1 (use --full for the 2.5D mesh)")
+            Px = g.Px
+        else:
+            Px = n_devices
+        if Px > n_devices:
+            raise SystemExit(f"Px={Px} needs {Px} devices, have {n_devices}")
+        grid = Grid3(Px, 1, 1)
+        mesh = make_mesh(grid, devices=jax.devices()[:Px])
+        Ml = -(-args.M // Px)
+        with profiler.region("init_matrix"):
+            A = rng.standard_normal((Px * Ml, args.cols)).astype(dtype)
+            dev = jnp.asarray(A.reshape(Px, Ml, args.cols))
+            sync(dev)
+        algo_name, N_rep, vrep = f"qr-{args.algo}", args.cols, args.cols
+
+        def factor():
+            if args.algo == "tsqr":
+                return tsqr_distributed(dev, mesh)
+            return cholesky_qr2_distributed(dev, mesh)
+
+    times = []
+    for rep in range(args.run + 1):
+        with WallTimer() as t:
+            with profiler.region("qr_factorization"):
+                Qout, Rout = factor()
+                sync(Qout)
+        if rep > 0:
+            times.append(t.ms)
+
+    for ms in times:
+        print(result_line(algo_name, N_rep, grid.P, grid, args.type, ms,
+                          vrep, args.dtype))
+
+    if args.validate:
+        with profiler.region("validation"):
+            if args.full:
+                Q = geom.gather(np.asarray(Qout))
+                R = np.triu(r_geometry(geom).gather(np.asarray(Rout))[: geom.N])
+            else:
+                Q = np.asarray(Qout).reshape(-1, args.cols)
+                R = np.asarray(Rout)
+            n = Q.shape[1]
+            orth = np.linalg.norm(Q.T @ Q - np.eye(n)) / np.sqrt(n)
+            rec = (np.linalg.norm(Q @ R - A.reshape(Q.shape[0], -1))
+                   / max(np.linalg.norm(A), 1e-30))
+        print(f"_residual_ orth={orth:.3e} reconstruction={rec:.3e}")
+
+    if args.profile:
+        profiler.report()
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -37,7 +37,7 @@ from jax.sharding import PartitionSpec as P
 
 from jax import lax
 
-from conflux_tpu.geometry import ragged_segments
+from conflux_tpu.geometry import LUGeometry, ragged_segments
 from conflux_tpu.ops import blas
 from conflux_tpu.parallel.mesh import (
     AXIS_X,
@@ -48,6 +48,22 @@ from conflux_tpu.parallel.mesh import (
     mesh_cache_key,
 )
 from conflux_tpu.qr.single import _positive_diag, _tree_r
+
+
+def _two_pass_tsqr(A, Px: int, chunk: int, passes: int, prec):
+    """Replicated TSQR election: local chunked tree -> all_gather of the
+    (n, n) Rs over 'x' -> replicated tree reduction; Q by TRSM, refined
+    over `passes` sweeps; positive-diagonal normalized. Shared by the
+    tall-skinny entry points and the block-cyclic loop's panel step."""
+    n = A.shape[1]
+    R = None
+    for _ in range(max(1, passes)):
+        r_loc = _tree_r(A, chunk)
+        allr = lax.all_gather(r_loc, AXIS_X).reshape(Px * n, n)
+        Ri = _tree_r(allr, chunk)
+        A = blas.trsm_right_upper(Ri, A)
+        R = Ri if R is None else jnp.matmul(Ri, R, precision=prec)
+    return _positive_diag(A, R)
 
 
 @functools.lru_cache(maxsize=32)
@@ -61,21 +77,17 @@ def _build(mesh_key, algo: str, shape, dtype_name: str, chunk: int,
 
     def device_fn(blk):
         A = blk[0].astype(blas.compute_dtype(dtype))
-        R = None
-        for _ in range(max(1, passes)):
-            if algo == "tsqr":
-                r_loc = _tree_r(A, chunk)
-                allr = jax.lax.all_gather(r_loc, AXIS_X)  # (Px, n, n)
-                # replicated reduction: every device factors the same
-                # stack, so R needs no broadcast
-                Ri = _tree_r(allr.reshape(Px * n, n), chunk)
-            else:  # cholesky
+        if algo == "tsqr":
+            Q, R = _two_pass_tsqr(A, Px, chunk, passes, prec)
+        else:  # cholesky: Gram psum + potrf election per pass
+            R = None
+            for _ in range(max(1, passes)):
                 G = jax.lax.psum(
                     jnp.matmul(A.T, A, precision=prec), AXIS_X)
                 Ri = blas.potrf(G).T
-            A = blas.trsm_right_upper(Ri, A)
-            R = Ri if R is None else jnp.matmul(Ri, R, precision=prec)
-        Q, R = _positive_diag(A, R)
+                A = blas.trsm_right_upper(Ri, A)
+                R = Ri if R is None else jnp.matmul(Ri, R, precision=prec)
+            Q, R = _positive_diag(A, R)
         # R is identical on every device already (replicated reduction /
         # psum'd Gram); pmax re-establishes replication for the out_spec,
         # same as the LU loop's perm output
@@ -235,15 +247,7 @@ def _build_full(geom, mesh_key, precision, backend: str, chunk: int,
 
         def tsqr_panel(P_):
             """Two-pass replicated TSQR election on the (Ml, v) panel."""
-            R = None
-            Q = P_
-            for _ in range(2):
-                r_loc = _tree_r(Q, chunk)
-                allr = lax.all_gather(r_loc, AXIS_X).reshape(Px * v, v)
-                Ri = _tree_r(allr, chunk)
-                Q = blas.trsm_right_upper(Ri, Q)
-                R = Ri if R is None else jnp.matmul(Ri, R, precision=prec)
-            return _positive_diag(Q, R)
+            return _two_pass_tsqr(P_, Px, chunk, 2, prec)
 
         def body(k, carry):
             Aloc, Rloc = carry
@@ -417,8 +421,6 @@ def qr_factor_distributed(shards, geom, mesh, precision=None,
 
 def r_geometry(geom):
     """The (N, N) block-cyclic geometry R comes back in."""
-    from conflux_tpu.geometry import LUGeometry
-
     return LUGeometry.create(geom.N, geom.N, geom.v, geom.grid)
 
 
@@ -429,8 +431,6 @@ def qr_blocked_distributed_host(A: np.ndarray, grid, v: int, mesh=None,
     R (N, N), geom). M, N are padded to grid multiples by the geometry;
     requires M >= N after padding (pad-with-identity is not meaningful
     for QR, so sizes should divide evenly or be padded by the caller)."""
-    from conflux_tpu.geometry import LUGeometry
-
     geom = LUGeometry.create(A.shape[0], A.shape[1], v, grid)
     if (geom.M, geom.N) != A.shape:
         raise ValueError(

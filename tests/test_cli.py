@@ -140,3 +140,39 @@ def test_cholesky_helper_reads_reference_raw_format(tmp_path):
     rc = cholesky_helper.main(
         ["compare", str(out), str(ref), "--lower", "--tol", "1e-10"])
     assert rc == 0
+
+
+def test_qr_miniapp_tall_and_full(capsys):
+    from conflux_tpu.cli import qr_miniapp
+
+    out = run_cli(
+        qr_miniapp.main,
+        ["-M", "128", "--cols", "16", "-r", "2", "--p_grid", "4,1,1",
+         "--validate", "--dtype", "float64"],
+        capsys,
+    )
+    lines = [l for l in out.splitlines() if l.startswith("_result_")]
+    assert len(lines) == 2
+    assert re.match(
+        r"_result_ qr-tsqr,conflux_tpu,16,8,4,4x1x1,time,weak,[\d.]+,16,float64",
+        lines[0]), lines[0]
+    res = [l for l in out.splitlines() if l.startswith("_residual_")]
+    assert "orth=" in res[0]
+    assert float(res[0].split("orth=")[1].split()[0]) < 1e-12
+
+    out = run_cli(
+        qr_miniapp.main,
+        ["-M", "64", "--cols", "64", "--full", "-b", "16", "--p_grid",
+         "2,2,1", "-r", "1", "--validate", "--dtype", "float64"],
+        capsys,
+    )
+    assert "_result_ qr,conflux_tpu,64," in out
+    res = [l for l in out.splitlines() if l.startswith("_residual_")][0]
+    assert float(res.split("reconstruction=")[1]) < 1e-12
+
+
+def test_qr_miniapp_rejects_wide(capsys):
+    from conflux_tpu.cli import qr_miniapp
+
+    with pytest.raises(SystemExit):
+        qr_miniapp.main(["-M", "16", "--cols", "32"])
