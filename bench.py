@@ -177,24 +177,41 @@ def _probe_worker(q):  # module-level: the spawn context pickles it by name
     q.put(float(jnp.ones((8,)).sum()))
 
 
-def _probe_device(timeout_s: int = 180) -> None:
-    """Fail fast (rc 1) when the chip is unresponsive instead of hanging
-    the whole harness: a wedged TPU program (e.g. a stuck DMA from an
+def _probe_device(timeout_s: int = 180, retries: int = 3,
+                  retry_wait_s: int = 240) -> None:
+    """Fail (rc 1) when the chip is unresponsive instead of hanging the
+    whole harness: a wedged TPU program (e.g. a stuck DMA from an
     earlier crashed client) blocks every later op indefinitely, and
-    block_until_ready through the tunnel cannot time out on its own."""
+    block_until_ready through the tunnel cannot time out on its own.
+    The tunnel wedge is sometimes transient (minutes), so the probe
+    retries over a ~15-minute window before giving up — a round-end
+    bench run then catches a recovery it would otherwise miss."""
     import multiprocessing as mp
 
     ctx = mp.get_context("spawn")
-    q = ctx.Queue()
-    p = ctx.Process(target=_probe_worker, args=(q,), daemon=True)
-    p.start()
-    p.join(timeout_s)
-    if p.is_alive():
+    for attempt in range(retries):
+        q = ctx.Queue()
+        p = ctx.Process(target=_probe_worker, args=(q,), daemon=True)
+        p.start()
+        p.join(timeout_s)
+        if not p.is_alive():
+            return
         p.terminate()
         p.join(5)
-        raise SystemExit(
-            f"bench: device unresponsive after {timeout_s}s "
-            "(wedged TPU program?); aborting instead of hanging")
+        if p.is_alive():
+            # SIGTERM-immune (stuck in the wedged device call): SIGKILL,
+            # or the zombie keeps the device client open through every
+            # later attempt
+            p.kill()
+            p.join(5)
+        if attempt < retries - 1:
+            print(f"bench: device unresponsive after {timeout_s}s "
+                  f"(attempt {attempt + 1}/{retries}); retrying in "
+                  f"{retry_wait_s}s", flush=True)
+            time.sleep(retry_wait_s)
+    raise SystemExit(
+        f"bench: device unresponsive after {retries} probes of "
+        f"{timeout_s}s (wedged TPU program?); aborting instead of hanging")
 
 
 def main():

@@ -79,6 +79,11 @@ def main(argv=None) -> int:
         if grid.P > n_devices:
             raise SystemExit(f"grid {grid} needs {grid.P} devices, have {n_devices}")
         geom = LUGeometry.create(args.M, args.cols, v, grid)
+        if geom.M < geom.N:
+            raise SystemExit(
+                f"after grid padding the problem is {geom.M}x{geom.N} "
+                f"(requested {args.M}x{args.cols}, tile {v}, grid {grid}): "
+                "QR needs M >= N — raise -M or shrink the y axis")
         mesh = make_mesh(grid, devices=jax.devices()[: grid.P])
         with profiler.region("init_matrix"):
             A = rng.standard_normal((geom.M, geom.N)).astype(dtype)
@@ -110,9 +115,15 @@ def main(argv=None) -> int:
         mesh = make_mesh(grid, devices=jax.devices()[:Px])
         Ml = -(-args.M // Px)
         with profiler.region("init_matrix"):
-            A = rng.standard_normal((Px * Ml, args.cols)).astype(dtype)
+            # rows pad with ZEROS to a Px multiple (qr_distributed_host's
+            # convention: zero rows leave R unchanged), so the factored
+            # problem is exactly the requested one
+            A = np.zeros((Px * Ml, args.cols), dtype)
+            A[: args.M] = rng.standard_normal((args.M, args.cols))
             dev = jnp.asarray(A.reshape(Px, Ml, args.cols))
             sync(dev)
+        if Px * Ml != args.M:
+            print(f"rows padded {args.M} -> {Px * Ml} (zero rows)")
         algo_name, N_rep, vrep = f"qr-{args.algo}", args.cols, args.cols
 
         def factor():

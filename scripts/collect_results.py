@@ -34,11 +34,15 @@ def parse_line(line: str):
         algo, _, N, Nbase, P, grid, _, dtype, ms, v = parts
         exp = "weak"  # legacy logs were all weak sweeps; keep keys merged
     N, ms = int(N), float(ms)
-    gflops = FLOPS[algo] * N**3 / (ms * 1e-3) / 1e9
+    # algos without a cubic-in-N flop model (e.g. the qr miniapp's tall
+    # mode, whose line carries only the column count) report time only
+    factor = FLOPS.get(algo)
+    gflops = (round(factor * N**3 / (ms * 1e-3) / 1e9, 2)
+              if factor is not None else None)
     return {
         "algorithm": algo, "N": N, "N_base": int(Nbase), "P": int(P),
         "grid": grid, "type": exp, "dtype": dtype, "time_ms": ms,
-        "tile": int(v), "gflops": round(gflops, 2),
+        "tile": int(v), "gflops": gflops,
     }
 
 
@@ -60,7 +64,7 @@ def to_markdown(rows) -> str:
         lines.append(
             f"| {r['algorithm']} | {r['type'] or 'weak'} | {r['P']} "
             f"| {r['grid']} | {r['N']} | {r['tile']} | {r['time_ms']:.0f} "
-            f"| {r['gflops']:.1f} |"
+            f"| {'-' if r['gflops'] is None else format(r['gflops'], '.1f')} |"
         )
     return "\n".join(lines)
 
