@@ -124,7 +124,9 @@ def main(argv=None) -> int:
             sync(dev)
         if Px * Ml != args.M:
             print(f"rows padded {args.M} -> {Px * Ml} (zero rows)")
-        algo_name, N_rep, vrep = f"qr-{args.algo}", args.cols, args.cols
+        # N field = row count (the quantity a tall-QR sweep scales);
+        # the tile field carries the column count
+        algo_name, N_rep, vrep = f"qr-{args.algo}", Px * Ml, args.cols
 
         def factor():
             if args.algo == "tsqr":

@@ -446,25 +446,43 @@ def solve(A: jax.Array, b: jax.Array, *, v: int = 256,
 
 
 def lstsq(A: jax.Array, b: jax.Array, chunk: int | None = None,
-          passes: int = 2) -> jax.Array:
+          passes: int = 2, factor_dtype=None, refine: int = 0) -> jax.Array:
     """Least-squares min_x ||A x - b|| for tall full-rank A (M >= n).
 
     QR route (`qr.single.tall_qr`): x = R^{-1} (Q^T b). Completes the
     solver family (LU for square, Cholesky for SPD, QR for overdetermined)
     — the reference has no solve API at all; see the module docstring.
+
+    `factor_dtype`/`refine` extend the HPL-MxP recipe to least squares:
+    factor in a cheap dtype (e.g. bf16), then `refine` sweeps of
+    r = b - A x in the accurate dtype with the correction solved through
+    the same cheap factors (for consistent systems / small residuals this
+    recovers the accurate-dtype solution like the square-solve IR path;
+    genuinely inconsistent systems are limited by the normal-equations
+    conditioning as usual).
     """
     M, n = A.shape
     if b.shape[0] != M:
         raise ValueError(f"b has {b.shape[0]} rows, A has {M}")
     from conflux_tpu.qr.single import tall_qr
 
-    Q, R = tall_qr(A, chunk=chunk, passes=passes)
+    Af = A.astype(factor_dtype) if factor_dtype is not None else A
+    Q, R = tall_qr(Af, chunk=chunk, passes=passes)
     cdtype = blas.compute_dtype(A.dtype)
+    Qc, Rc = Q.astype(cdtype), R.astype(cdtype)
     b2, squeeze = _as_2d(b.astype(cdtype))
-    with jax.default_matmul_precision("highest"):
-        c = jnp.matmul(Q.astype(cdtype).T, b2,
-                       precision=lax.Precision.HIGHEST)
-        x = blas.trsm_left_upper(R.astype(cdtype), c)
+
+    def solve_ls(rhs):
+        with jax.default_matmul_precision("highest"):
+            c = jnp.matmul(Qc.T, rhs, precision=lax.Precision.HIGHEST)
+            return blas.trsm_left_upper(Rc, c)
+
+    x = solve_ls(b2)
+    if refine:
+        Ac = A.astype(cdtype)
+        for _ in range(refine):
+            r = b2 - jnp.matmul(Ac, x, precision=lax.Precision.HIGHEST)
+            x = x + solve_ls(r)
     return x[:, 0] if squeeze else x
 
 

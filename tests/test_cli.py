@@ -154,7 +154,7 @@ def test_qr_miniapp_tall_and_full(capsys):
     lines = [l for l in out.splitlines() if l.startswith("_result_")]
     assert len(lines) == 2
     assert re.match(
-        r"_result_ qr-tsqr,conflux_tpu,16,8,4,4x1x1,time,weak,[\d.]+,16,float64",
+        r"_result_ qr-tsqr,conflux_tpu,128,64,4,4x1x1,time,weak,[\d.]+,16,float64",
         lines[0]), lines[0]
     res = [l for l in out.splitlines() if l.startswith("_residual_")]
     assert "orth=" in res[0]

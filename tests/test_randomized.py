@@ -73,3 +73,31 @@ def test_randomized_cholesky_configs():
         bound = residual_bound(geom.N, np.float32)
         assert res < bound, (trial, grid, v, N, res, bound)
     assert padded_trials, "no trial exercised the padding path"
+
+
+@pytest.mark.slow
+def test_randomized_qr_configs():
+    """Random (M, N, v, grid) draws through the full block-cyclic QR,
+    checked against the positive-diagonal-unique LAPACK factorization."""
+    from conflux_tpu.qr.distributed import qr_blocked_distributed_host
+
+    rng = np.random.default_rng(555)
+    for trial in range(6):
+        grid = Grid3(*GRID_POOL[rng.integers(len(GRID_POOL))])
+        v = int(rng.choice([4, 8]))
+        # exact grid multiples (no identity-padding for QR); M >= N
+        N = int(rng.integers(1, 4)) * v * grid.Py
+        # M >= N by construction, rounded up to a whole x-tile multiple
+        M = -(-(N + int(rng.integers(0, 3)) * v * grid.Px)
+              // (v * grid.Px)) * v * grid.Px
+        A = rng.standard_normal((M, N))
+        Q, R, _ = qr_blocked_distributed_host(A, grid, v)
+        Qr, Rr = np.linalg.qr(A)
+        s = np.sign(np.diag(Rr)); s[s == 0] = 1
+        np.testing.assert_allclose(
+            R, Rr * s[:, None], atol=1e-9 * max(1.0, np.abs(Rr).max()),
+            err_msg=str((trial, grid, v, M, N)))
+        orth = np.linalg.norm(Q.T @ Q - np.eye(N))
+        assert orth < 1e-12 * N + 1e-13, (trial, grid, orth)
+        np.testing.assert_allclose(Q @ R, A, atol=1e-10 * max(1.0, np.abs(A).max()),
+                                   err_msg=str((trial, grid, v, M, N)))
