@@ -55,6 +55,8 @@ def __getattr__(name):
         "tsqr_distributed": ("conflux_tpu.qr.distributed", "tsqr_distributed"),
         "qr_factor_distributed": (
             "conflux_tpu.qr.distributed", "qr_factor_distributed"),
+        "qr_factor_steps": (
+            "conflux_tpu.qr.distributed", "qr_factor_steps"),
         "cholesky_qr2_distributed": (
             "conflux_tpu.qr.distributed", "cholesky_qr2_distributed"),
         "qr_distributed_host": (
@@ -99,6 +101,7 @@ __all__ = [
     "tall_qr",
     "tsqr_distributed",
     "qr_factor_distributed",
+    "qr_factor_steps",
     "cholesky_qr2_distributed",
     "qr_distributed_host",
 ]

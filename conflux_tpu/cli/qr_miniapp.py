@@ -161,6 +161,13 @@ def main(argv=None) -> int:
         print(f"_residual_ orth={orth:.3e} reconstruction={rec:.3e}")
 
     if args.profile:
+        if args.full:
+            # per-phase device table of the one-jit loop (qr_* scopes),
+            # same machinery as the LU/Cholesky miniapps
+            from conflux_tpu.cli.common import phase_profile
+            from conflux_tpu.qr.distributed import build_program
+
+            phase_profile(build_program(geom, mesh), dev)
         profiler.report()
     return 0
 

@@ -78,7 +78,8 @@ def initialize_multihost(coordinator_address: str | None = None,
 
 
 def distribute_shards(shards, mesh: jax.sharding.Mesh, *,
-                      shape: tuple | None = None, dtype=None) -> jax.Array:
+                      shape: tuple | None = None, dtype=None,
+                      spec=None) -> jax.Array:
     """Build the (Px, Py, Ml, Nl) device-sharded global array from host data.
 
     Two forms:
@@ -91,19 +92,30 @@ def distribute_shards(shards, mesh: jax.sharding.Mesh, *,
       shards owned by THIS process's addressable devices, so on a multi-host
       pod no host ever materializes the global matrix — the role of the
       reference's per-rank `InitMatrix` fill (`lu_params.hpp:141-376`).
+
+    `spec` overrides the default block-cyclic (x, y, None, None)
+    partitioning — e.g. PartitionSpec('x', None, None) for the QR
+    family's (Px, Ml, n) row-block shards; the callable then takes one
+    coordinate per sharded dimension.
     """
     from jax.sharding import PartitionSpec
 
-    sharding = jax.sharding.NamedSharding(
-        mesh, PartitionSpec(AXIS_X, AXIS_Y, None, None)
-    )
+    if spec is None:
+        spec = PartitionSpec(AXIS_X, AXIS_Y, None, None)
+    sharding = jax.sharding.NamedSharding(mesh, spec)
+    # dims carrying a mesh axis must be leading index dims (size == axis
+    # extent), so a shard's slice start IS its mesh coordinate — true for
+    # both supported layouts: block-cyclic (Px, Py, Ml, Nl) and the QR
+    # family's row-block (Px, Ml, n)
+    sharded_dims = [i for i, ax in enumerate(spec) if ax is not None]
     if callable(shards):
         if shape is None or dtype is None:
             raise ValueError("callable form requires shape= and dtype=")
 
         def cb(idx):
-            px, py = idx[0].start or 0, idx[1].start or 0
-            return np.asarray(shards(px, py), dtype=dtype)[None, None]
+            coords = tuple(idx[i].start or 0 for i in sharded_dims)
+            blk = np.asarray(shards(*coords), dtype=dtype)
+            return blk[(None,) * len(sharded_dims)]
 
         return jax.make_array_from_callback(tuple(shape), sharding, cb)
     shards = np.asarray(shards)

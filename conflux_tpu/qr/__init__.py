@@ -6,6 +6,7 @@ from conflux_tpu.qr.distributed import (
     qr_blocked_distributed_host,
     qr_distributed_host,
     qr_factor_distributed,
+    qr_factor_steps,
     r_geometry,
     tsqr_distributed,
 )
@@ -17,6 +18,7 @@ __all__ = [
     "qr_distributed_host",
     "qr_factor_blocked",
     "qr_factor_distributed",
+    "qr_factor_steps",
     "r_geometry",
     "tall_qr",
     "tsqr_distributed",

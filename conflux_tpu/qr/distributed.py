@@ -168,7 +168,7 @@ def qr_distributed_host(A: np.ndarray, Px: int, mesh=None,
 
 @functools.lru_cache(maxsize=32)
 def _build_full(geom, mesh_key, precision, backend: str, chunk: int,
-                donate: bool = False):
+                donate: bool = False, resumable: bool = False):
     """Blocked distributed QR over the full (x, y, z) mesh.
 
     The general-matrix companion of `tsqr_distributed`, in the same design
@@ -223,7 +223,7 @@ def _build_full(geom, mesh_key, precision, backend: str, chunk: int,
             val = lax.pcast(val, ax, to="varying")
         return val
 
-    def device_fn(blk):
+    def device_fn(blk, rblk=None, k0=0, k_end=n_steps):
         x = lax.axis_index(AXIS_X)
         y = lax.axis_index(AXIS_Y)
         z = lax.axis_index(AXIS_Z)
@@ -231,10 +231,16 @@ def _build_full(geom, mesh_key, precision, backend: str, chunk: int,
         cdtype = blas.compute_dtype(dtype)
         prec = precision
 
+        # z-partial invariant (same line as the LU loop): data enters on
+        # z == 0; a resumed z-replicated state round-trips through it
         Aloc = jnp.where(z == 0, blk[0, 0], jnp.zeros((), dtype))
-        # R starts as a literal zero block: mark it varying over the mesh
-        # axes so the fori_loop carry type matches the body's outputs
-        Rloc = _vary(jnp.zeros((Nlr, Nl), dtype))
+        if rblk is None:
+            # R starts as a literal zero block: mark it varying over the
+            # mesh axes so the fori_loop carry type matches the body
+            Rloc = _vary(jnp.zeros((Nlr, Nl), dtype))
+        else:
+            # R is only ever written on layer 0; restore that invariant
+            Rloc = jnp.where(z == 0, rblk[0, 0], jnp.zeros((), dtype))
 
         lc = jnp.arange(Nl, dtype=jnp.int32)
         ctile = (lc // v) * Py + y  # global col-tile id per local col
@@ -389,15 +395,38 @@ def _build_full(geom, mesh_key, precision, backend: str, chunk: int,
                 Rnew = lax.dynamic_update_slice(Rnew, wcol, (i0, lj))
             return Anew, Rnew
 
-        Aloc, Rloc = lax.fori_loop(0, n_steps, body, (Aloc, Rloc))
+        Aloc, Rloc = lax.fori_loop(k0, k_end, body, (Aloc, Rloc))
         Qout = lax.psum(Aloc, AXIS_Z)
         Rout = lax.psum(Rloc, AXIS_Z)
         return Qout[None, None], Rout[None, None]
 
     shard_spec = P(AXIS_X, AXIS_Y, None, None)
-    fn = jax.shard_map(device_fn, mesh=mesh, in_specs=shard_spec,
+    if resumable:
+        in_specs = (shard_spec, shard_spec, P(), P())
+    else:
+        in_specs = shard_spec
+    fn = jax.shard_map(device_fn, mesh=mesh, in_specs=in_specs,
                        out_specs=(shard_spec, shard_spec))
-    return jax.jit(fn, donate_argnums=(0,) if donate else ())
+    # resumable mode donates the O(N^2) R state too — unlike LU's O(M)
+    # orig map, holding input and output R simultaneously is matrix-sized
+    donate_args = ((0, 1) if resumable else (0,)) if donate else ()
+    return jax.jit(fn, donate_argnums=donate_args)
+
+
+def build_program(geom, mesh, precision=None, backend: str | None = None,
+                  chunk: int | None = None, donate: bool = False,
+                  resumable: bool = False):
+    """The jitted block-cyclic QR program itself (cached per config) —
+    the single point resolving trace-time defaults, mirroring
+    `lu.distributed.build_program`. Direct use is for callers needing
+    the compile artifacts (the miniapp's --profile phase table)."""
+    precision = blas.matmul_precision() if precision is None else precision
+    backend = blas.get_backend() if backend is None else backend
+    chunk = blas._PANEL_CHUNK if chunk is None else chunk
+    if donate and next(iter(mesh.devices.flat)).platform == "cpu":
+        donate = False
+    return _build_full(geom, mesh_cache_key(mesh), precision, backend,
+                       chunk, donate, resumable)
 
 
 def qr_factor_distributed(shards, geom, mesh, precision=None,
@@ -409,14 +438,40 @@ def qr_factor_distributed(shards, geom, mesh, precision=None,
     triangular (N, N) block-cyclic over its own geometry (gather it with
     `r_geometry(geom)`). See `_build_full` for the algorithm.
     """
-    precision = blas.matmul_precision() if precision is None else precision
-    backend = blas.get_backend() if backend is None else backend
-    chunk = blas._PANEL_CHUNK if chunk is None else chunk
-    if donate and next(iter(mesh.devices.flat)).platform == "cpu":
-        donate = False
-    fn = _build_full(geom, mesh_cache_key(mesh), precision, backend, chunk,
-                     donate)
+    fn = build_program(geom, mesh, precision=precision, backend=backend,
+                       chunk=chunk, donate=donate)
     return fn(jnp.asarray(shards))
+
+
+def qr_factor_steps(shards, geom, mesh, k0: int, k1: int, R=None,
+                    precision=None, backend: str | None = None,
+                    chunk: int | None = None, donate: bool = False):
+    """Factor column panels [k0, k1) only — checkpoint/restart for the QR
+    loop (the `lu_factor_steps`/`cholesky_factor_steps` counterpart).
+
+    State = (shards, R): after k panels, columns with tile id < k hold
+    finished Q columns, the rest the projected trailing matrix, and R
+    holds its first k tile-rows — all plain saveable arrays. Pass R=None
+    only when k0 == 0; feed each call's outputs to the next. The step
+    bounds are traced scalars: one compiled program serves every segment.
+    Same 2.5D caveat as the LU form: the checkpoint consolidates
+    z-partial sums, so Pz > 1 resumes are numerically equivalent rather
+    than bit-identical; Pz == 1 round-trips exactly."""
+    if not (0 <= k0 < k1 <= geom.Nt):
+        raise ValueError(f"step range [{k0}, {k1}) outside [0, {geom.Nt})")
+    if R is None:
+        if k0 != 0:
+            raise ValueError("resuming at k0 > 0 requires the R state "
+                             "returned by the previous qr_factor_steps call")
+        # r_geometry's local row count IS the kernel's padded Nlr — one
+        # source of truth for the padding rule
+        R = jnp.zeros(
+            (geom.grid.Px, geom.grid.Py, r_geometry(geom).Ml, geom.Nl),
+            jnp.asarray(shards).dtype)
+    fn = build_program(geom, mesh, precision=precision, backend=backend,
+                       chunk=chunk, donate=donate, resumable=True)
+    return fn(jnp.asarray(shards), jnp.asarray(R), jnp.int32(k0),
+              jnp.int32(k1))
 
 
 def r_geometry(geom):

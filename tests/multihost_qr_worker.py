@@ -1,0 +1,76 @@
+"""Worker for the two-process multihost TSQR test.
+
+Two OS processes x 4 virtual CPU devices run `tsqr_distributed` over an
+8-wide x axis spanning the process boundary — the (n, n) R all_gather
+crosses the inter-process transport. Validation never materializes the
+global matrix: each process checks ||Q_loc R - A_loc|| on its OWN
+addressable shards, and orthogonality comes from the one-collective
+Gram check G = psum_x(Q_loc^T Q_loc) == I.
+"""
+
+import sys
+
+sys.path.insert(0, __import__("os").path.dirname(__file__))
+import mh_common  # noqa: F401  (must precede jax backend init)
+
+pid, nproc, port = int(sys.argv[1]), int(sys.argv[2]), sys.argv[3]
+
+import jax  # noqa: E402
+import jax.numpy as jnp  # noqa: E402
+import numpy as np  # noqa: E402
+from jax.sharding import PartitionSpec as P  # noqa: E402
+
+from conflux_tpu.geometry import Grid3  # noqa: E402
+from conflux_tpu.parallel.mesh import (  # noqa: E402
+    AXIS_X,
+    distribute_shards,
+    initialize_multihost,
+    make_mesh,
+)
+from conflux_tpu.qr.distributed import tsqr_distributed  # noqa: E402
+
+initialize_multihost(f"localhost:{port}", nproc, pid)
+assert len(jax.devices()) == 8, jax.devices()
+
+Px, Ml, n = 8, 32, 12
+grid = Grid3(Px, 1, 1)
+mesh = make_mesh(grid, devices=jax.devices()[:Px])
+
+
+def local_rows(px, _py=None):
+    # deterministic tall block from global row indices (no process ever
+    # holds the (M, n) matrix)
+    gi = px * Ml + np.arange(Ml)
+    j = np.arange(n)
+    blk = np.cos(0.23 * gi[:, None] + 0.71 * j[None, :]).astype(np.float32)
+    blk[:, :] += (gi[:, None] == j[None, :])
+    return blk
+
+
+shards = distribute_shards(
+    lambda px, py=None: local_rows(px), mesh, shape=(Px, Ml, n),
+    dtype=np.float32, spec=P(AXIS_X, None, None))
+Qs, R = tsqr_distributed(shards, mesh)
+
+# per-process local reconstruction on addressable shards only
+max_rec = 0.0
+Rh = np.asarray(R)
+for sh in Qs.addressable_shards:
+    px = sh.index[0].start if sh.index[0].start is not None else 0
+    q_loc = np.asarray(sh.data)[0]
+    a_loc = local_rows(px)
+    max_rec = max(max_rec, float(np.abs(q_loc @ Rh - a_loc).max()))
+
+# gather-free orthogonality: one (n, n) psum over 'x'
+gram = jax.jit(
+    jax.shard_map(
+        lambda q: jax.lax.psum(
+            jnp.matmul(q[0].T, q[0],
+                       precision=jax.lax.Precision.HIGHEST), AXIS_X),
+        mesh=mesh, in_specs=P(AXIS_X, None, None), out_specs=P()),
+)(Qs)
+orth = float(np.abs(np.asarray(gram) - np.eye(n)).max())
+
+print(f"proc {pid}: qr rec={max_rec:.3e} orth={orth:.3e}", flush=True)
+assert max_rec < 1e-5, max_rec
+assert orth < 1e-5, orth

@@ -121,3 +121,14 @@ def test_failure_recovery_new_processes_resume_from_checkpoint(tmp_path):
     for pid, (rc, out) in enumerate(outs2):
         assert rc == 0, f"phase2 proc {pid}:\n{out[-3000:]}"
         assert "phase2 residual=" in out
+
+
+@pytest.mark.slow
+def test_two_process_multihost_tsqr():
+    """TSQR's (n, n) R all_gather crosses the process boundary; each
+    worker validates reconstruction on its own shards and orthogonality
+    via one psum — no global matrix anywhere."""
+    results = _run_workers("multihost_qr_worker.py", [])
+    for pid, (rc, out) in enumerate(results):
+        assert rc == 0, f"proc {pid} failed:\n{out[-3000:]}"
+        assert f"proc {pid}: qr rec=" in out

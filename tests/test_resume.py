@@ -99,3 +99,54 @@ def test_cholesky_resume_matches_uninterrupted(gridspec):
 
         L = np.tril(geom.gather(np.asarray(s)))
         assert cholesky_residual(np.asarray(A, np.float64), L) < 5e-6
+
+
+@pytest.mark.parametrize("gridspec", [(1, 1, 1), (2, 2, 1), (2, 2, 2)])
+def test_qr_resume_matches_uninterrupted(gridspec):
+    import jax
+
+    from conflux_tpu.qr.distributed import (
+        qr_factor_distributed,
+        qr_factor_steps,
+    )
+
+    grid = Grid3(*gridspec)
+    v, Nt = 8, 8
+    N = v * Nt
+    geom = LUGeometry.create(N, N, v, grid)
+    mesh = make_mesh(grid, devices=jax.devices()[: grid.P])
+    A = make_test_matrix(N, N, dtype=np.float32)
+    shards = jnp.asarray(geom.scatter(A))
+
+    Qf, Rf = qr_factor_distributed(shards, geom, mesh)
+
+    Qs, Rs = qr_factor_steps(shards, geom, mesh, 0, 3)
+    Qs, Rs = jnp.asarray(np.asarray(Qs)), jnp.asarray(np.asarray(Rs))
+    Qs, Rs = qr_factor_steps(Qs, geom, mesh, 3, 5, R=Rs)
+    Qs, Rs = qr_factor_steps(Qs, geom, mesh, 5, geom.Nt, R=Rs)
+
+    if gridspec[2] == 1:
+        np.testing.assert_allclose(np.asarray(Qs), np.asarray(Qf),
+                                   rtol=0, atol=0)
+        np.testing.assert_allclose(np.asarray(Rs), np.asarray(Rf),
+                                   rtol=0, atol=0)
+    else:
+        np.testing.assert_allclose(np.asarray(Qs), np.asarray(Qf),
+                                   atol=1e-5)
+        np.testing.assert_allclose(np.asarray(Rs), np.asarray(Rf),
+                                   atol=1e-4)
+
+
+def test_qr_steps_rejects_bad_usage():
+    import jax
+
+    from conflux_tpu.qr.distributed import qr_factor_steps
+
+    grid = Grid3(1, 1, 1)
+    geom = LUGeometry.create(32, 32, 8, grid)
+    mesh = make_mesh(grid, devices=jax.devices()[:1])
+    shards = jnp.zeros((1, 1, 32, 32), jnp.float32)
+    with pytest.raises(ValueError):
+        qr_factor_steps(shards, geom, mesh, 2, 1)
+    with pytest.raises(ValueError):
+        qr_factor_steps(shards, geom, mesh, 2, 4)  # R=None at k0 > 0
