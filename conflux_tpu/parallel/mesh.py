@@ -124,6 +124,21 @@ def distribute_shards(shards, mesh: jax.sharding.Mesh, *,
     )
 
 
+def replicate(val, axes):
+    """Re-establish replication over mesh axes for an already-identical
+    value (the out_spec replication proof, see the LU loop's perm
+    output). pmax is the cheapest identity-preserving collective but has
+    no complex reduction on any backend, so complex values ride as their
+    real/imag parts."""
+    import jax.numpy as jnp
+    from jax import lax
+
+    if jnp.issubdtype(val.dtype, jnp.complexfloating):
+        return lax.complex(lax.pmax(val.real, axes),
+                           lax.pmax(val.imag, axes)).astype(val.dtype)
+    return lax.pmax(val, axes)
+
+
 def make_mesh(grid: Grid3, devices=None) -> jax.sharding.Mesh:
     """Build the ('x', 'y', 'z') mesh for a Grid3.
 

@@ -46,6 +46,7 @@ from conflux_tpu.parallel.mesh import (
     lookup_mesh,
     make_mesh,
     mesh_cache_key,
+    replicate,
 )
 from conflux_tpu.qr.single import _positive_diag, _tree_r
 
@@ -83,15 +84,16 @@ def _build(mesh_key, algo: str, shape, dtype_name: str, chunk: int,
             R = None
             for _ in range(max(1, passes)):
                 G = jax.lax.psum(
-                    jnp.matmul(A.T, A, precision=prec), AXIS_X)
-                Ri = blas.potrf(G).T
+                    jnp.matmul(A.conj().T, A, precision=prec), AXIS_X)
+                # G = L L^H (Hermitian), so the upper factor is L^H
+                Ri = blas.potrf(G).conj().T
                 A = blas.trsm_right_upper(Ri, A)
                 R = Ri if R is None else jnp.matmul(Ri, R, precision=prec)
             Q, R = _positive_diag(A, R)
         # R is identical on every device already (replicated reduction /
-        # psum'd Gram); pmax re-establishes replication for the out_spec,
-        # same as the LU loop's perm output
-        R = jax.lax.pmax(R, tuple(mesh.axis_names))
+        # psum'd Gram); re-establish replication for the out_spec, same
+        # as the LU loop's perm output (complex-safe helper)
+        R = replicate(R, tuple(mesh.axis_names))
         return Q.astype(dtype)[None], R.astype(dtype)
 
     fn = jax.shard_map(device_fn, mesh=mesh,
@@ -282,7 +284,8 @@ def _build_full(geom, mesh_key, precision, backend: str, chunk: int,
                     wparts.append(lax.cond(
                         dm.any(),
                         lambda a, m: jnp.matmul(
-                            jnp.where(m[:, None], a.T.astype(cdtype), 0.0),
+                            jnp.where(m[:, None],
+                                      a.conj().T.astype(cdtype), 0.0),
                             P_, precision=prec),
                         # pcast matches the compute branch's varying
                         # axes (a: x/z, m: y) for the cond output type
@@ -327,7 +330,8 @@ def _build_full(geom, mesh_key, precision, backend: str, chunk: int,
                     cparts.append(lax.cond(
                         lm.any(),
                         lambda a, m: jnp.matmul(
-                            Qp.T, jnp.where(m[None, :], a.astype(cdtype), 0.0),
+                            Qp.conj().T,
+                            jnp.where(m[None, :], a.astype(cdtype), 0.0),
                             precision=prec),
                         lambda a, m: _vary(jnp.zeros((v, a.shape[1]),
                                                            cdtype)),

@@ -60,11 +60,19 @@ def _tree_r(panel: jax.Array, chunk: int) -> jax.Array:
 
 
 def _positive_diag(Q: jax.Array, R: jax.Array):
-    """Flip signs so diag(R) >= 0 — the unique QR normalization (makes
-    results deterministic across chunkings/grids and comparable to
-    LAPACK's convention up to its own signs)."""
-    s = jnp.where(jnp.diagonal(R) < 0, -1.0, 1.0).astype(R.dtype)
-    return Q * s[None, :], R * s[:, None]
+    """Normalize so diag(R) is real and >= 0 — the unique QR
+    normalization (makes results deterministic across chunkings/grids
+    and comparable to LAPACK's convention up to its own signs). For
+    complex dtypes the correction is the diagonal's conjugate phase
+    (|d|/d), the unitary generalization of the real sign flip."""
+    d = jnp.diagonal(R)
+    if jnp.issubdtype(R.dtype, jnp.complexfloating):
+        mag = jnp.abs(d)
+        s = jnp.where(mag > 0, jnp.conj(d) / jnp.where(mag > 0, mag, 1.0),
+                      jnp.ones((), R.dtype))
+    else:
+        s = jnp.where(d < 0, -1.0, 1.0).astype(R.dtype)
+    return Q * jnp.conj(s)[None, :], R * s[:, None]
 
 
 def tall_qr(panel: jax.Array, chunk: int | None = None, passes: int = 2):
@@ -105,7 +113,7 @@ def _qr_blocked(A, v: int, chunk: int, passes: int):
         Qp, Rp = Qp.astype(cdtype), Rp.astype(cdtype)
         R = lax.dynamic_update_slice(R, Rp, (j0, j0))
         if j1 < N:
-            C = jnp.matmul(Qp.T, Ac[:, j1:], precision=prec)
+            C = jnp.matmul(Qp.conj().T, Ac[:, j1:], precision=prec)
             R = lax.dynamic_update_slice(R, C, (j0, j1))
             Ac = lax.dynamic_update_slice(
                 Ac, Ac[:, j1:] - jnp.matmul(Qp, C, precision=prec), (0, j1))

@@ -474,7 +474,8 @@ def lstsq(A: jax.Array, b: jax.Array, chunk: int | None = None,
 
     def solve_ls(rhs):
         with jax.default_matmul_precision("highest"):
-            c = jnp.matmul(Qc.T, rhs, precision=lax.Precision.HIGHEST)
+            c = jnp.matmul(Qc.conj().T, rhs,
+                           precision=lax.Precision.HIGHEST)
             return blas.trsm_left_upper(Rc, c)
 
     x = solve_ls(b2)
@@ -498,11 +499,13 @@ def _build_qtb(mesh_key, cdtype_name: str):
     mesh = lookup_mesh(mesh_key)
     cdtype = jnp.dtype(cdtype_name)
 
+    from conflux_tpu.parallel.mesh import replicate
+
     def device_fn(qblk, bblk):
         c = lax.psum(
-            jnp.matmul(qblk[0].astype(cdtype).T, bblk[0],
+            jnp.matmul(qblk[0].astype(cdtype).conj().T, bblk[0],
                        precision=lax.Precision.HIGHEST), AXIS_X)
-        return lax.pmax(c, tuple(mesh.axis_names))
+        return replicate(c, tuple(mesh.axis_names))
 
     return jax.jit(jax.shard_map(
         device_fn, mesh=mesh,

@@ -58,3 +58,60 @@ def test_lu_single_bfloat16_storage():
     assert LU.dtype == jnp.bfloat16
     res = lu_residual(A, np.asarray(LU, np.float32), perm)
     assert res < 100 * np.sqrt(N) * 2**-8, res  # bf16 eps = 2^-8
+
+
+@pytest.mark.parametrize("dtype", [np.complex64, np.complex128])
+def test_qr_complex(dtype):
+    """QR joins the complex instantiation set (`layout.cpp:138-191`):
+    tall tree + blocked path with unitary phase normalization."""
+    from conflux_tpu.qr import qr_factor_blocked, tall_qr
+
+    rng = np.random.default_rng(61)
+    A = (rng.standard_normal((96, 24))
+         + 1j * rng.standard_normal((96, 24))).astype(dtype)
+    Q, R = tall_qr(jnp.asarray(A), chunk=32)
+    Q, R = np.asarray(Q), np.asarray(R)
+    real = np.float32 if dtype == np.complex64 else np.float64
+    eps = np.finfo(real).eps
+    d = np.diag(R)
+    assert np.abs(d.imag).max() < 100 * eps * np.abs(d).max()  # real diag
+    assert (d.real >= -100 * eps).all()
+    assert np.linalg.norm(Q.conj().T @ Q - np.eye(24)) < 200 * eps
+    assert np.linalg.norm(Q @ R - A) / np.linalg.norm(A) < 200 * eps
+
+    Qb, Rb = qr_factor_blocked(jnp.asarray(A), v=8)
+    Qb, Rb = np.asarray(Qb), np.asarray(Rb)
+    assert np.linalg.norm(Qb @ Rb - A) / np.linalg.norm(A) < 500 * eps
+    assert np.linalg.norm(Qb.conj().T @ Qb - np.eye(24)) < 500 * eps
+
+
+def test_qr_distributed_complex():
+    from conflux_tpu.geometry import Grid3
+    from conflux_tpu.qr.distributed import qr_blocked_distributed_host
+
+    rng = np.random.default_rng(67)
+    A = (rng.standard_normal((64, 32))
+         + 1j * rng.standard_normal((64, 32))).astype(np.complex128)
+    Q, R, _ = qr_blocked_distributed_host(A, Grid3(2, 2, 1), 8)
+    assert np.linalg.norm(Q @ R - A) / np.linalg.norm(A) < 1e-13
+    assert np.linalg.norm(Q.conj().T @ Q - np.eye(32)) < 1e-12
+
+
+def test_cholesky_qr2_complex():
+    """The Gram election's upper factor must be L^H, not L^T: a plain
+    transpose keeps Q R = A (residual checks pass!) while Q loses
+    orthogonality by O(1) on complex inputs."""
+    import jax
+    from conflux_tpu.geometry import Grid3
+    from conflux_tpu.parallel.mesh import make_mesh
+    from conflux_tpu.qr.distributed import cholesky_qr2_distributed
+
+    rng = np.random.default_rng(71)
+    Px, Ml, n = 4, 32, 12
+    A = (rng.standard_normal((Px * Ml, n))
+         + 1j * rng.standard_normal((Px * Ml, n))).astype(np.complex128)
+    mesh = make_mesh(Grid3(Px, 1, 1), devices=jax.devices()[:Px])
+    Qs, R = cholesky_qr2_distributed(A.reshape(Px, Ml, n), mesh)
+    Q = np.asarray(Qs).reshape(-1, n)
+    assert np.linalg.norm(Q.conj().T @ Q - np.eye(n)) < 1e-12
+    assert np.linalg.norm(Q @ np.asarray(R) - A) / np.linalg.norm(A) < 1e-13
