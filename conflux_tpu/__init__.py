@@ -47,6 +47,9 @@ def __getattr__(name):
         "lu_solve": ("conflux_tpu.solvers", "lu_solve"),
         "cholesky_solve": ("conflux_tpu.solvers", "cholesky_solve"),
         "lstsq": ("conflux_tpu.solvers", "lstsq"),
+        "lu_solve_transposed": ("conflux_tpu.solvers", "lu_solve_transposed"),
+        "slogdet_from_lu": ("conflux_tpu.solvers", "slogdet_from_lu"),
+        "cond_estimate_1": ("conflux_tpu.solvers", "cond_estimate_1"),
         "lstsq_distributed": ("conflux_tpu.solvers", "lstsq_distributed"),
         "make_mesh": ("conflux_tpu.parallel.mesh", "make_mesh"),
         "initialize_multihost": ("conflux_tpu.parallel.mesh", "initialize_multihost"),
@@ -86,6 +89,9 @@ __all__ = [
     "lu_solve",
     "cholesky_solve",
     "lstsq",
+    "lu_solve_transposed",
+    "slogdet_from_lu",
+    "cond_estimate_1",
     "lstsq_distributed",
     "lu_factor_distributed",
     "lu_factor_steps",

@@ -143,6 +143,23 @@ def trsm_left_upper(U: jax.Array, B: jax.Array) -> jax.Array:
     )
 
 
+def trsm_left_upper_t(U: jax.Array, B: jax.Array) -> jax.Array:
+    """Solve U^T X = B with U upper triangular (transpose-system solve,
+    the getrs 'T' path)."""
+    return lax.linalg.triangular_solve(
+        U, B, left_side=True, lower=False, transpose_a=True,
+        unit_diagonal=False
+    )
+
+
+def trsm_left_lower_unit_t(L: jax.Array, B: jax.Array) -> jax.Array:
+    """Solve L^T X = B with L unit lower triangular."""
+    return lax.linalg.triangular_solve(
+        L, B, left_side=True, lower=True, transpose_a=True,
+        unit_diagonal=True
+    )
+
+
 def trsm_left_lower(L: jax.Array, B: jax.Array) -> jax.Array:
     """Solve L X = B with L lower triangular (Cholesky forward solve)."""
     return lax.linalg.triangular_solve(

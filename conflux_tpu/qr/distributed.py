@@ -487,22 +487,35 @@ def qr_blocked_distributed_host(A: np.ndarray, grid, v: int, mesh=None,
                                 precision=None, backend: str | None = None,
                                 chunk: int | None = None):
     """Host convenience: scatter, factor, gather. Returns (Q (M, N),
-    R (N, N), geom). M, N are padded to grid multiples by the geometry;
-    requires M >= N after padding (pad-with-identity is not meaningful
-    for QR, so sizes should divide evenly or be padded by the caller)."""
-    geom = LUGeometry.create(A.shape[0], A.shape[1], v, grid)
+    R (N, N), geom) for the ORIGINAL shape.
+
+    Non-grid-multiple sizes are handled by block-diagonal identity
+    extension: QR(blockdiag(A, I)) == blockdiag(Q, I) blockdiag(R, I)
+    exactly, so the padded problem's leading (M, N) / (N, N) blocks ARE
+    the answer (zero-column padding would instead feed singular panels
+    into the TRSM recovery and NaN the trailing matrix). The identity
+    lives in padded rows x padded columns, so rows are padded at least
+    as far as columns."""
+    M, N = A.shape
+    if M < N:
+        raise ValueError(f"distributed QR needs M >= N, got {A.shape}")
+    geom = LUGeometry.create(M, N, v, grid)
+    col_pad = geom.N - N
+    if geom.M - M < col_pad:
+        # need one identity row per pad column: grow the row padding
+        geom = LUGeometry.create(M + col_pad, N, v, grid)
     if (geom.M, geom.N) != A.shape:
-        raise ValueError(
-            f"shape {A.shape} pads to {(geom.M, geom.N)}; distributed QR "
-            "needs exact grid-multiple sizes (zero-pad rows yourself — "
-            "extra zero rows leave R unchanged)")
+        Ap = np.zeros((geom.M, geom.N), A.dtype)
+        Ap[:M, :N] = A
+        Ap[np.arange(M, M + col_pad), np.arange(N, geom.N)] = 1
+        A = Ap
     if mesh is None:
         mesh = make_mesh(geom.grid)
     Qs, Rs = qr_factor_distributed(
         jnp.asarray(geom.scatter(A)), geom, mesh, precision=precision,
         backend=backend, chunk=chunk)
-    Q = geom.gather(np.asarray(Qs))
+    Q = geom.gather(np.asarray(Qs))[:M, :N]
     # r_geometry pads R's rows to a tile multiple of Px; the pad tiles
     # are never written, so slicing restores the (N, N) contract
-    R = r_geometry(geom).gather(np.asarray(Rs))[: geom.N]
+    R = r_geometry(geom).gather(np.asarray(Rs))[:N, :N]
     return Q, np.triu(R), geom

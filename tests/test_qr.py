@@ -189,3 +189,20 @@ def test_qr_factor_distributed_ragged_r_rows():
     Q, R, _ = qr_blocked_distributed_host(A, Grid3(4, 2, 1), 8)
     assert Q.shape == (96, 48) and R.shape == (48, 48)
     _check(A, Q, R)
+
+
+@pytest.mark.parametrize("shape", [(50, 20), (40, 40), (70, 33)])
+def test_qr_factor_distributed_ragged_sizes(shape):
+    """Non-grid-multiple sizes go through the block-diagonal identity
+    extension (QR(blockdiag(A, I)) = blockdiag(Q, I) blockdiag(R, I)),
+    returning exact original-shape factors."""
+    from conflux_tpu.qr.distributed import qr_blocked_distributed_host
+
+    M, N = shape
+    rng = np.random.default_rng(M + N)
+    A = rng.standard_normal(shape)
+    Q, R, _ = qr_blocked_distributed_host(A, Grid3(2, 2, 1), 8)
+    assert Q.shape == (M, N) and R.shape == (N, N)
+    _check(A, Q, R)
+    Qr, Rr = _pos_diag_ref(A)
+    np.testing.assert_allclose(R, Rr, atol=1e-9 * np.abs(Rr).max())
