@@ -50,6 +50,7 @@ def __getattr__(name):
         "lu_solve_transposed": ("conflux_tpu.solvers", "lu_solve_transposed"),
         "slogdet_from_lu": ("conflux_tpu.solvers", "slogdet_from_lu"),
         "cond_estimate_1": ("conflux_tpu.solvers", "cond_estimate_1"),
+        "inv_from_lu": ("conflux_tpu.solvers", "inv_from_lu"),
         "lstsq_distributed": ("conflux_tpu.solvers", "lstsq_distributed"),
         "make_mesh": ("conflux_tpu.parallel.mesh", "make_mesh"),
         "initialize_multihost": ("conflux_tpu.parallel.mesh", "initialize_multihost"),
@@ -92,6 +93,7 @@ __all__ = [
     "lu_solve_transposed",
     "slogdet_from_lu",
     "cond_estimate_1",
+    "inv_from_lu",
     "lstsq_distributed",
     "lu_factor_distributed",
     "lu_factor_steps",

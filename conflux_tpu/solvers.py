@@ -587,7 +587,8 @@ def slogdet_from_lu(LU, perm):
         transpositions += clen - 1
     sign = -1.0 if transpositions % 2 else 1.0
     if (d == 0).any():
-        return 0.0 * sign, float("-inf")
+        # np convention: zero sign, complex-typed for complex input
+        return (0j if np.iscomplexobj(d) else 0.0), float("-inf")
     if np.iscomplexobj(d):
         ang = np.angle(d).sum()
         sign = sign * np.exp(1j * ang)
@@ -608,14 +609,28 @@ def cond_estimate_1(A, LU, perm, iters: int = 5) -> float:
     anorm = float(jnp.abs(A).sum(axis=0).max())
     x = jnp.full((n,), 1.0 / n, blas.compute_dtype(A.dtype))
     est = 0.0
-    for _ in range(max(1, iters)):
+    iters = max(1, iters)
+    for it in range(iters):
         y = lu_solve(LU, perm, x)                      # y = A^{-1} x
         est_new = float(jnp.abs(y).sum())
         if est_new <= est:  # converged: skip the dead solve pair
             break
         est = est_new
+        if it == iters - 1:  # count exit: the x update has no consumer
+            break
         xi = jnp.sign(jnp.where(y == 0, 1.0, y))
         z = lu_solve_transposed(LU, perm, xi)          # z = A^{-T} xi
         j = int(jnp.argmax(jnp.abs(z)))
         x = jnp.zeros((n,), x.dtype).at[j].set(1.0)
     return anorm * est
+
+
+def inv_from_lu(LU: jax.Array, perm: jax.Array) -> jax.Array:
+    """A^{-1} from packed LU factors (the `getri` role): solve with the
+    identity as RHS — N simultaneous columns through the same blocked
+    substitutions, so the MXU sees (N, N) triangular solves, not N
+    vector solves."""
+    N = LU.shape[0]
+    if LU.shape[0] != LU.shape[1]:
+        raise ValueError(f"inverse needs square factors, got {LU.shape}")
+    return lu_solve(LU, perm, jnp.eye(N, dtype=LU.dtype))

@@ -368,3 +368,16 @@ def test_slogdet_and_cond():
     exact = np.abs(A).sum(axis=0).max() * np.abs(np.linalg.inv(A)).sum(axis=0).max()
     est = cond_estimate_1(A, LU, perm)
     assert 0.1 * exact <= est <= 1.01 * exact, (est, exact)
+
+
+def test_inv_from_lu():
+    import numpy as np
+    from conflux_tpu.lu.single import lu_factor_blocked
+    from conflux_tpu.solvers import inv_from_lu
+
+    rng = np.random.default_rng(83)
+    N = 80
+    A = rng.standard_normal((N, N)) + 3 * np.eye(N)
+    LU, perm = lu_factor_blocked(jnp.asarray(A), v=16)
+    Ainv = np.asarray(inv_from_lu(LU, perm))
+    np.testing.assert_allclose(A @ Ainv, np.eye(N), atol=1e-9)
