@@ -1,23 +1,29 @@
-"""Single-chip tuning sweep for the distributed LU (run on real TPU).
+"""Single-chip tuning sweep (run on real TPU) for all three cores.
 
-Times `lu_factor_distributed` at bench scale across the knobs that the
-phase table (scripts/step_profile.py) identified as the levers:
+Times the distributed program for the selected algorithm at bench scale
+across the knobs the phase table (scripts/step_profile.py) identified as
+the levers:
 
   - matmul precision: HIGHEST (6-pass f32) vs HIGH (bf16x3) for the
-    trailing GEMMs — ~40% of device time; HIGH roughly halves it at some
-    residual cost (the IR solve absorbs factor-quality loss, solvers.py);
-  - panel_chunk: the nomination chunk height (VMEM-bounded);
+    trailing GEMMs — ~40% of device time in the LU loop; HIGH roughly
+    halves it at some residual cost (the IR solve absorbs factor-quality
+    loss, solvers.py);
+  - panel_chunk (LU only): the nomination chunk height (VMEM-bounded);
   - v: tile size (election work ~ N^2 v; GEMM efficiency grows with v).
 
-Prints one line per config: GFLOP/s + on-device residual. Skips instead
-of hanging when the chip is unresponsive (see bench.py).
+Prints one line per config: GFLOP/s + an on-device or host residual.
+Skips instead of hanging when the chip is unresponsive (see bench.py).
 
-Usage: python scripts/tpu_tune.py [-N 32768] [--reps 2]
+Usage:
+    python scripts/tpu_tune.py [-N 32768] [--reps 2] [--algo lu]
+    python scripts/tpu_tune.py --algo cholesky -N 32768
+    python scripts/tpu_tune.py --algo qr -N 16384 --configs highest:0:1024
 """
 
 from __future__ import annotations
 
 import argparse
+import functools
 import os
 import sys
 import time
@@ -25,13 +31,33 @@ import time
 sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
 
 
+def _spd_n(n):
+    """Compiled once per size (bench._make_n pattern): redefining a jit
+    function inside the config loop would recompile the (N, N) generator
+    for every config."""
+    import jax
+    import jax.numpy as jnp
+
+    @functools.partial(jax.jit, static_argnums=0)
+    def gen(n):
+        a = jax.random.normal(jax.random.PRNGKey(0), (n, n), jnp.float32)
+        s = (a + a.T) / 2 + n * jnp.eye(n, dtype=jnp.float32)
+        return s[None, None]
+
+    if not hasattr(_spd_n, "_fn"):
+        _spd_n._fn = gen
+    return _spd_n._fn(n)
+
+
 def main() -> None:
     ap = argparse.ArgumentParser()
     ap.add_argument("-N", type=int, default=32768)
     ap.add_argument("--reps", type=int, default=2)
+    ap.add_argument("--algo", default="lu", choices=["lu", "cholesky", "qr"])
     ap.add_argument("--configs", default=None,
                     help="comma list precision:chunk:v, e.g. "
-                    "highest:8192:1024,high:8192:1024")
+                    "highest:8192:1024,high:8192:1024 (chunk ignored for "
+                    "cholesky/qr; pass 0)")
     args = ap.parse_args()
 
     import jax
@@ -40,8 +66,7 @@ def main() -> None:
     from jax.sharding import NamedSharding, PartitionSpec as P
 
     import bench as bench_mod
-    from conflux_tpu.geometry import Grid3, LUGeometry
-    from conflux_tpu.lu.distributed import lu_factor_distributed
+    from conflux_tpu.geometry import CholeskyGeometry, Grid3, LUGeometry
     from conflux_tpu.parallel.mesh import AXIS_X, AXIS_Y, make_mesh
 
     bench_mod._probe_device()
@@ -51,13 +76,16 @@ def main() -> None:
     mesh = make_mesh(grid, devices=jax.devices()[:1])
     sharding = NamedSharding(mesh, P(AXIS_X, AXIS_Y, None, None))
     prec = {"highest": lax.Precision.HIGHEST, "high": lax.Precision.HIGH}
+    # qr times geqrf + explicit thin Q formation (orgqr), ~8/3 N^3 total,
+    # so its rate line is comparable to the LU/Cholesky MXU utilization
+    flop_coeff = {"lu": 2 / 3, "cholesky": 1 / 3, "qr": 8 / 3}[args.algo]
 
     if args.configs:
         configs = []
         for c in args.configs.split(","):
             p, chunk, v = c.split(":")
             configs.append((p, int(chunk), int(v)))
-    else:
+    elif args.algo == "lu":
         configs = [
             ("highest", 8192, 1024),
             ("high", 8192, 1024),
@@ -67,43 +95,98 @@ def main() -> None:
             ("high", 8192, 2048),
             ("highest", 8192, 512),
         ]
+    else:
+        configs = [
+            ("highest", 0, 1024),
+            ("high", 0, 1024),
+            ("highest", 0, 512),
+            ("highest", 0, 2048),
+        ]
 
     for pname, chunk, v in configs:
-        geom = LUGeometry.create(N, N, v, grid)
-
-        def make():
-            # bench's generator, not a copy: the residual oracle
-            # regenerates A through the same function, so the two must
-            # produce the bit-identical matrix
-            return bench_mod._make_n(geom.M)
-
         try:
-            def factor(s):
-                return lu_factor_distributed(
-                    s, geom, mesh, precision=prec[pname],
-                    panel_chunk=chunk, donate=True)
+            if args.algo == "lu":
+                from conflux_tpu.lu.distributed import lu_factor_distributed
 
-            out, perm = factor(jax.device_put(make(), sharding))  # warm-up
-            float(out[0, 0, 0, 0])
+                geom = LUGeometry.create(N, N, v, grid)
+
+                def factor(s, geom=geom, chunk=chunk, pname=pname):
+                    return lu_factor_distributed(
+                        s, geom, mesh, precision=prec[pname],
+                        panel_chunk=chunk, donate=True)
+
+                def make(geom=geom):
+                    # bench's generator, not a copy: the residual oracle
+                    # regenerates A through the same function, so the two
+                    # must produce the bit-identical matrix
+                    return jax.device_put(bench_mod._make_n(geom.M), sharding)
+
+                def residual(out, aux):
+                    return bench_mod._residual_on_device(out[0, 0], aux)
+
+            elif args.algo == "cholesky":
+                from conflux_tpu.cholesky.distributed import (
+                    cholesky_factor_distributed,
+                )
+                from conflux_tpu.validation import (
+                    cholesky_residual_distributed,
+                )
+
+                geom = CholeskyGeometry.create(N, v, grid)
+
+                def factor(s, geom=geom, pname=pname):
+                    # donate like the LU/QR branches: without it the loop
+                    # pays a full-buffer copy per superstep and the rates
+                    # are not comparable across cores
+                    return cholesky_factor_distributed(
+                        s, geom, mesh, precision=prec[pname],
+                        donate=True), None
+
+                def make(geom=geom):
+                    return jax.device_put(_spd_n(geom.N), sharding)
+
+                def residual(out, aux, geom=geom):
+                    return float(cholesky_residual_distributed(
+                        make(), out, geom, mesh))
+
+            else:  # qr
+                from conflux_tpu.qr.distributed import qr_factor_distributed
+
+                geom = LUGeometry.create(N, N, v, grid)
+
+                def factor(s, geom=geom, pname=pname):
+                    return qr_factor_distributed(
+                        s, geom, mesh, precision=prec[pname], donate=True)
+
+                def make(geom=geom):
+                    return jax.device_put(bench_mod._make_n(geom.M), sharding)
+
+                def residual(out, aux):
+                    return float("nan")  # no on-device QR oracle yet
+
+            out, aux = factor(make())  # warm-up
+            jnp.asarray(out).block_until_ready()
+            float(jnp.asarray(out)[(0,) * jnp.asarray(out).ndim])
             times = []
             for _ in range(args.reps):
-                s = jax.device_put(make(), sharding)
-                float(s[0, 0, 0, 0])
+                s = make()
+                float(jnp.asarray(s)[(0,) * jnp.asarray(s).ndim])
                 t0 = time.time()
-                out, perm = factor(s)
-                float(out[0, 0, 0, 0])
+                out, aux = factor(s)
+                float(jnp.asarray(out)[(0,) * jnp.asarray(out).ndim])
                 times.append(time.time() - t0)
-            gflops = (2 / 3) * geom.M**3 / (sum(times) / len(times)) / 1e9
-            print(f"precision={pname} chunk={chunk} v={v}: "
+            dim = geom.N if args.algo == "cholesky" else geom.M
+            gflops = flop_coeff * dim**3 / (sum(times) / len(times)) / 1e9
+            print(f"algo={args.algo} precision={pname} chunk={chunk} v={v}: "
                   f"{gflops:.1f} GFLOP/s", flush=True)
             try:  # residual separately: never discard a good timing
-                res = bench_mod._residual_on_device(out[0, 0], perm)
+                res = residual(out, aux)
                 print(f"    residual={res:.3e}", flush=True)
             except Exception as e:
                 print(f"    residual FAILED: {e}", flush=True)
         except Exception as e:  # OOM / VMEM overflow at some configs
-            print(f"precision={pname} chunk={chunk} v={v}: FAILED {e}",
-                  flush=True)
+            print(f"algo={args.algo} precision={pname} chunk={chunk} v={v}: "
+                  f"FAILED {e}", flush=True)
 
 
 if __name__ == "__main__":

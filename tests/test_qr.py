@@ -206,3 +206,29 @@ def test_qr_factor_distributed_ragged_sizes(shape):
     _check(A, Q, R)
     Qr, Rr = _pos_diag_ref(A)
     np.testing.assert_allclose(R, Rr, atol=1e-9 * np.abs(Rr).max())
+
+
+def test_qr_factor_distributed_bf16():
+    """bf16 storage with f32 panel/TSQR math: the trailing GEMMs ride the
+    storage dtype (the LU loop's bf16 fast-path contract)."""
+    from conflux_tpu.geometry import LUGeometry
+    from conflux_tpu.qr.distributed import qr_factor_distributed, r_geometry
+    from conflux_tpu.parallel.mesh import make_mesh
+
+    N, v = 64, 8
+    grid = Grid3(2, 2, 1)
+    rng = np.random.default_rng(89)
+    A = rng.standard_normal((N, N)).astype(np.float32)
+    geom = LUGeometry.create(N, N, v, grid)
+    mesh = make_mesh(grid, devices=jax.devices()[: grid.P])
+    shards = jnp.asarray(geom.scatter(A)).astype(jnp.bfloat16)
+    Qs, Rs = qr_factor_distributed(shards, geom, mesh)
+    assert Qs.dtype == jnp.bfloat16 and Rs.dtype == jnp.bfloat16
+    Q = geom.gather(np.asarray(Qs, np.float64))
+    R = np.triu(r_geometry(geom).gather(np.asarray(Rs, np.float64))[:N])
+    eps = 2.0 ** -7  # bf16
+    rec = np.linalg.norm(Q @ R - A) / np.linalg.norm(A)
+    assert rec < 0.5 * eps * np.sqrt(N), rec
+    assert rec > 1e-6  # genuinely ran in bf16
+    orth = np.linalg.norm(Q.T @ Q - np.eye(N)) / np.sqrt(N)
+    assert orth < 0.5 * eps * np.sqrt(N), orth
