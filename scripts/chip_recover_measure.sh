@@ -25,6 +25,11 @@ done
   echo "=== tune N=16384 highest/high $(date -u +%FT%TZ) ==="
   timeout -k 10 2400 python scripts/tpu_tune.py -N 16384 --reps 2 \
     --configs highest:8192:1024,high:8192:1024 2>&1 | grep -v WARNING
+  echo "=== tune cholesky/qr N=16384 $(date -u +%FT%TZ) ==="
+  timeout -k 10 2400 python scripts/tpu_tune.py --algo cholesky -N 16384 \
+    --reps 2 --configs highest:0:1024,high:0:1024 2>&1 | grep -v WARNING
+  timeout -k 10 2400 python scripts/tpu_tune.py --algo qr -N 16384 \
+    --reps 2 --configs highest:0:1024 2>&1 | grep -v WARNING
   echo "=== bench.py $(date -u +%FT%TZ) ==="
   timeout -k 10 3000 python bench.py 2>&1 | grep -v WARNING
   echo "=== done $(date -u +%FT%TZ) ==="
