@@ -159,6 +159,22 @@ def ragged_segments(n_tiles: int, v: int, max_seg: int) -> list[tuple[int, int]]
             for g in range(n) if g * per < n_tiles]
 
 
+def check_shards(shards, geom, what: str = "shards") -> None:
+    """Reject mis-shaped shard arrays with a geometry-aware message (a
+    wrong shape otherwise surfaces as a cryptic shard_map mismatch deep
+    inside the jitted program)."""
+    shape = tuple(shards.shape) if hasattr(shards, "shape") else None
+    Ml = getattr(geom, "Ml")
+    Nl = getattr(geom, "Nl")
+    want = (geom.grid.Px, geom.grid.Py, Ml, Nl)
+    if shape != want:
+        raise ValueError(
+            f"{what} shape {shape} does not match the geometry's "
+            f"block-cyclic layout {want} (grid {geom.grid}, "
+            f"local {Ml}x{Nl}); build shards with geom.scatter or "
+            f"distribute_shards")
+
+
 # --------------------------------------------------------------------------- #
 # LU geometry
 # --------------------------------------------------------------------------- #

@@ -571,6 +571,10 @@ def lu_factor_distributed(shards, geom: LUGeometry, mesh,
     compute on a mesh (P8; bitwise-identical results, ~one extra
     (Ml, v)-slab GEMM per superstep of redundant work).
     """
+    from conflux_tpu.geometry import check_shards
+
+    shards = jnp.asarray(shards)
+    check_shards(shards, geom)
     fn = build_program(geom, mesh, precision=precision, backend=backend,
                        panel_chunk=panel_chunk, donate=donate,
                        lookahead=lookahead)

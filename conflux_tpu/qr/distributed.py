@@ -442,9 +442,13 @@ def qr_factor_distributed(shards, geom, mesh, precision=None,
     triangular (N, N) block-cyclic over its own geometry (gather it with
     `r_geometry(geom)`). See `_build_full` for the algorithm.
     """
+    from conflux_tpu.geometry import check_shards
+
+    shards = jnp.asarray(shards)
+    check_shards(shards, geom)
     fn = build_program(geom, mesh, precision=precision, backend=backend,
                        chunk=chunk, donate=donate)
-    return fn(jnp.asarray(shards))
+    return fn(shards)
 
 
 def qr_factor_steps(shards, geom, mesh, k0: int, k1: int, R=None,

@@ -157,3 +157,23 @@ def test_cholesky_geometry():
     assert g.N % (128 * 2) == 0
     assert g.Kappa == g.N // 128
     assert g.nlayr == 64
+
+
+def test_check_shards_rejects_mismatch():
+    """Wrong shard shapes get a geometry-aware error instead of a
+    cryptic shard_map mismatch deep inside the jitted program."""
+    import jax
+    import jax.numpy as jnp
+    import pytest
+
+    from conflux_tpu.geometry import Grid3, LUGeometry
+    from conflux_tpu.lu.distributed import lu_factor_distributed
+    from conflux_tpu.parallel.mesh import make_mesh
+
+    grid = Grid3(2, 2, 1)
+    geom = LUGeometry.create(32, 32, 8, grid)
+    mesh = make_mesh(grid, devices=jax.devices()[: grid.P])
+    with pytest.raises(ValueError, match="block-cyclic layout"):
+        lu_factor_distributed(jnp.zeros((2, 2, 8, 16)), geom, mesh)
+    with pytest.raises(ValueError, match="block-cyclic layout"):
+        lu_factor_distributed(jnp.zeros((1, 1, 32, 32)), geom, mesh)
