@@ -71,7 +71,7 @@ def main(argv=None) -> int:
     rng = np.random.default_rng(42)
 
     if args.full:
-        from conflux_tpu.qr.distributed import qr_factor_distributed, r_geometry
+        from conflux_tpu.qr.distributed import qr_factor_distributed
 
         v = args.block or 256
         grid = (Grid3.parse(args.p_grid) if args.p_grid
@@ -149,15 +149,19 @@ def main(argv=None) -> int:
     if args.validate:
         with profiler.region("validation"):
             if args.full:
-                Q = geom.gather(np.asarray(Qout))
-                R = np.triu(r_geometry(geom).gather(np.asarray(Rout))[: geom.N])
+                # gather-free on-mesh oracle (pdgemm validation role):
+                # nothing (M, N)-sized leaves the mesh
+                from conflux_tpu.validation import qr_residual_distributed
+
+                rec, orth = qr_residual_distributed(dev, Qout, Rout,
+                                                    geom, mesh)
             else:
                 Q = np.asarray(Qout).reshape(-1, args.cols)
                 R = np.asarray(Rout)
-            n = Q.shape[1]
-            orth = np.linalg.norm(Q.T @ Q - np.eye(n)) / np.sqrt(n)
-            rec = (np.linalg.norm(Q @ R - A.reshape(Q.shape[0], -1))
-                   / max(np.linalg.norm(A), 1e-30))
+                n = Q.shape[1]
+                orth = np.linalg.norm(Q.T @ Q - np.eye(n)) / np.sqrt(n)
+                rec = (np.linalg.norm(Q @ R - A.reshape(Q.shape[0], -1))
+                       / max(np.linalg.norm(A), 1e-30))
         print(f"_residual_ orth={orth:.3e} reconstruction={rec:.3e}")
 
     if args.profile:

@@ -17,6 +17,12 @@ import numpy as np
 from jax import lax
 
 
+def _norm_ratio(rss, ass) -> float:
+    """sqrt(sum-of-squares ratio) with the zero-norm guard — shared
+    epilogue of the three on-mesh residual oracles."""
+    return float(np.sqrt(float(rss)) / max(np.sqrt(float(ass)), 1e-30))
+
+
 def lu_residual(A, LU, perm) -> float:
     """Normalized ||A[perm] - L U||_F / ||A||_F for packed LU factors.
 
@@ -57,7 +63,7 @@ def lu_residual_distributed(A_shards, LU_shards, perm, geom, mesh) -> float:
 
     fn = _build_lu_residual(geom, mesh_cache_key(mesh))
     rss, ass = fn(A_shards, LU_shards, jnp.asarray(perm, jnp.int32))
-    return float(np.sqrt(float(rss)) / max(np.sqrt(float(ass)), 1e-30))
+    return _norm_ratio(rss, ass)
 
 
 @functools.lru_cache(maxsize=16)
@@ -168,7 +174,7 @@ def cholesky_residual_distributed(A_shards, L_shards, geom, mesh) -> float:
 
     fn = _build_cholesky_residual(geom, mesh_cache_key(mesh))
     rss, ass = fn(A_shards, L_shards)
-    return float(np.sqrt(float(rss)) / max(np.sqrt(float(ass)), 1e-30))
+    return _norm_ratio(rss, ass)
 
 
 @functools.lru_cache(maxsize=16)
@@ -264,3 +270,96 @@ def make_spd_matrix(N: int, seed: int = 7, dtype=np.float64) -> np.ndarray:
     A = (B + B.T) / 2
     A[np.arange(N), np.arange(N)] += N
     return A
+
+
+def qr_residual_distributed(A_shards, Q_shards, R_shards, geom, mesh):
+    """Gather-free (||A - Q R||_F/||A||_F, ||Q^T Q - I||_F/sqrt(N)) on the
+    mesh — the QR counterpart of :func:`lu_residual_distributed` (pdgemm
+    validation role). One SUMMA loop over column tiles: the owner's Q
+    column slab is y-broadcast and R's row slab x-broadcast (masked
+    psums), every device accumulates its share of Q R; the same Q column
+    slab also yields an orthogonality strip Q^T Qcol - I. Both error
+    checks without any (N, N) array. Complex inputs use the Hermitian
+    adjoint throughout."""
+    from conflux_tpu.parallel.mesh import mesh_cache_key
+
+    fn = _build_qr_residual(geom, mesh_cache_key(mesh))
+    rss, ass, oss = fn(jnp.asarray(A_shards), jnp.asarray(Q_shards),
+                       jnp.asarray(R_shards))
+    return _norm_ratio(rss, ass), float(np.sqrt(float(oss)) / np.sqrt(geom.N))
+
+
+@functools.lru_cache(maxsize=16)
+def _build_qr_residual(geom, mesh_key):
+    from jax.sharding import PartitionSpec as P
+
+    from conflux_tpu.parallel.mesh import (
+        AXIS_X, AXIS_Y, AXIS_Z, lookup_mesh,
+    )
+
+    mesh = lookup_mesh(mesh_key)
+    v, Px, Py = geom.v, geom.grid.Px, geom.grid.Py
+    Ml, Nl, Nt = geom.Ml, geom.Nl, geom.Nt
+
+    def device_fn(Ablk, Qblk, Rblk):
+        x = lax.axis_index(AXIS_X)
+        y = lax.axis_index(AXIS_Y)
+        from conflux_tpu.ops import blas as _blas
+
+        dtype = _blas.compute_dtype(Ablk.dtype)
+        Aloc = Ablk[0, 0].astype(dtype)
+        Qloc = Qblk[0, 0].astype(dtype)
+        Rloc = Rblk[0, 0].astype(dtype)
+        lc = jnp.arange(Nl, dtype=jnp.int32)
+        gcol = ((lc // v) * Py + y) * v + (lc % v)
+        i0 = jnp.zeros((), jnp.int32)
+
+        def body(t, carry):
+            prod, oss = carry
+            ly = ((t // Py) * v).astype(jnp.int32)
+            lx = ((t // Px) * v).astype(jnp.int32)
+            Qcol = lax.psum(
+                jnp.where(y == t % Py,
+                          lax.dynamic_slice(Qloc, (i0, ly), (Ml, v)),
+                          jnp.zeros((), dtype)), AXIS_Y)  # (Ml, v)
+            Rrow = lax.psum(
+                jnp.where(x == t % Px,
+                          lax.dynamic_slice(Rloc, (lx, i0), (v, Nl)),
+                          jnp.zeros((), dtype)), AXIS_X)  # (v, Nl)
+            prod = prod + jnp.matmul(Qcol, Rrow,
+                                     precision=lax.Precision.HIGHEST)
+            # orthogonality strip: G[my cols, tile-t cols] via psum over
+            # rows (x); replicated over x afterwards, so only x == 0
+            # devices contribute to the sum of squares
+            strip = lax.psum(
+                jnp.matmul(Qloc.conj().T, Qcol,
+                           precision=lax.Precision.HIGHEST), AXIS_X)
+            eye = (gcol[:, None]
+                   == (t * v + jnp.arange(v, dtype=jnp.int32))[None, :])
+            E = strip - eye.astype(dtype)
+            oss = oss + jnp.where(
+                x == 0, jnp.sum(jnp.abs(E) ** 2).real, 0.0)
+            return prod, oss
+
+        rdtype = jnp.zeros((), dtype).real.dtype
+        zero = lax.pcast(jnp.zeros((Ml, Nl), dtype),
+                         (AXIS_X, AXIS_Y, AXIS_Z), to="varying")
+        zoss = lax.pcast(jnp.zeros((), rdtype),
+                         (AXIS_X, AXIS_Y, AXIS_Z), to="varying")
+        prod, oss = lax.fori_loop(0, Nt, body, (zero, zoss))
+        E = Aloc - prod
+        rss = lax.psum(jnp.sum(jnp.abs(E) ** 2).real, (AXIS_X, AXIS_Y))
+        ass = lax.psum(jnp.sum(jnp.abs(Aloc) ** 2).real, (AXIS_X, AXIS_Y))
+        oss = lax.psum(oss, (AXIS_X, AXIS_Y))
+        return (lax.pmax(rss, AXIS_Z), lax.pmax(ass, AXIS_Z),
+                lax.pmax(oss, AXIS_Z))
+
+    fn = jax.shard_map(
+        device_fn,
+        mesh=mesh,
+        in_specs=(P(AXIS_X, AXIS_Y, None, None),
+                  P(AXIS_X, AXIS_Y, None, None),
+                  P(AXIS_X, AXIS_Y, None, None)),
+        out_specs=(P(), P(), P()),
+    )
+    return jax.jit(fn)

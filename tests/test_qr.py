@@ -232,3 +232,37 @@ def test_qr_factor_distributed_bf16():
     assert rec > 1e-6  # genuinely ran in bf16
     orth = np.linalg.norm(Q.T @ Q - np.eye(N)) / np.sqrt(N)
     assert orth < 0.5 * eps * np.sqrt(N), orth
+
+
+def test_qr_residual_distributed_matches_host():
+    """The on-mesh QR oracle must agree with host oracles and detect
+    corruption."""
+    from conflux_tpu.geometry import LUGeometry
+    from conflux_tpu.qr.distributed import qr_factor_distributed, r_geometry
+    from conflux_tpu.validation import qr_residual_distributed
+
+    N, v = 64, 8
+    for gridspec in [(2, 2, 1), (2, 2, 2), (4, 2, 1)]:
+        grid = Grid3(*gridspec)
+        geom = LUGeometry.create(N, N, v, grid)
+        mesh = make_mesh(grid, devices=jax.devices()[: grid.P])
+        rng = np.random.default_rng(grid.P)
+        A = rng.standard_normal((N, N)).astype(np.float64)
+        A_shards = jnp.asarray(geom.scatter(A))
+        Qs, Rs = qr_factor_distributed(A_shards, geom, mesh)
+        res, orth = qr_residual_distributed(A_shards, Qs, Rs, geom, mesh)
+        # host oracles
+        Q = geom.gather(np.asarray(Qs))
+        R = np.triu(r_geometry(geom).gather(np.asarray(Rs))[:N])
+        res_h = np.linalg.norm(Q @ R - A) / np.linalg.norm(A)
+        orth_h = np.linalg.norm(Q.T @ Q - np.eye(N)) / np.sqrt(N)
+        assert abs(res - res_h) < 1e-12 + 0.05 * res_h, (gridspec, res, res_h)
+        assert abs(orth - orth_h) < 1e-12 + 0.05 * orth_h, (gridspec, orth, orth_h)
+        assert res < 1e-13 and orth < 1e-13
+
+    # corruption must blow both up
+    bad = np.array(Qs)
+    bad[0, 0, :4, :4] += 5.0
+    res, orth = qr_residual_distributed(A_shards, jnp.asarray(bad), Rs,
+                                        geom, mesh)
+    assert res > 1e-2 and orth > 1e-2
