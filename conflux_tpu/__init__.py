@@ -52,6 +52,7 @@ def __getattr__(name):
         "cond_estimate_1": ("conflux_tpu.solvers", "cond_estimate_1"),
         "inv_from_lu": ("conflux_tpu.solvers", "inv_from_lu"),
         "lstsq_distributed": ("conflux_tpu.solvers", "lstsq_distributed"),
+        "qr_lstsq_distributed": ("conflux_tpu.solvers", "qr_lstsq_distributed"),
         "make_mesh": ("conflux_tpu.parallel.mesh", "make_mesh"),
         "initialize_multihost": ("conflux_tpu.parallel.mesh", "initialize_multihost"),
         "qr_factor_blocked": ("conflux_tpu.qr.single", "qr_factor_blocked"),
@@ -95,6 +96,7 @@ __all__ = [
     "cond_estimate_1",
     "inv_from_lu",
     "lstsq_distributed",
+    "qr_lstsq_distributed",
     "lu_factor_distributed",
     "lu_factor_steps",
     "cholesky_factor_distributed",

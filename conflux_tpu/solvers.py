@@ -634,3 +634,99 @@ def inv_from_lu(LU: jax.Array, perm: jax.Array) -> jax.Array:
     if LU.shape[0] != LU.shape[1]:
         raise ValueError(f"inverse needs square factors, got {LU.shape}")
     return lu_solve(LU, perm, jnp.eye(N, dtype=LU.dtype))
+
+
+def qr_lstsq_distributed(Q_shards, R_shards, geom, mesh, b) -> jax.Array:
+    """Least squares min_x ||A x - b|| on the mesh from the BLOCK-CYCLIC
+    QR factors (`qr.qr_factor_distributed` outputs) — the general-matrix
+    counterpart of `lstsq_distributed`'s tall x-sharded form, completing
+    the distributed-solver matrix (LU square / Cholesky SPD / QR
+    overdetermined).
+
+    c = Q^H b is one (Nl, k) partial per device + psums; then R x = c is
+    block back substitution over R's own block-cyclic geometry (the
+    `lu_solve_distributed` machinery on the upper factor). b is (M,) or
+    (M, k) at the PADDED geometry size; x comes back (N,) or (N, k),
+    replicated.
+    """
+    from conflux_tpu.geometry import check_shards
+    from conflux_tpu.qr.distributed import r_geometry
+
+    M = geom.M
+    rows = np.shape(b)[0] if np.ndim(b) else 0
+    if rows != M:
+        raise ValueError(
+            f"rhs has {rows} rows, the (padded) factorization needs {M}")
+    Q_shards = jnp.asarray(Q_shards)
+    R_shards = jnp.asarray(R_shards)
+    check_shards(Q_shards, geom, "Q_shards")
+    check_shards(R_shards, r_geometry(geom), "R_shards")
+    b2, squeeze = _as_2d(jnp.asarray(b, blas.compute_dtype(Q_shards.dtype)))
+    fn = _build_qr_lstsq(geom, mesh_cache_key(mesh))
+    x = fn(Q_shards, R_shards, b2)
+    return x[:, 0] if squeeze else x
+
+
+@functools.lru_cache(maxsize=16)
+def _build_qr_lstsq(geom, mesh_key):
+    from jax.sharding import PartitionSpec as P
+
+    from conflux_tpu.parallel.mesh import (
+        AXIS_X, AXIS_Y, AXIS_Z, lookup_mesh,
+    )
+
+    mesh = lookup_mesh(mesh_key)
+    v, Px, Py = geom.v, geom.grid.Px, geom.grid.Py
+    Ml, Nl = geom.Ml, geom.Nl
+    n = geom.Nt  # R row tiles to substitute
+
+    def device_fn(Qblk, Rblk, b):
+        x_ = lax.axis_index(AXIS_X)
+        y_ = lax.axis_index(AXIS_Y)
+        dtype = blas.compute_dtype(Qblk.dtype)
+        Qloc = Qblk[0, 0].astype(dtype)
+        Rloc = Rblk[0, 0].astype(dtype)
+        b = b.astype(dtype)
+
+        lr = jnp.arange(Ml, dtype=jnp.int32)
+        grow = ((lr // v) * Px + x_) * v + (lr % v)
+        lc = jnp.arange(Nl, dtype=jnp.int32)
+        gcol = ((lc // v) * Py + y_) * v + (lc % v)
+        nrhs = b.shape[1]
+        i0 = jnp.zeros((), jnp.int32)
+
+        # ---- c = Q^H b: local rows contribute, psum over 'x' ---------- #
+        part = jnp.matmul(Qloc.conj().T, b[grow],
+                          precision=lax.Precision.HIGHEST)  # (Nl, k)
+        part = lax.psum(part, AXIS_X)
+        # assemble replicated (N, k): each y owns disjoint global cols
+        cv = lax.psum(
+            jnp.zeros((geom.N, nrhs), dtype).at[gcol].set(part), AXIS_Y)
+
+        # ---- back substitution R x = c over R's geometry -------------- #
+        def bwd(i, xv):
+            k = n - 1 - i
+            rows, diag = _diag_tile_rows(Rloc, k, x_, gcol, v, Px, Nl,
+                                         dtype)
+            ahead = gcol >= (k + 1) * v
+            s = jnp.matmul(rows, jnp.where(ahead[:, None], xv[gcol], 0.0),
+                           precision=lax.Precision.HIGHEST)
+            s = lax.psum(s, AXIS_Y)
+            kv = jnp.asarray(k * v, jnp.int32)
+            ck = lax.dynamic_slice(cv, (kv, i0), (v, nrhs))
+            xk = blas.trsm_left_upper(jnp.triu(diag), ck - s)
+            return lax.dynamic_update_slice(xv, xk, (kv, i0))
+
+        xv = lax.fori_loop(0, n, bwd, jnp.zeros((geom.N, nrhs), dtype))
+        from conflux_tpu.parallel.mesh import replicate
+
+        return replicate(xv, (AXIS_X, AXIS_Y, AXIS_Z))
+
+    fn = jax.shard_map(
+        device_fn,
+        mesh=mesh,
+        in_specs=(P(AXIS_X, AXIS_Y, None, None),
+                  P(AXIS_X, AXIS_Y, None, None), P()),
+        out_specs=P(),
+    )
+    return jax.jit(fn)
