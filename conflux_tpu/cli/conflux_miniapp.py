@@ -47,6 +47,11 @@ def parse_args(argv=None):
         help="software-pipelined loop: overlap the next step's pivot "
         "election with the trailing update (multi-chip meshes; P8)",
     )
+    p.add_argument(
+        "--election", default="gather", choices=["gather", "butterfly"],
+        help="cross-x pivot election: one all_gather tournament, or the "
+        "reference's log2(Px) ppermute hypercube (power-of-two Px)",
+    )
     add_experiment_type_arg(p)
     add_common_args(p)
     return p.parse_args(argv)
@@ -102,7 +107,8 @@ def main(argv=None) -> int:
                     out, perm_dev = lu_factor_blocked(dev, v=geom.v)
                 else:
                     out, perm_dev = lu_factor_distributed(
-                        dev, geom, mesh, lookahead=args.lookahead)
+                        dev, geom, mesh, lookahead=args.lookahead,
+                        election=args.election)
                 sync(out)
         if rep > 0:
             times.append(t.ms)
@@ -131,7 +137,8 @@ def main(argv=None) -> int:
             from conflux_tpu.lu.distributed import build_program
 
             phase_profile(
-                build_program(geom, mesh, lookahead=args.lookahead), dev)
+                build_program(geom, mesh, lookahead=args.lookahead,
+                              election=args.election), dev)
         profiler.report()
     return 0
 

@@ -80,7 +80,7 @@ _DEFAULT_PANEL_CHUNK = 8192
 @functools.lru_cache(maxsize=32)
 def _build(geom: LUGeometry, mesh_key, precision, backend: str,
            panel_chunk: int, donate: bool = False, resumable: bool = False,
-           lookahead: bool = False):
+           lookahead: bool = False, election: str = "gather"):
     """resumable=True builds the checkpoint/restart form: factor supersteps
     [k0, k1) given as TRACED scalars — one compile serves every segment of
     a checkpointed run — with the row-origin state as an explicit
@@ -170,25 +170,59 @@ def _build(geom: LUGeometry, mesh_key, precision, backend: str,
                     cand, chunk=panel_chunk, chunk_live=chunk_live)
                 wpos = jnp.take(pos_m, top, mode="fill",
                                 fill_value=_GRI_SENTINEL)
-            else:
-                _, top = blas.tournament_winners(
-                    cand, chunk=panel_chunk, chunk_live=chunk_live)
-                nom = jnp.take(cand, top, axis=0, mode="fill",
-                               fill_value=0)
-                nid = jnp.take(pos_m, top, mode="fill",
-                               fill_value=_GRI_SENTINEL)
-                blks = lax.all_gather(nom, AXIS_X)  # (Px, v, v)
-                poss = lax.all_gather(nid, AXIS_X)  # (Px, v)
-                flat = blks.reshape(Px * v, v)
-                # the election tournament is batched (no liveness
-                # structure), so its chunk stays within the batched
-                # VMEM-safe bound
-                lu00, wid = blas.tournament_winners(
-                    flat, chunk=min(panel_chunk, blas._PANEL_CHUNK))
-                # winners' positions in pivot order — replicated on
-                # every device, no broadcast needed
-                wpos = jnp.take(poss.reshape(Px * v), wid, mode="fill",
-                                fill_value=_GRI_SENTINEL)
+                return lu00, wpos
+            _, top = blas.tournament_winners(
+                cand, chunk=panel_chunk, chunk_live=chunk_live)
+            nom = jnp.take(cand, top, axis=0, mode="fill",
+                           fill_value=0)
+            nid = jnp.take(pos_m, top, mode="fill",
+                           fill_value=_GRI_SENTINEL)
+            if election == "butterfly":
+                # the reference's hypercube exchange
+                # (`conflux_opt.hpp:220-336`, partner at
+                # `conflux_opt.cpp:59-72`): log2(Px) ppermute rounds,
+                # each reducing a (2v, v) stack — only v rows ever cross
+                # the interconnect per round, vs the all_gather's Px*v.
+                # The stack is ordered by the LOWER x-coordinate of the
+                # pair so both partners reduce the bit-identical stack:
+                # the butterfly then converges to the same winners on
+                # every device (an all-reduce), and exact-tie pivot
+                # choices cannot diverge across ranks. Power-of-two Px
+                # only (enforced in build_program): with a missing
+                # partner a plain butterfly leaves device subsets that
+                # never see all candidates — the reference patches this
+                # with extra sends; here the gather election covers it.
+                for r in range(Px.bit_length() - 1):
+                    bit = 1 << r
+                    perm_pairs = [(i, i ^ bit) for i in range(Px)]
+                    onom = lax.ppermute(nom, AXIS_X, perm_pairs)
+                    onid = lax.ppermute(nid, AXIS_X, perm_pairs)
+                    low_first = (x & bit) == 0
+                    a0 = jnp.where(low_first, nom, onom)
+                    a1 = jnp.where(low_first, onom, nom)
+                    i0_ = jnp.where(low_first, nid, onid)
+                    i1_ = jnp.where(low_first, onid, nid)
+                    stack = jnp.concatenate([a0, a1], axis=0)  # (2v, v)
+                    ids = jnp.concatenate([i0_, i1_])
+                    lu00, wid = blas.tournament_winners(
+                        stack, chunk=min(panel_chunk, blas._PANEL_CHUNK))
+                    nom = jnp.take(stack, wid, axis=0, mode="fill",
+                                   fill_value=0)
+                    nid = jnp.take(ids, wid, mode="fill",
+                                   fill_value=_GRI_SENTINEL)
+                return lu00, nid
+            blks = lax.all_gather(nom, AXIS_X)  # (Px, v, v)
+            poss = lax.all_gather(nid, AXIS_X)  # (Px, v)
+            flat = blks.reshape(Px * v, v)
+            # the election tournament is batched (no liveness
+            # structure), so its chunk stays within the batched
+            # VMEM-safe bound
+            lu00, wid = blas.tournament_winners(
+                flat, chunk=min(panel_chunk, blas._PANEL_CHUNK))
+            # winners' positions in pivot order — replicated on
+            # every device, no broadcast needed
+            wpos = jnp.take(poss.reshape(Px * v), wid, mode="fill",
+                            fill_value=_GRI_SENTINEL)
             return lu00, wpos
 
         def body_core(k, Aloc, orig, panel, lu00, wpos):
@@ -520,7 +554,7 @@ def _build(geom: LUGeometry, mesh_key, precision, backend: str,
 def build_program(geom: LUGeometry, mesh, precision=None,
                   backend: str | None = None, panel_chunk: int | None = None,
                   donate: bool = False, resumable: bool = False,
-                  lookahead: bool = False):
+                  lookahead: bool = False, election: str = "gather"):
     """The jitted distributed-LU program itself (cached per config).
 
     The single point resolving the trace-time defaults (precision/backend/
@@ -536,14 +570,23 @@ def build_program(geom: LUGeometry, mesh, precision=None,
         panel_chunk = _DEFAULT_PANEL_CHUNK
     if donate and next(iter(mesh.devices.flat)).platform == "cpu":
         donate = False  # CPU PJRT has no buffer donation (warns per call)
+    if election not in ("gather", "butterfly"):
+        raise ValueError(f"unknown election {election!r} (gather|butterfly)")
+    Px = geom.grid.Px
+    if election == "butterfly" and Px > 1 and (Px & (Px - 1)):
+        raise ValueError(
+            f"butterfly election needs a power-of-two Px, got {Px} "
+            "(a missing hypercube partner strands candidate subsets; "
+            "use election='gather' for this grid)")
     return _build(geom, mesh_cache_key(mesh), precision, backend,
-                  panel_chunk, donate, resumable, lookahead)
+                  panel_chunk, donate, resumable, lookahead, election)
 
 
 def lu_factor_distributed(shards, geom: LUGeometry, mesh,
                           precision=None, backend: str | None = None,
                           panel_chunk: int | None = None,
-                          donate: bool = False, lookahead: bool = False):
+                          donate: bool = False, lookahead: bool = False,
+                          election: str = "gather"):
     """Factor block-cyclic shards (Px, Py, Ml, Nl) in place on a mesh.
 
     Returns (shards_out, perm): shards_out holds the packed factors in
@@ -577,7 +620,7 @@ def lu_factor_distributed(shards, geom: LUGeometry, mesh,
     check_shards(shards, geom)
     fn = build_program(geom, mesh, precision=precision, backend=backend,
                        panel_chunk=panel_chunk, donate=donate,
-                       lookahead=lookahead)
+                       lookahead=lookahead, election=election)
     return fn(shards)
 
 

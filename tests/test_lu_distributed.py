@@ -107,26 +107,26 @@ def test_lu_distributed_election_height_bound():
     v, chunk = 8, 16
     geom = LUGeometry.create(256, 256, v, grid)
     mesh = make_mesh(grid, devices=jax.devices()[: grid.P])
-    fn = _build(geom, mesh_cache_key(mesh), lax.Precision.HIGHEST, "xla",
-                chunk)
-    jaxpr = jax.make_jaxpr(fn)(jnp.zeros((4, 2, geom.Ml, geom.Nl)))
 
-    heights = []
-
-    def walk(jx):
+    def walk(jx, heights):
         for eqn in jx.eqns:
             if eqn.primitive.name == "lu":
                 heights.append(eqn.invars[0].aval.shape[-2])
             for p in eqn.params.values():
                 for q in (p if isinstance(p, (list, tuple)) else [p]):
                     if hasattr(q, "eqns"):
-                        walk(q)
+                        walk(q, heights)
                     elif hasattr(q, "jaxpr"):
-                        walk(q.jaxpr)
+                        walk(q.jaxpr, heights)
 
-    walk(jaxpr.jaxpr)
-    assert heights, "expected lu primitives in the traced program"
-    assert max(heights) <= max(chunk, 2 * v), heights
+    for election in ("gather", "butterfly"):
+        fn = _build(geom, mesh_cache_key(mesh), lax.Precision.HIGHEST,
+                    "xla", chunk, election=election)
+        jaxpr = jax.make_jaxpr(fn)(jnp.zeros((4, 2, geom.Ml, geom.Nl)))
+        heights = []
+        walk(jaxpr.jaxpr, heights)
+        assert heights, "expected lu primitives in the traced program"
+        assert max(heights) <= max(chunk, 2 * v), (election, heights)
 
 
 def test_lu_distributed_chunked_matches_unchunked():
@@ -422,3 +422,49 @@ def test_lu_distributed_lookahead_bitwise_equal(gridspec):
     np.testing.assert_array_equal(np.asarray(perm_a), np.asarray(perm_b))
     np.testing.assert_allclose(np.asarray(out_a), np.asarray(out_b),
                                rtol=0, atol=0)
+
+
+def test_lu_distributed_butterfly_election():
+    """The ppermute hypercube election (reference `conflux_opt.hpp:220-336`
+    structure: log2(Px) rounds of (2v, v) reductions) must produce a
+    residual-correct factorization with a valid permutation — also under
+    lookahead (the miniapp exposes the combination); non-power-of-two Px
+    is rejected. CALU pivot sets are bracket-dependent, so butterfly and
+    gather may elect different, equally valid pivots."""
+    import jax
+    import jax.numpy as jnp
+
+    from conflux_tpu.geometry import LUGeometry
+    from conflux_tpu.lu.distributed import lu_factor_distributed
+    from conflux_tpu.parallel.mesh import make_mesh
+
+    N, v = 128, 8
+    A = make_test_matrix(N, N, seed=97)
+    for gridspec, la in [((2, 2, 1), False), ((4, 2, 1), False),
+                         ((2, 1, 2), False), ((4, 2, 1), True)]:
+        grid = Grid3(*gridspec)
+        geom = LUGeometry.create(N, N, v, grid)
+        mesh = make_mesh(grid, devices=jax.devices()[: grid.P])
+        shards = jnp.asarray(geom.scatter(A))
+        out, perm = lu_factor_distributed(shards, geom, mesh,
+                                          election="butterfly",
+                                          lookahead=la)
+        perm = np.asarray(perm)
+        assert sorted(perm.tolist()) == list(range(N)), (gridspec, la)
+        LUp = geom.gather(np.asarray(out))
+        res = lu_residual(A, LUp, perm)
+        assert res < residual_bound(N, np.float64), (gridspec, la, res)
+        res_g = None
+        if not la:
+            out_g, perm_g = lu_factor_distributed(shards, geom, mesh)
+            res_g = lu_residual(A, geom.gather(np.asarray(out_g)),
+                                np.asarray(perm_g))
+            assert res_g < residual_bound(N, np.float64), (gridspec, res_g)
+
+    grid = Grid3(3, 1, 1)
+    geom = LUGeometry.create(48, 48, 8, grid)
+    mesh = make_mesh(grid, devices=jax.devices()[:3])
+    with pytest.raises(ValueError, match="power-of-two"):
+        lu_factor_distributed(jnp.asarray(geom.scatter(
+            make_test_matrix(48, 48, seed=1))), geom, mesh,
+            election="butterfly")
