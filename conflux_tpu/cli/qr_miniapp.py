@@ -43,6 +43,9 @@ def parse_args(argv=None):
     p.add_argument("--p_grid", default=None, help="Px,Py,Pz (default: auto)")
     p.add_argument("--algo", default="tsqr", choices=["tsqr", "cholesky"],
                    help="tall-mode election (QR tree vs Gram/CholeskyQR2)")
+    p.add_argument("--tree", default="gather", choices=["gather", "butterfly"],
+                   help="tsqr cross-x reduction: one all_gather, or the "
+                   "log2(Px) ppermute hypercube (power-of-two Px)")
     p.add_argument("--full", action="store_true",
                    help="general block-cyclic QR on the (x, y, z) mesh")
     p.add_argument("-r", "--run", type=int, default=2, help="timed reps")
@@ -66,6 +69,10 @@ def main(argv=None) -> int:
 
     if args.cols > args.M:
         raise SystemExit(f"--cols {args.cols} > rows {args.M}: QR needs M >= n")
+    if args.tree != "gather" and (args.full or args.algo != "tsqr"):
+        raise SystemExit(
+            "--tree applies to the tall tsqr mode only (the Gram and "
+            "block-cyclic paths have no cross-x R tree)")
     n_devices = len(jax.devices())
     dtype = np_dtype(args.dtype)
     rng = np.random.default_rng(42)
@@ -130,7 +137,7 @@ def main(argv=None) -> int:
 
         def factor():
             if args.algo == "tsqr":
-                return tsqr_distributed(dev, mesh)
+                return tsqr_distributed(dev, mesh, tree=args.tree)
             return cholesky_qr2_distributed(dev, mesh)
 
     times = []

@@ -62,6 +62,7 @@ from conflux_tpu.parallel.mesh import (
     AXIS_X,
     AXIS_Y,
     AXIS_Z,
+    butterfly_allreduce,
     lookup_mesh,
     make_mesh,
     mesh_cache_key,
@@ -181,35 +182,28 @@ def _build(geom: LUGeometry, mesh_key, precision, backend: str,
                 # the reference's hypercube exchange
                 # (`conflux_opt.hpp:220-336`, partner at
                 # `conflux_opt.cpp:59-72`): log2(Px) ppermute rounds,
-                # each reducing a (2v, v) stack — only v rows ever cross
-                # the interconnect per round, vs the all_gather's Px*v.
-                # The stack is ordered by the LOWER x-coordinate of the
-                # pair so both partners reduce the bit-identical stack:
-                # the butterfly then converges to the same winners on
-                # every device (an all-reduce), and exact-tie pivot
-                # choices cannot diverge across ranks. Power-of-two Px
-                # only (enforced in build_program): with a missing
-                # partner a plain butterfly leaves device subsets that
-                # never see all candidates — the reference patches this
-                # with extra sends; here the gather election covers it.
-                for r in range(Px.bit_length() - 1):
-                    bit = 1 << r
-                    perm_pairs = [(i, i ^ bit) for i in range(Px)]
-                    onom = lax.ppermute(nom, AXIS_X, perm_pairs)
-                    onid = lax.ppermute(nid, AXIS_X, perm_pairs)
-                    low_first = (x & bit) == 0
-                    a0 = jnp.where(low_first, nom, onom)
-                    a1 = jnp.where(low_first, onom, nom)
-                    i0_ = jnp.where(low_first, nid, onid)
-                    i1_ = jnp.where(low_first, onid, nid)
-                    stack = jnp.concatenate([a0, a1], axis=0)  # (2v, v)
-                    ids = jnp.concatenate([i0_, i1_])
-                    lu00, wid = blas.tournament_winners(
+                # each reducing a pair-ordered (2v, v) stack — only v
+                # rows ever cross the interconnect per round, vs the
+                # all_gather's Px*v. The ordering/replication invariant
+                # lives in `butterfly_allreduce`; power-of-two Px is
+                # enforced in build_program (the reference patches odd
+                # grids with extra sends; here gather covers them).
+                # lu00 rides the tuple so the final round's packed
+                # factor comes out replicated with the winners.
+                def reduce_pair(top, bot):
+                    stack = jnp.concatenate([top[0], bot[0]], axis=0)
+                    ids = jnp.concatenate([top[1], bot[1]])
+                    lu00_, wid = blas.tournament_winners(
                         stack, chunk=min(panel_chunk, blas._PANEL_CHUNK))
-                    nom = jnp.take(stack, wid, axis=0, mode="fill",
-                                   fill_value=0)
-                    nid = jnp.take(ids, wid, mode="fill",
-                                   fill_value=_GRI_SENTINEL)
+                    return (jnp.take(stack, wid, axis=0, mode="fill",
+                                     fill_value=0),
+                            jnp.take(ids, wid, mode="fill",
+                                     fill_value=_GRI_SENTINEL),
+                            lu00_)
+
+                nom, nid, lu00 = butterfly_allreduce(
+                    (nom, nid, jnp.zeros((v, v), cdtype)), Px, AXIS_X,
+                    reduce_pair)
                 return lu00, nid
             blks = lax.all_gather(nom, AXIS_X)  # (Px, v, v)
             poss = lax.all_gather(nid, AXIS_X)  # (Px, v)
@@ -626,7 +620,8 @@ def lu_factor_distributed(shards, geom: LUGeometry, mesh,
 
 def lu_factor_steps(shards, geom: LUGeometry, mesh, k0: int, k1: int,
                     orig=None, precision=None, backend: str | None = None,
-                    panel_chunk: int | None = None, donate: bool = False):
+                    panel_chunk: int | None = None, donate: bool = False,
+                    election: str = "gather"):
     """Factor supersteps [k0, k1) only — the checkpoint/restart primitive.
 
     The reference has no notion of resuming a partial factorization
@@ -668,7 +663,7 @@ def lu_factor_steps(shards, geom: LUGeometry, mesh, k0: int, k1: int,
     # run reuses ONE compiled program
     fn = build_program(geom, mesh, precision=precision, backend=backend,
                        panel_chunk=panel_chunk, donate=donate,
-                       resumable=True)
+                       resumable=True, election=election)
     return fn(shards, orig, jnp.int32(k0), jnp.int32(k1))
 
 

@@ -139,6 +139,37 @@ def replicate(val, axes):
     return lax.pmax(val, axes)
 
 
+def butterfly_allreduce(vals: tuple, Px: int, axis: str, reduce_pair):
+    """log2(Px) hypercube all-reduce over a mesh axis (the reference's
+    tournament butterfly shape, `conflux_opt.hpp:220-336`): each round,
+    partners exchange `vals` via ppermute and `reduce_pair(top, bot)`
+    combines the two tuples into the next `vals`.
+
+    The correctness-critical invariant lives here ONCE: the pair is
+    ordered by the LOWER coordinate, so both partners reduce the
+    bit-identical inputs and the result converges replicated across the
+    axis without a broadcast (tie-stable for order-dependent reducers
+    like the CALU tournament). Power-of-two Px only — with a missing
+    partner a plain butterfly leaves device subsets that never see all
+    contributions; callers must validate.
+    """
+    import jax.numpy as jnp
+    from jax import lax
+
+    x = lax.axis_index(axis)
+    for r in range(Px.bit_length() - 1):
+        bit = 1 << r
+        perm = [(i, i ^ bit) for i in range(Px)]
+        others = tuple(lax.ppermute(v, axis, perm) for v in vals)
+        low_first = (x & bit) == 0
+        top = tuple(jnp.where(low_first, a, b)
+                    for a, b in zip(vals, others))
+        bot = tuple(jnp.where(low_first, b, a)
+                    for a, b in zip(vals, others))
+        vals = tuple(reduce_pair(top, bot))
+    return vals
+
+
 def make_mesh(grid: Grid3, devices=None) -> jax.sharding.Mesh:
     """Build the ('x', 'y', 'z') mesh for a Grid3.
 

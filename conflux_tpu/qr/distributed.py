@@ -43,6 +43,7 @@ from conflux_tpu.parallel.mesh import (
     AXIS_X,
     AXIS_Y,
     AXIS_Z,
+    butterfly_allreduce,
     lookup_mesh,
     make_mesh,
     mesh_cache_key,
@@ -51,17 +52,32 @@ from conflux_tpu.parallel.mesh import (
 from conflux_tpu.qr.single import _positive_diag, _tree_r
 
 
-def _two_pass_tsqr(A, Px: int, chunk: int, passes: int, prec):
-    """Replicated TSQR election: local chunked tree -> all_gather of the
-    (n, n) Rs over 'x' -> replicated tree reduction; Q by TRSM, refined
-    over `passes` sweeps; positive-diagonal normalized. Shared by the
-    tall-skinny entry points and the block-cyclic loop's panel step."""
+def _two_pass_tsqr(A, Px: int, chunk: int, passes: int, prec,
+                   tree: str = "gather"):
+    """Replicated TSQR election: local chunked tree, then a cross-x
+    reduction of the (n, n) R factors; Q by TRSM, refined over `passes`
+    sweeps; positive-diagonal normalized. Shared by the tall-skinny
+    entry points and the block-cyclic loop's panel step.
+
+    tree='gather' (default): one all_gather + replicated tree — a single
+    optimized collective. tree='butterfly': the canonical TSQR hypercube
+    — log2(Px) `ppermute` rounds each QR-reducing a pair-ordered
+    (2n, n) stack, only n rows per round; pair ordering by the lower
+    x-coordinate keeps every device's reduction bit-identical, so the
+    result is replicated without a broadcast. Power-of-two Px only
+    (checked by callers exposing the option)."""
     n = A.shape[1]
     R = None
     for _ in range(max(1, passes)):
-        r_loc = _tree_r(A, chunk)
-        allr = lax.all_gather(r_loc, AXIS_X).reshape(Px * n, n)
-        Ri = _tree_r(allr, chunk)
+        Ri = _tree_r(A, chunk)
+        if tree == "butterfly":
+            (Ri,) = butterfly_allreduce(
+                (Ri,), Px, AXIS_X,
+                lambda top, bot: (_tree_r(
+                    jnp.concatenate([top[0], bot[0]], axis=0), chunk),))
+        elif Px > 1:
+            allr = lax.all_gather(Ri, AXIS_X).reshape(Px * n, n)
+            Ri = _tree_r(allr, chunk)
         A = blas.trsm_right_upper(Ri, A)
         R = Ri if R is None else jnp.matmul(Ri, R, precision=prec)
     return _positive_diag(A, R)
@@ -69,7 +85,7 @@ def _two_pass_tsqr(A, Px: int, chunk: int, passes: int, prec):
 
 @functools.lru_cache(maxsize=32)
 def _build(mesh_key, algo: str, shape, dtype_name: str, chunk: int,
-           passes: int):
+           passes: int, tree: str = "gather"):
     mesh = lookup_mesh(mesh_key)
     Px = mesh.shape[AXIS_X]
     Ml, n = shape
@@ -79,7 +95,7 @@ def _build(mesh_key, algo: str, shape, dtype_name: str, chunk: int,
     def device_fn(blk):
         A = blk[0].astype(blas.compute_dtype(dtype))
         if algo == "tsqr":
-            Q, R = _two_pass_tsqr(A, Px, chunk, passes, prec)
+            Q, R = _two_pass_tsqr(A, Px, chunk, passes, prec, tree=tree)
         else:  # cholesky: Gram psum + potrf election per pass
             R = None
             for _ in range(max(1, passes)):
@@ -102,7 +118,8 @@ def _build(mesh_key, algo: str, shape, dtype_name: str, chunk: int,
     return jax.jit(fn)
 
 
-def _factor(shards, mesh, algo: str, chunk: int | None, passes: int):
+def _factor(shards, mesh, algo: str, chunk: int | None, passes: int,
+            tree: str = "gather"):
     shards = jnp.asarray(shards)
     if shards.ndim != 3:
         raise ValueError(
@@ -114,17 +131,24 @@ def _factor(shards, mesh, algo: str, chunk: int | None, passes: int):
     if Px * Ml < n:
         raise ValueError(f"need M = {Px * Ml} >= n = {n}")
     chunk = blas._PANEL_CHUNK if chunk is None else chunk
+    if tree not in ("gather", "butterfly"):
+        raise ValueError(f"unknown tree {tree!r} (gather|butterfly)")
+    if tree == "butterfly" and Px > 1 and (Px & (Px - 1)):
+        raise ValueError(
+            f"butterfly tree needs a power-of-two Px, got {Px}")
     fn = _build(mesh_cache_key(mesh), algo, (Ml, n), shards.dtype.name,
-                chunk, passes)
+                chunk, passes, tree)
     return fn(shards)
 
 
 def tsqr_distributed(shards, mesh, chunk: int | None = None,
-                     passes: int = 2):
+                     passes: int = 2, tree: str = "gather"):
     """(Q_shards, R) of an x-sharded (Px, Ml, n) tall matrix via the QR
     reduction tree. Every QR call is height-bounded by
-    max(chunk, 2n, Px*n-tree levels); robust at any conditioning."""
-    return _factor(shards, mesh, "tsqr", chunk, passes)
+    max(chunk, 2n, Px*n-tree levels); robust at any conditioning.
+    tree='butterfly' selects the log-depth ppermute hypercube reduction
+    (power-of-two Px; see `_two_pass_tsqr`)."""
+    return _factor(shards, mesh, "tsqr", chunk, passes, tree)
 
 
 def cholesky_qr2_distributed(shards, mesh, passes: int = 2):

@@ -266,3 +266,25 @@ def test_qr_residual_distributed_matches_host():
     res, orth = qr_residual_distributed(A_shards, jnp.asarray(bad), Rs,
                                         geom, mesh)
     assert res > 1e-2 and orth > 1e-2
+
+
+def test_tsqr_butterfly_tree():
+    """The ppermute hypercube TSQR reduction must agree with the gather
+    tree bitwise (QR tree reductions are bracket-dependent in general,
+    but the butterfly's pair order over 4 ranks reduces (0,1),(2,3) then
+    pairs of pairs — same shape as the gather path's chunked reduction
+    of the 4-stack, and the positive-diag normalization makes R unique
+    regardless); non-power-of-two Px is rejected."""
+    rng = np.random.default_rng(101)
+    Px, Ml, n = 4, 48, 16
+    A = rng.standard_normal((Px * Ml, n))
+    mesh = make_mesh(Grid3(Px, 1, 1), devices=jax.devices()[:Px])
+    Qb, Rb = tsqr_distributed(A.reshape(Px, Ml, n), mesh, tree="butterfly")
+    _check(A, np.asarray(Qb).reshape(-1, n), np.asarray(Rb))
+    _, Rg = tsqr_distributed(A.reshape(Px, Ml, n), mesh)
+    np.testing.assert_allclose(np.asarray(Rb), np.asarray(Rg),
+                               atol=1e-10 * np.abs(np.asarray(Rg)).max())
+
+    mesh3 = make_mesh(Grid3(3, 1, 1), devices=jax.devices()[:3])
+    with pytest.raises(ValueError, match="power-of-two"):
+        tsqr_distributed(np.zeros((3, 32, 8)), mesh3, tree="butterfly")

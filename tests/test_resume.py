@@ -150,3 +150,27 @@ def test_qr_steps_rejects_bad_usage():
         qr_factor_steps(shards, geom, mesh, 2, 1)
     with pytest.raises(ValueError):
         qr_factor_steps(shards, geom, mesh, 2, 4)  # R=None at k0 > 0
+
+
+def test_lu_resume_butterfly_election_bitwise():
+    """A butterfly-elected factorization must checkpoint/resume with the
+    same pivot bracket (election passthrough): bitwise at Pz == 1."""
+    import jax
+
+    grid = Grid3(2, 2, 1)
+    v, Nt = 8, 8
+    N = v * Nt
+    geom = LUGeometry.create(N, N, v, grid)
+    mesh = make_mesh(grid, devices=jax.devices()[: grid.P])
+    A = make_test_matrix(N, N, dtype=np.float32)
+    shards = jnp.asarray(geom.scatter(A))
+
+    full, perm_full = lu_factor_distributed(shards, geom, mesh,
+                                            election="butterfly")
+    s, o, _ = lu_factor_steps(shards, geom, mesh, 0, 4,
+                              election="butterfly")
+    s, o, perm = lu_factor_steps(s, geom, mesh, 4, geom.n_steps, orig=o,
+                                 election="butterfly")
+    np.testing.assert_array_equal(np.asarray(perm), np.asarray(perm_full))
+    np.testing.assert_allclose(np.asarray(s), np.asarray(full),
+                               rtol=0, atol=0)
