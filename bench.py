@@ -13,11 +13,11 @@ row swaps, chunked tournament election, segmented trailing updates) — not the
 unrolled single-device path: after the round-2 redesign the distributed
 program matches it (10.3-10.6 vs 10.4 TFLOP/s at this config, protocol
 dependent) while compiling in O(1) supersteps and scaling to meshes.
-Mid-round the swap scatter (339 ms of the 2235 ms run — an XLA serial
-per-row loop, see docs/DESIGN.md §12) was folded into the trailing-update
-segments; the expected gain (~10-13%) is unverified on hardware because the
-chip spent the rest of the round wedged (scripts/tpu_tune.py holds the
-queued re-measurement).
+A mid-round attempt to fold the swap scatter (339 ms of the 2235 ms run —
+an XLA serial per-row loop, docs/DESIGN.md §12) into the trailing-update
+segments was reverted: on hardware it was ~30% slower AND silently
+produced garbage factors at N=32768 (residual 29; correct on CPU and on
+TPU at N<=16384 — docs/DESIGN.md §14 has the forensics).
 
 Measurement notes: this environment reaches the TPU through a tunnel with a
 ~75 ms host round-trip floor and an async dispatch queue whose

@@ -13,14 +13,12 @@ shapes throughout:
    and positions >= k*v are active. Each step performs LAPACK-style row
    swaps — elected pivot rows move into the step's diagonal block, the
    displaced occupants move to the vacated slots — expressed as two
-   (v, Nl) psums plus per-row hit/src maps whose writes ride the step-6
-   segment updates as gather+selects (an explicit row scatter lowers to a
-   serial per-row loop on TPU, ~15% of the factorization). This is the
-   TPU answer to the reference's `push_pivots_up` row compaction (P6):
-   because eliminated rows now occupy a tile-aligned *prefix* of every
-   device's local rows, row liveness (like column liveness) is monotone
-   in the local tile index, and the hot ops shrink with k instead of
-   paying full-height masked work every superstep;
+   (v, Nl) psums plus value-level scatters. This is the TPU answer to the
+   reference's `push_pivots_up` row compaction (P6): because eliminated
+   rows now occupy a tile-aligned *prefix* of every device's local rows,
+   row liveness (like column liveness) is monotone in the local tile
+   index, and the hot ops shrink with k instead of paying full-height
+   masked work every superstep;
  - rotating owner roles (P5) -> `axis_index` comparisons inside the loop;
  - the z-layer 2.5D replication (P3) -> each device holds a *partial sum*
    shard; sum over the z axis is the true matrix. Panel reads are `psum`s
@@ -234,38 +232,31 @@ def _build(geom: LUGeometry, mesh_key, precision, backend: str,
             # k*v..(k+1)*v); the non-winner occupants move to the slots
             # vacated by external winners (i-th displaced occupant -> i-th
             # vacated position, both ascending — a canonical matching).
-            # No (Ml, Nl) row scatter is ever issued: XLA lowers one to a
-            # serial per-row while loop (~10 ms/step at v=1024, 15% of the
-            # whole factorization). Instead this block only computes the
-            # swap's row-level maps (hit/src below); the actual writes ride
-            # the step-6 segment updates as gather+selects that fuse into
-            # the GEMM epilogue.
+            # The writes are a (Ml,)-indexed row scatter. XLA lowers it to
+            # a serial per-row loop (~10 ms/step at v=1024) — a round-2
+            # attempt to fold the writes into the step-6 segments as
+            # gather+selects was REVERTED: on a real v5e it was ~30%
+            # slower and silently produced garbage factors at N=32768
+            # (residual 29 vs 2.9e-05; correct on CPU at every tested
+            # size and on TPU at N<=16384, valid perm, bounded factor
+            # magnitudes — an XLA TPU miscompile at 4 GiB operands is the
+            # best available explanation; see docs/DESIGN.md §14).
             with jax.named_scope("step2_pivotrows"):
                 slots = k * v + jnp.arange(v, dtype=jnp.int32)
-                jv = jnp.arange(v, dtype=jnp.int32)
                 occ_is_winner = (wpos[None, :] == slots[:, None]).any(1)
                 is_ext = wpos >= (k + 1) * v
                 # ascending order of the external winners' positions by
-                # comparison ranking — (v, v) compares; jnp.sort costs
-                # ~13 ms/step on TPU (bitonic) and a (v,) scatter lowers to
-                # a 1024-iteration serial loop, so neither is used
+                # comparison ranking — a (v, v) compare + tiny scatter; a
+                # jnp.sort here costs ~13 ms/step on TPU (bitonic)
                 both = is_ext[None, :] & is_ext[:, None]
                 rank = jnp.sum(both & (wpos[None, :] < wpos[:, None]),
                                axis=1).astype(jnp.int32)
-                # ext_sorted[r] = r-th smallest external winner position
-                # (sentinel tail), via vectorized rank inversion
-                rank_eq = is_ext[None, :] & (rank[None, :] == jv[:, None])
-                ext_sorted = jnp.where(
-                    rank_eq.any(1),
-                    jnp.sum(jnp.where(rank_eq, wpos[None, :], 0), axis=1),
-                    _GRI_SENTINEL)
+                ext_sorted = jnp.full((v,), _GRI_SENTINEL, jnp.int32).at[
+                    jnp.where(is_ext, rank, v)
+                ].set(wpos, mode="drop")
                 disp_rank = jnp.cumsum((~occ_is_winner).astype(jnp.int32)) - 1
-                # src_of_rank[r] = which diagonal-block occupant (j) moves
-                # to the r-th vacated position
-                src_eq = (~occ_is_winner)[None, :] & (
-                    disp_rank[None, :] == jv[:, None])
-                src_of_rank = jnp.sum(
-                    jnp.where(src_eq, jv[None, :], 0), axis=1)
+                dest_disp = jnp.where(~occ_is_winner, ext_sorted[disp_rank],
+                                      _GRI_SENTINEL)
 
                 # winners' full rows + ids, reduced over (x, z) (ref step 3)
                 wloc = loc_of(wpos)
@@ -290,26 +281,22 @@ def _build(geom: LUGeometry, mesh_key, precision, backend: str,
                               jnp.zeros((), cdtype)),
                     AXIS_X)  # (v, v)
 
-                # row-level swap maps: hit[r] = a displaced occupant lands
-                # at local row r; src[r] = which one. ext_sorted is
-                # ascending, so searchsorted gives each row its rank in
-                # O(log v) vectorized compares.
-                q = jnp.searchsorted(ext_sorted, gp).astype(jnp.int32)
-                qc = jnp.minimum(q, v - 1)
-                hit = jnp.take(ext_sorted, qc) == gp  # sentinel never hits
-                src = jnp.take(src_of_rank, qc)  # (Ml,) occupant index
-
-                # bookkeeping swaps (vector-width, cheap): diagonal block
-                # takes the winners' ids; vacated rows take the displaced
-                # occupants' ids
+                # swap writes: vacated positions get the displaced rows now
+                # (they stay active and take the trailing update); diagonal
+                # rows are fully rewritten after the GEMM. Swapped rows
+                # carry their z-summed value on layer 0, zeros elsewhere.
+                didx = loc_of(dest_disp)
+                Aloc = Aloc.at[didx].set(
+                    jnp.where(z0, Drows.astype(dtype), jnp.zeros((), dtype)),
+                    mode="drop")
                 orig = jnp.where(
                     own_d, lax.dynamic_update_slice(orig, worig, (li,)), orig)
-                orig = jnp.where(hit, jnp.take(dorig, src), orig)
-                # the panel after the swap, for the L10 solve: displaced
-                # rows read their diagonal-block panel values (winner rows
-                # are masked out of the TRSM by row_live)
-                panel_post = jnp.where(
-                    hit[:, None], jnp.take(diag_panel, src, axis=0), panel)
+                orig = orig.at[didx].set(dorig, mode="drop")
+                # the panel after the swap, for the L10 solve. Only the
+                # displaced rows matter: the diagonal rows (winners) are
+                # masked out of the TRSM by row_live, so their panel values
+                # are never written back here.
+                panel_post = panel.at[didx].set(diag_panel, mode="drop")
 
             # ---- L10 for the live row suffix (ref step 4 TRSM) ----------- #
             row_live = rtile > k  # whole tiles: diag tile k is done now
@@ -359,35 +346,16 @@ def _build(geom: LUGeometry, mesh_key, precision, backend: str,
             with jax.named_scope("step6_dgemm"):
                 # in-place cond'd DUS per live segment: a slice->concat
                 # formulation materializes the full local matrix every step
-                # (~26 ms/step of pure copies at N=32768).
-                # The step-2 row swaps are folded in here as gather+selects
-                # (`hit`/`src` row maps): live-column segments apply them
-                # inside the GEMM epilogue fusion; dead-column segments
-                # (the frozen L region, whose columns displaced rows carry
-                # with them) get a select-only write. This bounds the
-                # swap's cost by one masked pass over the live rows instead
-                # of XLA's serial per-row scatter loop.
+                # (~26 ms/step of pure copies at N=32768)
                 Anew = Aloc
-
-                def seg_swapped(A, rlo, rhi, clo, chi, hseg, sseg):
-                    a_seg = lax.slice(A, (rlo, clo), (rhi, chi))
-                    moved = jnp.take(Drows[:, clo:chi], sseg, axis=0)
-                    return jnp.where(
-                        hseg[:, None],
-                        jnp.where(z0, moved, jnp.zeros((), dtype)),
-                        a_seg)
-
                 for rlo, rhi in row_segs:
                     rm = row_live[rlo:rhi]
-                    hseg = hit[rlo:rhi]
-                    sseg = src[rlo:rhi]
                     for clo, chi in col_segs:
                         cm = col_trail[clo:chi]
 
                         def seg_update(A, rlo=rlo, rhi=rhi, clo=clo, chi=chi,
-                                       rm=rm, cm=cm, hseg=hseg, sseg=sseg):
-                            a_seg = seg_swapped(A, rlo, rhi, clo, chi,
-                                                hseg, sseg)
+                                       rm=rm, cm=cm):
+                            a_seg = lax.slice(A, (rlo, clo), (rhi, chi))
                             upd = blas.gemm(
                                 L10s[rlo:rhi], U01s[:, clo:chi],
                                 precision=precision, backend=backend)
@@ -397,18 +365,8 @@ def _build(geom: LUGeometry, mesh_key, precision, backend: str,
                             return lax.dynamic_update_slice(A, new,
                                                             (rlo, clo))
 
-                        def seg_swap_only(A, rlo=rlo, rhi=rhi, clo=clo,
-                                          chi=chi, hseg=hseg, sseg=sseg):
-                            return lax.dynamic_update_slice(
-                                A, seg_swapped(A, rlo, rhi, clo, chi,
-                                               hseg, sseg), (rlo, clo))
-
-                        def seg_else(A, hseg=hseg, swap=seg_swap_only):
-                            return lax.cond(hseg.any(), swap,
-                                            lambda A_: A_, A)
-
                         Anew = lax.cond(rm.any() & cm.any(), seg_update,
-                                        seg_else, Anew)
+                                        lambda A: A, Anew)
 
             # ---- factor writes (z==0 carries factors, z!=0 zeroed) ------- #
             # diagonal block rows: leading columns keep the winners' frozen
@@ -440,8 +398,10 @@ def _build(geom: LUGeometry, mesh_key, precision, backend: str,
                     lax.dynamic_update_slice(Anew, pcol_new, (i0, lj)),
                     Anew,
                 )
-            art = dict(Drows=Drows, hit=hit, src=src, L10s=L10s, U01s=U01s,
-                       U01=U01, row_live=row_live, own_d=own_d, li=li, z0=z0)
+            # A_sw = the post-swap, pre-update matrix: the lookahead body
+            # recomputes next step's panel slab from it
+            art = dict(A_sw=Aloc, L10s=L10s, U01s=U01s, U01=U01,
+                       row_live=row_live, own_d=own_d, li=li, z0=z0)
             return Anew, orig, art
 
         def body(k, carry):
@@ -471,15 +431,7 @@ def _build(geom: LUGeometry, mesh_key, precision, backend: str,
                 with jax.named_scope("step0_reduce"):
                     j1 = kn % Py
                     lj1 = ((kn // Py) * v).astype(jnp.int32)
-                    slab = lax.dynamic_slice(Aloc, (i0, lj1), (Ml, v))
-                    dslab = lax.dynamic_slice(art["Drows"], (i0, lj1),
-                                              (v, v))
-                    slab = jnp.where(
-                        art["hit"][:, None],
-                        jnp.where(art["z0"],
-                                  jnp.take(dslab, art["src"], axis=0),
-                                  jnp.zeros((), dtype)),
-                        slab)
+                    slab = lax.dynamic_slice(art["A_sw"], (i0, lj1), (Ml, v))
                     upd = blas.gemm(art["L10s"],
                                     lax.dynamic_slice(art["U01s"],
                                                       (i0, lj1),

@@ -1,0 +1,140 @@
+"""Host-vs-device matvec residual probe for the N=32768 garbage readings.
+
+The strip oracle (bench._residual_on_device) reads 29 at N=32768 while the
+perm is a valid permutation and factor magnitudes look healthy — so either
+the factors are subtly wrong everywhere or the on-device oracle itself
+breaks at 4 GiB operands. A matvec probe r = A[perm]x - L(Ux) is O(N^2):
+cheap enough to run in float64 on the single-core host from the SAME
+device-computed factors, and to run on the device with the same math.
+Disagreement localizes the bug to the device compute path; agreement on a
+large value indicts the factorization.
+
+Usage: python scripts/debug_matvec_probe.py [-N 32768] [--chunk 8192]
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("-N", type=int, default=32768)
+    ap.add_argument("--chunk", type=int, default=8192)
+    ap.add_argument("-v", type=int, default=1024)
+    args = ap.parse_args()
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from jax import lax
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    import bench as bench_mod
+    from conflux_tpu.geometry import Grid3, LUGeometry
+    from conflux_tpu.lu.distributed import lu_factor_distributed
+    from conflux_tpu.parallel.mesh import AXIS_X, AXIS_Y, make_mesh
+
+    N, v = args.N, args.v
+    grid = Grid3(1, 1, 1)
+    geom = LUGeometry.create(N, N, v, grid)
+    mesh = make_mesh(grid, devices=jax.devices()[:1])
+    sharding = NamedSharding(mesh, P(AXIS_X, AXIS_Y, None, None))
+
+    shards = jax.device_put(bench_mod._make_n(N), sharding)
+    float(shards[0, 0, 0, 0])
+    t0 = time.time()
+    out, perm = lu_factor_distributed(
+        shards, geom, mesh, panel_chunk=args.chunk, donate=True)
+    float(out[0, 0, 0, 0])
+    print(f"factor: {time.time() - t0:.1f} s", flush=True)
+    LU = out[0, 0]
+
+    blk = 4096
+    rows = np.arange(N, dtype=np.int32)
+
+    # ---- device probe (same math as the host one below) ------------------ #
+    @jax.jit
+    def device_probe(LU, perm, x):
+        A = bench_mod._make_n(N)[0, 0]
+        r = jnp.arange(N, dtype=jnp.int32)
+        y = jnp.zeros((N,), jnp.float32)
+        z = jnp.zeros((N,), jnp.float32)
+        for i in range(0, N, blk):
+            s = LU[i:i + blk]
+            y = lax.dynamic_update_slice(
+                y, A[i:i + blk] @ x, (i,))
+            z = lax.dynamic_update_slice(
+                z, jnp.where(r[i:i + blk, None] <= r[None, :], s, 0.0) @ x,
+                (i,))
+        w = jnp.zeros((N,), jnp.float32)
+        for i in range(0, N, blk):
+            s = LU[i:i + blk]
+            w = lax.dynamic_update_slice(
+                w,
+                jnp.where(r[i:i + blk, None] > r[None, :], s, 0.0) @ z
+                + z[i:i + blk],
+                (i,))
+        yp = jnp.take(y, perm)
+        return (jnp.linalg.norm(yp - w) / jnp.linalg.norm(yp),
+                jnp.linalg.norm(y), jnp.linalg.norm(z))
+
+    key = jax.random.PRNGKey(7)
+    x = jax.random.normal(key, (N,), jnp.float32)
+    rel_dev, ny_dev, nz_dev = device_probe(LU, perm, x)
+    print(f"device probe: rel={float(rel_dev):.3e} "
+          f"||Ax||={float(ny_dev):.4e} ||Ux||={float(nz_dev):.4e}",
+          flush=True)
+
+    # ---- pull to host ---------------------------------------------------- #
+    # order matters for HBM: pull + drop the 4 GB factor buffer BEFORE
+    # regenerating the 4 GB input (holding both next to the probe's
+    # temporaries ResourceExhausts a 16 GB chip)
+    t0 = time.time()
+    LU_h = np.asarray(LU)
+    perm_h = np.asarray(perm)
+    x_h = np.asarray(x)
+    del LU, out
+    A_dev = bench_mod._make_n(N)
+    A_h = np.asarray(A_dev[0, 0])
+    del A_dev
+    print(f"transfers: {time.time() - t0:.1f} s", flush=True)
+
+    # ---- host probe in float64 ------------------------------------------- #
+    x64 = x_h.astype(np.float64)
+    y = np.empty(N, np.float64)
+    z = np.empty(N, np.float64)
+    for i in range(0, N, blk):
+        strip = LU_h[i:i + blk].astype(np.float64)
+        y[i:i + blk] = A_h[i:i + blk].astype(np.float64) @ x64
+        U_strip = np.where(rows[i:i + blk, None] <= rows[None, :], strip, 0.0)
+        z[i:i + blk] = U_strip @ x64
+    w = np.empty(N, np.float64)
+    for i in range(0, N, blk):
+        strip = LU_h[i:i + blk].astype(np.float64)
+        L_strip = np.where(rows[i:i + blk, None] > rows[None, :], strip, 0.0)
+        w[i:i + blk] = L_strip @ z + z[i:i + blk]
+    yp = y[perm_h]
+    rel = np.linalg.norm(yp - w) / np.linalg.norm(yp)
+    print(f"host probe (f64): rel={rel:.3e} "
+          f"||Ax||={np.linalg.norm(y):.4e} ||Ux||={np.linalg.norm(z):.4e}",
+          flush=True)
+
+    # f32 floor for this probe is ~eps*sqrt(N)*growth ~ 1e-4; anything at
+    # O(1) or above means the factors really are wrong on the host too
+    if rel < 1e-3:
+        print("VERDICT: factors are GOOD on host -> device-side compute "
+              "(oracle or probe math) is producing garbage at this size",
+              flush=True)
+    else:
+        print("VERDICT: factors are BAD on host too -> the factorization "
+              "itself is wrong at this size", flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,142 @@
+"""One-shot N=32768 TPU debug: factor once, validate perm, then residual.
+
+Isolates the deterministic garbage the round-2 bench observed at N=32768
+(residual 28.9 twice across chip sessions — too deterministic for the
+"degraded device" diagnosis in docs/DESIGN.md §14). Checks, in order:
+
+1. perm is a valid permutation (election integrity);
+2. factor magnitude stats (pivot blowup vs bounded factors);
+3. the strip residual, per strip (localizes WHERE the factorization
+   diverges — a bad superstep poisons strips below/right of it).
+
+Usage: python scripts/debug_n32768.py [-N 32768] [--chunk 8192] [-v 1024]
+       [--reps 1] [--no-donate]
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("-N", type=int, default=32768)
+    ap.add_argument("--chunk", type=int, default=8192)
+    ap.add_argument("-v", type=int, default=1024)
+    ap.add_argument("--reps", type=int, default=1,
+                    help="factor this many times (garbage might need a "
+                    "re-donated buffer to appear)")
+    ap.add_argument("--no-donate", action="store_true")
+    args = ap.parse_args()
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    import bench as bench_mod
+    from conflux_tpu.geometry import Grid3, LUGeometry
+    from conflux_tpu.lu.distributed import lu_factor_distributed
+    from conflux_tpu.parallel.mesh import AXIS_X, AXIS_Y, make_mesh
+
+    N, v = args.N, args.v
+    grid = Grid3(1, 1, 1)
+    geom = LUGeometry.create(N, N, v, grid)
+    mesh = make_mesh(grid, devices=jax.devices()[:1])
+    sharding = NamedSharding(mesh, P(AXIS_X, AXIS_Y, None, None))
+
+    def factor(s):
+        return lu_factor_distributed(
+            s, geom, mesh, panel_chunk=args.chunk,
+            donate=not args.no_donate)
+
+    out = perm = None
+    for rep in range(args.reps):
+        shards = jax.device_put(bench_mod._make_n(N), sharding)
+        float(shards[0, 0, 0, 0])
+        t0 = time.time()
+        out, perm = factor(shards)
+        float(out[0, 0, 0, 0])
+        print(f"rep {rep}: {time.time() - t0:.2f} s", flush=True)
+
+    # 1. perm integrity: must be a permutation of arange(N)
+    perm_h = np.asarray(perm)
+    valid = (np.sort(perm_h) == np.arange(N)).all()
+    print(f"perm valid permutation: {valid}", flush=True)
+    if not valid:
+        u, c = np.unique(perm_h, return_counts=True)
+        dup = u[c > 1]
+        missing = np.setdiff1d(np.arange(N), u)
+        oob = u[(u < 0) | (u >= N)]
+        print(f"  dups: {dup[:10]} (n={dup.size})  "
+              f"missing: {missing[:10]} (n={missing.size})  "
+              f"oob: {oob[:10]} (n={oob.size})", flush=True)
+        # which superstep first elects a bad row: perm reshaped (n_steps, v)
+        steps = perm_h[: geom.n_steps * v].reshape(geom.n_steps, v)
+        for k in range(geom.n_steps):
+            s = steps[k]
+            bad = (np.unique(s).size != v) or (s < 0).any() or (s >= N).any()
+            if bad:
+                print(f"  first bad superstep: k={k}", flush=True)
+                break
+
+    # 2. factor magnitude per diagonal block (pivot blowup shows as a
+    # growing |L|/|U| envelope after the bad step)
+    LU = out[0, 0]
+    mags = jax.jit(
+        lambda LU: jnp.stack([
+            jnp.max(jnp.abs(LU[i * v:(i + 1) * v]))
+            for i in range(geom.n_steps)
+        ])
+    )(LU)
+    mags = np.asarray(mags)
+    print("max |LU| per row-block:", flush=True)
+    for i in range(0, geom.n_steps, 4):
+        row = " ".join(f"{m:9.2e}" for m in mags[i:i + 4])
+        print(f"  k={i:3d}: {row}", flush=True)
+
+    # 3. strip residuals (which row strips are wrong) — same math as
+    # bench._ssq_blocks but reporting per strip
+    import math
+    blk = math.gcd(N, bench_mod.RES_BLOCK)
+    from jax import lax
+
+    @jax.jit
+    def strip_res(LU, perm):
+        A = bench_mod._make_n(N)[0, 0]
+        rows = jnp.arange(N, dtype=jnp.int32)
+        outs = []
+        for i in range(0, N, blk):
+            Ap_i = jnp.take(A, perm[i:i + blk], axis=0)
+            Li = jnp.where(rows[i:i + blk, None] > rows[None, :],
+                           LU[i:i + blk], 0.0) + jnp.eye(blk, N, i,
+                                                         dtype=LU.dtype)
+            acc = jnp.zeros((blk, N), jnp.float32)
+            for j in range(0, N, blk):
+                Uj = jnp.where(rows[:, None] <= rows[None, j:j + blk],
+                               LU[:, j:j + blk], 0.0)
+                acc = lax.dynamic_update_slice(
+                    acc, jnp.matmul(Li, Uj,
+                                    precision=lax.Precision.HIGHEST), (0, j))
+            R = Ap_i - acc
+            outs.append(jnp.sqrt(jnp.sum(R * R)))
+        return jnp.stack(outs), jnp.sqrt(jnp.sum(A * A))
+
+    rs, anorm = strip_res(LU, perm)
+    rs = np.asarray(rs)
+    anorm = float(anorm)
+    print(f"||A||_F = {anorm:.4e}", flush=True)
+    for i, r in enumerate(rs):
+        print(f"  strip {i} (rows {i * blk}..{(i + 1) * blk}): "
+              f"rel {r / anorm:.3e}", flush=True)
+    print(f"total rel residual: "
+          f"{np.sqrt((rs ** 2).sum()) / anorm:.3e}", flush=True)
+
+
+if __name__ == "__main__":
+    main()
