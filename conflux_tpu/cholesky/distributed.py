@@ -47,7 +47,7 @@ from conflux_tpu.parallel.mesh import (
 @functools.lru_cache(maxsize=32)
 def _build(geom: CholeskyGeometry, mesh_key, precision, backend: str,
            donate: bool = False, resumable: bool = False,
-           lookahead: bool = False):
+           lookahead: bool = False, segs: tuple = (8, 8)):
     mesh = lookup_mesh(mesh_key)
     v = geom.v
     Px, Py, Pz = geom.grid.Px, geom.grid.Py, geom.grid.Pz
@@ -59,11 +59,17 @@ def _build(geom: CholeskyGeometry, mesh_key, precision, backend: str,
     # trailing-update segmentation (same idea as lu.distributed): both the
     # live rows (rtile > k) and live columns (ctile > k) are contiguous
     # local suffixes under the block-cyclic map, so ceil-divide each axis
-    # into up to 4 ragged segments and skip dead (row, col) blocks with
-    # lax.cond — GEMM work stays near the true N^3/3P instead of the 3x a
-    # full-local-shape masked update would spend
-    row_bounds = ragged_segments(Ml // v, v, 4)
-    col_bounds = ragged_segments(Nl // v, v, 4)
+    # into ragged segments and skip dead (row, col) blocks with lax.cond —
+    # GEMM work stays near the true N^3/3P instead of the 3x a
+    # full-local-shape masked update would spend. Segments whose tiles lie
+    # ENTIRELY above the diagonal are skipped too: the factorization never
+    # reads the strict upper triangle (future panels mask rows above their
+    # diagonal), so updating it is pure waste — segment-level triangle
+    # skipping approaches the reference's lower-triangle-only owner set
+    # (`Cholesky.cpp:333-355`) as the segmentation refines; mixed segments
+    # still update their (unread, unspecified) upper elements.
+    row_bounds = ragged_segments(Ml // v, v, segs[0])
+    col_bounds = ragged_segments(Nl // v, v, segs[1])
 
     def device_fn(blk, k0=0, k_end=n_steps):
         x = lax.axis_index(AXIS_X)
@@ -115,13 +121,21 @@ def _build(geom: CholeskyGeometry, mesh_key, precision, backend: str,
                 L00 = blas.potrf(Akk)
 
             # ---- L10 for rows below the diagonal (row-segmented) ---------- #
+            # segment liveness as scalar tile-index compares (liveness is
+            # monotone in the local tile index; see lu.distributed)
+            def seg_r_live(rhi):
+                return ((rhi - 1) // v) * Px + x > k
+
+            def seg_c_live(chi):
+                return ((chi - 1) // v) * Py + y > k
+
             with jax.named_scope("updateA10"):
                 below = rtile > k
                 pieces = []
                 for rlo, rhi in row_bounds:
                     rm = below[rlo:rhi]
                     pieces.append(lax.cond(
-                        rm.any(),
+                        seg_r_live(rhi),
                         lambda p, m: blas.trsm_right_lower_t(
                             L00, jnp.where(m[:, None], p,
                                            jnp.zeros((), cdtype))),
@@ -176,8 +190,17 @@ def _build(geom: CholeskyGeometry, mesh_key, precision, backend: str,
                             return lax.dynamic_update_slice(A, new,
                                                             (rlo, clo))
 
-                        Anew = lax.cond(rm.any() & cm.any(), seg_update,
-                                        lambda A: A, Anew)
+                        # touches_lower: the segment's last row tile
+                        # reaches (or passes) its first column tile —
+                        # false means every tile is strictly upper and
+                        # the segment's content is never read again
+                        touches_lower = (
+                            ((rhi - 1) // v) * Px + x
+                            >= (clo // v) * Py + y)
+                        Anew = lax.cond(
+                            seg_r_live(rhi) & seg_c_live(chi)
+                            & touches_lower,
+                            seg_update, lambda A: A, Anew)
 
             # ---- factor writes: panel column on layer z==0 ---------------- #
             on_diag = rtile == k
@@ -259,7 +282,8 @@ def _build(geom: CholeskyGeometry, mesh_key, precision, backend: str,
 
 def build_program(geom: CholeskyGeometry, mesh, precision=None,
                   backend: str | None = None, donate: bool = False,
-                  resumable: bool = False, lookahead: bool = False):
+                  resumable: bool = False, lookahead: bool = False,
+                  segs: tuple = (8, 8)):
     """The jitted distributed-Cholesky program (cached per config) — the
     single point resolving trace-time defaults and the CPU donate guard;
     `cholesky_factor_distributed` goes through here. Direct use is for
@@ -270,7 +294,7 @@ def build_program(geom: CholeskyGeometry, mesh, precision=None,
     if donate and next(iter(mesh.devices.flat)).platform == "cpu":
         donate = False  # CPU PJRT has no buffer donation (warns per call)
     return _build(geom, mesh_cache_key(mesh), precision, backend, donate,
-                  resumable, lookahead)
+                  resumable, lookahead, tuple(segs))
 
 
 def cholesky_factor_steps(shards, geom: CholeskyGeometry, mesh,
@@ -295,18 +319,20 @@ def cholesky_factor_steps(shards, geom: CholeskyGeometry, mesh,
 def cholesky_factor_distributed(shards, geom: CholeskyGeometry, mesh,
                                 precision=None, backend: str | None = None,
                                 donate: bool = False,
-                                lookahead: bool = False):
+                                lookahead: bool = False,
+                                segs: tuple = (8, 8)):
     """Factor block-cyclic shards of an SPD matrix; returns factored shards
     (lower triangle = L, upper triangle unspecified). `donate=True`
     aliases the input into the output — without it the superstep loop
     cannot update in place (an immutable input forces a full-buffer copy
-    per step, measured ~6 ms/step at N=16384 on a v5e)."""
+    per step, measured ~6 ms/step at N=16384 on a v5e). `segs` = (row,
+    col) trailing-update segment counts (see `lu.distributed`)."""
     from conflux_tpu.geometry import check_shards
 
     shards = jnp.asarray(shards)
     check_shards(shards, geom)
     fn = build_program(geom, mesh, precision=precision, backend=backend,
-                       donate=donate, lookahead=lookahead)
+                       donate=donate, lookahead=lookahead, segs=segs)
     return fn(shards)
 
 

@@ -79,7 +79,8 @@ _DEFAULT_PANEL_CHUNK = 8192
 @functools.lru_cache(maxsize=32)
 def _build(geom: LUGeometry, mesh_key, precision, backend: str,
            panel_chunk: int, donate: bool = False, resumable: bool = False,
-           lookahead: bool = False, election: str = "gather"):
+           lookahead: bool = False, election: str = "gather",
+           segs: tuple = (16, 16)):
     """resumable=True builds the checkpoint/restart form: factor supersteps
     [k0, k1) given as TRACED scalars — one compile serves every segment of
     a checkpointed run — with the row-origin state as an explicit
@@ -99,9 +100,11 @@ def _build(geom: LUGeometry, mesh_key, precision, backend: str,
     # columns because tile lt has global id lt*P + coord), so the live
     # region is a contiguous (row-suffix x col-suffix) block; ragged
     # segments + lax.cond skip dead blocks, bounding flop overshoot at one
-    # segment of width/height per superstep
-    col_segs = ragged_segments(geom.Ntl, v, 8)
-    row_segs = ragged_segments(geom.Mtl, v, 4)
+    # segment of width/height per superstep. `segs` = (row, col) segment
+    # counts: finer cuts overshoot (avg half a segment of dead rows/cols
+    # ride every GEMM) at the cost of more cond/DUS ops per step.
+    row_segs = ragged_segments(geom.Mtl, v, segs[0])
+    col_segs = ragged_segments(geom.Ntl, v, segs[1])
 
     def device_fn(blk, orig_blk=None, k0=0, k_end=n_steps):
         x = lax.axis_index(AXIS_X)
@@ -157,10 +160,16 @@ def _build(geom: LUGeometry, mesh_key, precision, backend: str,
             pos_m = jnp.where(live, gp, _GRI_SENTINEL)
             # dead rows form a tile-aligned prefix (LAPACK-order layout),
             # so whole chunks die as k advances: a chunk is live iff its
-            # last row's position is still active
+            # last row's position is still active (the position of a local
+            # row is a closed form, so this is a scalar compare per chunk,
+            # not a gather)
             c_h, nch = blas.chunk_layout(Ml, v, panel_chunk)
+
+            def pos_of_local(r):  # python-int local row -> global position
+                return ((r // v) * Px + x) * v + (r % v)
+
             chunk_live = jnp.stack([
-                gp[min((i + 1) * c_h, Ml) - 1] >= k * v
+                pos_of_local(min((i + 1) * c_h, Ml) - 1) >= k * v
                 for i in range(nch)
             ])
             if Px == 1:
@@ -300,12 +309,23 @@ def _build(geom: LUGeometry, mesh_key, precision, backend: str,
 
             # ---- L10 for the live row suffix (ref step 4 TRSM) ----------- #
             row_live = rtile > k  # whole tiles: diag tile k is done now
+            # segment liveness as SCALAR tile-index compares: liveness is
+            # monotone in the local tile index (LAPACK-order rows,
+            # block-cyclic columns), so "any row/col of the segment live"
+            # == "its last row/col's tile is still trailing" — a bool
+            # vector .any() here costs ~1 ms/step in reduce fusions
+            def seg_r_live(rhi):
+                return ((rhi - 1) // v) * Px + x > k
+
+            def seg_c_live(chi):
+                return ((chi - 1) // v) * Py + y > k
+
             with jax.named_scope("step4_dtrsm"):
                 pieces = []
                 for rlo, rhi in row_segs:
                     rm = row_live[rlo:rhi]
                     pieces.append(lax.cond(
-                        rm.any(),
+                        seg_r_live(rhi),
                         lambda p, m: blas.trsm_right_upper(
                             U00, jnp.where(m[:, None], p,
                                            jnp.zeros((), cdtype))),
@@ -321,9 +341,8 @@ def _build(geom: LUGeometry, mesh_key, precision, backend: str,
             with jax.named_scope("step5_dtrsm"):
                 pieces = []
                 for clo, chi in col_segs:
-                    cm = col_trail[clo:chi]
                     pieces.append(lax.cond(
-                        cm.any(),
+                        seg_c_live(chi),
                         lambda p: blas.trsm_left_lower_unit(L00, p),
                         # pcast matches the solve branch's varying axes
                         # (L00 varies over x) for the cond output type
@@ -365,8 +384,8 @@ def _build(geom: LUGeometry, mesh_key, precision, backend: str,
                             return lax.dynamic_update_slice(A, new,
                                                             (rlo, clo))
 
-                        Anew = lax.cond(rm.any() & cm.any(), seg_update,
-                                        lambda A: A, Anew)
+                        Anew = lax.cond(seg_r_live(rhi) & seg_c_live(chi),
+                                        seg_update, lambda A: A, Anew)
 
             # ---- factor writes (z==0 carries factors, z!=0 zeroed) ------- #
             # diagonal block rows: leading columns keep the winners' frozen
@@ -500,7 +519,8 @@ def _build(geom: LUGeometry, mesh_key, precision, backend: str,
 def build_program(geom: LUGeometry, mesh, precision=None,
                   backend: str | None = None, panel_chunk: int | None = None,
                   donate: bool = False, resumable: bool = False,
-                  lookahead: bool = False, election: str = "gather"):
+                  lookahead: bool = False, election: str = "gather",
+                  segs: tuple = (16, 16)):
     """The jitted distributed-LU program itself (cached per config).
 
     The single point resolving the trace-time defaults (precision/backend/
@@ -525,14 +545,15 @@ def build_program(geom: LUGeometry, mesh, precision=None,
             "(a missing hypercube partner strands candidate subsets; "
             "use election='gather' for this grid)")
     return _build(geom, mesh_cache_key(mesh), precision, backend,
-                  panel_chunk, donate, resumable, lookahead, election)
+                  panel_chunk, donate, resumable, lookahead, election,
+                  tuple(segs))
 
 
 def lu_factor_distributed(shards, geom: LUGeometry, mesh,
                           precision=None, backend: str | None = None,
                           panel_chunk: int | None = None,
                           donate: bool = False, lookahead: bool = False,
-                          election: str = "gather"):
+                          election: str = "gather", segs: tuple = (16, 16)):
     """Factor block-cyclic shards (Px, Py, Ml, Nl) in place on a mesh.
 
     Returns (shards_out, perm): shards_out holds the packed factors in
@@ -566,7 +587,8 @@ def lu_factor_distributed(shards, geom: LUGeometry, mesh,
     check_shards(shards, geom)
     fn = build_program(geom, mesh, precision=precision, backend=backend,
                        panel_chunk=panel_chunk, donate=donate,
-                       lookahead=lookahead, election=election)
+                       lookahead=lookahead, election=election,
+                       segs=segs)
     return fn(shards)
 
 

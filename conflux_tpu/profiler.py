@@ -96,6 +96,18 @@ def trace(logdir: str):
 # (reference vocabulary: reduceA11/choleskyA00/updateA10/scatterA11/computeA11)
 _PHASE_RE = r"(step\d+_[a-z0-9]+|(?:reduce|cholesky|update|compute|scatter)A\d\d)"
 
+# optimized-HLO "op token -> op_name metadata" line shape; shared with
+# scripts/step_profile.py's --top-other listing so the two parsers cannot
+# drift apart across jax versions
+OP_NAME_RE = r"%([\w.-]+) = .*?metadata=\{[^}]*?op_name=\"([^\"]*)\""
+
+
+def op_name_map(hlo_text: str) -> dict[str, str]:
+    """HLO op token -> op_name metadata string (empty-metadata ops absent)."""
+    import re
+
+    return dict(re.findall(OP_NAME_RE, hlo_text))
+
 
 def _scope_map(hlo_text: str, phase_re: str) -> dict[str, str]:
     """HLO op token -> phase name, from optimized-HLO `op_name` metadata.
@@ -110,12 +122,9 @@ def _scope_map(hlo_text: str, phase_re: str) -> dict[str, str]:
     """
     import re
 
-    pat = re.compile(
-        r"%([\w.-]+) = .*?metadata=\{[^}]*?op_name=\"([^\"]*)\""
-    )
     phase = re.compile(phase_re)
     out: dict[str, str] = {}
-    for tok, op_name in pat.findall(hlo_text):
+    for tok, op_name in op_name_map(hlo_text).items():
         m = phase.search(op_name)
         if m:
             out[tok] = m.group(1)

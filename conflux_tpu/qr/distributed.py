@@ -292,6 +292,15 @@ def _build_full(geom, mesh_key, precision, backend: str, chunk: int,
             col_done = ctile < k
             col_live = ctile > k
 
+            # segment liveness as scalar tile-index compares (done is a
+            # tile prefix, live a tile suffix, both monotone in the local
+            # tile index — see lu.distributed.seg_r_live)
+            def seg_c_done(clo):
+                return (clo // v) * Py + y < k
+
+            def seg_c_live(chi):
+                return ((chi - 1) // v) * Py + y > k
+
             with jax.named_scope("qr_panel_reduce"):
                 panel_loc = lax.dynamic_slice(Aloc, (i0, lj), (Ml, v))
                 P_ = lax.psum(
@@ -306,7 +315,7 @@ def _build_full(geom, mesh_key, precision, backend: str, chunk: int,
                 for clo, chi in col_segs:
                     dm = col_done[clo:chi]
                     wparts.append(lax.cond(
-                        dm.any(),
+                        seg_c_done(clo),
                         lambda a, m: jnp.matmul(
                             jnp.where(m[:, None],
                                       a.conj().T.astype(cdtype), 0.0),
@@ -340,7 +349,8 @@ def _build_full(geom, mesh_key, precision, backend: str, chunk: int,
                         return acc + jnp.matmul(Qseg, W[clo:chi],
                                                 precision=prec)
 
-                    Dacc = lax.cond(dm.any(), proj, lambda acc: acc, Dacc)
+                    Dacc = lax.cond(seg_c_done(clo), proj,
+                                    lambda acc: acc, Dacc)
                 P_ = P_ - lax.psum(Dacc, (AXIS_Y, AXIS_Z))
 
             with jax.named_scope("qr_panel_tsqr"):
@@ -352,7 +362,7 @@ def _build_full(geom, mesh_key, precision, backend: str, chunk: int,
                 for clo, chi in col_segs:
                     lm = col_live[clo:chi]
                     cparts.append(lax.cond(
-                        lm.any(),
+                        seg_c_live(chi),
                         lambda a, m: jnp.matmul(
                             Qp.conj().T,
                             jnp.where(m[None, :], a.astype(cdtype), 0.0),
@@ -385,7 +395,8 @@ def _build_full(geom, mesh_key, precision, backend: str, chunk: int,
                                                 jnp.zeros((), dtype))
                         return lax.dynamic_update_slice(A, new, (0, clo))
 
-                    Anew = lax.cond(lm.any(), seg_update, lambda A: A, Anew)
+                    Anew = lax.cond(seg_c_live(chi), seg_update,
+                                    lambda A: A, Anew)
 
             # ---- Q panel write (z0, column owner) ---------------------- #
             with jax.named_scope("qr_writes"):

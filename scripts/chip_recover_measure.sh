@@ -22,15 +22,16 @@ print(float(jax.numpy.ones((8,)).sum()))
   sleep 300
 done
 {
-  echo "=== tune N=16384 highest/high $(date -u +%FT%TZ) ==="
-  timeout -k 10 2400 python scripts/tpu_tune.py -N 16384 --reps 2 \
-    --configs highest:8192:1024,high:8192:1024 2>&1 | grep -v WARNING
-  echo "=== tune cholesky/qr N=16384 $(date -u +%FT%TZ) ==="
-  timeout -k 10 2400 python scripts/tpu_tune.py --algo cholesky -N 16384 \
+  echo "=== bench.py (LU 16x16 segs default at-scale gate) $(date -u +%FT%TZ) ==="
+  timeout -k 10 3000 python bench.py 2>&1 | grep -v WARNING
+  echo "=== cholesky N=32768 (triangle-skip at-scale gate) $(date -u +%FT%TZ) ==="
+  timeout -k 10 2400 python scripts/tpu_tune.py --algo cholesky -N 32768 \
     --reps 2 --configs highest:0:1024,high:0:1024 2>&1 | grep -v WARNING
+  echo "=== tune LU taller nomination chunks $(date -u +%FT%TZ) ==="
+  timeout -k 10 2400 python scripts/tpu_tune.py -N 32768 --reps 2 \
+    --configs highest:12288:1024,highest:10240:1024 2>&1 | grep -v WARNING
+  echo "=== qr N=16384 $(date -u +%FT%TZ) ==="
   timeout -k 10 2400 python scripts/tpu_tune.py --algo qr -N 16384 \
     --reps 2 --configs highest:0:1024 2>&1 | grep -v WARNING
-  echo "=== bench.py $(date -u +%FT%TZ) ==="
-  timeout -k 10 3000 python bench.py 2>&1 | grep -v WARNING
   echo "=== done $(date -u +%FT%TZ) ==="
 } >> "$LOG" 2>&1

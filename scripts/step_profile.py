@@ -31,6 +31,9 @@ def main() -> None:
     ap.add_argument("--grid", default="1,1,1")
     ap.add_argument("--trace-dir", default=None)
     ap.add_argument("--panel-chunk", type=int, default=None)
+    ap.add_argument("--top-other", type=int, default=0,
+                    help="also list the N heaviest ops that carry no phase "
+                    "scope (the '(other)' row), with their HLO op kinds")
     args = ap.parse_args()
 
     import jax
@@ -90,6 +93,19 @@ def main() -> None:
     flops = (2 / 3) * geom.M**3
     print(f"# per-device total {total_ms:.1f} ms -> "
           f"{flops / total_ms / 1e6:.1f} GFLOP/s aggregate")
+
+    if args.top_other:
+        hlo = compiled.as_text()
+        scope = profiler._scope_map(hlo, profiler._PHASE_RE)
+        durs = profiler._trace_durations(trace_dir)
+        # op_name metadata (when present at all) for unattributed ops shows
+        # WHICH jaxpr eqn the op came from even without a phase scope
+        meta = profiler.op_name_map(hlo)
+        rows = sorted(((ms, tok) for tok, ms in durs.items()
+                       if tok not in scope), reverse=True)
+        print(f"# top {args.top_other} unattributed ops:")
+        for ms, tok in rows[: args.top_other]:
+            print(f"  {ms:9.3f} ms  {tok:<40} {meta.get(tok, '')[:80]}")
 
 
 if __name__ == "__main__":

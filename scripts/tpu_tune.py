@@ -87,9 +87,10 @@ def main() -> None:
     ap.add_argument("--reps", type=int, default=2)
     ap.add_argument("--algo", default="lu", choices=["lu", "cholesky", "qr"])
     ap.add_argument("--configs", default=None,
-                    help="comma list precision:chunk:v, e.g. "
-                    "highest:8192:1024,high:8192:1024 (chunk ignored for "
-                    "cholesky/qr; pass 0)")
+                    help="comma list precision:chunk:v[:RxC], e.g. "
+                    "highest:8192:1024,high:8192:1024,highest:8192:1024:8x16 "
+                    "(chunk ignored for cholesky/qr; pass 0; RxC = LU "
+                    "trailing-update row x col segment counts)")
     args = ap.parse_args()
 
     import jax
@@ -115,37 +116,49 @@ def main() -> None:
     if args.configs:
         configs = []
         for c in args.configs.split(","):
-            p, chunk, v = c.split(":")
-            configs.append((p, int(chunk), int(v)))
+            parts = c.split(":")
+            p, chunk, v = parts[:3]
+            segs = None  # None = the library default for the algorithm
+            if len(parts) > 3:
+                r, _, s = parts[3].partition("x")
+                segs = (int(r), int(s))
+            configs.append((p, int(chunk), int(v), segs))
     elif args.algo == "lu":
         configs = [
-            ("highest", 8192, 1024),
-            ("high", 8192, 1024),
-            ("highest", 12288, 1024),
-            ("highest", 4096, 1024),
-            ("highest", 8192, 2048),
-            ("high", 8192, 2048),
-            ("highest", 8192, 512),
+            ("highest", 8192, 1024, None),
+            ("high", 8192, 1024, None),
+            ("highest", 12288, 1024, None),
+            ("highest", 4096, 1024, None),
+            ("highest", 8192, 2048, None),
+            ("high", 8192, 2048, None),
+            ("highest", 8192, 512, None),
         ]
     else:
         configs = [
-            ("highest", 0, 1024),
-            ("high", 0, 1024),
-            ("highest", 0, 512),
-            ("highest", 0, 2048),
+            ("highest", 0, 1024, None),
+            ("high", 0, 1024, None),
+            ("highest", 0, 512, None),
+            ("highest", 0, 2048, None),
         ]
 
-    for pname, chunk, v in configs:
+    for pname, chunk, v, segs in configs:
+        if segs is not None and args.algo == "qr":
+            print(f"algo=qr: segs field {segs} not supported (qr has no "
+                  "row segmentation); drop the :RxC suffix", flush=True)
+            continue
+        seg_kw = {} if segs is None else {"segs": segs}
+        seg_lbl = "lib" if segs is None else f"{segs[0]}x{segs[1]}"
         try:
             if args.algo == "lu":
                 from conflux_tpu.lu.distributed import lu_factor_distributed
 
                 geom = LUGeometry.create(N, N, v, grid)
 
-                def factor(s, geom=geom, chunk=chunk, pname=pname):
+                def factor(s, geom=geom, chunk=chunk, pname=pname,
+                           seg_kw=seg_kw):
                     return lu_factor_distributed(
                         s, geom, mesh, precision=prec[pname],
-                        panel_chunk=chunk, donate=True)
+                        panel_chunk=chunk, donate=True, **seg_kw)
 
                 def make(geom=geom):
                     # bench's generator, not a copy: the residual oracle
@@ -166,13 +179,13 @@ def main() -> None:
 
                 geom = CholeskyGeometry.create(N, v, grid)
 
-                def factor(s, geom=geom, pname=pname):
+                def factor(s, geom=geom, pname=pname, seg_kw=seg_kw):
                     # donate like the LU/QR branches: without it the loop
                     # pays a full-buffer copy per superstep and the rates
                     # are not comparable across cores
                     return cholesky_factor_distributed(
                         s, geom, mesh, precision=prec[pname],
-                        donate=True), None
+                        donate=True, **seg_kw), None
 
                 def make(geom=geom):
                     return jax.device_put(_spd_n(geom.N), sharding)
@@ -209,16 +222,16 @@ def main() -> None:
                 times.append(time.time() - t0)
             dim = geom.N if args.algo == "cholesky" else geom.M
             gflops = flop_coeff * dim**3 / (sum(times) / len(times)) / 1e9
-            print(f"algo={args.algo} precision={pname} chunk={chunk} v={v}: "
-                  f"{gflops:.1f} GFLOP/s", flush=True)
+            print(f"algo={args.algo} precision={pname} chunk={chunk} v={v} "
+                  f"segs={seg_lbl}: {gflops:.1f} GFLOP/s", flush=True)
             try:  # residual separately: never discard a good timing
                 res = residual(out, aux)
                 print(f"    residual={res:.3e}", flush=True)
             except Exception as e:
                 print(f"    residual FAILED: {e}", flush=True)
         except Exception as e:  # OOM / VMEM overflow at some configs
-            print(f"algo={args.algo} precision={pname} chunk={chunk} v={v}: "
-                  f"FAILED {e}", flush=True)
+            print(f"algo={args.algo} precision={pname} chunk={chunk} v={v} "
+                  f"segs={seg_lbl}: FAILED {e}", flush=True)
 
 
 if __name__ == "__main__":
