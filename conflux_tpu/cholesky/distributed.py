@@ -337,7 +337,8 @@ def cholesky_factor_distributed(shards, geom: CholeskyGeometry, mesh,
 
 
 def cholesky_distributed_host(A: np.ndarray, grid: Grid3, v: int, mesh=None,
-                              precision=None, backend: str | None = None):
+                              precision=None, backend: str | None = None,
+                              segs: tuple = (8, 8)):
     """Scatter an SPD matrix, factor on the mesh, gather L back.
 
     Role of the reference's initialize/parallelCholesky/finalize sequence
@@ -354,7 +355,8 @@ def cholesky_distributed_host(A: np.ndarray, grid: Grid3, v: int, mesh=None,
         A = Ap
     shards = geom.scatter(A)
     out = cholesky_factor_distributed(
-        jnp.asarray(shards), geom, mesh, precision=precision, backend=backend
+        jnp.asarray(shards), geom, mesh, precision=precision, backend=backend,
+        segs=segs,
     )
     L = np.tril(geom.gather(np.asarray(out)))
     return L, geom

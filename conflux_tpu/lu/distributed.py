@@ -643,7 +643,8 @@ def lu_factor_steps(shards, geom: LUGeometry, mesh, k0: int, k1: int,
 
 def lu_distributed_host(A: np.ndarray, grid: Grid3, v: int, mesh=None,
                         precision=None, backend: str | None = None,
-                        panel_chunk: int | None = None):
+                        panel_chunk: int | None = None,
+                        segs: tuple = (16, 16)):
     """Host-level convenience: scatter a global matrix, factor on the mesh,
     gather back. Returns (LU_packed (M, N) in original row order, perm (M,)).
 
@@ -658,7 +659,7 @@ def lu_distributed_host(A: np.ndarray, grid: Grid3, v: int, mesh=None,
     # program aliases input into output (frees a full matrix of HBM)
     out, perm = lu_factor_distributed(
         jnp.asarray(shards), geom, mesh, precision=precision, backend=backend,
-        panel_chunk=panel_chunk, donate=True,
+        panel_chunk=panel_chunk, donate=True, segs=segs,
     )
     perm = np.asarray(perm)
     LUp = geom.gather(np.asarray(out))  # factors in pivoted order

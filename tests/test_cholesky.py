@@ -54,6 +54,18 @@ def test_cholesky_distributed_matches_numpy():
     np.testing.assert_allclose(L, np.linalg.cholesky(A), atol=1e-8)
 
 
+def test_cholesky_distributed_segs_invariant():
+    """Segmentation (incl. the above-diagonal segment skip) partitions the
+    same per-element math: any (row, col) segment counts must give a
+    correct factor; the skipped strict-upper region is never read."""
+    N, v = 64, 8
+    A = make_spd_matrix(N, seed=7)
+    for segs in [(4, 4), (1, 1), (3, 5), (8, 8)]:
+        L, _ = cholesky_distributed_host(A, Grid3(2, 2, 2), v, segs=segs)
+        res = cholesky_residual(A, L)
+        assert res < residual_bound(N, np.float64), (segs, res)
+
+
 def test_cholesky_distributed_padding():
     N, v = 50, 8
     A = make_spd_matrix(N, seed=31)

@@ -141,6 +141,23 @@ def test_lu_distributed_chunked_matches_unchunked():
         assert res < residual_bound(N, np.float64), (chunk, res)
 
 
+def test_lu_distributed_segs_invariant():
+    """Trailing-update segmentation partitions the same per-element math:
+    any (row, col) segment counts — coarse, odd/ragged, tile-granular —
+    must produce the same permutation and a correct factorization."""
+    N, v = 64, 8
+    A = make_test_matrix(N, N, seed=9)
+    base = None
+    for segs in [(4, 8), (1, 1), (3, 5), (16, 16)]:
+        LU, perm, _ = lu_distributed_host(A, Grid3(2, 2, 2), v, segs=segs)
+        res = lu_residual(A, LU[perm], perm)
+        assert res < residual_bound(N, np.float64), (segs, res)
+        if base is None:
+            base = perm
+        else:
+            np.testing.assert_array_equal(base, perm)
+
+
 @pytest.mark.parametrize("grid", [Grid3(2, 2, 1), Grid3(4, 2, 1)], ids=str)
 @pytest.mark.parametrize("shape", [(64, 32), (32, 64)], ids=["tall", "wide"])
 def test_lu_distributed_rectangular(shape, grid):
