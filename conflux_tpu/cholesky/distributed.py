@@ -181,7 +181,10 @@ def _build(geom: CholeskyGeometry, mesh_key, precision, backend: str,
                         def seg_update(A, rlo=rlo, rhi=rhi, clo=clo, chi=chi,
                                        rm=rm, cm=cm):
                             a_seg = lax.slice(A, (rlo, clo), (rhi, chi))
-                            upd = blas.gemm(L10s[rlo:rhi], Lcs[clo:chi].T,
+                            # conj().T = herk for complex dtypes (no-op
+                            # conj on real, folded by XLA)
+                            upd = blas.gemm(L10s[rlo:rhi],
+                                            Lcs[clo:chi].conj().T,
                                             precision=precision,
                                             backend=backend)
                             keep = rm[:, None] & cm[None, :]
@@ -248,7 +251,7 @@ def _build(geom: CholeskyGeometry, mesh_key, precision, backend: str,
                     upd = blas.gemm(
                         art["L10s"],
                         lax.dynamic_slice(art["Lcs"], (lj1, i0),
-                                          (v, nlayr)).T,
+                                          (v, nlayr)).conj().T,
                         precision=precision, backend=backend)
                     slab = slab - jnp.where(art["below"][:, None], upd,
                                             jnp.zeros((), dtype))

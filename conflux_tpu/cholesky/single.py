@@ -43,15 +43,16 @@ def _cholesky_blocked(A: jax.Array, v: int, precision, backend: str):
         L00 = blas.potrf(A[off : off + v, off : off + v].astype(cdtype))
         A = A.at[off : off + v, off : off + v].set(L00.astype(A.dtype))
         if off + v < N:
-            # (2) A10 panel: X L00^T = A10 (reference `Cholesky.cpp:449-452`)
+            # (2) A10 panel: X L00^H = A10 (reference `Cholesky.cpp:449-452`;
+            # ^H == ^T for real dtypes throughout)
             L10 = blas.trsm_right_lower_t(
                 L00, A[off + v :, off : off + v].astype(cdtype)
             ).astype(A.dtype)
             A = A.at[off + v :, off : off + v].set(L10)
-            # (3) trailing syrk-style update (reference `Cholesky.cpp:333-355`)
+            # (3) trailing syrk/herk update (reference `Cholesky.cpp:333-355`)
             A = A.at[off + v :, off + v :].set(
-                blas.gemm(L10, L10.T, c=A[off + v :, off + v :], alpha=-1.0,
-                          precision=precision, backend=backend)
+                blas.gemm(L10, L10.conj().T, c=A[off + v :, off + v :],
+                          alpha=-1.0, precision=precision, backend=backend)
             )
 
     return jnp.tril(A)

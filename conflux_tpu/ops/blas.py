@@ -130,9 +130,12 @@ def trsm_right_upper(U: jax.Array, B: jax.Array) -> jax.Array:
 
 def trsm_right_lower_t(L: jax.Array, B: jax.Array) -> jax.Array:
     """Solve X L^T = B with L lower triangular (Cholesky A10 update,
-    reference `Cholesky.cpp:218-319` dtrsm)."""
+    reference `Cholesky.cpp:218-319` dtrsm). For complex dtypes the
+    transpose is Hermitian (X L^H = B) — the A = L L^H convention."""
     return lax.linalg.triangular_solve(
-        L, B, left_side=False, lower=True, transpose_a=True, unit_diagonal=False
+        L, B, left_side=False, lower=True, transpose_a=True,
+        conjugate_a=jnp.issubdtype(L.dtype, jnp.complexfloating),
+        unit_diagonal=False
     )
 
 
@@ -168,9 +171,12 @@ def trsm_left_lower(L: jax.Array, B: jax.Array) -> jax.Array:
 
 
 def trsm_left_lower_t(L: jax.Array, B: jax.Array) -> jax.Array:
-    """Solve L^T X = B with L lower triangular (Cholesky back solve)."""
+    """Solve L^T X = B with L lower triangular (Cholesky back solve).
+    For complex dtypes the transpose is Hermitian (L^H X = B)."""
     return lax.linalg.triangular_solve(
-        L, B, left_side=True, lower=True, transpose_a=True, unit_diagonal=False
+        L, B, left_side=True, lower=True, transpose_a=True,
+        conjugate_a=jnp.issubdtype(L.dtype, jnp.complexfloating),
+        unit_diagonal=False
     )
 
 

@@ -176,9 +176,11 @@ def _build_lu_solve(geom, mesh_key):
             return lax.dynamic_update_slice(xv, xk, (kv, i0))
 
         xv = lax.fori_loop(0, n, bwd, jnp.zeros((geom.N, nrhs), dtype))
-        # replicated by construction (pure collectives); pmax satisfies the
+        # replicated by construction (pure collectives); replicate (a
+        # complex-safe pmax) satisfies the
         # out_spec's replication check
-        return lax.pmax(xv, (AXIS_X, AXIS_Y, AXIS_Z))
+        from conflux_tpu.parallel.mesh import replicate
+        return replicate(xv, (AXIS_X, AXIS_Y, AXIS_Z))
 
     fn = jax.shard_map(
         device_fn,
@@ -256,7 +258,9 @@ def _build_cholesky_solve(geom, mesh_key):
                                             (Ml, v)),
                           jnp.zeros((), dtype)), AXIS_Y)
             ahead = grow >= (k + 1) * v
-            s = jnp.matmul(cols.T, jnp.where(ahead[:, None], xv[grow], 0.0),
+            # conj().T: the back sweep applies L^H for complex dtypes
+            s = jnp.matmul(cols.conj().T,
+                           jnp.where(ahead[:, None], xv[grow], 0.0),
                            precision=lax.Precision.HIGHEST)
             s = lax.psum(s, AXIS_X)  # (v, nrhs)
             idx = jnp.where((grow >= k * v) & (grow < (k + 1) * v),
@@ -270,7 +274,8 @@ def _build_cholesky_solve(geom, mesh_key):
             return lax.dynamic_update_slice(xv, xk, (kv, i0))
 
         xv = lax.fori_loop(0, n, bwd, jnp.zeros((geom.N, nrhs), dtype))
-        return lax.pmax(xv, (AXIS_X, AXIS_Y, AXIS_Z))
+        from conflux_tpu.parallel.mesh import replicate
+        return replicate(xv, (AXIS_X, AXIS_Y, AXIS_Z))
 
     fn = jax.shard_map(
         device_fn,

@@ -138,8 +138,9 @@ def _build_lu_residual(geom, mesh_key):
                       (AXIS_X, AXIS_Y, AXIS_Z), to="varying"))
 
         R = Ap - prod
-        rss = lax.psum(jnp.sum(R * R), (AXIS_X, AXIS_Y))
-        ass = lax.psum(jnp.sum(Aloc * Aloc), (AXIS_X, AXIS_Y))
+        rss = lax.psum(jnp.sum((R * jnp.conj(R)).real), (AXIS_X, AXIS_Y))
+        ass = lax.psum(jnp.sum((Aloc * jnp.conj(Aloc)).real),
+                       (AXIS_X, AXIS_Y))
         # identical across z already; pmax satisfies replication
         return (lax.pmax(rss, AXIS_Z), lax.pmax(ass, AXIS_Z))
 
@@ -154,21 +155,23 @@ def _build_lu_residual(geom, mesh_key):
 
 
 def cholesky_residual(A, L) -> float:
-    """Normalized ||A - L L^T||_F / ||A||_F for a lower Cholesky factor."""
+    """Normalized ||A - L L^H||_F / ||A||_F for a lower Cholesky factor
+    (^H == ^T for real dtypes)."""
     A = np.asarray(A)
     L = np.tril(np.asarray(L))
-    R = A - L @ L.T
+    R = A - L @ L.conj().T
     return float(np.linalg.norm(R) / max(np.linalg.norm(A), 1e-30))
 
 
 def cholesky_residual_distributed(A_shards, L_shards, geom, mesh) -> float:
-    """Gather-free ||A - L L^T||_F / ||A||_F on the mesh — the Cholesky
+    """Gather-free ||A - L L^H||_F / ||A||_F on the mesh — the Cholesky
     counterpart of :func:`lu_residual_distributed` (reference pdgemm
-    validation role). One SUMMA pass: for each column tile t, the lower-
-    triangular column slab of L is y-broadcast and its transpose-rows are
-    delivered to column owners by the same masked-psum exchange the
-    factorization's scatterA11 uses; every device accumulates its share of
-    L L^T. No (N, N) array exists anywhere.
+    validation role; ^H == ^T for real dtypes). One SUMMA pass: for each
+    column tile t, the lower-triangular column slab of L is y-broadcast
+    and its conjugate-transpose-rows are delivered to column owners by the
+    same masked-psum exchange the factorization's scatterA11 uses; every
+    device accumulates its share of L L^H. No (N, N) array exists
+    anywhere.
     """
     from conflux_tpu.parallel.mesh import mesh_cache_key
 
@@ -221,7 +224,8 @@ def _build_cholesky_residual(geom, mesh_key):
                 jnp.take(Lcol, col_local_row, axis=0, mode="fill",
                          fill_value=0),
                 jnp.zeros((), dtype))
-            LrowT = lax.psum(from_L, AXIS_X).T  # (v, Nl)
+            # conj().T: the product is L L^H for complex dtypes
+            LrowT = lax.psum(from_L, AXIS_X).conj().T  # (v, Nl)
             return acc + jnp.matmul(Lcol, LrowT,
                                     precision=lax.Precision.HIGHEST)
 
@@ -230,8 +234,8 @@ def _build_cholesky_residual(geom, mesh_key):
         prod = lax.fori_loop(0, Nt, summa, zero0)
 
         R = Aloc - prod
-        rss = lax.psum(jnp.sum(R * R), (AXIS_X, AXIS_Y))
-        ass = lax.psum(jnp.sum(Aloc * Aloc), (AXIS_X, AXIS_Y))
+        rss = lax.psum(jnp.sum((R * jnp.conj(R)).real), (AXIS_X, AXIS_Y))
+        ass = lax.psum(jnp.sum((Aloc * jnp.conj(Aloc)).real), (AXIS_X, AXIS_Y))
         return (lax.pmax(rss, AXIS_Z), lax.pmax(ass, AXIS_Z))
 
     fn = jax.shard_map(
@@ -268,6 +272,19 @@ def make_spd_matrix(N: int, seed: int = 7, dtype=np.float64) -> np.ndarray:
     rng = np.random.default_rng(seed)
     B = rng.uniform(-1.0, 1.0, size=(N, N)).astype(dtype)
     A = (B + B.T) / 2
+    A[np.arange(N), np.arange(N)] += N
+    return A
+
+
+def make_hpd_matrix(N: int, seed: int = 7,
+                    dtype=np.complex128) -> np.ndarray:
+    """Deterministic Hermitian positive-definite matrix (the complex
+    instantiation of :func:`make_spd_matrix`: random Hermitian + diagonal
+    dominance; the diagonal is real by construction)."""
+    rng = np.random.default_rng(seed)
+    B = (rng.uniform(-1.0, 1.0, size=(N, N))
+         + 1j * rng.uniform(-1.0, 1.0, size=(N, N))).astype(dtype)
+    A = (B + B.conj().T) / 2
     A[np.arange(N), np.arange(N)] += N
     return A
 
@@ -338,7 +355,7 @@ def _build_qr_residual(geom, mesh_key):
                    == (t * v + jnp.arange(v, dtype=jnp.int32))[None, :])
             E = strip - eye.astype(dtype)
             oss = oss + jnp.where(
-                x == 0, jnp.sum(jnp.abs(E) ** 2).real, 0.0)
+                x == 0, jnp.sum((E * jnp.conj(E)).real), 0.0)
             return prod, oss
 
         rdtype = jnp.zeros((), dtype).real.dtype
@@ -348,8 +365,8 @@ def _build_qr_residual(geom, mesh_key):
                          (AXIS_X, AXIS_Y, AXIS_Z), to="varying")
         prod, oss = lax.fori_loop(0, Nt, body, (zero, zoss))
         E = Aloc - prod
-        rss = lax.psum(jnp.sum(jnp.abs(E) ** 2).real, (AXIS_X, AXIS_Y))
-        ass = lax.psum(jnp.sum(jnp.abs(Aloc) ** 2).real, (AXIS_X, AXIS_Y))
+        rss = lax.psum(jnp.sum((E * jnp.conj(E)).real), (AXIS_X, AXIS_Y))
+        ass = lax.psum(jnp.sum((Aloc * jnp.conj(Aloc)).real), (AXIS_X, AXIS_Y))
         oss = lax.psum(oss, (AXIS_X, AXIS_Y))
         return (lax.pmax(rss, AXIS_Z), lax.pmax(ass, AXIS_Z),
                 lax.pmax(oss, AXIS_Z))
