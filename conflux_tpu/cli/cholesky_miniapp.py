@@ -22,6 +22,7 @@ from conflux_tpu.cli.common import (
     add_experiment_type_arg,
     np_dtype,
     result_line,
+    segs_arg,
     setup_platform,
     sync,
 )
@@ -38,6 +39,11 @@ def parse_args(argv=None):
         "--lookahead", action="store_true",
         help="software-pipelined loop: overlap the next panel reduce "
         "with the trailing update (multi-chip meshes; P8)",
+    )
+    p.add_argument(
+        "--segs", default=None, metavar="RxC", type=segs_arg,
+        help="trailing-update row x col segment counts, e.g. 8x8 "
+        "(default: tuned library value)",
     )
     add_experiment_type_arg(p)
     add_common_args(p)
@@ -80,6 +86,7 @@ def main(argv=None) -> int:
     # compile on a 1x1x1 mesh) for very deep factorizations
     single = grid.P == 1 and geom.Kappa <= 64
     mesh = None if single else make_mesh(grid, devices=jax.devices()[: grid.P])
+    seg_kw = {} if args.segs is None else {"segs": args.segs}
     with profiler.region("init_matrix"):
         A = make_spd_matrix(geom.N, dtype=dtype)
         dev = jnp.asarray(A) if single else jnp.asarray(geom.scatter(A))
@@ -97,7 +104,7 @@ def main(argv=None) -> int:
                     out = cholesky_blocked(dev, v=geom.v)
                 else:
                     out = cholesky_factor_distributed(
-                        dev, geom, mesh, lookahead=args.lookahead)
+                        dev, geom, mesh, lookahead=args.lookahead, **seg_kw)
                 sync(out)
         if rep > 0:
             times.append(t.ms)
@@ -136,7 +143,8 @@ def main(argv=None) -> int:
             from conflux_tpu.cli.common import phase_profile
 
             phase_profile(
-                build_program(geom, mesh, lookahead=args.lookahead), dev)
+                build_program(geom, mesh, lookahead=args.lookahead,
+                              **seg_kw), dev)
         profiler.report()
     return 0
 

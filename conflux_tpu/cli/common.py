@@ -28,6 +28,19 @@ def add_common_args(p: argparse.ArgumentParser) -> None:
     p.add_argument("--profile", action="store_true", help="print region timings")
 
 
+def segs_arg(text: str) -> tuple[int, int]:
+    """argparse type for --segs RxC (e.g. '16x16'): two positive ints."""
+    r, sep, c = text.lower().partition("x")
+    try:
+        segs = (int(r), int(c))
+    except ValueError:
+        segs = None
+    if not sep or segs is None or segs[0] < 1 or segs[1] < 1:
+        raise argparse.ArgumentTypeError(
+            f"expected RxC with positive integers (e.g. 16x16), got {text!r}")
+    return segs
+
+
 def setup_platform(args) -> None:
     """Must run before any JAX backend initializes."""
     if args.platform == "cpu":

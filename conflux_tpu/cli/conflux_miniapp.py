@@ -26,6 +26,7 @@ from conflux_tpu.cli.common import (
     add_experiment_type_arg,
     np_dtype,
     result_line,
+    segs_arg,
     setup_platform,
     sync,
 )
@@ -51,6 +52,12 @@ def parse_args(argv=None):
         "--election", default="gather", choices=["gather", "butterfly"],
         help="cross-x pivot election: one all_gather tournament, or the "
         "reference's log2(Px) ppermute hypercube (power-of-two Px)",
+    )
+    p.add_argument(
+        "--segs", default=None, metavar="RxC", type=segs_arg,
+        help="trailing-update row x col segment counts, e.g. 16x16 "
+        "(default: tuned library value); finer cuts dead-region flop "
+        "overshoot at the cost of more per-step conds",
     )
     add_experiment_type_arg(p)
     add_common_args(p)
@@ -90,6 +97,7 @@ def main(argv=None) -> int:
     # in O(1) (see conflux_tpu/lu/single.py docstring).
     single = grid.P == 1 and geom.n_steps <= 64
     mesh = None if single else make_mesh(grid, devices=jax.devices()[: grid.P])
+    seg_kw = {} if args.segs is None else {"segs": args.segs}
     with profiler.region("init_matrix"):
         A = make_test_matrix(geom.M, geom.N, dtype=dtype)
         dev = jnp.asarray(A) if single else jnp.asarray(geom.scatter(A))
@@ -108,7 +116,7 @@ def main(argv=None) -> int:
                 else:
                     out, perm_dev = lu_factor_distributed(
                         dev, geom, mesh, lookahead=args.lookahead,
-                        election=args.election)
+                        election=args.election, **seg_kw)
                 sync(out)
         if rep > 0:
             times.append(t.ms)
@@ -138,7 +146,7 @@ def main(argv=None) -> int:
 
             phase_profile(
                 build_program(geom, mesh, lookahead=args.lookahead,
-                              election=args.election), dev)
+                              election=args.election, **seg_kw), dev)
         profiler.report()
     return 0
 
