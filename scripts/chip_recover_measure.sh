@@ -25,8 +25,9 @@ done
   echo "=== bench.py (LU 16x16 segs default at-scale gate) $(date -u +%FT%TZ) ==="
   timeout -k 10 3000 python bench.py 2>&1 | grep -v WARNING
   echo "=== cholesky N=32768 (triangle-skip at-scale gate) $(date -u +%FT%TZ) ==="
-  timeout -k 10 2400 python scripts/tpu_tune.py --algo cholesky -N 32768 \
-    --reps 2 --configs highest:0:1024,high:0:1024 2>&1 | grep -v WARNING
+  timeout -k 10 3000 python scripts/tpu_tune.py --algo cholesky -N 32768 \
+    --reps 2 --configs highest:0:1024,high:0:1024,highest:0:1024:16x16 \
+    2>&1 | grep -v WARNING
   echo "=== tune LU taller nomination chunks $(date -u +%FT%TZ) ==="
   timeout -k 10 2400 python scripts/tpu_tune.py -N 32768 --reps 2 \
     --configs highest:12288:1024,highest:10240:1024 2>&1 | grep -v WARNING
