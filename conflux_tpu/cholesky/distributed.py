@@ -296,6 +296,10 @@ def build_program(geom: CholeskyGeometry, mesh, precision=None,
     backend = blas.get_backend() if backend is None else backend
     if donate and next(iter(mesh.devices.flat)).platform == "cpu":
         donate = False  # CPU PJRT has no buffer donation (warns per call)
+    if len(segs) != 2 or segs[0] < 1 or segs[1] < 1:
+        raise ValueError(
+            f"segs must be two positive segment counts, got {segs!r} "
+            "(non-positive counts would silently skip trailing updates)")
     return _build(geom, mesh_cache_key(mesh), precision, backend, donate,
                   resumable, lookahead, tuple(segs))
 

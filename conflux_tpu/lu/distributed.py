@@ -544,6 +544,10 @@ def build_program(geom: LUGeometry, mesh, precision=None,
             f"butterfly election needs a power-of-two Px, got {Px} "
             "(a missing hypercube partner strands candidate subsets; "
             "use election='gather' for this grid)")
+    if len(segs) != 2 or segs[0] < 1 or segs[1] < 1:
+        raise ValueError(
+            f"segs must be two positive segment counts, got {segs!r} "
+            "(non-positive counts would silently skip trailing updates)")
     return _build(geom, mesh_cache_key(mesh), precision, backend,
                   panel_chunk, donate, resumable, lookahead, election,
                   tuple(segs))

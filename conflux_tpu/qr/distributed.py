@@ -194,7 +194,8 @@ def qr_distributed_host(A: np.ndarray, Px: int, mesh=None,
 
 @functools.lru_cache(maxsize=32)
 def _build_full(geom, mesh_key, precision, backend: str, chunk: int,
-                donate: bool = False, resumable: bool = False):
+                donate: bool = False, resumable: bool = False,
+                csegs: int = 8):
     """Blocked distributed QR over the full (x, y, z) mesh.
 
     The general-matrix companion of `tsqr_distributed`, in the same design
@@ -240,7 +241,10 @@ def _build_full(geom, mesh_key, precision, backend: str, chunk: int,
     # x-rank, padded so every x-rank holds whole tiles (r_geometry pads
     # the global row count the same way; pad tiles are never written)
     Nlr = (geom.Nt // Px + (1 if geom.Nt % Px else 0)) * v
-    col_segs = ragged_segments(geom.Ntl, v, 8)
+    # trailing-update column segmentation (`csegs` segments): the QR
+    # loop's analogue of the LU/Cholesky segs knob (rows are never
+    # segmented here — every local row participates in every panel)
+    col_segs = ragged_segments(geom.Ntl, v, csegs)
 
     def _vary(val):
         # mark a literal as varying over every mesh axis so lax.cond
@@ -454,7 +458,7 @@ def _build_full(geom, mesh_key, precision, backend: str, chunk: int,
 
 def build_program(geom, mesh, precision=None, backend: str | None = None,
                   chunk: int | None = None, donate: bool = False,
-                  resumable: bool = False):
+                  resumable: bool = False, csegs: int = 8):
     """The jitted block-cyclic QR program itself (cached per config) —
     the single point resolving trace-time defaults, mirroring
     `lu.distributed.build_program`. Direct use is for callers needing
@@ -464,13 +468,18 @@ def build_program(geom, mesh, precision=None, backend: str | None = None,
     chunk = blas._PANEL_CHUNK if chunk is None else chunk
     if donate and next(iter(mesh.devices.flat)).platform == "cpu":
         donate = False
+    if csegs < 1:
+        raise ValueError(
+            f"csegs must be a positive segment count, got {csegs} "
+            "(non-positive counts would silently skip trailing updates)")
     return _build_full(geom, mesh_cache_key(mesh), precision, backend,
-                       chunk, donate, resumable)
+                       chunk, donate, resumable, csegs)
 
 
 def qr_factor_distributed(shards, geom, mesh, precision=None,
                           backend: str | None = None,
-                          chunk: int | None = None, donate: bool = False):
+                          chunk: int | None = None, donate: bool = False,
+                          csegs: int = 8):
     """Blocked QR of block-cyclic (Px, Py, Ml, Nl) shards on the mesh.
 
     Returns (Q_shards, R_shards): Q thin (M, N) in A's layout, R upper-
@@ -482,7 +491,7 @@ def qr_factor_distributed(shards, geom, mesh, precision=None,
     shards = jnp.asarray(shards)
     check_shards(shards, geom)
     fn = build_program(geom, mesh, precision=precision, backend=backend,
-                       chunk=chunk, donate=donate)
+                       chunk=chunk, donate=donate, csegs=csegs)
     return fn(shards)
 
 

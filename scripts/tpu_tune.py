@@ -142,12 +142,18 @@ def main() -> None:
         ]
 
     for pname, chunk, v, segs in configs:
-        if segs is not None and args.algo == "qr":
-            print(f"algo=qr: segs field {segs} not supported (qr has no "
-                  "row segmentation); drop the :RxC suffix", flush=True)
-            continue
-        seg_kw = {} if segs is None else {"segs": segs}
-        seg_lbl = "lib" if segs is None else f"{segs[0]}x{segs[1]}"
+        if args.algo == "qr":
+            # qr segments columns only: the 4th field is a single csegs
+            # count written as 1xC (row part must be 1)
+            if segs is not None and segs[0] != 1:
+                print(f"algo=qr: segs {segs} not supported (qr has no row "
+                      "segmentation); write the 4th field as 1xC", flush=True)
+                continue
+            seg_kw = {} if segs is None else {"csegs": segs[1]}
+            seg_lbl = "lib" if segs is None else f"1x{segs[1]}"
+        else:
+            seg_kw = {} if segs is None else {"segs": segs}
+            seg_lbl = "lib" if segs is None else f"{segs[0]}x{segs[1]}"
         try:
             if args.algo == "lu":
                 from conflux_tpu.lu.distributed import lu_factor_distributed
@@ -199,9 +205,10 @@ def main() -> None:
 
                 geom = LUGeometry.create(N, N, v, grid)
 
-                def factor(s, geom=geom, pname=pname):
+                def factor(s, geom=geom, pname=pname, seg_kw=seg_kw):
                     return qr_factor_distributed(
-                        s, geom, mesh, precision=prec[pname], donate=True)
+                        s, geom, mesh, precision=prec[pname], donate=True,
+                        **seg_kw)
 
                 def make(geom=geom):
                     return jax.device_put(bench_mod._make_n(geom.M), sharding)
