@@ -28,11 +28,13 @@ done
   timeout -k 10 3000 python scripts/tpu_tune.py --algo cholesky -N 32768 \
     --reps 2 --configs highest:0:1024,high:0:1024,highest:0:1024:16x16 \
     2>&1 | grep -v WARNING
-  echo "=== tune LU taller nomination chunks $(date -u +%FT%TZ) ==="
-  timeout -k 10 2400 python scripts/tpu_tune.py -N 32768 --reps 2 \
-    --configs highest:12288:1024,highest:10240:1024 2>&1 | grep -v WARNING
   echo "=== qr N=16384 $(date -u +%FT%TZ) ==="
   timeout -k 10 2400 python scripts/tpu_tune.py --algo qr -N 16384 \
     --reps 2 --configs highest:0:1024 2>&1 | grep -v WARNING
+  echo "=== tune LU taller nomination chunks (LAST: the round-2 wedge "
+  echo "    started during the 12288 trial — quarantine the risky configs"
+  echo "    behind everything else) $(date -u +%FT%TZ) ==="
+  timeout -k 10 2400 python scripts/tpu_tune.py -N 32768 --reps 2 \
+    --configs highest:12288:1024,highest:10240:1024 2>&1 | grep -v WARNING
   echo "=== done $(date -u +%FT%TZ) ==="
 } >> "$LOG" 2>&1
