@@ -28,6 +28,18 @@ def add_common_args(p: argparse.ArgumentParser) -> None:
     p.add_argument("--profile", action="store_true", help="print region timings")
 
 
+def positive_int(text: str) -> int:
+    """argparse type for counts that must be >= 1."""
+    try:
+        n = int(text)
+    except ValueError:
+        n = 0
+    if n < 1:
+        raise argparse.ArgumentTypeError(
+            f"expected a positive integer, got {text!r}")
+    return n
+
+
 def segs_arg(text: str) -> tuple[int, int]:
     """argparse type for --segs RxC (e.g. '16x16'): two positive ints."""
     r, sep, c = text.lower().partition("x")

@@ -28,6 +28,7 @@ from conflux_tpu.cli.common import (
     add_common_args,
     add_experiment_type_arg,
     np_dtype,
+    positive_int,
     result_line,
     setup_platform,
     sync,
@@ -48,6 +49,9 @@ def parse_args(argv=None):
                    "log2(Px) ppermute hypercube (power-of-two Px)")
     p.add_argument("--full", action="store_true",
                    help="general block-cyclic QR on the (x, y, z) mesh")
+    p.add_argument("--csegs", type=positive_int, default=None, metavar="C",
+                   help="trailing-update column segment count for --full "
+                   "(default: tuned library value)")
     p.add_argument("-r", "--run", type=int, default=2, help="timed reps")
     p.add_argument("--validate", action="store_true",
                    help="orthogonality + reconstruction residuals")
@@ -80,6 +84,8 @@ def main(argv=None) -> int:
     if args.full:
         from conflux_tpu.qr.distributed import qr_factor_distributed
 
+        seg_kw = {} if args.csegs is None else {"csegs": args.csegs}
+
         v = args.block or 256
         grid = (Grid3.parse(args.p_grid) if args.p_grid
                 else choose_grid(n_devices, args.M, args.cols))
@@ -99,7 +105,7 @@ def main(argv=None) -> int:
         algo_name, N_rep, vrep = "qr", geom.N, v
 
         def factor():
-            return qr_factor_distributed(dev, geom, mesh)
+            return qr_factor_distributed(dev, geom, mesh, **seg_kw)
 
     else:
         from conflux_tpu.qr.distributed import (
@@ -178,7 +184,7 @@ def main(argv=None) -> int:
             from conflux_tpu.cli.common import phase_profile
             from conflux_tpu.qr.distributed import build_program
 
-            phase_profile(build_program(geom, mesh), dev)
+            phase_profile(build_program(geom, mesh, **seg_kw), dev)
         profiler.report()
     return 0
 
