@@ -28,6 +28,9 @@ done
   timeout -k 10 3000 python scripts/tpu_tune.py --algo cholesky -N 32768 \
     --reps 2 --configs highest:0:1024,high:0:1024,highest:0:1024:16x16 \
     2>&1 | grep -v WARNING
+  echo "=== LU segmentation refinement probe $(date -u +%FT%TZ) ==="
+  timeout -k 10 2400 python scripts/tpu_tune.py -N 32768 --reps 2 \
+    --configs highest:8192:1024:32x16 2>&1 | grep -v WARNING
   echo "=== qr N=16384 $(date -u +%FT%TZ) ==="
   timeout -k 10 2400 python scripts/tpu_tune.py --algo qr -N 16384 \
     --reps 2 --configs highest:0:1024 2>&1 | grep -v WARNING
