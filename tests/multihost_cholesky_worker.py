@@ -1,0 +1,62 @@
+"""Worker for the two-process multihost Cholesky test (`test_multihost.py`).
+
+Same execution model as `multihost_worker.py` (the LU form): each
+process brings up `jax.distributed`, contributes 4 virtual CPU devices
+to an 8-device mesh, materializes ONLY its own block-cyclic shards from
+an SPD position formula, factors with the distributed 2.5D Cholesky, and
+validates gather-free on the mesh.
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(__file__))
+import mh_common  # noqa: F401  (must precede jax backend init)
+
+pid, nproc, port = int(sys.argv[1]), int(sys.argv[2]), sys.argv[3]
+grid_arg = sys.argv[4] if len(sys.argv) > 4 else "4,2,1"
+
+import jax  # noqa: E402
+import numpy as np  # noqa: E402
+
+from conflux_tpu.cholesky.distributed import (  # noqa: E402
+    cholesky_factor_distributed,
+)
+from conflux_tpu.geometry import CholeskyGeometry, Grid3  # noqa: E402
+from conflux_tpu.parallel.mesh import (  # noqa: E402
+    distribute_shards,
+    initialize_multihost,
+    make_mesh,
+)
+from conflux_tpu.validation import cholesky_residual_distributed  # noqa: E402
+
+initialize_multihost(f"localhost:{port}", nproc, pid)
+assert len(jax.devices()) == 8, jax.devices()
+
+grid = Grid3.parse(grid_arg)
+v = 8
+geom = CholeskyGeometry.create(v * 8, v, grid)
+mesh = make_mesh(grid, devices=jax.devices()[: grid.P])
+
+calls: list[tuple[int, int]] = []
+
+
+def local_shard(px, py):
+    calls.append((px, py))
+    # the library's tile-local SPD generator (the reference's per-rank
+    # InitMatrix role) — exactly one device's shard, no global matrix
+    from conflux_tpu.io import generate_spd_local
+
+    return generate_spd_local(geom, px, py, dtype=np.float32)
+
+
+shards = distribute_shards(
+    local_shard, mesh, shape=(grid.Px, grid.Py, geom.Ml, geom.Nl),
+    dtype=np.float32)
+out = cholesky_factor_distributed(shards, geom, mesh)
+res = float(cholesky_residual_distributed(shards, out, geom, mesh))
+n_local = len(set(calls))
+mine = mh_common.my_shard_coords(mesh)
+print(f"proc {pid}: local_shards={n_local} residual={res:.3e}", flush=True)
+assert n_local == len(mine), (pid, sorted(set(calls)), mine)
+assert res < 1e-5, res

@@ -68,6 +68,17 @@ def test_two_process_multihost_lu(gridspec, shards_per_proc):
 
 
 @pytest.mark.slow
+def test_two_process_multihost_cholesky():
+    """Core parity: the distributed Cholesky runs the same real
+    two-process model as the LU (jax.distributed, per-process shard
+    materialization, gather-free on-mesh validation)."""
+    results = _run_workers("multihost_cholesky_worker.py", ["2,2,2"])
+    for pid, (rc, out) in enumerate(results):
+        assert rc == 0, f"proc {pid} failed:\n{out[-3000:]}"
+        assert f"proc {pid}: local_shards=2 residual=" in out
+
+
+@pytest.mark.slow
 def test_peer_failure_detected_in_bounded_time():
     """Failure detection (beyond the reference, which has none: a lost MPI
     rank hangs the job): when one process dies, the coordination service's
