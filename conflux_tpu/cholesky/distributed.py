@@ -306,7 +306,8 @@ def build_program(geom: CholeskyGeometry, mesh, precision=None,
 
 def cholesky_factor_steps(shards, geom: CholeskyGeometry, mesh,
                           k0: int, k1: int, precision=None,
-                          backend: str | None = None, donate: bool = False):
+                          backend: str | None = None, donate: bool = False,
+                          segs: tuple = (8, 8)):
     """Factor supersteps [k0, k1) only — checkpoint/restart for Cholesky
     (no pivot state to carry, unlike `lu.distributed.lu_factor_steps`):
     feed each call's output shards into the next; after the last call the
@@ -314,12 +315,15 @@ def cholesky_factor_steps(shards, geom: CholeskyGeometry, mesh,
     bit-identically when Pz == 1; with Pz > 1 the checkpoint consolidates
     the 2.5D z-partial sums, so a resumed run is numerically equivalent
     but re-associates f32 additions (same caveat as `lu_factor_steps`).
+    `segs` matches `cholesky_factor_distributed` so a resumed run keeps
+    the tuned segmentation of the run it resumes (segmentation is
+    math-invariant; only performance differs).
     """
     if not (0 <= k0 < k1 <= geom.Kappa):
         raise ValueError(f"step range [{k0}, {k1}) outside [0, {geom.Kappa})")
     # traced step bounds: one compiled program serves every segment
     fn = build_program(geom, mesh, precision=precision, backend=backend,
-                       donate=donate, resumable=True)
+                       donate=donate, resumable=True, segs=segs)
     return fn(shards, jnp.int32(k0), jnp.int32(k1))
 
 

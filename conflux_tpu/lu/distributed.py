@@ -80,7 +80,7 @@ _DEFAULT_PANEL_CHUNK = 8192
 def _build(geom: LUGeometry, mesh_key, precision, backend: str,
            panel_chunk: int, donate: bool = False, resumable: bool = False,
            lookahead: bool = False, election: str = "gather",
-           segs: tuple = (16, 16)):
+           segs: tuple = (16, 16), tree: str = "pairwise"):
     """resumable=True builds the checkpoint/restart form: factor supersteps
     [k0, k1) given as TRACED scalars — one compile serves every segment of
     a checkpointed run — with the row-origin state as an explicit
@@ -175,12 +175,13 @@ def _build(geom: LUGeometry, mesh_key, precision, backend: str,
             if Px == 1:
                 # single x-rank: the local nomination IS the election
                 lu00, top = blas.tournament_winners(
-                    cand, chunk=panel_chunk, chunk_live=chunk_live)
+                    cand, chunk=panel_chunk, chunk_live=chunk_live,
+                    tree=tree)
                 wpos = jnp.take(pos_m, top, mode="fill",
                                 fill_value=_GRI_SENTINEL)
                 return lu00, wpos
             _, top = blas.tournament_winners(
-                cand, chunk=panel_chunk, chunk_live=chunk_live)
+                cand, chunk=panel_chunk, chunk_live=chunk_live, tree=tree)
             nom = jnp.take(cand, top, axis=0, mode="fill",
                            fill_value=0)
             nid = jnp.take(pos_m, top, mode="fill",
@@ -219,7 +220,7 @@ def _build(geom: LUGeometry, mesh_key, precision, backend: str,
             # structure), so its chunk stays within the batched
             # VMEM-safe bound
             lu00, wid = blas.tournament_winners(
-                flat, chunk=min(panel_chunk, blas._PANEL_CHUNK))
+                flat, chunk=min(panel_chunk, blas._PANEL_CHUNK), tree=tree)
             # winners' positions in pivot order — replicated on
             # every device, no broadcast needed
             wpos = jnp.take(poss.reshape(Px * v), wid, mode="fill",
@@ -520,7 +521,7 @@ def build_program(geom: LUGeometry, mesh, precision=None,
                   backend: str | None = None, panel_chunk: int | None = None,
                   donate: bool = False, resumable: bool = False,
                   lookahead: bool = False, election: str = "gather",
-                  segs: tuple = (16, 16)):
+                  segs: tuple = (16, 16), tree: str = "pairwise"):
     """The jitted distributed-LU program itself (cached per config).
 
     The single point resolving the trace-time defaults (precision/backend/
@@ -548,16 +549,48 @@ def build_program(geom: LUGeometry, mesh, precision=None,
         raise ValueError(
             f"segs must be two positive segment counts, got {segs!r} "
             "(non-positive counts would silently skip trailing updates)")
+    if tree not in ("pairwise", "flat"):
+        raise ValueError(f"unknown tree {tree!r} (pairwise|flat)")
+    if tree == "flat":
+        # the flat election is ONE (nch*v, v) LU custom call per
+        # tournament; keep every such stack within the measured
+        # single-call VMEM-safe height (8192 ok, 16384 fails to compile
+        # on v5e — ops/blas.py panel notes). Two tournaments can go
+        # flat: the local nomination over Ml rows, and (gather election,
+        # Px > 1) the cross-x election over the Px*v nominee panel,
+        # whose chunk is additionally capped at blas._PANEL_CHUNK (the
+        # elect() call site). Butterfly's pair reductions are 2v tall —
+        # single-chunk at any legal v, never a flat stack.
+        v = geom.v
+        stacks = []
+        _, nch = blas.chunk_layout(geom.Ml, v, panel_chunk)
+        if nch > 1:
+            stacks.append(nch * v)
+        if geom.grid.Px > 1 and election == "gather":
+            _, nch2 = blas.chunk_layout(
+                geom.grid.Px * v, v, min(panel_chunk, blas._PANEL_CHUNK))
+            if nch2 > 1:
+                stacks.append(nch2 * v)
+        # scoped-VMEM footprint scales with rows*v elements; the measured
+        # safe point is 8192 rows AT v=1024 (16384x1024 fails), so bound
+        # the element count, not the row count
+        if stacks and max(stacks) * v > 8192 * 1024:
+            raise ValueError(
+                f"tree='flat' would stack {max(stacks)} nominee rows of "
+                f"width {v} in one LU call (> the 8192x1024-element "
+                "VMEM-safe size); raise panel_chunk or use "
+                "tree='pairwise'")
     return _build(geom, mesh_cache_key(mesh), precision, backend,
                   panel_chunk, donate, resumable, lookahead, election,
-                  tuple(segs))
+                  tuple(segs), tree)
 
 
 def lu_factor_distributed(shards, geom: LUGeometry, mesh,
                           precision=None, backend: str | None = None,
                           panel_chunk: int | None = None,
                           donate: bool = False, lookahead: bool = False,
-                          election: str = "gather", segs: tuple = (16, 16)):
+                          election: str = "gather", segs: tuple = (16, 16),
+                          tree: str = "pairwise"):
     """Factor block-cyclic shards (Px, Py, Ml, Nl) in place on a mesh.
 
     Returns (shards_out, perm): shards_out holds the packed factors in
@@ -584,6 +617,11 @@ def lu_factor_distributed(shards, geom: LUGeometry, mesh,
     trailing GEMMs, letting XLA overlap the election collectives with
     compute on a mesh (P8; bitwise-identical results, ~one extra
     (Ml, v)-slab GEMM per superstep of redundant work).
+    `tree` shapes the election's reduction ('pairwise' binary tree vs
+    'flat' single stacked LU — fewer sequential latency-bound custom
+    calls; see `ops.blas.tournament_winners`). Both are valid CALU
+    elections; pivot choices can differ on ties, so results are
+    comparable by residual, not bitwise.
     """
     from conflux_tpu.geometry import check_shards
 
@@ -592,14 +630,15 @@ def lu_factor_distributed(shards, geom: LUGeometry, mesh,
     fn = build_program(geom, mesh, precision=precision, backend=backend,
                        panel_chunk=panel_chunk, donate=donate,
                        lookahead=lookahead, election=election,
-                       segs=segs)
+                       segs=segs, tree=tree)
     return fn(shards)
 
 
 def lu_factor_steps(shards, geom: LUGeometry, mesh, k0: int, k1: int,
                     orig=None, precision=None, backend: str | None = None,
                     panel_chunk: int | None = None, donate: bool = False,
-                    election: str = "gather"):
+                    election: str = "gather", segs: tuple = (16, 16),
+                    tree: str = "pairwise"):
     """Factor supersteps [k0, k1) only — the checkpoint/restart primitive.
 
     The reference has no notion of resuming a partial factorization
@@ -638,17 +677,21 @@ def lu_factor_steps(shards, geom: LUGeometry, mesh, k0: int, k1: int,
         # same gri map the geometry exposes)
         orig = jnp.asarray(geom.global_row_index(), jnp.int32)
     # the step bounds are traced scalars: every segment of a checkpointed
-    # run reuses ONE compiled program
+    # run reuses ONE compiled program. `segs` rides through so a resumed
+    # run keeps the tuned segmentation (math-invariant, perf-only);
+    # `tree` rides through because trees may elect different winners on
+    # ties — a resume must keep the uninterrupted run's pivot bracket.
     fn = build_program(geom, mesh, precision=precision, backend=backend,
                        panel_chunk=panel_chunk, donate=donate,
-                       resumable=True, election=election)
+                       resumable=True, election=election, segs=segs,
+                       tree=tree)
     return fn(shards, orig, jnp.int32(k0), jnp.int32(k1))
 
 
 def lu_distributed_host(A: np.ndarray, grid: Grid3, v: int, mesh=None,
                         precision=None, backend: str | None = None,
                         panel_chunk: int | None = None,
-                        segs: tuple = (16, 16)):
+                        segs: tuple = (16, 16), tree: str = "pairwise"):
     """Host-level convenience: scatter a global matrix, factor on the mesh,
     gather back. Returns (LU_packed (M, N) in original row order, perm (M,)).
 
@@ -663,7 +706,7 @@ def lu_distributed_host(A: np.ndarray, grid: Grid3, v: int, mesh=None,
     # program aliases input into output (frees a full matrix of HBM)
     out, perm = lu_factor_distributed(
         jnp.asarray(shards), geom, mesh, precision=precision, backend=backend,
-        panel_chunk=panel_chunk, donate=True, segs=segs,
+        panel_chunk=panel_chunk, donate=True, segs=segs, tree=tree,
     )
     perm = np.asarray(perm)
     LUp = geom.gather(np.asarray(out))  # factors in pivoted order

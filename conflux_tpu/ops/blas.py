@@ -285,7 +285,8 @@ def chunk_layout(m: int, v: int, chunk: int | None = None) -> tuple[int, int]:
 
 
 def tournament_winners(panel: jax.Array, chunk: int | None = None,
-                       use_pallas: bool = False, chunk_live=None):
+                       use_pallas: bool = False, chunk_live=None,
+                       tree: str = "pairwise"):
     """Elect v pivot rows of an (m, v) panel by tournament (CALU).
 
     Single-device analogue of the reference's butterfly tournament
@@ -294,6 +295,19 @@ def tournament_winners(panel: jax.Array, chunk: int | None = None,
     and a binary reduction tree of stacked (2v, v) LUs elects the winners.
     All LU calls are height-bounded (chunk or 2v rows) and the chunk round
     is batched, so this scales to arbitrarily tall panels.
+
+    `tree` picks the reduction shape after nomination: 'pairwise' is the
+    binary tree above (log2(nch) batched rounds); 'flat' stacks ALL
+    nominees into one (nch*v, v) LU call — fewer sequential custom calls
+    (each is latency-bound in its serial column sweep, so call count is
+    the cost driver on TPU), at the price of a taller single call. 'flat'
+    requires nch*v within the single-call VMEM-safe height (~8192 rows at
+    v=1024 measured; the caller picks tree='flat' only when that holds).
+    Both trees elect with identical tie-breaking semantics (zero pad rows
+    lose every contest) but may order DIFFERENT winners for rank-deficient
+    or tied inputs; at full rank the winner SET matches partial pivoting's
+    growth properties either way (CALU's guarantee, not bitwise equality
+    between trees).
 
     `chunk_live`, if given, is a (nch,)-shaped traced bool vector (see
     :func:`chunk_layout`): chunk i's LU is skipped via `lax.cond` when
@@ -317,6 +331,8 @@ def tournament_winners(panel: jax.Array, chunk: int | None = None,
             "panel would elect zero-pad rows with out-of-range ids even at "
             "full rank"
         )
+    if tree not in ("pairwise", "flat"):
+        raise ValueError(f"unknown tree {tree!r} (pairwise|flat)")
     c, nch = chunk_layout(m, v, chunk)
     mp = nch * c
     if mp != m:  # zero rows lose every pivot contest against real rows
@@ -352,6 +368,18 @@ def tournament_winners(panel: jax.Array, chunk: int | None = None,
     win = jnp.take_along_axis(cand, top[:, :, None], axis=1)  # (nch, v, v)
     wid = jnp.take_along_axis(cid, top, axis=1)
 
+    if nch == 1:  # single chunk: its local LU already decided everything
+        return lu0, wid[0]
+
+    if tree == "flat":
+        # one (nch*v, v) LU elects straight from all nominees: 1 sequential
+        # custom call instead of log2(nch) tree rounds
+        stack = win.reshape(nch * v, v)
+        sid = wid.reshape(nch * v)
+        lu_f, _, perm_f = lax.linalg.lu(stack)
+        top = perm_f[:v]
+        return lu_f[:v], jnp.take(sid, top, mode="fill", fill_value=mp)
+
     n = 1 << (nch - 1).bit_length()
     if n != nch:
         # pad blocks are all-zero rows with out-of-range ids: they lose every
@@ -360,9 +388,6 @@ def tournament_winners(panel: jax.Array, chunk: int | None = None,
         # onto a real row
         win = jnp.pad(win, ((0, n - nch), (0, 0), (0, 0)))
         wid = jnp.pad(wid, ((0, n - nch), (0, 0)), constant_values=mp)
-
-    if n == 1:  # single chunk: its local LU already decided everything
-        return lu0, wid[0]
 
     lu_top = None
     while n > 1:

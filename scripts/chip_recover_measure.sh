@@ -1,10 +1,14 @@
 #!/bin/bash
-# Wait for the (currently wedged) TPU tunnel to recover, then run the
-# queued round-2 measurements once, logging to data/benchmarks/.
+# Round 3: wait for the (wedged-since-round-2) TPU tunnel to recover, then
+# run the queued measurements once, logging to data/benchmarks/.
+# Order = VERDICT r2 priority: headline bench FIRST (measure-then-experiment),
+# then the zero-hardware-data cores (cholesky 32k, qr 16k), then tuning
+# trials, riskiest (the 12288-chunk trial that coincided with the round-2
+# wedge) LAST.
 # Probe = tiny reduction with a hard timeout; the tunnel wedge manifests
 # as an indefinite hang on the first device op (see bench._probe_device).
 cd "$(dirname "$0")/.." || exit 1
-LOG=data/benchmarks/round2-recovery.txt
+LOG=data/benchmarks/round3-recovery.txt
 echo "watch start $(date -u +%FT%TZ)" >> "$LOG"
 while true; do
   # the platform assert rejects a CPU-fallback backend: a fast plugin-init
@@ -22,18 +26,18 @@ print(float(jax.numpy.ones((8,)).sum()))
   sleep 300
 done
 {
-  echo "=== bench.py (LU 16x16 segs default at-scale gate) $(date -u +%FT%TZ) ==="
+  echo "=== bench.py (headline LU at-scale gate) $(date -u +%FT%TZ) ==="
   timeout -k 10 3000 python bench.py 2>&1 | grep -v WARNING
   echo "=== cholesky N=32768 (triangle-skip at-scale gate) $(date -u +%FT%TZ) ==="
   timeout -k 10 3000 python scripts/tpu_tune.py --algo cholesky -N 32768 \
     --reps 2 --configs highest:0:1024,high:0:1024,highest:0:1024:16x16 \
     2>&1 | grep -v WARNING
-  echo "=== LU segmentation refinement probe $(date -u +%FT%TZ) ==="
-  timeout -k 10 2400 python scripts/tpu_tune.py -N 32768 --reps 2 \
-    --configs highest:8192:1024:32x16 2>&1 | grep -v WARNING
   echo "=== qr N=16384 $(date -u +%FT%TZ) ==="
   timeout -k 10 2400 python scripts/tpu_tune.py --algo qr -N 16384 \
     --reps 2 --configs highest:0:1024 2>&1 | grep -v WARNING
+  echo "=== LU segmentation refinement probe $(date -u +%FT%TZ) ==="
+  timeout -k 10 2400 python scripts/tpu_tune.py -N 32768 --reps 2 \
+    --configs highest:8192:1024:32x16 2>&1 | grep -v WARNING
   echo "=== tune LU taller nomination chunks (LAST: the round-2 wedge "
   echo "    started during the 12288 trial — quarantine the risky configs"
   echo "    behind everything else) $(date -u +%FT%TZ) ==="

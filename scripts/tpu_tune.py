@@ -25,6 +25,7 @@ from __future__ import annotations
 import argparse
 import functools
 import os
+import re
 import sys
 import time
 
@@ -93,6 +94,38 @@ def main() -> None:
                     "trailing-update row x col segment counts)")
     args = ap.parse_args()
 
+    # validate configs BEFORE the device probe: a malformed flag must
+    # error in milliseconds, not after a (possibly wedged-chip) probe
+    # sequence. segs_arg is the same RxC grammar the miniapps use.
+    from conflux_tpu.cli.common import segs_arg
+
+    prec_names = ("high", "highest")
+    if args.configs:
+        configs = []
+        for c in args.configs.split(","):
+            parts = c.split(":")
+            if len(parts) < 3 or parts[0] not in prec_names:
+                ap.error(f"bad config {c!r}: want precision:chunk:v[:RxC] "
+                         f"with precision in {sorted(prec_names)}")
+            p, chunk, v = parts[:3]
+            segs = None  # None = the library default for the algorithm
+            if len(parts) > 3:
+                try:
+                    segs = segs_arg(parts[3])
+                except argparse.ArgumentTypeError as e:
+                    ap.error(f"bad segment field in config {c!r}: {e}")
+            if not re.fullmatch(r"\d+", chunk) or not re.fullmatch(r"\d+", v) \
+                    or int(v) < 1:
+                ap.error(f"bad config {c!r}: chunk must be a non-negative "
+                         "integer (0 = the library default) and v a "
+                         "positive integer")
+            # chunk 0 means "library default": panel_chunk=None downstream
+            # (passing 0 through would clamp to v-tall chunks — a silently
+            # pathological nomination, not the default)
+            configs.append((p, int(chunk) or None, int(v), segs))
+    else:
+        configs = None
+
     import jax
     import jax.numpy as jnp
     from jax import lax
@@ -113,16 +146,8 @@ def main() -> None:
     # so its rate line is comparable to the LU/Cholesky MXU utilization
     flop_coeff = {"lu": 2 / 3, "cholesky": 1 / 3, "qr": 8 / 3}[args.algo]
 
-    if args.configs:
-        configs = []
-        for c in args.configs.split(","):
-            parts = c.split(":")
-            p, chunk, v = parts[:3]
-            segs = None  # None = the library default for the algorithm
-            if len(parts) > 3:
-                r, _, s = parts[3].partition("x")
-                segs = (int(r), int(s))
-            configs.append((p, int(chunk), int(v), segs))
+    if configs is not None:
+        pass
     elif args.algo == "lu":
         configs = [
             ("highest", 8192, 1024, None),
@@ -142,6 +167,7 @@ def main() -> None:
         ]
 
     for pname, chunk, v, segs in configs:
+        chunk_lbl = "default" if chunk is None else chunk
         if args.algo == "qr":
             # qr segments columns only: the 4th field is a single csegs
             # count written as 1xC (row part must be 1)
@@ -229,7 +255,7 @@ def main() -> None:
                 times.append(time.time() - t0)
             dim = geom.N if args.algo == "cholesky" else geom.M
             gflops = flop_coeff * dim**3 / (sum(times) / len(times)) / 1e9
-            print(f"algo={args.algo} precision={pname} chunk={chunk} v={v} "
+            print(f"algo={args.algo} precision={pname} chunk={chunk_lbl} v={v} "
                   f"segs={seg_lbl}: {gflops:.1f} GFLOP/s", flush=True)
             try:  # residual separately: never discard a good timing
                 res = residual(out, aux)
@@ -237,7 +263,7 @@ def main() -> None:
             except Exception as e:
                 print(f"    residual FAILED: {e}", flush=True)
         except Exception as e:  # OOM / VMEM overflow at some configs
-            print(f"algo={args.algo} precision={pname} chunk={chunk} v={v} "
+            print(f"algo={args.algo} precision={pname} chunk={chunk_lbl} v={v} "
                   f"segs={seg_lbl}: FAILED {e}", flush=True)
 
 

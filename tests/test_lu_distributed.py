@@ -141,6 +141,49 @@ def test_lu_distributed_chunked_matches_unchunked():
         assert res < residual_bound(N, np.float64), (chunk, res)
 
 
+def test_lu_distributed_flat_tree():
+    """The flat election tree (one stacked LU instead of the pairwise
+    reduction tree — fewer sequential latency-bound custom calls on TPU)
+    is a valid CALU election: correct residual, pure permutation, across
+    the chunked single-rank path (Px=1, multi-chunk nomination), the
+    cross-x gather election, and rectangular shapes."""
+    N, v = 128, 8
+    A = make_test_matrix(N, N, seed=13)
+    for grid in (Grid3(1, 1, 1), Grid3(2, 2, 1), Grid3(4, 2, 1)):
+        LU, perm, _ = lu_distributed_host(A, grid, v, panel_chunk=16,
+                                          tree="flat")
+        res = lu_residual(A, LU[perm], perm)
+        assert res < residual_bound(N, np.float64), (grid, res)
+        assert sorted(perm.tolist()) == list(range(N))
+    # bench-shape ratios (32 supersteps, 4 nomination chunks) as in
+    # test_lu_distributed_bench_ratios, now through the flat tree
+    N2 = 256
+    A2 = make_test_matrix(N2, N2, seed=2, dtype=np.float32)
+    LU, perm, _ = lu_distributed_host(A2, Grid3(1, 1, 1), v, panel_chunk=64,
+                                      tree="flat")
+    assert sorted(perm.tolist()) == list(range(N2))
+    assert lu_residual(A2, LU[perm], perm) < residual_bound(N2, np.float32)
+
+
+def test_lu_flat_tree_vmem_guard():
+    """tree='flat' must refuse configurations whose nominee stack exceeds
+    the single-call VMEM-safe height instead of failing at compile time
+    on the chip."""
+    import jax
+
+    from conflux_tpu.geometry import LUGeometry
+    from conflux_tpu.lu.distributed import build_program
+    from conflux_tpu.parallel.mesh import make_mesh
+
+    grid = Grid3(1, 1, 1)
+    geom = LUGeometry.create(32768, 32768, 1024, grid)
+    mesh = make_mesh(grid, devices=jax.devices()[:1])
+    with pytest.raises(ValueError, match="flat"):
+        build_program(geom, mesh, panel_chunk=2048, tree="flat")
+    with pytest.raises(ValueError, match="tree"):
+        build_program(geom, mesh, tree="bogus")
+
+
 def test_lu_distributed_segs_invariant():
     """Trailing-update segmentation partitions the same per-element math:
     any (row, col) segment counts — coarse, odd/ragged, tile-granular —

@@ -152,6 +152,54 @@ def test_qr_steps_rejects_bad_usage():
         qr_factor_steps(shards, geom, mesh, 2, 4)  # R=None at k0 > 0
 
 
+def test_factor_steps_accept_segs():
+    """Resumed runs keep the tuned segmentation (ADVICE r2): segs threads
+    through the *_factor_steps wrappers. Segmentation is math-invariant
+    (same pivots, residual-level factors — f32 summation order differs per
+    segment shape, so not bitwise; cf. test_lu_distributed_segs_invariant)."""
+    import jax
+
+    from conflux_tpu.validation import cholesky_residual, lu_residual
+
+    grid = Grid3(1, 1, 1)
+    v, Nt = 8, 8
+    N = v * Nt
+    geom = LUGeometry.create(N, N, v, grid)
+    mesh = make_mesh(grid, devices=jax.devices()[:1])
+    A = make_test_matrix(N, N, dtype=np.float32)
+    shards = jnp.asarray(geom.scatter(A))
+
+    _, perm_full = lu_factor_distributed(shards, geom, mesh)
+    s, o, _ = lu_factor_steps(shards, geom, mesh, 0, 3, segs=(4, 2))
+    s, o, perm = lu_factor_steps(s, geom, mesh, 3, geom.n_steps, orig=o,
+                                 segs=(4, 2))
+    np.testing.assert_array_equal(np.asarray(perm), np.asarray(perm_full))
+    p = np.asarray(perm)
+    LUp = geom.gather(np.asarray(s))
+    assert lu_residual(A, LUp, p) < 5e-6
+
+    cgeom = CholeskyGeometry.create(N, v, grid)
+    Aspd = make_spd_matrix(N, dtype=np.float32)
+    cshards = jnp.asarray(cgeom.scatter(Aspd))
+    cs = cholesky_factor_steps(cshards, cgeom, mesh, 0, 4, segs=(4, 2))
+    cs = cholesky_factor_steps(cs, cgeom, mesh, 4, cgeom.Kappa, segs=(4, 2))
+    L = np.tril(cgeom.gather(np.asarray(cs)))
+    assert cholesky_residual(np.asarray(Aspd, np.float64), L) < 5e-6
+
+    # tree threads through too (flat may break ties differently from
+    # pairwise, so a flat-tuned run must resume flat): same-tree resume
+    # is bitwise at Pz=1
+    ffull, fperm = lu_factor_distributed(shards, geom, mesh,
+                                         panel_chunk=16, tree="flat")
+    fs, fo, _ = lu_factor_steps(shards, geom, mesh, 0, 3, panel_chunk=16,
+                                tree="flat")
+    fs, fo, fp = lu_factor_steps(fs, geom, mesh, 3, geom.n_steps, orig=fo,
+                                 panel_chunk=16, tree="flat")
+    np.testing.assert_array_equal(np.asarray(fp), np.asarray(fperm))
+    np.testing.assert_allclose(np.asarray(fs), np.asarray(ffull),
+                               rtol=0, atol=0)
+
+
 def test_lu_resume_butterfly_election_bitwise():
     """A butterfly-elected factorization must checkpoint/resume with the
     same pivot bracket (election passthrough): bitwise at Pz == 1."""
