@@ -159,6 +159,58 @@ def _residual_on_device(LU, perm):
     return float(jnp.sqrt(rss) / jnp.sqrt(ass))
 
 
+def tpu_bench_mxp(refine: int = 5, precision_name: str = "high",
+                  ir: str = "classic"):
+    """(GFLOP/s, final solve residual) of the HPL-MxP mode.
+
+    ONE timed span covers scatter + factor (bf16x3 trailing GEMMs via
+    lax.Precision.HIGH — the measured v5e fast path) + triangular solve +
+    refinement (`ir='classic'`: `refine` Richardson sweeps; `ir='gmres'`:
+    FGMRES preconditioned by the factors — the actual HPL-MxP engine,
+    required when classic IR's contraction stalls) with f64 residuals
+    (emulated on TPU but O(N^2) per sweep). Rate = 2/3 N^3 / end-to-end
+    time — the HPL-MxP convention: flops counted for the nominal LU, the
+    time includes the refinement that buys the accuracy back. Acceptance
+    is the reference's all-f64 bar translated to solve accuracy
+    (BASELINE.md): rel residual ||Ax - b|| / ||b|| <= 1e-6.
+
+    HBM: A (4 GB) + factors (4 GB, scatter copy donated into the loop) +
+    loop temporaries — same pair the f32 bench fits, plus A staying
+    resident for the residual sweeps.
+    """
+    from jax import lax as _lax
+
+    from conflux_tpu import solvers
+    from conflux_tpu.geometry import Grid3
+
+    jax.config.update("jax_enable_x64", True)
+    geom, mesh, sharding = _setup()
+    precision = {"high": _lax.Precision.HIGH,
+                 "highest": _lax.Precision.HIGHEST}[precision_name]
+
+    def run(A, b):
+        return solvers.solve_distributed(
+            A, b, grid=Grid3(1, 1, 1), v=V, mesh=mesh, refine=refine,
+            precision=precision, ir=ir, tol=1e-8)
+
+    A = _make()[0, 0]
+    b = jnp.ones((N,), jnp.float32)
+    float(A[0, 0])
+
+    x = run(A, b)  # compile + warm-up
+    float(x[0])
+    t0 = time.time()
+    x = run(A, b)
+    float(x[0])
+    dt = time.time() - t0
+    gflops = (2 / 3) * N**3 / dt / 1e9
+
+    b_r = b.astype(jnp.float64)
+    r = solvers._residual_strips(A, x, b_r, jnp.float64)
+    rel = float(jnp.linalg.norm(r) / jnp.linalg.norm(b_r))
+    return gflops, rel
+
+
 def cpu_gflops() -> float:
     import scipy.linalg
 
@@ -215,12 +267,45 @@ def _probe_device(timeout_s: int = 180, retries: int = 3,
 
 
 def main():
+    import argparse
+
+    ap = argparse.ArgumentParser("bench")
+    ap.add_argument("--mode", default="f32", choices=["f32", "mxp"],
+                    help="f32: factorization rate at HIGHEST precision "
+                    "(driver default); mxp: HPL-MxP end-to-end solve — "
+                    "bf16x3 factor + IR to <=1e-6")
+    ap.add_argument("--refine", type=int, default=5,
+                    help="IR sweeps in mxp mode")
+    ap.add_argument("--precision", default="high",
+                    choices=["high", "highest"],
+                    help="trailing-GEMM precision in mxp mode")
+    ap.add_argument("--ir", default="classic", choices=["classic", "gmres"],
+                    help="refinement engine in mxp mode (gmres = FGMRES "
+                    "preconditioned by the factors)")
+    args = ap.parse_args()
+
     _probe_device()
-    tpu, res = tpu_bench()
     try:
         cpu = cpu_gflops()
     except Exception:
         cpu = float("nan")
+    if args.mode == "mxp":
+        tpu, res = tpu_bench_mxp(refine=args.refine,
+                                 precision_name=args.precision, ir=args.ir)
+        ir_lbl = (f"IR{args.refine}" if args.ir == "classic"
+                  else "GMRES-IR")
+        print(f"_residual_ {res:.3e}")
+        print(json.dumps({
+            "metric": f"HPL-MxP LU solve N={N} v={V} "
+                      f"{args.precision}+{ir_lbl} GFLOP/s "
+                      "(single chip, end-to-end)",
+            "value": round(tpu, 1),
+            "unit": "GFLOP/s",
+            "vs_baseline": round(tpu / cpu, 2) if cpu == cpu else None,
+            "residual": res,
+        }))
+        return
+    tpu, res = tpu_bench()
     print(f"_residual_ {res:.3e}")
     print(
         json.dumps(

@@ -65,7 +65,9 @@ def setup_platform(args) -> None:
         jax.config.update("jax_platforms", "cpu")
     elif args.platform in ("tpu", "axon"):
         pass  # the environment default
-    if args.dtype == "float64":
+    if args.dtype == "float64" or getattr(args, "refine", None) is not None:
+        # --refine computes its residuals in f64 (O(N^2) work only;
+        # software-emulated on TPU) — the HPL-MxP recipe's high half
         jax.config.update("jax_enable_x64", True)
 
 

@@ -59,6 +59,17 @@ def parse_args(argv=None):
         "(default: tuned library value); finer cuts dead-region flop "
         "overshoot at the cost of more per-step conds",
     )
+    p.add_argument(
+        "--tree", default="pairwise", choices=["pairwise", "flat"],
+        help="pivot election reduction: pairwise binary tree, or one "
+        "stacked LU call (fewer sequential latency-bound custom calls)",
+    )
+    p.add_argument(
+        "--refine", type=int, default=None, metavar="K",
+        help="after factoring, solve A x = 1 with K iterative-refinement "
+        "sweeps (f64 residual — the HPL-MxP recipe; pairs with --dtype "
+        "bfloat16 for the fast-factor path) and report the solve residual",
+    )
     add_experiment_type_arg(p)
     add_common_args(p)
     return p.parse_args(argv)
@@ -116,7 +127,7 @@ def main(argv=None) -> int:
                 else:
                     out, perm_dev = lu_factor_distributed(
                         dev, geom, mesh, lookahead=args.lookahead,
-                        election=args.election, **seg_kw)
+                        election=args.election, tree=args.tree, **seg_kw)
                 sync(out)
         if rep > 0:
             times.append(t.ms)
@@ -139,6 +150,39 @@ def main(argv=None) -> int:
                 res = lu_residual_distributed(dev, out, perm_dev, geom, mesh)
         print(f"_residual_ {res:.3e}")
 
+    if args.refine is not None:
+        # HPL-MxP demonstration on the factors just computed: solve
+        # A x = 1 and refine with f64 residuals (O(N^2) per sweep). The
+        # reference's accuracy story is all-f64 factors
+        # (`src/conflux/lu/blas.cpp:15-123`); the TPU-native answer is
+        # cheap factors + refinement to the same <=1e-6 solve bar.
+        if geom.M != geom.N:
+            raise SystemExit("--refine needs a square system")
+        if args.refine < 0:
+            raise SystemExit("--refine needs a sweep count >= 0")
+        from conflux_tpu import solvers
+
+        with profiler.region("refine_solve"):
+            b = jnp.ones((geom.N,), jnp.float32)
+            b_r = b.astype(jnp.float64)
+            Adev = jnp.asarray(A.astype(np.float32))
+            if single:
+                def solve(r):
+                    return solvers.lu_solve(out, perm_dev, r)
+            else:
+                def solve(r):
+                    return solvers.lu_solve_distributed(
+                        out, perm_dev, geom, mesh, r)
+            x = solve(b).astype(jnp.float64)
+            for _ in range(args.refine):
+                r = solvers._residual_strips(Adev, x, b_r, jnp.float64)
+                x = x + solve(r.astype(jnp.float32)).astype(jnp.float64)
+            r = solvers._residual_strips(Adev, x, b_r, jnp.float64)
+            rel = float(jnp.linalg.norm(r) / jnp.linalg.norm(b_r))
+        flag = "PASS" if rel <= 1e-6 else "----"
+        print(f"_solve_residual_ refine={args.refine} rel={rel:.3e} "
+              f"[{flag} <=1e-6]")
+
     if args.profile:
         if not single:
             from conflux_tpu.cli.common import phase_profile
@@ -146,7 +190,8 @@ def main(argv=None) -> int:
 
             phase_profile(
                 build_program(geom, mesh, lookahead=args.lookahead,
-                              election=args.election, **seg_kw), dev)
+                              election=args.election, tree=args.tree,
+                              **seg_kw), dev)
         profiler.report()
     return 0
 

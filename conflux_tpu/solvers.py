@@ -288,7 +288,11 @@ def _build_cholesky_solve(geom, mesh_key):
 
 def solve_distributed(A, b, *, grid=None, v: int = 1024, mesh=None,
                       refine: int = 0, factor_dtype=None,
-                      residual_dtype=None, panel_chunk: int | None = None):
+                      residual_dtype=None, panel_chunk: int | None = None,
+                      precision=None, segs: tuple = (16, 16),
+                      tree: str = "pairwise", ir: str = "classic",
+                      tol: float = 1e-6, restart: int = 16,
+                      max_restarts: int = 12):
     """Factor + solve + iterative refinement on a device mesh.
 
     The at-scale solve path: the factorization is the distributed program
@@ -306,7 +310,10 @@ def solve_distributed(A, b, *, grid=None, v: int = 1024, mesh=None,
     reaches <= 1e-6 in 2 sweeps — the BASELINE.md acceptance bar. This is
     the HPL-MxP recipe (low-precision O(N^3), high-precision O(N^2)); with
     factor_dtype=bfloat16 the factorization itself rides the fast MXU path
-    and a few more sweeps recover the same bar.
+    and a few more sweeps recover the same bar. `precision` reaches the
+    trailing GEMMs the same way (lax.Precision.HIGH = bf16x3 passes on f32
+    storage — the measured fast path on v5e — vs the default HIGHEST);
+    `segs`/`tree` pass through to the factorization untouched.
 
     A must be the original matrix, (N, N); device placement recommended at
     scale (a host A costs a full transfer). Returns x (N,) in the
@@ -319,6 +326,10 @@ def solve_distributed(A, b, *, grid=None, v: int = 1024, mesh=None,
     N = A.shape[0]
     if A.shape[0] != A.shape[1]:
         raise ValueError("solve_distributed needs a square A")
+    if ir not in ("classic", "gmres"):
+        # before the O(N^3) factorization: a typo must fail in
+        # microseconds, not after a multi-minute factor
+        raise ValueError(f"unknown ir {ir!r} (classic|gmres)")
     if grid is None:
         grid = choose_grid(jax.device_count(), N, N)
     geom = LUGeometry.create(N, N, v, grid)
@@ -338,12 +349,37 @@ def solve_distributed(A, b, *, grid=None, v: int = 1024, mesh=None,
     shards = _build_scatter(geom, mesh_cache_key(mesh),
                             jnp.dtype(fdtype).name)(jnp.asarray(A))
     out, perm = lu_factor_distributed(shards, geom, mesh,
-                                      panel_chunk=panel_chunk, donate=True)
+                                      panel_chunk=panel_chunk, donate=True,
+                                      precision=precision, segs=segs,
+                                      tree=tree)
 
+    b_r = jnp.asarray(b, residual_dtype)
+    if ir == "gmres":
+        # GMRES-IR: the factors precondition FGMRES instead of driving a
+        # Richardson iteration — converges where classic IR diverges
+        # (cond(A)·eps_factor ~ 1, the bf16/bf16x3 factor regime). This
+        # is the actual HPL-MxP algorithm; `refine` is ignored here
+        # (tol/restart/max_restarts govern). The callables come from a
+        # geometry-keyed cache and the data rides fgmres's `args`, so
+        # repeated solves at one geometry share one compiled cycle.
+        matvec, precond = _gmres_ops(geom, mesh_cache_key(mesh),
+                                     jnp.dtype(residual_dtype).name)
+        x, info = fgmres(
+            matvec, precond, b_r, args=(jnp.asarray(A), out, perm),
+            tol=tol, restart=restart, max_restarts=max_restarts,
+            rdtype=residual_dtype)
+        if info["residual"] > tol:
+            import warnings
+
+            warnings.warn(
+                f"GMRES-IR stalled at residual {info['residual']:.3e} "
+                f"(> tol {tol:.1e}) after {info['restarts']} restarts "
+                "— raise max_restarts/restart or improve the factors",
+                RuntimeWarning, stacklevel=2)
+        return x
     # classic IR: x and b stay in the high (residual) precision — a b
     # downcast would make IR converge to A x = low(b) instead — and only
     # the corrections ride the low-precision factors
-    b_r = jnp.asarray(b, residual_dtype)
     x = lu_solve_distributed(out, perm, geom, mesh,
                              b_r.astype(cdtype)).astype(residual_dtype)
     for _ in range(refine):
@@ -351,6 +387,115 @@ def solve_distributed(A, b, *, grid=None, v: int = 1024, mesh=None,
         corr = lu_solve_distributed(out, perm, geom, mesh, r.astype(cdtype))
         x = x + corr.astype(residual_dtype)
     return x
+
+
+def fgmres(matvec, precond, b, *, args=(), x0=None, tol: float = 1e-6,
+           restart: int = 16, max_restarts: int = 12, rdtype=None):
+    """Flexible GMRES with right preconditioning — the GMRES-IR engine.
+
+    Solves A x = b where `matvec(x, *args)` applies A (accumulate in
+    `rdtype`) and `precond(r, *args)` applies an approximate inverse
+    (typically a low-precision LU solve: the HPL-MxP recipe — classic
+    iterative
+    refinement is a Richardson iteration that DIVERGES once
+    cond(A)·eps_factor approaches 1, e.g. bf16 factors on a
+    cond ~1e3 matrix; FGMRES with the same factors as preconditioner
+    converges whenever the preconditioned spectrum clusters).
+
+    TPU-native structure: each restart cycle is ONE jitted program — the
+    full Arnoldi process with masked modified Gram-Schmidt runs
+    device-resident (`lax.fori_loop` over the basis; H and the Krylov
+    bases V, Z are fixed-shape carries), so a cycle costs zero host
+    round-trips; the only readback per cycle is the small H matrix and
+    residual norm for the host-side least-squares update. The basis is
+    flexible (Z stores preconditioned vectors), so `precond` may itself
+    be any jit-traceable approximate solve.
+
+    `args` rides through to both callables AS JIT ARGUMENTS — pass the
+    factors/matrix here (not via closure) so the compiled cycle is
+    reused across calls with different data: callers that pass the same
+    (matvec, precond, restart, rdtype) identities share one compile.
+
+    Returns (x, info) with info = {'restarts', 'residual'} — residual is
+    ||b - A x|| / ||b|| measured with `matvec` at the end.
+    """
+    if rdtype is None:
+        rdtype = jnp.float64 if jax.config.jax_enable_x64 else jnp.float32
+    b_r = jnp.asarray(b, rdtype)
+    N = b_r.shape[0]
+    m = int(restart)
+    if m < 1:
+        raise ValueError(f"restart must be >= 1, got {restart}")
+    cycle = _fgmres_cycle(matvec, precond, m, jnp.dtype(rdtype).name)
+
+    x = (jnp.zeros((N,), rdtype) if x0 is None
+         else jnp.asarray(x0, rdtype))
+    done_restarts = 0
+    bnorm = float(jnp.sqrt(jnp.sum(b_r * b_r)))
+    if bnorm == 0:
+        return x, {"restarts": 0, "residual": 0.0}
+    for k in range(max_restarts):
+        beta, H, Z = cycle(x, b_r, *args)
+        beta_f = float(beta)
+        done_restarts = k + 1
+        if beta_f / bnorm <= tol:
+            break
+        # small (m+1, m) least squares on host; breakdown columns (zero
+        # subdiagonal) are harmless — lstsq handles the rank
+        Hh = np.asarray(H, np.float64)
+        e1 = np.zeros(m + 1)
+        e1[0] = beta_f
+        y, *_ = np.linalg.lstsq(Hh, e1, rcond=None)
+        x = x + Z.T @ jnp.asarray(y, rdtype)
+        # projected residual estimate: stop next cycle from launching if
+        # this one already converged
+        if np.linalg.norm(e1 - Hh @ y) / bnorm <= tol:
+            break
+    r = b_r - matvec(x, *args).astype(rdtype)
+    rel = float(jnp.sqrt(jnp.sum(r * r))) / bnorm
+    return x, {"restarts": done_restarts, "residual": rel}
+
+
+@functools.lru_cache(maxsize=16)
+def _fgmres_cycle(matvec, precond, m: int, rdtype_name: str):
+    """One compiled Arnoldi cycle per (matvec, precond, restart, dtype):
+    repeat fgmres calls with the SAME callables (e.g. a bench loop, or
+    the restart loop itself) reuse the compiled program instead of
+    re-jitting a fresh closure every call. Callers that pass fresh
+    lambdas each time simply fall back to one compile per call."""
+    rdtype = jnp.dtype(rdtype_name)
+
+    @jax.jit
+    def cycle(x, b_r, *args):
+        N = b_r.shape[0]
+        r = b_r - matvec(x, *args).astype(rdtype)
+        beta = jnp.sqrt(jnp.sum(r * r))
+        V = jnp.zeros((m + 1, N), rdtype).at[0].set(
+            r / jnp.where(beta > 0, beta, 1))
+        Z = jnp.zeros((m, N), rdtype)
+        H = jnp.zeros((m + 1, m), rdtype)
+
+        def arnoldi(j, carry):
+            V, Z, H = carry
+            z = precond(V[j], *args).astype(rdtype)
+            w = matvec(z, *args).astype(rdtype)
+            # masked modified Gram-Schmidt: dot against every basis row,
+            # rows > j are zero so their coefficients vanish — the loop
+            # body stays fixed-shape for the one-compile cycle
+            h = V @ w  # (m+1,)
+            mask = jnp.arange(m + 1) <= j
+            h = jnp.where(mask, h, 0)
+            w = w - V.T @ h
+            hn = jnp.sqrt(jnp.sum(w * w))
+            V = V.at[j + 1].set(w / jnp.where(hn > 0, hn, 1))
+            H = H.at[:, j].set(h).at[j + 1, j].set(hn)
+            Z = Z.at[j].set(z)
+            return V, Z, H
+
+        V, Z, H = lax.fori_loop(0, m, arnoldi, (V, Z, H))
+        return beta, H, Z
+
+    return cycle
 
 
 @functools.lru_cache(maxsize=16)
@@ -387,6 +532,39 @@ def _residual_strips(A, x, b, rdtype):
         - A[i : i + strip].astype(rdtype) @ xr
         for i in range(0, N, strip)
     ]
+    return jnp.concatenate(pieces) if len(pieces) > 1 else pieces[0]
+
+
+@functools.lru_cache(maxsize=16)
+def _gmres_ops(geom, mesh_key, rdtype_name: str):
+    """(matvec, precond) pair for GMRES-IR at one geometry: stable
+    function identities (the fgmres cycle-compile cache key) with the
+    matrix/factors as runtime arguments."""
+    from conflux_tpu.parallel.mesh import lookup_mesh
+
+    mesh = lookup_mesh(mesh_key)
+    rdtype = jnp.dtype(rdtype_name)
+
+    def matvec(x, A, shards, perm):
+        return _matvec_strips(A, x, rdtype)
+
+    def precond(r, A, shards, perm):
+        return lu_solve_distributed(
+            shards, perm, geom, mesh,
+            r.astype(blas.compute_dtype(shards.dtype)))
+
+    return matvec, precond
+
+
+def _matvec_strips(A, x, rdtype):
+    """A @ x accumulated in `rdtype` with strip-wise casts (same HBM
+    discipline as `_residual_strips`); traceable inside fgmres's jitted
+    cycle."""
+    N = A.shape[0]
+    strip = max(1, min(4096, N))
+    xr = x.astype(rdtype)
+    pieces = [A[i : i + strip].astype(rdtype) @ xr
+              for i in range(0, N, strip)]
     return jnp.concatenate(pieces) if len(pieces) > 1 else pieces[0]
 
 

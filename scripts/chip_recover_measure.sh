@@ -35,6 +35,8 @@ done
   echo "=== qr N=16384 $(date -u +%FT%TZ) ==="
   timeout -k 10 2400 python scripts/tpu_tune.py --algo qr -N 16384 \
     --reps 2 --configs highest:0:1024 2>&1 | grep -v WARNING
+  echo "=== HPL-MxP end-to-end (bf16x3 factor + GMRES-IR to 1e-6) $(date -u +%FT%TZ) ==="
+  timeout -k 10 3000 python bench.py --mode mxp --ir gmres 2>&1 | grep -v WARNING
   echo "=== LU segmentation refinement probe $(date -u +%FT%TZ) ==="
   timeout -k 10 2400 python scripts/tpu_tune.py -N 32768 --reps 2 \
     --configs highest:8192:1024:32x16 2>&1 | grep -v WARNING

@@ -196,6 +196,73 @@ def test_solve_distributed_bf16_factors():
     assert _relerr(A, np.asarray(x, np.float64), b) < 1e-7
 
 
+def test_fgmres_exact_preconditioner_one_cycle():
+    """With an exact inverse as preconditioner, FGMRES converges in the
+    first Arnoldi step — the identity sanity check of the engine."""
+    from conflux_tpu.solvers import fgmres
+
+    rng = np.random.default_rng(7)
+    N = 96
+    A = rng.standard_normal((N, N)) + 4 * np.eye(N)
+    b = rng.standard_normal(N)
+    Ad = jnp.asarray(A, jnp.float64)
+    Ainv = jnp.asarray(np.linalg.inv(A), jnp.float64)
+    x, info = fgmres(lambda v: Ad @ v, lambda r: Ainv @ r,
+                     jnp.asarray(b, jnp.float64), tol=1e-12, restart=4)
+    assert info["restarts"] == 1
+    assert info["residual"] < 1e-12
+    np.testing.assert_allclose(np.asarray(x), np.linalg.solve(A, b),
+                               rtol=1e-9)
+
+
+def test_fgmres_beats_classic_ir_on_bf16_factors():
+    """The GMRES-IR claim (HPL-MxP): on a matrix where classic IR with
+    bf16 factors contracts at ~0.7/sweep (cond ~1e3 — measured: 10 sweeps
+    still stall above 1e-2), FGMRES preconditioned by the SAME factors
+    reaches 1e-6."""
+    from conflux_tpu.lu.single import lu_factor_blocked
+    from conflux_tpu.solvers import fgmres, lu_solve
+
+    N = 512
+    A = make_test_matrix(N, N, dtype=np.float32)  # cond ~1.4e3
+    b = np.ones(N, np.float32)
+    LU, perm = lu_factor_blocked(jnp.asarray(A).astype(jnp.bfloat16), v=64)
+    Ad = jnp.asarray(A)
+
+    # classic IR baseline: verify it genuinely stalls on this problem
+    b_r = jnp.asarray(b, jnp.float64)
+    x = lu_solve(LU, perm, jnp.asarray(b)).astype(jnp.float64)
+    from conflux_tpu.solvers import _residual_strips
+    for _ in range(6):
+        r = _residual_strips(Ad, x, b_r, jnp.float64)
+        x = x + lu_solve(LU, perm, r.astype(jnp.float32)).astype(jnp.float64)
+    r = _residual_strips(Ad, x, b_r, jnp.float64)
+    classic = float(jnp.linalg.norm(r) / jnp.linalg.norm(b_r))
+    assert classic > 1e-4, f"classic IR unexpectedly converged: {classic}"
+
+    xg, info = fgmres(
+        lambda v: Ad.astype(jnp.float64) @ v,
+        lambda rr: lu_solve(LU, perm, rr.astype(jnp.float32)),
+        b_r, tol=1e-6, restart=16, max_restarts=8)
+    assert info["residual"] <= 1e-6, info
+    assert _relerr(A, np.asarray(xg, np.float64), b) < 1e-6
+
+
+def test_solve_distributed_gmres_ir():
+    """ir='gmres' end-to-end on the mesh: bf16 factors + FGMRES reach the
+    1e-6 bar where refine= (classic) cannot on an ill-enough matrix."""
+    from conflux_tpu.geometry import Grid3
+    from conflux_tpu.solvers import solve_distributed
+
+    N = 128
+    A = make_test_matrix(N, N, seed=18, dtype=np.float32)  # no diag boost
+    b = np.ones(N, np.float32)
+    x = solve_distributed(jnp.asarray(A), jnp.asarray(b), grid=Grid3(2, 1, 1),
+                          v=16, factor_dtype=jnp.bfloat16, ir="gmres",
+                          tol=1e-8, restart=16, max_restarts=8)
+    assert _relerr(A, np.asarray(x, np.float64), b) < 1e-8
+
+
 def test_solve_distributed_rejects_padding():
     import pytest
 
