@@ -100,6 +100,12 @@ def main(argv=None) -> int:
 
     dtype = np_dtype(args.dtype)
     geom = LUGeometry.create(M, args.N, args.block_size, grid)
+    if args.refine is not None:
+        # fail in milliseconds, not after the timed O(N^3) factor reps
+        if args.refine < 0:
+            raise SystemExit("--refine needs a sweep count >= 0")
+        if geom.M != geom.N:
+            raise SystemExit("--refine needs a square system")
 
     # Dedicated single-device path: exact shrinking shapes per superstep
     # (true 2/3 N^3 flops) instead of the masked fixed-shape distributed
@@ -156,16 +162,16 @@ def main(argv=None) -> int:
         # reference's accuracy story is all-f64 factors
         # (`src/conflux/lu/blas.cpp:15-123`); the TPU-native answer is
         # cheap factors + refinement to the same <=1e-6 solve bar.
-        if geom.M != geom.N:
-            raise SystemExit("--refine needs a square system")
-        if args.refine < 0:
-            raise SystemExit("--refine needs a sweep count >= 0")
         from conflux_tpu import solvers
+        from conflux_tpu.ops import blas as _blas
 
         with profiler.region("refine_solve"):
-            b = jnp.ones((geom.N,), jnp.float32)
-            b_r = b.astype(jnp.float64)
-            Adev = jnp.asarray(A.astype(np.float32))
+            b = jnp.ones((geom.N,), dtype)
+            # residuals against the matrix actually factored, in its own
+            # dtype (an f32 round-trip of an f64 A would certify the
+            # wrong system); corrections ride the factors' compute dtype
+            Adev = jnp.asarray(A)
+            corr_dtype = _blas.compute_dtype(jnp.asarray(out).dtype)
             if single:
                 def solve(r):
                     return solvers.lu_solve(out, perm_dev, r)
@@ -173,12 +179,12 @@ def main(argv=None) -> int:
                 def solve(r):
                     return solvers.lu_solve_distributed(
                         out, perm_dev, geom, mesh, r)
-            x = solve(b).astype(jnp.float64)
-            for _ in range(args.refine):
-                r = solvers._residual_strips(Adev, x, b_r, jnp.float64)
-                x = x + solve(r.astype(jnp.float32)).astype(jnp.float64)
-            r = solvers._residual_strips(Adev, x, b_r, jnp.float64)
-            rel = float(jnp.linalg.norm(r) / jnp.linalg.norm(b_r))
+            x = solvers.refine_classic(solve, Adev, b, args.refine,
+                                       jnp.float64, corr_dtype)
+            r = solvers._residual_strips(Adev, x, b.astype(jnp.float64),
+                                         jnp.float64)
+            rel = float(jnp.linalg.norm(r)
+                        / jnp.linalg.norm(b.astype(jnp.float64)))
         flag = "PASS" if rel <= 1e-6 else "----"
         print(f"_solve_residual_ refine={args.refine} rel={rel:.3e} "
               f"[{flag} <=1e-6]")

@@ -49,6 +49,10 @@ def parse_args(argv=None):
                    "log2(Px) ppermute hypercube (power-of-two Px)")
     p.add_argument("--full", action="store_true",
                    help="general block-cyclic QR on the (x, y, z) mesh")
+    p.add_argument("--lookahead", action="store_true",
+                   help="software-pipelined --full loop: overlap the next "
+                   "panel's election with the trailing update (P8; "
+                   "bitwise-identical results)")
     p.add_argument("--csegs", type=positive_int, default=None, metavar="C",
                    help="trailing-update column segment count for --full "
                    "(default: tuned library value)")
@@ -77,6 +81,10 @@ def main(argv=None) -> int:
         raise SystemExit(
             "--tree applies to the tall tsqr mode only (the Gram and "
             "block-cyclic paths have no cross-x R tree)")
+    if args.lookahead and not args.full:
+        raise SystemExit(
+            "--lookahead applies to the --full block-cyclic loop only "
+            "(the tall-skinny paths have no superstep loop to pipeline)")
     n_devices = len(jax.devices())
     dtype = np_dtype(args.dtype)
     rng = np.random.default_rng(42)
@@ -85,6 +93,7 @@ def main(argv=None) -> int:
         from conflux_tpu.qr.distributed import qr_factor_distributed
 
         seg_kw = {} if args.csegs is None else {"csegs": args.csegs}
+        seg_kw["lookahead"] = args.lookahead
 
         v = args.block or 256
         grid = (Grid3.parse(args.p_grid) if args.p_grid

@@ -195,7 +195,7 @@ def qr_distributed_host(A: np.ndarray, Px: int, mesh=None,
 @functools.lru_cache(maxsize=32)
 def _build_full(geom, mesh_key, precision, backend: str, chunk: int,
                 donate: bool = False, resumable: bool = False,
-                csegs: int = 8):
+                csegs: int = 8, lookahead: bool = False):
     """Blocked distributed QR over the full (x, y, z) mesh.
 
     The general-matrix companion of `tsqr_distributed`, in the same design
@@ -227,6 +227,18 @@ def _build_full(geom, mesh_key, precision, backend: str, chunk: int,
     Q comes back thin (M, N) in A's layout; A = Q R with diag(R) >= 0.
     Rank-deficient panels leave their block's columns/rows unspecified
     (same contract as the LU loop's degenerate supersteps).
+
+    lookahead=True selects the software-pipelined loop (the LU/Cholesky
+    body_la pattern, P8): step k+1's panel reduce, BCGS2 re-projection
+    and TSQR election are computed at the END of step k from (a) the
+    pre-update matrix with ONLY the Q-column write applied — value-
+    identical at every done column to the post-step matrix, but with no
+    dataflow edge from the trailing segment GEMMs — and (b) a panel-slab
+    GEMM mirroring the segment update operand-for-operand (bitwise-
+    identical values). XLA's scheduler can therefore overlap the
+    election collectives (panel psum, W/D psums, TSQR all_gather) with
+    the trailing update on a mesh. Cost: one redundant (Ml, v)-slab GEMM
+    per superstep.
     """
     mesh = lookup_mesh(mesh_key)
     v = geom.v
@@ -285,80 +297,93 @@ def _build_full(geom, mesh_key, precision, backend: str, chunk: int,
             """Two-pass replicated TSQR election on the (Ml, v) panel."""
             return _two_pass_tsqr(P_, Px, chunk, 2, prec)
 
-        def body(k, carry):
-            Aloc, Rloc = carry
+        def panel_reduce(Asrc, k):
+            """Column panel k: one psum over ('y','z')."""
+            i0 = jnp.zeros((), jnp.int32)
+            lj = jnp.asarray((k // Py) * v, jnp.int32)  # k may be a py int
+            panel_loc = lax.dynamic_slice(Asrc, (i0, lj), (Ml, v))
+            return lax.psum(
+                jnp.where(y == k % Py, panel_loc, jnp.zeros((), dtype)),
+                (AXIS_Y, AXIS_Z)).astype(cdtype)
+
+        def reproject(Asrc, P_, k):
+            """BCGS2 re-projection of panel P_ against the finished Q
+            columns of Asrc (tiles < k). Returns (W, P_reprojected);
+            `Asrc` is the loop matrix in body, or the Q-write-only
+            matrix A_q in the lookahead carry computation."""
+            z0 = z == 0
+            col_done = ctile < k
+
+            def seg_c_done(clo):
+                return (clo // v) * Py + y < k
+
+            # W = Q_done^T P, (Nl, v), rows indexed by my local cols;
+            # Q columns live on layer 0 only
+            wparts = []
+            for clo, chi in col_segs:
+                dm = col_done[clo:chi]
+                wparts.append(lax.cond(
+                    seg_c_done(clo),
+                    lambda a, m: jnp.matmul(
+                        jnp.where(m[:, None],
+                                  a.conj().T.astype(cdtype), 0.0),
+                        P_, precision=prec),
+                    # pcast matches the compute branch's varying
+                    # axes (a: x/z, m: y) for the cond output type
+                    lambda a, m: _vary(jnp.zeros((a.shape[1], v),
+                                                 cdtype)),
+                    jnp.where(z0, lax.slice(
+                        Asrc, (0, clo), (Ml, chi)), jnp.zeros((), dtype)),
+                    dm,
+                ))
+            W = lax.psum(
+                jnp.concatenate(wparts, axis=0) if len(wparts) > 1
+                else wparts[0],
+                (AXIS_X, AXIS_Z))  # (Nl, v) replicated over x, z
+            # P -= Q_done W: per-segment local partials (NO
+            # collective inside the cond — divergent predicates across
+            # y would deadlock a psum), one unconditional psum over 'y'
+            # (columns are y-partitioned; rows stay local to x) + 'z'
+            # (Q lives on layer 0) at the end
+            Dacc = _vary(jnp.zeros((Ml, v), cdtype))
+            for clo, chi in col_segs:
+                dm = col_done[clo:chi]
+
+                def proj(acc, clo=clo, chi=chi, dm=dm):
+                    Qseg = jnp.where(
+                        dm[:, None].T & z0,
+                        lax.slice(Asrc, (0, clo), (Ml, chi)).astype(cdtype),
+                        0.0)
+                    return acc + jnp.matmul(Qseg, W[clo:chi],
+                                            precision=prec)
+
+                Dacc = lax.cond(seg_c_done(clo), proj,
+                                lambda acc: acc, Dacc)
+            return W, P_ - lax.psum(Dacc, (AXIS_Y, AXIS_Z))
+
+        def elect(Asrc, k):
+            """panel reduce + BCGS2 re-projection + TSQR election: the
+            whole per-step panel pipeline (everything the lookahead
+            carries ahead)."""
+            with jax.named_scope("qr_panel_reduce"):
+                P_ = panel_reduce(Asrc, k)
+            with jax.named_scope("qr_reproject"):
+                W, P_ = reproject(Asrc, P_, k)
+            with jax.named_scope("qr_panel_tsqr"):
+                Qp, Rp = tsqr_panel(P_)
+            return W, Qp, Rp
+
+        def body_core(k, Aloc, Rloc, W, Qp, Rp):
             i0 = jnp.zeros((), jnp.int32)
             z0 = z == 0
             yo = k % Py
             xo = k % Px
             lj = ((k // Py) * v).astype(jnp.int32)
             lir = ((k // Px) * v).astype(jnp.int32)  # R-local row slab
-            col_done = ctile < k
             col_live = ctile > k
-
-            # segment liveness as scalar tile-index compares (done is a
-            # tile prefix, live a tile suffix, both monotone in the local
-            # tile index — see lu.distributed.seg_r_live)
-            def seg_c_done(clo):
-                return (clo // v) * Py + y < k
 
             def seg_c_live(chi):
                 return ((chi - 1) // v) * Py + y > k
-
-            with jax.named_scope("qr_panel_reduce"):
-                panel_loc = lax.dynamic_slice(Aloc, (i0, lj), (Ml, v))
-                P_ = lax.psum(
-                    jnp.where(y == yo, panel_loc, jnp.zeros((), dtype)),
-                    (AXIS_Y, AXIS_Z)).astype(cdtype)
-
-            # ---- BCGS2 re-projection against finished Q columns -------- #
-            with jax.named_scope("qr_reproject"):
-                # W = Q_done^T P, (Nl, v), rows indexed by my local cols;
-                # Q columns live on layer 0 only
-                wparts = []
-                for clo, chi in col_segs:
-                    dm = col_done[clo:chi]
-                    wparts.append(lax.cond(
-                        seg_c_done(clo),
-                        lambda a, m: jnp.matmul(
-                            jnp.where(m[:, None],
-                                      a.conj().T.astype(cdtype), 0.0),
-                            P_, precision=prec),
-                        # pcast matches the compute branch's varying
-                        # axes (a: x/z, m: y) for the cond output type
-                        lambda a, m: _vary(jnp.zeros((a.shape[1], v),
-                                                     cdtype)),
-                        jnp.where(z0, lax.slice(
-                            Aloc, (0, clo), (Ml, chi)), jnp.zeros((), dtype)),
-                        dm,
-                    ))
-                W = lax.psum(
-                    jnp.concatenate(wparts, axis=0) if len(wparts) > 1
-                    else wparts[0],
-                    (AXIS_X, AXIS_Z))  # (Nl, v) replicated over x, z
-                # P -= Q_done W: per-segment local partials (NO
-                # collective inside the cond — divergent predicates across
-                # y would deadlock a psum), one unconditional psum over 'y'
-                # (columns are y-partitioned; rows stay local to x) + 'z'
-                # (Q lives on layer 0) at the end
-                Dacc = _vary(jnp.zeros((Ml, v), cdtype))
-                for clo, chi in col_segs:
-                    dm = col_done[clo:chi]
-
-                    def proj(acc, clo=clo, chi=chi, dm=dm):
-                        Qseg = jnp.where(
-                            dm[:, None].T & z0,
-                            lax.slice(Aloc, (0, clo), (Ml, chi)).astype(cdtype),
-                            0.0)
-                        return acc + jnp.matmul(Qseg, W[clo:chi],
-                                                precision=prec)
-
-                    Dacc = lax.cond(seg_c_done(clo), proj,
-                                    lambda acc: acc, Dacc)
-                P_ = P_ - lax.psum(Dacc, (AXIS_Y, AXIS_Z))
-
-            with jax.named_scope("qr_panel_tsqr"):
-                Qp, Rp = tsqr_panel(P_)
 
             # ---- trailing projection C = Qp^T A (first GS sweep) ------- #
             with jax.named_scope("qr_trailing_c"):
@@ -436,9 +461,70 @@ def _build_full(geom, mesh_key, precision, backend: str, chunk: int,
                 wcol = wcol + jnp.where(
                     (y == yo) & z0, Wr.astype(dtype), jnp.zeros((), dtype))
                 Rnew = lax.dynamic_update_slice(Rnew, wcol, (i0, lj))
+            art = dict(Qps=Qps, Cs=Cs, qcol=qcol, lj=lj, yo=yo)
+            return Anew, Rnew, art
+
+        def body(k, carry):
+            Aloc, Rloc = carry
+            W, Qp, Rp = elect(Aloc, k)
+            Anew, Rnew, _ = body_core(k, Aloc, Rloc, W, Qp, Rp)
             return Anew, Rnew
 
-        Aloc, Rloc = lax.fori_loop(k0, k_end, body, (Aloc, Rloc))
+        def body_la(k, carry):
+            # software-pipelined body: this step's election arrives in
+            # the carry; the next step's election is computed from
+            # sources with no dataflow edge to the trailing GEMMs so
+            # XLA can overlap its collectives with them on a mesh.
+            Aloc, Rloc, W, Qp, Rp = carry
+            Anew, Rnew, art = body_core(k, Aloc, Rloc, W, Qp, Rp)
+            kn = k + 1
+            i0 = jnp.zeros((), jnp.int32)
+
+            def compute_next(_):
+                # A_q: pre-update matrix + ONLY the Q-column write —
+                # value-identical to Anew at every done column (the
+                # trailing update touches live columns only; tile k's
+                # column is neither: it is overwritten by qcol), but
+                # dataflow-independent of the segment GEMMs
+                A_q = jnp.where(
+                    y == art["yo"],
+                    lax.dynamic_update_slice(Aloc, art["qcol"],
+                                             (i0, art["lj"])),
+                    Aloc)
+                # panel slab of tile kn, updated by a GEMM that mirrors
+                # the segment update operand-for-operand (same z-slab
+                # operands Qps/Cs -> bitwise-identical values)
+                with jax.named_scope("qr_panel_reduce"):
+                    lj1 = ((kn // Py) * v).astype(jnp.int32)
+                    slab = lax.dynamic_slice(Aloc, (i0, lj1), (Ml, v))
+                    upd = blas.gemm(
+                        art["Qps"],
+                        lax.dynamic_slice(art["Cs"], (i0, lj1),
+                                          (nlayr, v)),
+                        precision=prec, backend=backend)
+                    slab = slab - upd  # tile kn is fully live at step k
+                    P_n = lax.psum(
+                        jnp.where(y == kn % Py, slab,
+                                  jnp.zeros((), dtype)),
+                        (AXIS_Y, AXIS_Z)).astype(cdtype)
+                with jax.named_scope("qr_reproject"):
+                    W_n, P_n = reproject(A_q, P_n, kn)
+                with jax.named_scope("qr_panel_tsqr"):
+                    Qp_n, Rp_n = tsqr_panel(P_n)
+                return W_n, Qp_n, Rp_n
+
+            # the last iteration has no next panel: skip the dangling
+            # election (a whole superstep's collectives + TSQR)
+            W_n, Qp_n, Rp_n = lax.cond(
+                kn < k_end, compute_next, lambda _: (W, Qp, Rp), 0)
+            return Anew, Rnew, W_n, Qp_n, Rp_n
+
+        if lookahead:
+            W0, Qp0, Rp0 = elect(Aloc, k0)
+            Aloc, Rloc, _, _, _ = lax.fori_loop(
+                k0, k_end, body_la, (Aloc, Rloc, W0, Qp0, Rp0))
+        else:
+            Aloc, Rloc = lax.fori_loop(k0, k_end, body, (Aloc, Rloc))
         Qout = lax.psum(Aloc, AXIS_Z)
         Rout = lax.psum(Rloc, AXIS_Z)
         return Qout[None, None], Rout[None, None]
@@ -458,7 +544,8 @@ def _build_full(geom, mesh_key, precision, backend: str, chunk: int,
 
 def build_program(geom, mesh, precision=None, backend: str | None = None,
                   chunk: int | None = None, donate: bool = False,
-                  resumable: bool = False, csegs: int = 8):
+                  resumable: bool = False, csegs: int = 8,
+                  lookahead: bool = False):
     """The jitted block-cyclic QR program itself (cached per config) —
     the single point resolving trace-time defaults, mirroring
     `lu.distributed.build_program`. Direct use is for callers needing
@@ -473,25 +560,28 @@ def build_program(geom, mesh, precision=None, backend: str | None = None,
             f"csegs must be a positive segment count, got {csegs} "
             "(non-positive counts would silently skip trailing updates)")
     return _build_full(geom, mesh_cache_key(mesh), precision, backend,
-                       chunk, donate, resumable, csegs)
+                       chunk, donate, resumable, csegs, lookahead)
 
 
 def qr_factor_distributed(shards, geom, mesh, precision=None,
                           backend: str | None = None,
                           chunk: int | None = None, donate: bool = False,
-                          csegs: int = 8):
+                          csegs: int = 8, lookahead: bool = False):
     """Blocked QR of block-cyclic (Px, Py, Ml, Nl) shards on the mesh.
 
     Returns (Q_shards, R_shards): Q thin (M, N) in A's layout, R upper-
     triangular (N, N) block-cyclic over its own geometry (gather it with
-    `r_geometry(geom)`). See `_build_full` for the algorithm.
-    """
+    `r_geometry(geom)`). See `_build_full` for the algorithm;
+    `lookahead=True` software-pipelines the loop (P8 — next panel's
+    election overlaps the trailing update on a mesh; bitwise-identical
+    results)."""
     from conflux_tpu.geometry import check_shards
 
     shards = jnp.asarray(shards)
     check_shards(shards, geom)
     fn = build_program(geom, mesh, precision=precision, backend=backend,
-                       chunk=chunk, donate=donate, csegs=csegs)
+                       chunk=chunk, donate=donate, csegs=csegs,
+                       lookahead=lookahead)
     return fn(shards)
 
 

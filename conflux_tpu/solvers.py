@@ -353,8 +353,8 @@ def solve_distributed(A, b, *, grid=None, v: int = 1024, mesh=None,
                                       precision=precision, segs=segs,
                                       tree=tree)
 
-    b_r = jnp.asarray(b, residual_dtype)
     if ir == "gmres":
+        b_r = jnp.asarray(b, residual_dtype)
         # GMRES-IR: the factors precondition FGMRES instead of driving a
         # Richardson iteration — converges where classic IR diverges
         # (cond(A)·eps_factor ~ 1, the bf16/bf16x3 factor regime). This
@@ -377,15 +377,25 @@ def solve_distributed(A, b, *, grid=None, v: int = 1024, mesh=None,
                 "— raise max_restarts/restart or improve the factors",
                 RuntimeWarning, stacklevel=2)
         return x
-    # classic IR: x and b stay in the high (residual) precision — a b
-    # downcast would make IR converge to A x = low(b) instead — and only
-    # the corrections ride the low-precision factors
-    x = lu_solve_distributed(out, perm, geom, mesh,
-                             b_r.astype(cdtype)).astype(residual_dtype)
-    for _ in range(refine):
-        r = _residual_strips(A, x, b_r, residual_dtype)
-        corr = lu_solve_distributed(out, perm, geom, mesh, r.astype(cdtype))
-        x = x + corr.astype(residual_dtype)
+    return refine_classic(
+        lambda r: lu_solve_distributed(out, perm, geom, mesh, r),
+        A, b, refine, residual_dtype, cdtype)
+
+
+def refine_classic(solve_fn, A, b, sweeps: int, rdtype, corr_dtype):
+    """Classic (Richardson) iterative refinement: x0 = solve(b), then
+    `sweeps` rounds of x += solve(b - A x). The single implementation of
+    the numerically delicate discipline shared by `solve_distributed`,
+    the miniapp's --refine and the bench: x and b stay in the high
+    (residual) precision `rdtype` — a b downcast would make IR converge
+    to A x = low(b) instead — and only the corrections ride the
+    low-precision factors through `solve_fn` (input cast to
+    `corr_dtype`)."""
+    b_r = jnp.asarray(b, rdtype)
+    x = solve_fn(jnp.asarray(b, corr_dtype)).astype(rdtype)
+    for _ in range(sweeps):
+        r = _residual_strips(A, x, b_r, rdtype)
+        x = x + solve_fn(r.astype(corr_dtype)).astype(rdtype)
     return x
 
 
@@ -403,7 +413,7 @@ def fgmres(matvec, precond, b, *, args=(), x0=None, tol: float = 1e-6,
     converges whenever the preconditioned spectrum clusters).
 
     TPU-native structure: each restart cycle is ONE jitted program — the
-    full Arnoldi process with masked modified Gram-Schmidt runs
+    full Arnoldi process with masked reorthogonalized Gram-Schmidt (CGS2) runs
     device-resident (`lax.fori_loop` over the basis; H and the Krylov
     bases V, Z are fixed-shape carries), so a cycle costs zero host
     round-trips; the only readback per cycle is the small H matrix and
@@ -479,13 +489,21 @@ def _fgmres_cycle(matvec, precond, m: int, rdtype_name: str):
             V, Z, H = carry
             z = precond(V[j], *args).astype(rdtype)
             w = matvec(z, *args).astype(rdtype)
-            # masked modified Gram-Schmidt: dot against every basis row,
-            # rows > j are zero so their coefficients vanish — the loop
-            # body stays fixed-shape for the one-compile cycle
-            h = V @ w  # (m+1,)
+            # masked classical Gram-Schmidt with reorthogonalization
+            # (CGS2): two batched projection passes against the whole
+            # basis — rows > j are zero so their coefficients vanish and
+            # the loop body stays fixed-shape for the one-compile cycle.
+            # Single-pass CGS loses orthogonality at O(eps*kappa^2) on
+            # ill-conditioned preconditioned operators (exactly the weak-
+            # factor regime GMRES-IR exists for); CGS2 restores it at the
+            # cost of two extra (m+1, N) GEMVs, and unlike true MGS stays
+            # batched (no serial per-column dependence).
             mask = jnp.arange(m + 1) <= j
-            h = jnp.where(mask, h, 0)
+            h = jnp.where(mask, V @ w, 0)  # (m+1,)
             w = w - V.T @ h
+            h2 = jnp.where(mask, V @ w, 0)
+            w = w - V.T @ h2
+            h = h + h2
             hn = jnp.sqrt(jnp.sum(w * w))
             V = V.at[j + 1].set(w / jnp.where(hn > 0, hn, 1))
             H = H.at[:, j].set(h).at[j + 1, j].set(hn)
