@@ -71,6 +71,109 @@ class BlockCyclicLayout:
         )
 
 
+@dataclasses.dataclass(frozen=True)
+class CustomLayout:
+    """Explicit per-tile owner layout — the `costa::custom_layout` role
+    (`src/conflux/lu/layout.cpp:114-135`): uniform (vr, vc) tiles whose
+    owners form an ARBITRARY (Mt, Nt, 2) array rather than the cyclic
+    `(ti % Prows, tj % Pcols)` rule. conflux itself only ever builds the
+    cyclic form, but COSTA accepts any owner array; this closes that
+    last sliver of the adapter surface.
+
+    Local storage convention: because an arbitrary owner set is not a
+    product of row/col tile sets, a coordinate's tiles do not pack into
+    one rectangle — storage is `{(p, q): {(ti, tj): tile}}` with each
+    tile row-major and trailing tiles short, matching COSTA's
+    block-pointer representation rather than ScaLAPACK's dense local
+    matrix."""
+
+    M: int
+    N: int
+    vr: int
+    vc: int
+    owners: tuple  # hashable (Mt, Nt, 2) owner entries; use .owner()
+
+    @classmethod
+    def from_owner_map(cls, M: int, N: int, vr: int, vc: int,
+                       owners: np.ndarray) -> "CustomLayout":
+        owners = np.asarray(owners, dtype=np.int64)
+        Mt, Nt = -(-M // vr), -(-N // vc)
+        if owners.shape != (Mt, Nt, 2):
+            raise ValueError(
+                f"owner map shape {owners.shape} != tile grid {(Mt, Nt, 2)}")
+        if owners.min() < 0:
+            raise ValueError("owner coordinates must be non-negative")
+        return cls(M=M, N=N, vr=vr, vc=vc,
+                   owners=tuple(map(tuple, owners.reshape(-1, 2).tolist())))
+
+    def tile_counts(self) -> tuple[int, int]:
+        return -(-self.M // self.vr), -(-self.N // self.vc)
+
+    def owner(self, ti: int, tj: int) -> tuple[int, int]:
+        _, Nt = self.tile_counts()
+        return self.owners[ti * Nt + tj]
+
+    def tile_shape(self, ti: int, tj: int) -> tuple[int, int]:
+        return (min((ti + 1) * self.vr, self.M) - ti * self.vr,
+                min((tj + 1) * self.vc, self.N) - tj * self.vc)
+
+    def scatter(self, A: np.ndarray) -> dict:
+        """Split a host matrix into the per-owner tile stores."""
+        out: dict = {}
+        Mt, Nt = self.tile_counts()
+        for ti in range(Mt):
+            for tj in range(Nt):
+                r0, c0 = ti * self.vr, tj * self.vc
+                h, w = self.tile_shape(ti, tj)
+                out.setdefault(self.owner(ti, tj), {})[(ti, tj)] = (
+                    A[r0 : r0 + h, c0 : c0 + w].copy())
+        return out
+
+    def gather(self, store: dict) -> np.ndarray:
+        """Inverse of :meth:`scatter`."""
+        some = next(iter(next(iter(store.values())).values()))
+        A = np.zeros((self.M, self.N), some.dtype)
+        Mt, Nt = self.tile_counts()
+        for ti in range(Mt):
+            for tj in range(Nt):
+                tile = store[self.owner(ti, tj)][(ti, tj)]
+                A[ti * self.vr : ti * self.vr + tile.shape[0],
+                  tj * self.vc : tj * self.vc + tile.shape[1]] = tile
+        return A
+
+
+def _src_view(shards, src, r: int, r_end: int, c: int, c_end: int):
+    """View of global region [r:r_end, c:c_end] — which must lie within
+    ONE source tile — from either layout kind's storage."""
+    sti, stj = r // src.vr, c // src.vc
+    sp, sq = src.owner(sti, stj)
+    if isinstance(src, CustomLayout):
+        tile = shards[sp, sq][(sti, stj)]
+        return tile[r - sti * src.vr : r_end - sti * src.vr,
+                    c - stj * src.vc : c_end - stj * src.vc]
+    sbuf = shards[sp][sq]
+    sr = ((sti - sp) // src.Prows) * src.vr + (r - sti * src.vr)
+    sc = ((stj - sq) // src.Pcols) * src.vc + (c - stj * src.vc)
+    return sbuf[sr : sr + (r_end - r), sc : sc + (c_end - c)]
+
+
+def _copy_region(shards, src, r0: int, r1: int, c0: int, c1: int,
+                 out: np.ndarray, or0: int, oc0: int) -> None:
+    """Walk the source tiles covering [r0:r1, c0:c1] and copy into
+    out[or0.., oc0..] — the shared kernel of every transform direction."""
+    r = r0
+    while r < r1:
+        r_end = min((r // src.vr + 1) * src.vr, r1)
+        c = c0
+        while c < c1:
+            c_end = min((c // src.vc + 1) * src.vc, c1)
+            out[or0 + (r - r0) : or0 + (r - r0) + (r_end - r),
+                oc0 + (c - c0) : oc0 + (c - c0) + (c_end - c)] = (
+                _src_view(shards, src, r, r_end, c, c_end))
+            c = c_end
+        r = r_end
+
+
 def numroc(n: int, nb: int, iproc: int, isrcproc: int, nprocs: int) -> int:
     """NUMber of Rows Or Columns: ScaLAPACK's exact `numroc` formula
     (the reference links it via `examples/utils.hpp` local-size math).
@@ -198,28 +301,48 @@ def gather(shards: list[list[np.ndarray]], layout: BlockCyclicLayout) -> np.ndar
     return A
 
 
-def transform(shards: list[list[np.ndarray]], src: BlockCyclicLayout,
-              dst: BlockCyclicLayout) -> list[list[np.ndarray]]:
-    """Redistribute between two block-cyclic layouts (the `costa::transform`
-    role, `examples/conflux_miniapp.cpp:349-353`). Tile sizes and grids may
-    differ; shapes must agree.
+def transform(shards, src, dst):
+    """Redistribute between layouts (the `costa::transform` role,
+    `examples/conflux_miniapp.cpp:349-353`). Either side may be a
+    :class:`BlockCyclicLayout` (list-of-lists local rectangles) or a
+    :class:`CustomLayout` (per-owner tile stores); tile sizes and grids
+    may differ; shapes must agree.
 
-    Streams tile intersections directly from source local buffers into each
-    destination local buffer — COSTA's whole reason to exist is moving
-    between layouts *without* materializing the global matrix
+    Streams tile intersections directly from source local buffers into
+    each destination local buffer — COSTA's whole reason to exist is
+    moving between layouts *without* materializing the global matrix
     (`src/conflux/lu/layout.cpp:48`), so peak extra memory here is one
-    destination-coordinate buffer, never (M, N).
+    destination-coordinate buffer (block-cyclic) or one tile (custom),
+    never (M, N).
     """
     if (src.M, src.N) != (dst.M, dst.N):
         raise ValueError(f"layout shapes differ: {(src.M, src.N)} vs {(dst.M, dst.N)}")
+    if isinstance(dst, CustomLayout):
+        dtype = _src_dtype(shards, src)
+        out: dict = {}
+        Mt, Nt = dst.tile_counts()
+        for ti in range(Mt):
+            for tj in range(Nt):
+                h, w = dst.tile_shape(ti, tj)
+                tile = np.zeros((h, w), dtype)
+                _copy_region(shards, src, ti * dst.vr, ti * dst.vr + h,
+                             tj * dst.vc, tj * dst.vc + w, tile, 0, 0)
+                out.setdefault(dst.owner(ti, tj), {})[(ti, tj)] = tile
+        return out
     return [
         [_build_local(shards, src, dst, p, q) for q in range(dst.Pcols)]
         for p in range(dst.Prows)
     ]
 
 
-def _build_local(shards: list[list[np.ndarray]], src: BlockCyclicLayout,
-                 dst: BlockCyclicLayout, p: int, q: int) -> np.ndarray:
+def _src_dtype(shards, src):
+    if isinstance(src, CustomLayout):
+        return next(iter(next(iter(shards.values())).values())).dtype
+    return shards[0][0].dtype
+
+
+def _build_local(shards, src, dst: BlockCyclicLayout, p: int,
+                 q: int) -> np.ndarray:
     """One destination coordinate's local buffer, assembled from the source
     tiles intersecting each of its tiles. Short trailing tiles are safe on
     both sides: a block-cyclic owner's short tile is always its LAST local
@@ -227,7 +350,7 @@ def _build_local(shards: list[list[np.ndarray]], src: BlockCyclicLayout,
     Mt, Nt = dst.tile_counts()
     row_tiles = range(p, Mt, dst.Prows)
     col_tiles = range(q, Nt, dst.Pcols)
-    dtype = shards[0][0].dtype
+    dtype = _src_dtype(shards, src)
     if not len(row_tiles) or not len(col_tiles):
         # same one-sided numroc extents as scatter's empty shards
         return np.zeros(dst.local_shape(p, q), dtype)
@@ -236,22 +359,6 @@ def _build_local(shards: list[list[np.ndarray]], src: BlockCyclicLayout,
         r0, r1 = ti * dst.vr, min((ti + 1) * dst.vr, dst.M)
         for lj, tj in enumerate(col_tiles):
             c0, c1 = tj * dst.vc, min((tj + 1) * dst.vc, dst.N)
-            r = r0
-            while r < r1:  # walk the source tiles covering [r0:r1, c0:c1]
-                sti = r // src.vr
-                r_end = min((sti + 1) * src.vr, r1)
-                c = c0
-                while c < c1:
-                    stj = c // src.vc
-                    c_end = min((stj + 1) * src.vc, c1)
-                    sp, sq = src.owner(sti, stj)
-                    sbuf = shards[sp][sq]
-                    sr = ((sti - sp) // src.Prows) * src.vr + (r - sti * src.vr)
-                    sc = ((stj - sq) // src.Pcols) * src.vc + (c - stj * src.vc)
-                    loc[
-                        li * dst.vr + (r - r0) : li * dst.vr + (r - r0) + (r_end - r),
-                        lj * dst.vc + (c - c0) : lj * dst.vc + (c - c0) + (c_end - c),
-                    ] = sbuf[sr : sr + (r_end - r), sc : sc + (c_end - c)]
-                    c = c_end
-                r = r_end
+            _copy_region(shards, src, r0, r1, c0, c1,
+                         loc, li * dst.vr, lj * dst.vc)
     return loc

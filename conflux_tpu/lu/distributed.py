@@ -68,19 +68,20 @@ from conflux_tpu.parallel.mesh import (
 
 _GRI_SENTINEL = np.iinfo(np.int32).max
 
-# Default nomination chunk. Unlike ops/blas._PANEL_CHUNK (4096, the safe
-# height for *batched* LU custom calls — batch x height shares one scoped
-# VMEM budget), the chunk_live nomination runs each chunk as a separate
-# cond'd call, so a single 8192-row call is VMEM-safe and measured faster
-# (10.5 vs 9.8 TFLOP/s at N=32768/v=1024 on a v5e).
-_DEFAULT_PANEL_CHUNK = 8192
+# The default nomination chunk is blas.single_call_rows(v): unlike the
+# batched ceiling (blas.batched_call_rows — batch x height shares one
+# scoped VMEM budget), the chunk_live nomination runs each chunk as a
+# separate cond'd call, so the full single-call height is VMEM-safe and
+# measured faster (10.5 vs 9.8 TFLOP/s at N=32768/v=1024 on a v5e, where
+# the derived values pin to 8192/4096).
 
 
 @functools.lru_cache(maxsize=32)
 def _build(geom: LUGeometry, mesh_key, precision, backend: str,
            panel_chunk: int, donate: bool = False, resumable: bool = False,
            lookahead: bool = False, election: str = "gather",
-           segs: tuple = (16, 16), tree: str = "pairwise"):
+           segs: tuple = (16, 16), tree: str = "pairwise",
+           swap: str = "xla"):
     """resumable=True builds the checkpoint/restart form: factor supersteps
     [k0, k1) given as TRACED scalars — one compile serves every segment of
     a checkpointed run — with the row-origin state as an explicit
@@ -202,7 +203,8 @@ def _build(geom: LUGeometry, mesh_key, precision, backend: str,
                     stack = jnp.concatenate([top[0], bot[0]], axis=0)
                     ids = jnp.concatenate([top[1], bot[1]])
                     lu00_, wid = blas.tournament_winners(
-                        stack, chunk=min(panel_chunk, blas._PANEL_CHUNK))
+                        stack, chunk=min(panel_chunk,
+                                         blas.batched_call_rows(v, cdtype)))
                     return (jnp.take(stack, wid, axis=0, mode="fill",
                                      fill_value=0),
                             jnp.take(ids, wid, mode="fill",
@@ -220,7 +222,9 @@ def _build(geom: LUGeometry, mesh_key, precision, backend: str,
             # structure), so its chunk stays within the batched
             # VMEM-safe bound
             lu00, wid = blas.tournament_winners(
-                flat, chunk=min(panel_chunk, blas._PANEL_CHUNK), tree=tree)
+                flat, chunk=min(panel_chunk,
+                                blas.batched_call_rows(v, cdtype)),
+                tree=tree)
             # winners' positions in pivot order — replicated on
             # every device, no broadcast needed
             wpos = jnp.take(poss.reshape(Px * v), wid, mode="fill",
@@ -296,9 +300,21 @@ def _build(geom: LUGeometry, mesh_key, precision, backend: str,
                 # rows are fully rewritten after the GEMM. Swapped rows
                 # carry their z-summed value on layer 0, zeros elsewhere.
                 didx = loc_of(dest_disp)
-                Aloc = Aloc.at[didx].set(
-                    jnp.where(z0, Drows.astype(dtype), jnp.zeros((), dtype)),
-                    mode="drop")
+                disp_vals = jnp.where(z0, Drows.astype(dtype),
+                                      jnp.zeros((), dtype))
+                if swap == "dma":
+                    # EXPERIMENTAL: pipelined row DMAs through a VMEM
+                    # stage instead of XLA's serial per-row scatter loop
+                    # (~10 ms/step at v=1024, N=32768 — the "other"
+                    # phase-table bucket). Unverified on hardware; see
+                    # ops/pallas_kernels.scatter_rows and
+                    # scripts/swap_probe.py for the A/B protocol.
+                    from conflux_tpu.ops import pallas_kernels
+
+                    Aloc = pallas_kernels.scatter_rows(
+                        Aloc, disp_vals, didx, use_dma=True)
+                else:
+                    Aloc = Aloc.at[didx].set(disp_vals, mode="drop")
                 orig = jnp.where(
                     own_d, lax.dynamic_update_slice(orig, worig, (li,)), orig)
                 orig = orig.at[didx].set(dorig, mode="drop")
@@ -521,7 +537,8 @@ def build_program(geom: LUGeometry, mesh, precision=None,
                   backend: str | None = None, panel_chunk: int | None = None,
                   donate: bool = False, resumable: bool = False,
                   lookahead: bool = False, election: str = "gather",
-                  segs: tuple = (16, 16), tree: str = "pairwise"):
+                  segs: tuple = (16, 16), tree: str = "pairwise",
+                  swap: str = "xla"):
     """The jitted distributed-LU program itself (cached per config).
 
     The single point resolving the trace-time defaults (precision/backend/
@@ -534,7 +551,10 @@ def build_program(geom: LUGeometry, mesh, precision=None,
     precision = blas.matmul_precision() if precision is None else precision
     backend = blas.get_backend() if backend is None else backend
     if panel_chunk is None:
-        panel_chunk = _DEFAULT_PANEL_CHUNK
+        # dtype-blind fallback (no shards in scope): f32 compute is the
+        # TPU reality for real dtypes; the entry points that hold shards
+        # resolve with the true compute dtype before calling
+        panel_chunk = blas.single_call_rows(geom.v)
     if donate and next(iter(mesh.devices.flat)).platform == "cpu":
         donate = False  # CPU PJRT has no buffer donation (warns per call)
     if election not in ("gather", "butterfly"):
@@ -553,14 +573,14 @@ def build_program(geom: LUGeometry, mesh, precision=None,
         raise ValueError(f"unknown tree {tree!r} (pairwise|flat)")
     if tree == "flat":
         # the flat election is ONE (nch*v, v) LU custom call per
-        # tournament; keep every such stack within the measured
-        # single-call VMEM-safe height (8192 ok, 16384 fails to compile
-        # on v5e — ops/blas.py panel notes). Two tournaments can go
-        # flat: the local nomination over Ml rows, and (gather election,
-        # Px > 1) the cross-x election over the Px*v nominee panel,
-        # whose chunk is additionally capped at blas._PANEL_CHUNK (the
-        # elect() call site). Butterfly's pair reductions are 2v tall —
-        # single-chunk at any legal v, never a flat stack.
+        # tournament; keep every such stack within the derived
+        # single-call VMEM-safe height (blas.single_call_rows — v5e pin
+        # 8192 rows at v=1024; 16384 fails to compile). Two tournaments
+        # can go flat: the local nomination over Ml rows, and (gather
+        # election, Px > 1) the cross-x election over the Px*v nominee
+        # panel, whose chunk is additionally capped at the batched bound
+        # (the elect() call site). Butterfly's pair reductions are 2v
+        # tall — single-chunk at any legal v, never a flat stack.
         v = geom.v
         stacks = []
         _, nch = blas.chunk_layout(geom.Ml, v, panel_chunk)
@@ -568,21 +588,21 @@ def build_program(geom: LUGeometry, mesh, precision=None,
             stacks.append(nch * v)
         if geom.grid.Px > 1 and election == "gather":
             _, nch2 = blas.chunk_layout(
-                geom.grid.Px * v, v, min(panel_chunk, blas._PANEL_CHUNK))
+                geom.grid.Px * v, v,
+                min(panel_chunk, blas.batched_call_rows(v)))
             if nch2 > 1:
                 stacks.append(nch2 * v)
-        # scoped-VMEM footprint scales with rows*v elements; the measured
-        # safe point is 8192 rows AT v=1024 (16384x1024 fails), so bound
-        # the element count, not the row count
-        if stacks and max(stacks) * v > 8192 * 1024:
+        if stacks and max(stacks) > blas.single_call_rows(v):
             raise ValueError(
                 f"tree='flat' would stack {max(stacks)} nominee rows of "
-                f"width {v} in one LU call (> the 8192x1024-element "
-                "VMEM-safe size); raise panel_chunk or use "
-                "tree='pairwise'")
+                f"width {v} in one LU call (> the "
+                f"{blas.single_call_rows(v)}-row VMEM-safe height); "
+                "raise panel_chunk or use tree='pairwise'")
+    if swap not in ("xla", "dma"):
+        raise ValueError(f"unknown swap {swap!r} (xla|dma)")
     return _build(geom, mesh_cache_key(mesh), precision, backend,
                   panel_chunk, donate, resumable, lookahead, election,
-                  tuple(segs), tree)
+                  tuple(segs), tree, swap)
 
 
 def lu_factor_distributed(shards, geom: LUGeometry, mesh,
@@ -590,7 +610,7 @@ def lu_factor_distributed(shards, geom: LUGeometry, mesh,
                           panel_chunk: int | None = None,
                           donate: bool = False, lookahead: bool = False,
                           election: str = "gather", segs: tuple = (16, 16),
-                          tree: str = "pairwise"):
+                          tree: str = "pairwise", swap: str = "xla"):
     """Factor block-cyclic shards (Px, Py, Ml, Nl) in place on a mesh.
 
     Returns (shards_out, perm): shards_out holds the packed factors in
@@ -606,9 +626,10 @@ def lu_factor_distributed(shards, geom: LUGeometry, mesh,
     eliminated before the degeneracy is correct and frozen.
 
     `panel_chunk` bounds the height of every LU call inside the pivot
-    election (default: `_DEFAULT_PANEL_CHUNK` — 8192, safe for the
+    election (default: `blas.single_call_rows(v)` — the derived
+    single-call VMEM-safe height, 8192 on a v5e at v=1024, safe for the
     unbatched cond'd nomination calls; the batched election stack is
-    additionally capped at ops/blas._PANEL_CHUNK).
+    additionally capped at `blas.batched_call_rows(v)`).
     `donate=True` aliases the input shards into the output (the caller's
     array is invalidated) — at N=32768 f32 on a 16 GB chip this saves the
     4 GB that makes the difference between fitting and OOM.
@@ -627,10 +648,13 @@ def lu_factor_distributed(shards, geom: LUGeometry, mesh,
 
     shards = jnp.asarray(shards)
     check_shards(shards, geom)
+    if panel_chunk is None:
+        panel_chunk = blas.single_call_rows(
+            geom.v, blas.compute_dtype(shards.dtype))
     fn = build_program(geom, mesh, precision=precision, backend=backend,
                        panel_chunk=panel_chunk, donate=donate,
                        lookahead=lookahead, election=election,
-                       segs=segs, tree=tree)
+                       segs=segs, tree=tree, swap=swap)
     return fn(shards)
 
 
@@ -681,6 +705,13 @@ def lu_factor_steps(shards, geom: LUGeometry, mesh, k0: int, k1: int,
     # run keeps the tuned segmentation (math-invariant, perf-only);
     # `tree` rides through because trees may elect different winners on
     # ties — a resume must keep the uninterrupted run's pivot bracket.
+    # The default chunk resolves with the same compute dtype as
+    # lu_factor_distributed's: a dtype-blind default here would chunk a
+    # resumed f64 run differently from the run it resumes (different
+    # nomination bracket -> different pivots).
+    if panel_chunk is None:
+        panel_chunk = blas.single_call_rows(
+            geom.v, blas.compute_dtype(jnp.asarray(shards).dtype))
     fn = build_program(geom, mesh, precision=precision, backend=backend,
                        panel_chunk=panel_chunk, donate=donate,
                        resumable=True, election=election, segs=segs,

@@ -181,14 +181,103 @@ def trsm_left_lower_t(L: jax.Array, B: jax.Array) -> jax.Array:
 
 
 # --------------------------------------------------------------------------- #
-# Panel factorizations
+# VMEM-derived call ceilings
 # --------------------------------------------------------------------------- #
 
-# Rows per local chunk in the tournament panel factorization. XLA's TPU LU
-# custom call factors an (m, v) panel serially in m x 128 column blocks and
-# overflows its 16 MB scoped VMEM once m reaches ~16384; chunking keeps every
-# LU call at a bounded height. 4096 measured fastest on a v5e chip.
-_PANEL_CHUNK = 4096
+# XLA's TPU LU custom call stages its operand through scoped VMEM: on a v5e
+# a single (8192, 1024) f32 call (32 MiB) compiles, (16384, 1024) (64 MiB)
+# does not — an ELEMENT-COUNT wall, so the safe height scales as
+# budget / (itemsize * v). The measured v5e values (8192 rows single-call,
+# 4096 batched, at v=1024 f32) are pinned by tests; other generations get
+# the same model with their own budget via the device-kind table or the
+# explicit override. Overridable because no public API queries scoped VMEM.
+_SCOPED_VMEM_BYTES = None  # explicit override (set_scoped_vmem_bytes)
+
+# budget per device kind, bytes. Only v5e is measured; other rows inherit
+# the conservative v5e figure until measured on hardware.
+_SCOPED_VMEM_TABLE = {
+    "v5 lite": 32 << 20,
+    "v5e": 32 << 20,
+}
+_SCOPED_VMEM_DEFAULT = 32 << 20
+
+
+def set_scoped_vmem_bytes(n: int | None) -> None:
+    """Override the scoped-VMEM budget the chunk ceilings derive from
+    (None restores device-kind detection). Use when a new TPU generation
+    mis-sizes: the pinned table only knows measured hardware."""
+    global _SCOPED_VMEM_BYTES
+    if n is not None and n < (1 << 20):
+        raise ValueError(f"implausible scoped VMEM budget {n} bytes")
+    _SCOPED_VMEM_BYTES = n
+
+
+def scoped_vmem_bytes() -> int:
+    """The scoped-VMEM budget bounding a single LU/QR custom call's
+    operand: override > $CONFLUX_TPU_SCOPED_VMEM_BYTES > device-kind
+    table > conservative default. Device detection may initialize a
+    backend; pure-host callers (e.g. the NumPy spec) pass an explicit
+    `budget` to the ceiling helpers instead of reaching this."""
+    if _SCOPED_VMEM_BYTES is not None:
+        return _SCOPED_VMEM_BYTES
+    import os
+
+    env = os.environ.get("CONFLUX_TPU_SCOPED_VMEM_BYTES")
+    if env:
+        # same validation as set_scoped_vmem_bytes: a typo'd override on
+        # the unmeasured generation the env var exists for must fail
+        # loudly, not silently fall back to detection
+        try:
+            n = int(env)
+        except ValueError:
+            raise ValueError(
+                f"CONFLUX_TPU_SCOPED_VMEM_BYTES={env!r} is not an "
+                "integer byte count") from None
+        if n < (1 << 20):
+            raise ValueError(
+                f"CONFLUX_TPU_SCOPED_VMEM_BYTES={env}: implausible "
+                "scoped VMEM budget (< 1 MiB)")
+        return n
+    try:
+        kind = jax.devices()[0].device_kind.lower()
+        for key, budget in _SCOPED_VMEM_TABLE.items():
+            if key in kind:
+                return budget
+    except Exception:
+        pass
+    return _SCOPED_VMEM_DEFAULT
+
+
+def single_call_rows(v: int, dtype=jnp.float32, budget: int | None = None
+                     ) -> int:
+    """Max rows of ONE (m, v) LU/QR custom call that stays within the
+    scoped-VMEM budget — tile-rounded, at least one tile. v5e pin:
+    single_call_rows(1024) == 8192 (the measured default nomination
+    chunk). `budget` bypasses device detection (pure-host callers)."""
+    budget = scoped_vmem_bytes() if budget is None else budget
+    elems = budget // jnp.dtype(dtype).itemsize
+    return max(v, (elems // v) // v * v)
+
+
+def batched_call_rows(v: int, dtype=jnp.float32, budget: int | None = None
+                      ) -> int:
+    """Max per-element rows of a BATCHED (b, m, v) custom call: the batch
+    shares the scoped budget, so half the single-call height.
+
+    v5e pin: batched_call_rows(1024) == 4096 — the measured-FASTEST chunk
+    as well as the safe one. The model treats that optimum as an
+    ELEMENT count (4 Mi elements), so other widths get equal-footprint
+    (not equal-row) defaults — e.g. 16384 rows at v=256. Only v=1024 is
+    hardware-measured; per-call `chunk=` arguments override everywhere
+    if a width-specific tune disagrees."""
+    budget = scoped_vmem_bytes() if budget is None else budget
+    elems = budget // jnp.dtype(dtype).itemsize // 2
+    return max(v, (elems // v) // v * v)
+
+
+# --------------------------------------------------------------------------- #
+# Panel factorizations
+# --------------------------------------------------------------------------- #
 
 # 'auto' uses plain partial pivoting for short panels and the tournament for
 # tall ones; 'partial'/'tournament' force one path (tests and experiments).
@@ -204,7 +293,10 @@ def set_panel_algo(name: str) -> None:
 
 # VMEM ceiling of the Pallas elimination kernel: the (m, 128) block, the
 # lane-padded (m, 1) masks/temporaries and the double-buffered outputs must
-# stay under the 16 MB scoped VMEM (m=8192 measured 3.8 MB over)
+# stay under the 16 MiB scoped VMEM (m=8192 measured 3.8 MB over). This is
+# a property of the KERNEL's scratch layout (128 lanes x 4 B x ~8 buffers
+# -> 16 MiB / 4 KiB = 4096 rows), not of the LU custom call's budget —
+# a module var (not derived per-call) so tests can shrink the ceiling.
 _PALLAS_MAX_ROWS = 4096
 
 
@@ -264,8 +356,12 @@ def _resolve_panel_algo(dtype, m: int, v: int, algo: str) -> str:
         # measured on v5e (m=4096, v=1024): XLA custom call 11.7 ms, pallas
         # masked elimination 17 ms (its per-step scalar reductions serialize
         # the pipeline) — so 'auto' prefers partial/tournament and 'pallas'
-        # stays opt-in until the kernel wins
-        algo = "tournament" if m > 2 * max(_PANEL_CHUNK, v) else "partial"
+        # stays opt-in until the kernel wins. The threshold derives from
+        # the COMPUTE dtype: a bf16 panel runs f32 panel math, so its
+        # single exact-LU call is f32-sized
+        cd = compute_dtype(dtype)
+        algo = ("tournament" if m > 2 * max(batched_call_rows(v, cd), v)
+                else "partial")
     if algo == "pallas" and not _pallas_panel_ok(dtype, min(m, _PALLAS_MAX_ROWS), v):
         raise ValueError(
             f"pallas panel kernel supports float32 with width a multiple "
@@ -277,8 +373,9 @@ def _resolve_panel_algo(dtype, m: int, v: int, algo: str) -> str:
 def chunk_layout(m: int, v: int, chunk: int | None = None) -> tuple[int, int]:
     """(chunk height c, chunk count nch) used by :func:`tournament_winners`
     for an (m, v) panel — exposed so callers can build per-chunk liveness
-    predicates with the same rounding."""
-    c = chunk if chunk is not None else _PANEL_CHUNK
+    predicates with the same rounding. The default chunk is the batched
+    VMEM-safe height for width v (the chunk round is a batched call)."""
+    c = chunk if chunk is not None else batched_call_rows(v)
     c = min(c, -(-m // v) * v)  # never taller than the (tile-rounded) panel
     c = max(v, c // v * v)  # multiple of v, at least one tile tall
     return c, -(-m // c)

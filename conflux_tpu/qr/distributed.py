@@ -130,7 +130,9 @@ def _factor(shards, mesh, algo: str, chunk: int | None, passes: int,
             f"shards leading dim {Px} != mesh x extent {mesh.shape[AXIS_X]}")
     if Px * Ml < n:
         raise ValueError(f"need M = {Px * Ml} >= n = {n}")
-    chunk = blas._PANEL_CHUNK if chunk is None else chunk
+    if chunk is None:
+        chunk = blas.batched_call_rows(
+            n, blas.compute_dtype(shards.dtype))
     if tree not in ("gather", "butterfly"):
         raise ValueError(f"unknown tree {tree!r} (gather|butterfly)")
     if tree == "butterfly" and Px > 1 and (Px & (Px - 1)):
@@ -552,7 +554,11 @@ def build_program(geom, mesh, precision=None, backend: str | None = None,
     the compile artifacts (the miniapp's --profile phase table)."""
     precision = blas.matmul_precision() if precision is None else precision
     backend = blas.get_backend() if backend is None else backend
-    chunk = blas._PANEL_CHUNK if chunk is None else chunk
+    if chunk is None:
+        # dtype-blind fallback (no shards in scope here): f32 compute is
+        # the TPU reality for real dtypes; the entry points that hold
+        # shards resolve with the true compute dtype before calling
+        chunk = blas.batched_call_rows(geom.v)
     if donate and next(iter(mesh.devices.flat)).platform == "cpu":
         donate = False
     if csegs < 1:
@@ -579,6 +585,9 @@ def qr_factor_distributed(shards, geom, mesh, precision=None,
 
     shards = jnp.asarray(shards)
     check_shards(shards, geom)
+    if chunk is None:
+        chunk = blas.batched_call_rows(
+            geom.v, blas.compute_dtype(shards.dtype))
     fn = build_program(geom, mesh, precision=precision, backend=backend,
                        chunk=chunk, donate=donate, csegs=csegs,
                        lookahead=lookahead)
@@ -601,6 +610,11 @@ def qr_factor_steps(shards, geom, mesh, k0: int, k1: int, R=None,
     than bit-identical; Pz == 1 round-trips exactly."""
     if not (0 <= k0 < k1 <= geom.Nt):
         raise ValueError(f"step range [{k0}, {k1}) outside [0, {geom.Nt})")
+    if chunk is None:
+        # same compute-dtype resolution as qr_factor_distributed: a
+        # resumed run must chunk its panel TSQR like the run it resumes
+        chunk = blas.batched_call_rows(
+            geom.v, blas.compute_dtype(jnp.asarray(shards).dtype))
     if R is None:
         if k0 != 0:
             raise ValueError("resuming at k0 > 0 requires the R state "

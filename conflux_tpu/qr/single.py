@@ -86,7 +86,11 @@ def tall_qr(panel: jax.Array, chunk: int | None = None, passes: int = 2):
     m, n = panel.shape
     if m < n:
         raise ValueError(f"tall_qr needs m >= n, got {panel.shape}")
-    chunk = blas._PANEL_CHUNK if chunk is None else chunk
+    # the chunk round is a batched QR call: default to the batched
+    # VMEM-safe height for this width in the COMPUTE dtype (bf16 panels
+    # run f32 math; v5e pin: 4096 at n=1024 f32)
+    if chunk is None:
+        chunk = blas.batched_call_rows(n, blas.compute_dtype(panel.dtype))
     cdtype = blas.compute_dtype(panel.dtype)
     prec = blas.matmul_precision()
     A = panel.astype(cdtype)
@@ -135,6 +139,8 @@ def qr_factor_blocked(A: jax.Array, v: int = 256, chunk: int | None = None,
     M, N = A.shape
     if M < N:
         raise ValueError(f"qr_factor_blocked needs M >= N, got {A.shape}")
-    chunk = blas._PANEL_CHUNK if chunk is None else chunk
+    if chunk is None:
+        chunk = blas.batched_call_rows(min(v, N),
+                                       blas.compute_dtype(A.dtype))
     Q, R = _qr_blocked(A, min(v, N), chunk, passes)
     return Q.astype(A.dtype), jnp.triu(R).astype(A.dtype)

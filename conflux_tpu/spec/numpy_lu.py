@@ -23,6 +23,16 @@ import scipy.linalg
 from conflux_tpu.geometry import Grid3, LUGeometry
 
 
+def _np_compute_dtype(dtype) -> np.dtype:
+    """NumPy mirror of `blas.compute_dtype` (no jax import): panel math
+    runs in f32 for narrow types, natively otherwise — the dtype the
+    impl resolves its chunk ceilings with."""
+    dtype = np.dtype(dtype)
+    if dtype == np.float16 or dtype.name == "bfloat16":
+        return np.dtype(np.float32)
+    return dtype
+
+
 def _lu_packed(A: np.ndarray):
     """Packed LU with row pivoting: returns (lu, perm) with A[perm] = L@U."""
     P, L, U = scipy.linalg.lu(A)
@@ -113,11 +123,17 @@ def _select_tournament(cand: np.ndarray, gri_m: np.ndarray, Px: int, v: int,
     stack = np.concatenate(noms, axis=0)
     sids = np.concatenate(nids, axis=0)
     # the implementation's election tournament is batched, so its chunk is
-    # capped at the batched VMEM-safe bound; the constant is imported (not
+    # capped at the batched VMEM-safe bound; the helper is imported (not
     # duplicated) so retuning it cannot desynchronize spec and impl
-    from conflux_tpu.ops.blas import _PANEL_CHUNK
+    from conflux_tpu.ops import blas
 
-    lu00, wid = _tournament_winners_np(stack, v, min(chunk, _PANEL_CHUNK))
+    # pinned budget, NOT device detection: the spec is pure NumPy and a
+    # simulation — its chunking must not depend on which host runs it.
+    # dtype is a property of the INPUT (mirrors the impl's compute-dtype
+    # resolution), so the spec stays host-independent AND synchronized.
+    cap = blas.batched_call_rows(v, _np_compute_dtype(stack.dtype),
+                                 budget=blas._SCOPED_VMEM_DEFAULT)
+    lu00, wid = _tournament_winners_np(stack, v, min(chunk, cap))
     gpiv = _take_fill(sids, wid, _ID_SENTINEL)
     return gpiv, lu00
 
@@ -182,13 +198,17 @@ def simulate_lu(A: np.ndarray, grid: Grid3, v: int, pivoting: str = "tournament"
     `conflux_tpu.lu.distributed.lu_factor_distributed` (whose shards come
     back pivoted; its `perm[:n_steps*v]` reshaped is this `pivots`).
     `panel_chunk` defaults to the implementation's default
-    (`lu/distributed._DEFAULT_PANEL_CHUNK`); pass the same value used there
+    (`blas.single_call_rows(v)`); pass the same value used there
     for buffer-exact cross-validation in the chunked regime.
     """
     if panel_chunk is None:
-        from conflux_tpu.lu.distributed import _DEFAULT_PANEL_CHUNK
+        from conflux_tpu.ops import blas
 
-        panel_chunk = _DEFAULT_PANEL_CHUNK
+        # pinned budget (see _select_tournament): host-independent spec;
+        # dtype from the input, mirroring lu_factor_distributed
+        panel_chunk = blas.single_call_rows(
+            v, _np_compute_dtype(np.asarray(A).dtype),
+            budget=blas._SCOPED_VMEM_DEFAULT)
     select = PIVOTING_STRATEGIES[pivoting]
     geom = LUGeometry.create(A.shape[0], A.shape[1], v, grid)
     Px, Py, Pz = grid.Px, grid.Py, grid.Pz

@@ -51,7 +51,7 @@ def main() -> None:
     grid = Grid3(Px, Py, Pz)
     geom = LUGeometry.create(args.N, args.N, args.v, grid)
     mesh = make_mesh(grid, devices=jax.devices()[: grid.P])
-    chunk = args.panel_chunk or D._DEFAULT_PANEL_CHUNK
+    chunk = args.panel_chunk or blas.single_call_rows(args.v)
     fn = D._build(geom, mesh_cache_key(mesh), blas.matmul_precision(),
                   blas.get_backend(), chunk, False)
 

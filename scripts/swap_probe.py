@@ -1,0 +1,104 @@
+"""On-chip A/B of the LU row-swap implementation (VERDICT r2 item 4).
+
+The phase table attributes ~10 ms/superstep at N=32768/v=1024 to the swap
+row-scatter's XLA lowering (a serial per-row loop — the bulk of the 17.4%
+"other" bucket). `ops/pallas_kernels.scatter_rows(use_dma=True)` replaces
+it with pipelined row DMAs through a VMEM stage, but is UNVERIFIED on
+hardware (a first HBM->HBM variant wedged the chip; docs/DESIGN.md §14's
+lesson also applies: a hot-loop rewrite at 4 GiB operands must be
+re-validated at full bench scale, rate AND residual).
+
+Protocol (run on a healthy chip):
+  1. bring-up: the kernel alone at small shapes, checked elementwise;
+  2. mid-scale: full factorization at N=8192 swap=xla vs dma, residuals;
+  3. full scale: N=32768 both swaps, rate + residual (the §14 gate).
+
+    python scripts/swap_probe.py [--full]
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--full", action="store_true",
+                    help="include the N=32768 stage (several minutes of "
+                    "compile + run per swap mode)")
+    ap.add_argument("--reps", type=int, default=2)
+    args = ap.parse_args()
+
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    import bench as bench_mod
+    from conflux_tpu.geometry import Grid3, LUGeometry
+    from conflux_tpu.lu.distributed import lu_factor_distributed
+    from conflux_tpu.ops import pallas_kernels
+    from conflux_tpu.parallel.mesh import AXIS_X, AXIS_Y, make_mesh
+
+    bench_mod._probe_device()
+
+    # ---- stage 1: kernel bring-up at small shapes ---------------------- #
+    key = jax.random.PRNGKey(0)
+    for M, N, v in ((64, 1024, 8), (256, 2048, 32)):
+        a = jax.random.normal(key, (M, N), jnp.float32)
+        rows = jax.random.normal(jax.random.PRNGKey(1), (v, N), jnp.float32)
+        idx = jax.random.permutation(jax.random.PRNGKey(2),
+                                     M)[:v].astype(jnp.int32)
+        # include one dropped (sentinel) index — the swap path's contract
+        idx = idx.at[0].set(M)
+        want = a.at[idx].set(rows, mode="drop")
+        got = pallas_kernels.scatter_rows(a, rows, idx, use_dma=True)
+        err = float(jnp.max(jnp.abs(want - got)))
+        print(f"scatter_rows bring-up M={M} N={N} v={v}: max|diff|={err:.1e}"
+              f" {'OK' if err == 0 else 'MISMATCH'}", flush=True)
+        if err != 0:
+            print("bring-up failed; NOT proceeding to factorizations",
+                  flush=True)
+            return
+
+    # ---- stages 2/3: full factorization A/B --------------------------- #
+    grid = Grid3(1, 1, 1)
+    mesh = make_mesh(grid, devices=jax.devices()[:1])
+    sharding = NamedSharding(mesh, P(AXIS_X, AXIS_Y, None, None))
+    sizes = [(8192, 1024)] + ([(32768, 1024)] if args.full else [])
+    for N, v in sizes:
+        geom = LUGeometry.create(N, N, v, grid)
+        for swap in ("xla", "dma"):
+            try:
+                def make():
+                    return jax.device_put(bench_mod._make_n(N), sharding)
+
+                def factor(s, swap=swap, geom=geom):
+                    return lu_factor_distributed(
+                        s, geom, mesh, donate=True, swap=swap)
+
+                out, perm = factor(make())  # compile + warm-up
+                float(out[0, 0, 0, 0])
+                times = []
+                for _ in range(args.reps):
+                    s = make()
+                    float(s[0, 0, 0, 0])
+                    t0 = time.time()
+                    out, perm = factor(s)
+                    float(out[0, 0, 0, 0])
+                    times.append(time.time() - t0)
+                gflops = (2 / 3) * N**3 / (sum(times) / len(times)) / 1e9
+                res = bench_mod._residual_on_device(out[0, 0], perm)
+                print(f"lu N={N} v={v} swap={swap}: {gflops:.1f} GFLOP/s "
+                      f"residual={res:.3e}", flush=True)
+            except Exception as e:
+                print(f"lu N={N} v={v} swap={swap}: FAILED "
+                      f"{type(e).__name__}: {e}", flush=True)
+
+
+if __name__ == "__main__":
+    main()

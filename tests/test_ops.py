@@ -75,3 +75,35 @@ def test_unit_lower():
     L = blas.unit_lower(lu00)
     assert np.allclose(np.diag(np.asarray(L)), 1.0)
     assert np.allclose(np.triu(np.asarray(L), 1), 0.0)
+
+
+def test_vmem_derived_ceilings_pin_v5e():
+    """The chunk ceilings derive from the scoped-VMEM budget (element-
+    count model); the measured v5e values are pinned here so a budget or
+    model change that silently shifts the tuned defaults fails loudly."""
+    import pytest
+
+    from conflux_tpu.ops import blas
+
+    # default budget (32 MiB — the measured v5e figure) at the bench tile
+    assert blas.single_call_rows(1024) == 8192
+    assert blas.batched_call_rows(1024) == 4096
+    # element model: heights scale as 1/v and 1/itemsize
+    assert blas.single_call_rows(2048) == 4096
+    assert blas.batched_call_rows(2048) == 2048
+    assert blas.single_call_rows(1024, jnp.bfloat16) == 16384
+    # never shorter than one tile
+    assert blas.single_call_rows(8192) == 8192
+    # chunk_layout's default chunk is the derived batched bound
+    c, nch = blas.chunk_layout(32768, 1024)
+    assert (c, nch) == (4096, 8)
+    # override for unmeasured generations
+    blas.set_scoped_vmem_bytes(16 << 20)
+    try:
+        assert blas.single_call_rows(1024) == 4096
+        assert blas.batched_call_rows(1024) == 2048
+    finally:
+        blas.set_scoped_vmem_bytes(None)
+    assert blas.single_call_rows(1024) == 8192
+    with pytest.raises(ValueError, match="implausible"):
+        blas.set_scoped_vmem_bytes(1000)
