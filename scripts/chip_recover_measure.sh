@@ -1,10 +1,15 @@
 #!/bin/bash
 # Round 3: wait for the (wedged-since-round-2) TPU tunnel to recover, then
 # run the queued measurements once, logging to data/benchmarks/.
-# Order = VERDICT r2 priority: headline bench FIRST (measure-then-experiment),
-# then the zero-hardware-data cores (cholesky 32k, qr 16k), then tuning
-# trials, riskiest (the 12288-chunk trial that coincided with the round-2
-# wedge) LAST.
+# Order = strict priority (a re-wedge mid-queue loses everything after it):
+#   1. headline bench (BENCH_r03's number MUST exist)
+#   2. election probe (the cost model that picks the election structure)
+#   3. LU election/segmentation A/B at scale (flat tree, segs variants)
+#   4. the zero-hardware-data cores: cholesky 32k, qr 16k
+#   5. HPL-MxP end-to-end (bf16x3 + GMRES-IR)
+#   6. swap_probe (DMA row scatter bring-up + full-scale residual gate)
+#   7. chunk 12288/10240 trials LAST (the round-2 wedge began during the
+#      12288 trial; quarantine the risky configs behind everything else)
 # Probe = tiny reduction with a hard timeout; the tunnel wedge manifests
 # as an indefinite hang on the first device op (see bench._probe_device).
 cd "$(dirname "$0")/.." || exit 1
@@ -28,6 +33,12 @@ done
 {
   echo "=== bench.py (headline LU at-scale gate) $(date -u +%FT%TZ) ==="
   timeout -k 10 3000 python bench.py 2>&1 | grep -v WARNING
+  echo "=== election probe (LU-call cost model) $(date -u +%FT%TZ) ==="
+  timeout -k 10 2400 python scripts/election_probe.py 2>&1 | grep -v WARNING
+  echo "=== LU flat-tree + segmentation A/B at N=32768 $(date -u +%FT%TZ) ==="
+  timeout -k 10 4200 python scripts/tpu_tune.py -N 32768 --reps 2 \
+    --configs highest:8192:1024:-:flat,highest:8192:1024:32x16,highest:8192:1024:8x8 \
+    2>&1 | grep -v WARNING
   echo "=== cholesky N=32768 (triangle-skip at-scale gate) $(date -u +%FT%TZ) ==="
   timeout -k 10 3000 python scripts/tpu_tune.py --algo cholesky -N 32768 \
     --reps 2 --configs highest:0:1024,high:0:1024,highest:0:1024:16x16 \
@@ -37,9 +48,8 @@ done
     --reps 2 --configs highest:0:1024 2>&1 | grep -v WARNING
   echo "=== HPL-MxP end-to-end (bf16x3 factor + GMRES-IR to 1e-6) $(date -u +%FT%TZ) ==="
   timeout -k 10 3000 python bench.py --mode mxp --ir gmres 2>&1 | grep -v WARNING
-  echo "=== LU segmentation refinement probe $(date -u +%FT%TZ) ==="
-  timeout -k 10 2400 python scripts/tpu_tune.py -N 32768 --reps 2 \
-    --configs highest:8192:1024:32x16 2>&1 | grep -v WARNING
+  echo "=== swap_probe (DMA row scatter bring-up + full-scale gate) $(date -u +%FT%TZ) ==="
+  timeout -k 10 4200 python scripts/swap_probe.py --full 2>&1 | grep -v WARNING
   echo "=== tune LU taller nomination chunks (LAST: the round-2 wedge "
   echo "    started during the 12288 trial — quarantine the risky configs"
   echo "    behind everything else) $(date -u +%FT%TZ) ==="

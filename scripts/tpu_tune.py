@@ -88,10 +88,12 @@ def main() -> None:
     ap.add_argument("--reps", type=int, default=2)
     ap.add_argument("--algo", default="lu", choices=["lu", "cholesky", "qr"])
     ap.add_argument("--configs", default=None,
-                    help="comma list precision:chunk:v[:RxC], e.g. "
-                    "highest:8192:1024,high:8192:1024,highest:8192:1024:8x16 "
+                    help="comma list precision:chunk:v[:RxC[:tree[:swap]]], "
+                    "e.g. highest:8192:1024,highest:8192:1024:16x16:flat "
                     "(chunk ignored for cholesky/qr; pass 0; RxC = LU "
-                    "trailing-update row x col segment counts)")
+                    "trailing-update row x col segment counts, '-' for the "
+                    "library default; tree = pairwise|flat election "
+                    "reduction; swap = xla|dma row-swap path — LU only)")
     args = ap.parse_args()
 
     # validate configs BEFORE the device probe: a malformed flag must
@@ -104,16 +106,27 @@ def main() -> None:
         configs = []
         for c in args.configs.split(","):
             parts = c.split(":")
-            if len(parts) < 3 or parts[0] not in prec_names:
-                ap.error(f"bad config {c!r}: want precision:chunk:v[:RxC] "
-                         f"with precision in {sorted(prec_names)}")
+            if not 3 <= len(parts) <= 6 or parts[0] not in prec_names:
+                ap.error(f"bad config {c!r}: want "
+                         "precision:chunk:v[:RxC[:tree[:swap]]] with "
+                         f"precision in {sorted(prec_names)}, RxC segment "
+                         "counts ('-' = library default), tree in "
+                         "pairwise|flat, swap in xla|dma")
             p, chunk, v = parts[:3]
             segs = None  # None = the library default for the algorithm
-            if len(parts) > 3:
+            if len(parts) > 3 and parts[3] not in ("", "-"):
                 try:
                     segs = segs_arg(parts[3])
                 except argparse.ArgumentTypeError as e:
                     ap.error(f"bad segment field in config {c!r}: {e}")
+            tree = parts[4] if len(parts) > 4 else "pairwise"
+            if tree not in ("pairwise", "flat"):
+                ap.error(f"bad tree field {tree!r} in config {c!r}: "
+                         "want pairwise|flat")
+            swap = parts[5] if len(parts) > 5 else "xla"
+            if swap not in ("xla", "dma"):
+                ap.error(f"bad swap field {swap!r} in config {c!r}: "
+                         "want xla|dma")
             if not re.fullmatch(r"\d+", chunk) or not re.fullmatch(r"\d+", v) \
                     or int(v) < 1:
                 ap.error(f"bad config {c!r}: chunk must be a non-negative "
@@ -122,7 +135,7 @@ def main() -> None:
             # chunk 0 means "library default": panel_chunk=None downstream
             # (passing 0 through would clamp to v-tall chunks — a silently
             # pathological nomination, not the default)
-            configs.append((p, int(chunk) or None, int(v), segs))
+            configs.append((p, int(chunk) or None, int(v), segs, tree, swap))
     else:
         configs = None
 
@@ -150,24 +163,30 @@ def main() -> None:
         pass
     elif args.algo == "lu":
         configs = [
-            ("highest", 8192, 1024, None),
-            ("high", 8192, 1024, None),
-            ("highest", 12288, 1024, None),
-            ("highest", 4096, 1024, None),
-            ("highest", 8192, 2048, None),
-            ("high", 8192, 2048, None),
-            ("highest", 8192, 512, None),
+            ("highest", 8192, 1024, None, "pairwise", "xla"),
+            ("high", 8192, 1024, None, "pairwise", "xla"),
+            ("highest", 12288, 1024, None, "pairwise", "xla"),
+            ("highest", 4096, 1024, None, "pairwise", "xla"),
+            ("highest", 8192, 2048, None, "pairwise", "xla"),
+            ("high", 8192, 2048, None, "pairwise", "xla"),
+            ("highest", 8192, 512, None, "pairwise", "xla"),
         ]
     else:
         configs = [
-            ("highest", 0, 1024, None),
-            ("high", 0, 1024, None),
-            ("highest", 0, 512, None),
-            ("highest", 0, 2048, None),
+            ("highest", 0, 1024, None, "pairwise", "xla"),
+            ("high", 0, 1024, None, "pairwise", "xla"),
+            ("highest", 0, 512, None, "pairwise", "xla"),
+            ("highest", 0, 2048, None, "pairwise", "xla"),
         ]
 
-    for pname, chunk, v, segs in configs:
+    for pname, chunk, v, segs, tree, swap in configs:
         chunk_lbl = "default" if chunk is None else chunk
+        cfg_lbl = (f"algo={args.algo} precision={pname} chunk={chunk_lbl} "
+                   f"v={v}")
+        if args.algo != "lu" and (tree != "pairwise" or swap != "xla"):
+            print(f"{cfg_lbl}: tree={tree} swap={swap} are LU-only; "
+                  "skipping config", flush=True)
+            continue
         if args.algo == "qr":
             # qr segments columns only: the 4th field is a single csegs
             # count written as 1xC (row part must be 1)
@@ -187,10 +206,11 @@ def main() -> None:
                 geom = LUGeometry.create(N, N, v, grid)
 
                 def factor(s, geom=geom, chunk=chunk, pname=pname,
-                           seg_kw=seg_kw):
+                           seg_kw=seg_kw, tree=tree, swap=swap):
                     return lu_factor_distributed(
                         s, geom, mesh, precision=prec[pname],
-                        panel_chunk=chunk, donate=True, **seg_kw)
+                        panel_chunk=chunk, donate=True, tree=tree,
+                        swap=swap, **seg_kw)
 
                 def make(geom=geom):
                     # bench's generator, not a copy: the residual oracle
@@ -255,16 +275,16 @@ def main() -> None:
                 times.append(time.time() - t0)
             dim = geom.N if args.algo == "cholesky" else geom.M
             gflops = flop_coeff * dim**3 / (sum(times) / len(times)) / 1e9
-            print(f"algo={args.algo} precision={pname} chunk={chunk_lbl} v={v} "
-                  f"segs={seg_lbl}: {gflops:.1f} GFLOP/s", flush=True)
+            print(f"{cfg_lbl} segs={seg_lbl} tree={tree} swap={swap}: "
+                  f"{gflops:.1f} GFLOP/s", flush=True)
             try:  # residual separately: never discard a good timing
                 res = residual(out, aux)
                 print(f"    residual={res:.3e}", flush=True)
             except Exception as e:
                 print(f"    residual FAILED: {e}", flush=True)
         except Exception as e:  # OOM / VMEM overflow at some configs
-            print(f"algo={args.algo} precision={pname} chunk={chunk_lbl} v={v} "
-                  f"segs={seg_lbl}: FAILED {e}", flush=True)
+            print(f"{cfg_lbl} segs={seg_lbl} tree={tree} swap={swap}: "
+                  f"FAILED {e}", flush=True)
 
 
 if __name__ == "__main__":
