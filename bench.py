@@ -282,12 +282,34 @@ def main():
     ap.add_argument("--ir", default="classic", choices=["classic", "gmres"],
                     help="refinement engine in mxp mode (gmres = FGMRES "
                     "preconditioned by the factors)")
+    ap.add_argument("-N", type=int, default=None,
+                    help="override the bench size (smoke-testing the bench "
+                    "code path off-chip; the driver headline always runs "
+                    "the default N)")
+    ap.add_argument("--platform", default=None, choices=["cpu"],
+                    help="force the CPU backend (smoke tests)")
     args = ap.parse_args()
 
-    _probe_device()
-    try:
-        cpu = cpu_gflops()
-    except Exception:
+    if args.platform == "cpu":
+        jax.config.update("jax_platforms", "cpu")
+    if args.N is not None:
+        if args.N % V or args.N < V:
+            ap.error(f"-N must be a positive multiple of the tile size "
+                     f"V={V}, got {args.N}")
+        global N
+        N = args.N
+
+    if args.platform != "cpu":
+        # the probe targets the default (tunneled TPU) platform; a forced
+        # CPU smoke run must not hang 15 minutes on a wedged tunnel
+        _probe_device()
+        try:
+            cpu = cpu_gflops()
+        except Exception:
+            cpu = float("nan")
+    else:
+        # CPU-vs-CPU would be meaningless AND the 8192 getrf baseline
+        # dominates a smoke run's wall time
         cpu = float("nan")
     if args.mode == "mxp":
         tpu, res = tpu_bench_mxp(refine=args.refine,

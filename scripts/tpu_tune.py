@@ -120,13 +120,22 @@ def main() -> None:
                 except argparse.ArgumentTypeError as e:
                     ap.error(f"bad segment field in config {c!r}: {e}")
             tree = parts[4] if len(parts) > 4 else "pairwise"
+            if tree in ("", "-"):  # same default placeholder as RxC
+                tree = "pairwise"
             if tree not in ("pairwise", "flat"):
                 ap.error(f"bad tree field {tree!r} in config {c!r}: "
-                         "want pairwise|flat")
+                         "want pairwise|flat (or '-' for the default)")
             swap = parts[5] if len(parts) > 5 else "xla"
+            if swap in ("", "-"):
+                swap = "xla"
             if swap not in ("xla", "dma"):
                 ap.error(f"bad swap field {swap!r} in config {c!r}: "
-                         "want xla|dma")
+                         "want xla|dma (or '-' for the default)")
+            if args.algo != "lu" and (tree != "pairwise" or swap != "xla"):
+                # known at parse time: do not burn a (possibly wedged)
+                # device probe before saying so
+                ap.error(f"config {c!r}: tree/swap fields are LU-only "
+                         f"(algo={args.algo})")
             if not re.fullmatch(r"\d+", chunk) or not re.fullmatch(r"\d+", v) \
                     or int(v) < 1:
                 ap.error(f"bad config {c!r}: chunk must be a non-negative "
@@ -183,10 +192,6 @@ def main() -> None:
         chunk_lbl = "default" if chunk is None else chunk
         cfg_lbl = (f"algo={args.algo} precision={pname} chunk={chunk_lbl} "
                    f"v={v}")
-        if args.algo != "lu" and (tree != "pairwise" or swap != "xla"):
-            print(f"{cfg_lbl}: tree={tree} swap={swap} are LU-only; "
-                  "skipping config", flush=True)
-            continue
         if args.algo == "qr":
             # qr segments columns only: the 4th field is a single csegs
             # count written as 1xC (row part must be 1)
