@@ -81,7 +81,7 @@ def _build(geom: LUGeometry, mesh_key, precision, backend: str,
            panel_chunk: int, donate: bool = False, resumable: bool = False,
            lookahead: bool = False, election: str = "gather",
            segs: tuple = (16, 16), tree: str = "pairwise",
-           swap: str = "xla"):
+           swap: str = "xla", update: str = "segments"):
     """resumable=True builds the checkpoint/restart form: factor supersteps
     [k0, k1) given as TRACED scalars — one compile serves every segment of
     a checkpointed run — with the row-origin state as an explicit
@@ -380,29 +380,78 @@ def _build(geom: LUGeometry, mesh_key, precision, backend: str,
             U01s = lax.dynamic_slice(U01p, (zoff, i0), (nlayr, Nl))
 
             with jax.named_scope("step6_dgemm"):
-                # in-place cond'd DUS per live segment: a slice->concat
-                # formulation materializes the full local matrix every step
-                # (~26 ms/step of pure copies at N=32768)
-                Anew = Aloc
-                for rlo, rhi in row_segs:
-                    rm = row_live[rlo:rhi]
-                    for clo, chi in col_segs:
-                        cm = col_trail[clo:chi]
+                if update == "block":
+                    # ONE live-suffix block per step instead of the
+                    # row x col segment lattice: a lax.switch over the
+                    # (row, col) segment-boundary pair containing the
+                    # first live row/col selects a branch with STATIC
+                    # slice offsets — one slice + one GEMM + one mask +
+                    # one DUS, eliminating the per-segment cond/DUS/
+                    # select overhead (~9 ms/step of the N=32768 phase
+                    # table). Flop overshoot identical to the segment
+                    # scheme at the same `segs` (up to one segment of
+                    # dead rows/cols rides the GEMM, masked out of the
+                    # subtract).
+                    def br(args, ri=0, cj=0):
+                        A, L10s_, U01s_ = args
+                        a = lax.slice(A, (ri, cj), (Ml, Nl))
+                        upd = blas.gemm(L10s_[ri:], U01s_[:, cj:],
+                                        precision=precision,
+                                        backend=backend)
+                        keep = (row_live[ri:, None]
+                                & col_trail[None, cj:])
+                        new = a - jnp.where(keep, upd,
+                                            jnp.zeros((), dtype))
+                        return lax.dynamic_update_slice(A, new, (ri, cj))
 
-                        def seg_update(A, rlo=rlo, rhi=rhi, clo=clo, chi=chi,
-                                       rm=rm, cm=cm):
-                            a_seg = lax.slice(A, (rlo, clo), (rhi, chi))
-                            upd = blas.gemm(
-                                L10s[rlo:rhi], U01s[:, clo:chi],
-                                precision=precision, backend=backend)
-                            keep = rm[:, None] & cm[None, :]
-                            new = a_seg - jnp.where(keep, upd,
-                                                    jnp.zeros((), dtype))
-                            return lax.dynamic_update_slice(A, new,
-                                                            (rlo, clo))
+                    branches = [
+                        functools.partial(br, ri=rlo, cj=clo)
+                        for rlo, _ in row_segs for clo, _ in col_segs
+                    ]
+                    # first live local row: tiles with rtile <= k are
+                    # dead (LAPACK-order prefix)
+                    ndead_t = jnp.where(x <= k, (k - x) // Px + 1, 0)
+                    first_live = ndead_t * v
+                    # first trailing local col: tiles with ctile > k
+                    lt0 = jnp.where(y > k, 0, (k - y) // Py + 1)
+                    first_col = lt0 * v
+                    # index of the segment CONTAINING the boundary =
+                    # (# starts <= boundary) - 1; a fully-dead axis
+                    # clamps to the last segment (its mask is all-False)
+                    ri_idx = sum(
+                        (jnp.asarray(rlo) <= first_live).astype(jnp.int32)
+                        for rlo, _ in row_segs) - 1
+                    cj_idx = sum(
+                        (jnp.asarray(clo) <= first_col).astype(jnp.int32)
+                        for clo, _ in col_segs) - 1
+                    Anew = lax.switch(ri_idx * len(col_segs) + cj_idx,
+                                      branches, (Aloc, L10s, U01s))
+                else:
+                    # in-place cond'd DUS per live segment: a slice->
+                    # concat formulation materializes the full local
+                    # matrix every step (~26 ms/step of pure copies at
+                    # N=32768)
+                    Anew = Aloc
+                    for rlo, rhi in row_segs:
+                        rm = row_live[rlo:rhi]
+                        for clo, chi in col_segs:
+                            cm = col_trail[clo:chi]
 
-                        Anew = lax.cond(seg_r_live(rhi) & seg_c_live(chi),
-                                        seg_update, lambda A: A, Anew)
+                            def seg_update(A, rlo=rlo, rhi=rhi, clo=clo,
+                                           chi=chi, rm=rm, cm=cm):
+                                a_seg = lax.slice(A, (rlo, clo), (rhi, chi))
+                                upd = blas.gemm(
+                                    L10s[rlo:rhi], U01s[:, clo:chi],
+                                    precision=precision, backend=backend)
+                                keep = rm[:, None] & cm[None, :]
+                                new = a_seg - jnp.where(keep, upd,
+                                                        jnp.zeros((), dtype))
+                                return lax.dynamic_update_slice(A, new,
+                                                                (rlo, clo))
+
+                            Anew = lax.cond(
+                                seg_r_live(rhi) & seg_c_live(chi),
+                                seg_update, lambda A: A, Anew)
 
             # ---- factor writes (z==0 carries factors, z!=0 zeroed) ------- #
             # diagonal block rows: leading columns keep the winners' frozen
@@ -538,7 +587,7 @@ def build_program(geom: LUGeometry, mesh, precision=None,
                   donate: bool = False, resumable: bool = False,
                   lookahead: bool = False, election: str = "gather",
                   segs: tuple = (16, 16), tree: str = "pairwise",
-                  swap: str = "xla"):
+                  swap: str = "xla", update: str = "segments"):
     """The jitted distributed-LU program itself (cached per config).
 
     The single point resolving the trace-time defaults (precision/backend/
@@ -600,9 +649,11 @@ def build_program(geom: LUGeometry, mesh, precision=None,
                 "raise panel_chunk or use tree='pairwise'")
     if swap not in ("xla", "dma"):
         raise ValueError(f"unknown swap {swap!r} (xla|dma)")
+    if update not in ("segments", "block"):
+        raise ValueError(f"unknown update {update!r} (segments|block)")
     return _build(geom, mesh_cache_key(mesh), precision, backend,
                   panel_chunk, donate, resumable, lookahead, election,
-                  tuple(segs), tree, swap)
+                  tuple(segs), tree, swap, update)
 
 
 def lu_factor_distributed(shards, geom: LUGeometry, mesh,
@@ -610,7 +661,8 @@ def lu_factor_distributed(shards, geom: LUGeometry, mesh,
                           panel_chunk: int | None = None,
                           donate: bool = False, lookahead: bool = False,
                           election: str = "gather", segs: tuple = (16, 16),
-                          tree: str = "pairwise", swap: str = "xla"):
+                          tree: str = "pairwise", swap: str = "xla",
+                          update: str = "segments"):
     """Factor block-cyclic shards (Px, Py, Ml, Nl) in place on a mesh.
 
     Returns (shards_out, perm): shards_out holds the packed factors in
@@ -654,7 +706,7 @@ def lu_factor_distributed(shards, geom: LUGeometry, mesh,
     fn = build_program(geom, mesh, precision=precision, backend=backend,
                        panel_chunk=panel_chunk, donate=donate,
                        lookahead=lookahead, election=election,
-                       segs=segs, tree=tree, swap=swap)
+                       segs=segs, tree=tree, swap=swap, update=update)
     return fn(shards)
 
 
@@ -662,7 +714,7 @@ def lu_factor_steps(shards, geom: LUGeometry, mesh, k0: int, k1: int,
                     orig=None, precision=None, backend: str | None = None,
                     panel_chunk: int | None = None, donate: bool = False,
                     election: str = "gather", segs: tuple = (16, 16),
-                    tree: str = "pairwise"):
+                    tree: str = "pairwise", update: str = "segments"):
     """Factor supersteps [k0, k1) only — the checkpoint/restart primitive.
 
     The reference has no notion of resuming a partial factorization
@@ -715,14 +767,15 @@ def lu_factor_steps(shards, geom: LUGeometry, mesh, k0: int, k1: int,
     fn = build_program(geom, mesh, precision=precision, backend=backend,
                        panel_chunk=panel_chunk, donate=donate,
                        resumable=True, election=election, segs=segs,
-                       tree=tree)
+                       tree=tree, update=update)
     return fn(shards, orig, jnp.int32(k0), jnp.int32(k1))
 
 
 def lu_distributed_host(A: np.ndarray, grid: Grid3, v: int, mesh=None,
                         precision=None, backend: str | None = None,
                         panel_chunk: int | None = None,
-                        segs: tuple = (16, 16), tree: str = "pairwise"):
+                        segs: tuple = (16, 16), tree: str = "pairwise",
+                        update: str = "segments"):
     """Host-level convenience: scatter a global matrix, factor on the mesh,
     gather back. Returns (LU_packed (M, N) in original row order, perm (M,)).
 
@@ -738,6 +791,7 @@ def lu_distributed_host(A: np.ndarray, grid: Grid3, v: int, mesh=None,
     out, perm = lu_factor_distributed(
         jnp.asarray(shards), geom, mesh, precision=precision, backend=backend,
         panel_chunk=panel_chunk, donate=True, segs=segs, tree=tree,
+        update=update,
     )
     perm = np.asarray(perm)
     LUp = geom.gather(np.asarray(out))  # factors in pivoted order

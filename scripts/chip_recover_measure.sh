@@ -5,10 +5,11 @@
 #   1. headline bench (BENCH_r03's number MUST exist)
 #   2. election probe (the cost model that picks the election structure)
 #   3. LU election/segmentation A/B at scale (flat tree, segs variants)
-#   4. the zero-hardware-data cores: cholesky 32k, qr 16k
-#   5. HPL-MxP end-to-end (bf16x3 + GMRES-IR)
-#   6. swap_probe (DMA row scatter bring-up + full-scale residual gate)
-#   7. chunk 12288/10240 trials LAST (the round-2 wedge began during the
+#   4. LU block-update A/B (one switch-selected suffix GEMM per step)
+#   5. the zero-hardware-data cores: cholesky 32k, qr 16k
+#   6. HPL-MxP end-to-end (bf16x3 + GMRES-IR)
+#   7. swap_probe (DMA row scatter bring-up + full-scale residual gate)
+#   8. chunk 12288/10240 trials LAST (the round-2 wedge began during the
 #      12288 trial; quarantine the risky configs behind everything else)
 # Probe = tiny reduction with a hard timeout; the tunnel wedge manifests
 # as an indefinite hang on the first device op (see bench._probe_device).
@@ -38,6 +39,10 @@ done
   echo "=== LU flat-tree + segmentation A/B at N=32768 $(date -u +%FT%TZ) ==="
   timeout -k 10 4200 python scripts/tpu_tune.py -N 32768 --reps 2 \
     --configs highest:8192:1024:-:flat,highest:8192:1024:32x16,highest:8192:1024:8x8 \
+    2>&1 | grep -v WARNING
+  echo "=== LU block-update A/B at N=32768 $(date -u +%FT%TZ) ==="
+  timeout -k 10 3000 python scripts/tpu_tune.py -N 32768 --reps 2 \
+    --update block --configs highest:8192:1024,highest:8192:1024:-:flat \
     2>&1 | grep -v WARNING
   echo "=== cholesky N=32768 (triangle-skip at-scale gate) $(date -u +%FT%TZ) ==="
   timeout -k 10 3000 python scripts/tpu_tune.py --algo cholesky -N 32768 \

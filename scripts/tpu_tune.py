@@ -87,6 +87,11 @@ def main() -> None:
     ap.add_argument("-N", type=int, default=32768)
     ap.add_argument("--reps", type=int, default=2)
     ap.add_argument("--algo", default="lu", choices=["lu", "cholesky", "qr"])
+    ap.add_argument("--update", default="segments",
+                    choices=["segments", "block"],
+                    help="LU trailing-update partitioning: cond'd segment "
+                    "lattice vs one switch-selected live-suffix block "
+                    "(applies to every LU config in this invocation)")
     ap.add_argument("--configs", default=None,
                     help="comma list precision:chunk:v[:RxC[:tree[:swap]]], "
                     "e.g. highest:8192:1024,highest:8192:1024:16x16:flat "
@@ -95,6 +100,8 @@ def main() -> None:
                     "library default; tree = pairwise|flat election "
                     "reduction; swap = xla|dma row-swap path — LU only)")
     args = ap.parse_args()
+    if args.update != "segments" and args.algo != "lu":
+        ap.error("--update applies to --algo lu only")
 
     # validate configs BEFORE the device probe: a malformed flag must
     # error in milliseconds, not after a (possibly wedged-chip) probe
@@ -215,7 +222,7 @@ def main() -> None:
                     return lu_factor_distributed(
                         s, geom, mesh, precision=prec[pname],
                         panel_chunk=chunk, donate=True, tree=tree,
-                        swap=swap, **seg_kw)
+                        swap=swap, update=args.update, **seg_kw)
 
                 def make(geom=geom):
                     # bench's generator, not a copy: the residual oracle
@@ -280,8 +287,8 @@ def main() -> None:
                 times.append(time.time() - t0)
             dim = geom.N if args.algo == "cholesky" else geom.M
             gflops = flop_coeff * dim**3 / (sum(times) / len(times)) / 1e9
-            print(f"{cfg_lbl} segs={seg_lbl} tree={tree} swap={swap}: "
-                  f"{gflops:.1f} GFLOP/s", flush=True)
+            print(f"{cfg_lbl} segs={seg_lbl} tree={tree} swap={swap} "
+                  f"update={args.update}: {gflops:.1f} GFLOP/s", flush=True)
             try:  # residual separately: never discard a good timing
                 res = residual(out, aux)
                 print(f"    residual={res:.3e}", flush=True)
