@@ -52,6 +52,26 @@ CPU_N = 8192
 RES_BLOCK = 4096
 
 
+def _enable_compile_cache() -> None:
+    """Persistent XLA compilation cache on disk: the N=32768 program
+    costs 4-6 min of compile per config and a measurement session runs
+    many; re-runs of an already-compiled config then start in seconds.
+    Guarded — an unsupported backend just misses the cache."""
+    import os
+
+    try:
+        cache = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                             ".jax_cache")
+        os.makedirs(cache, exist_ok=True)
+        jax.config.update("jax_compilation_cache_dir", cache)
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 10)
+        # the default min entry size filters small executables out of the
+        # cache entirely; zero keeps everything the 10 s threshold admits
+        jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)
+    except Exception:
+        pass
+
+
 def _setup():
     from jax.sharding import NamedSharding, PartitionSpec as P
 
@@ -292,6 +312,7 @@ def main():
 
     if args.platform == "cpu":
         jax.config.update("jax_platforms", "cpu")
+    _enable_compile_cache()
     if args.N is not None:
         if args.N % V or args.N < V:
             ap.error(f"-N must be a positive multiple of the tile size "

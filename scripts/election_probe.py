@@ -43,6 +43,7 @@ def main() -> None:
     import bench as bench_mod
     from conflux_tpu.ops import blas
 
+    bench_mod._enable_compile_cache()
     bench_mod._probe_device()
     reps = args.reps
     v = args.v

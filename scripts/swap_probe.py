@@ -44,6 +44,7 @@ def main() -> None:
     from conflux_tpu.ops import pallas_kernels
     from conflux_tpu.parallel.mesh import AXIS_X, AXIS_Y, make_mesh
 
+    bench_mod._enable_compile_cache()
     bench_mod._probe_device()
 
     # ---- stage 1: kernel bring-up at small shapes ---------------------- #

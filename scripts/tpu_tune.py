@@ -164,6 +164,7 @@ def main() -> None:
     from conflux_tpu.geometry import CholeskyGeometry, Grid3, LUGeometry
     from conflux_tpu.parallel.mesh import AXIS_X, AXIS_Y, make_mesh
 
+    bench_mod._enable_compile_cache()
     bench_mod._probe_device()
 
     N = args.N
