@@ -391,7 +391,11 @@ def _build(geom: LUGeometry, mesh_key, precision, backend: str,
                     # table). Flop overshoot identical to the segment
                     # scheme at the same `segs` (up to one segment of
                     # dead rows/cols rides the GEMM, masked out of the
-                    # subtract).
+                    # subtract). Composition note: under lookahead the
+                    # carried slab GEMM mirrors operands but not the
+                    # wide GEMM's SHAPE, so block+lookahead is value-
+                    # equivalent (same pivots, f32-noise factors), not
+                    # bitwise like segments+lookahead.
                     def br(args, ri=0, cj=0):
                         A, L10s_, U01s_ = args
                         a = lax.slice(A, (ri, cj), (Ml, Nl))

@@ -570,3 +570,37 @@ def test_lu_distributed_block_update_bench_ratios():
                                       update="block")
     assert sorted(perm.tolist()) == list(range(N))
     assert lu_residual(A, LU[perm], perm) < residual_bound(N, np.float32)
+
+
+def test_lu_distributed_block_update_lookahead():
+    """update='block' composes with the software-pipelined loop. Unlike
+    segments (whose lookahead mirror is bitwise-identical, asserted in
+    test_lu_distributed_lookahead_bitwise_equal), the block path's ONE
+    wide suffix GEMM may round differently from the mirror's narrow slab
+    GEMM (shape-dependent kernel accumulation) — so the contract here is
+    value-level: identical pivots, f32-noise-level factors, correct
+    residual."""
+    import jax
+    import jax.numpy as jnp
+
+    from conflux_tpu.geometry import LUGeometry
+    from conflux_tpu.lu.distributed import lu_factor_distributed
+    from conflux_tpu.parallel.mesh import make_mesh
+
+    grid = Grid3(2, 2, 1)
+    N, v = 64, 8
+    geom = LUGeometry.create(N, N, v, grid)
+    mesh = make_mesh(grid, devices=jax.devices()[: grid.P])
+    A = make_test_matrix(N, N, dtype=np.float32)
+    shards = jnp.asarray(geom.scatter(A))
+
+    out_a, perm_a = lu_factor_distributed(shards, geom, mesh,
+                                          update="block")
+    out_b, perm_b = lu_factor_distributed(shards, geom, mesh,
+                                          update="block", lookahead=True)
+    np.testing.assert_array_equal(np.asarray(perm_a), np.asarray(perm_b))
+    np.testing.assert_allclose(np.asarray(out_a), np.asarray(out_b),
+                               atol=1e-4)
+    LUp = geom.gather(np.asarray(out_b))
+    p = np.asarray(perm_b)
+    assert lu_residual(A, LUp, p) < residual_bound(N, np.float32)
