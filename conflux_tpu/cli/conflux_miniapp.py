@@ -65,6 +65,16 @@ def parse_args(argv=None):
         "stacked LU call (fewer sequential latency-bound custom calls)",
     )
     p.add_argument(
+        "--update", default="segments", choices=["segments", "block"],
+        help="trailing-update partitioning: cond'd segment lattice, or "
+        "one switch-selected live-suffix block per step",
+    )
+    p.add_argument(
+        "--swap", default="xla", choices=["xla", "dma"],
+        help="row-swap path: XLA scatter, or the experimental pipelined "
+        "DMA kernel (TPU only; falls back to XLA off-TPU)",
+    )
+    p.add_argument(
         "--refine", type=int, default=None, metavar="K",
         help="after factoring, solve A x = 1 with K iterative-refinement "
         "sweeps (f64 residual — the HPL-MxP recipe; pairs with --dtype "
@@ -133,7 +143,8 @@ def main(argv=None) -> int:
                 else:
                     out, perm_dev = lu_factor_distributed(
                         dev, geom, mesh, lookahead=args.lookahead,
-                        election=args.election, tree=args.tree, **seg_kw)
+                        election=args.election, tree=args.tree,
+                        update=args.update, swap=args.swap, **seg_kw)
                 sync(out)
         if rep > 0:
             times.append(t.ms)
@@ -197,6 +208,7 @@ def main(argv=None) -> int:
             phase_profile(
                 build_program(geom, mesh, lookahead=args.lookahead,
                               election=args.election, tree=args.tree,
+                              update=args.update, swap=args.swap,
                               **seg_kw), dev)
         profiler.report()
     return 0
