@@ -42,6 +42,12 @@ def parse_args(argv=None):
         help="Px,Py,Pz (default: auto-pick over all available devices)",
     )
     p.add_argument("-r", "--n_rep", type=int, default=2, help="timed repetitions")
+    p.add_argument(
+        "-l", "--print_limit", type=int, default=30,
+        help="print the input matrix and packed factors when max(M, N) is "
+        "below this limit (the reference's debug aid, "
+        "`examples/conflux_miniapp.cpp:57,86`)",
+    )
     p.add_argument("--validate", action="store_true", help="residual ||PA-LU||_F check")
     p.add_argument(
         "--lookahead", action="store_true",
@@ -152,6 +158,17 @@ def main(argv=None) -> int:
     for ms in times:
         print(result_line("lu", geom.N, grid.P, grid, args.type, ms, geom.v,
                           args.dtype))
+
+    if max(geom.M, geom.N) < args.print_limit:
+        # the reference's print_full_matrices debug aid
+        np.set_printoptions(precision=4, suppress=True, linewidth=200)
+        print("input matrix:")
+        print(np.asarray(A))
+        LUp = (np.asarray(out) if single
+               else geom.gather(np.asarray(out)))
+        print("packed LU factors (pivoted row order):")
+        print(LUp)
+        print("perm:", np.asarray(perm_dev).tolist())
 
     if args.validate:
         with profiler.region("validation"):
