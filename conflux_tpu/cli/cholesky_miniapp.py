@@ -36,6 +36,12 @@ def parse_args(argv=None):
     p.add_argument("--run", type=int, default=2, help="timed repetitions")
     p.add_argument("--validate", action="store_true", help="residual ||A-LL^T||_F check")
     p.add_argument(
+        "--refine", type=int, default=None, metavar="K",
+        help="after factoring, solve A x = 1 with K iterative-refinement "
+        "sweeps (f64 residual — the HPL-MxP recipe; pairs with --dtype "
+        "bfloat16) and report the solve residual",
+    )
+    p.add_argument(
         "--lookahead", action="store_true",
         help="software-pipelined loop: overlap the next panel reduce "
         "with the trailing update (multi-chip meshes; P8)",
@@ -136,6 +142,33 @@ def main(argv=None) -> int:
                 # nothing (N, N)-sized leaves the mesh
                 res = cholesky_residual_distributed(dev, out, geom, mesh)
         print(f"_residual_ {res:.3e}")
+
+    if args.refine is not None:
+        if args.refine < 0:
+            raise SystemExit("--refine needs a sweep count >= 0")
+        from conflux_tpu import solvers
+        from conflux_tpu.ops import blas as _blas
+
+        with profiler.region("refine_solve"):
+            b = jnp.ones((geom.N,), dtype)
+            Adev = jnp.asarray(A)
+            corr_dtype = _blas.compute_dtype(jnp.asarray(out).dtype)
+            if single:
+                def solve(r):
+                    return solvers.cholesky_solve(out, r)
+            else:
+                def solve(r):
+                    return solvers.cholesky_solve_distributed(
+                        out, geom, mesh, r)
+            x = solvers.refine_classic(solve, Adev, b, args.refine,
+                                       jnp.float64, corr_dtype)
+            r = solvers._residual_strips(Adev, x, b.astype(jnp.float64),
+                                         jnp.float64)
+            rel = float(jnp.linalg.norm(r)
+                        / jnp.linalg.norm(b.astype(jnp.float64)))
+        flag = "PASS" if rel <= 1e-6 else "----"
+        print(f"_solve_residual_ refine={args.refine} rel={rel:.3e} "
+              f"[{flag} <=1e-6]")
 
     if args.profile:
         if not single:
