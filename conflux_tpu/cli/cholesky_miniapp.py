@@ -86,6 +86,9 @@ def main(argv=None) -> int:
 
     dtype = np_dtype(args.dtype)
     geom = CholeskyGeometry.create(args.dim, v, grid)
+    if args.refine is not None and args.refine < 0:
+        # fail in milliseconds, not after the timed O(N^3) factor reps
+        raise SystemExit("--refine needs a sweep count >= 0")
 
     # dedicated single-device path (true 1/3 N^3 flops); it unrolls Kappa
     # supersteps at trace time, so fall back to the distributed program (O(1)
@@ -144,15 +147,10 @@ def main(argv=None) -> int:
         print(f"_residual_ {res:.3e}")
 
     if args.refine is not None:
-        if args.refine < 0:
-            raise SystemExit("--refine needs a sweep count >= 0")
         from conflux_tpu import solvers
-        from conflux_tpu.ops import blas as _blas
+        from conflux_tpu.cli.common import refine_report
 
         with profiler.region("refine_solve"):
-            b = jnp.ones((geom.N,), dtype)
-            Adev = jnp.asarray(A)
-            corr_dtype = _blas.compute_dtype(jnp.asarray(out).dtype)
             if single:
                 def solve(r):
                     return solvers.cholesky_solve(out, r)
@@ -160,15 +158,7 @@ def main(argv=None) -> int:
                 def solve(r):
                     return solvers.cholesky_solve_distributed(
                         out, geom, mesh, r)
-            x = solvers.refine_classic(solve, Adev, b, args.refine,
-                                       jnp.float64, corr_dtype)
-            r = solvers._residual_strips(Adev, x, b.astype(jnp.float64),
-                                         jnp.float64)
-            rel = float(jnp.linalg.norm(r)
-                        / jnp.linalg.norm(b.astype(jnp.float64)))
-        flag = "PASS" if rel <= 1e-6 else "----"
-        print(f"_solve_residual_ refine={args.refine} rel={rel:.3e} "
-              f"[{flag} <=1e-6]")
+            refine_report(solve, A, jnp.asarray(out).dtype, args.refine)
 
     if args.profile:
         if not single:

@@ -83,6 +83,31 @@ def sync(x) -> float:
     return float(jax.numpy.sum(x))
 
 
+def refine_report(solve_fn, A_host, out_dtype, sweeps: int) -> float:
+    """Shared --refine epilogue of the miniapps: solve A x = 1 with
+    `sweeps` classic-IR rounds (f64 residuals — the HPL-MxP recipe),
+    print the `_solve_residual_` line, return the relative residual.
+    The residual is measured against the matrix actually factored, in
+    its own dtype; corrections ride the factors' compute dtype."""
+    import jax.numpy as jnp
+
+    from conflux_tpu import solvers
+    from conflux_tpu.ops import blas
+
+    n = A_host.shape[0]
+    b = jnp.ones((n,), A_host.dtype)
+    Adev = jnp.asarray(A_host)
+    corr_dtype = blas.compute_dtype(jnp.dtype(out_dtype))
+    x = solvers.refine_classic(solve_fn, Adev, b, sweeps, jnp.float64,
+                               corr_dtype)
+    r = solvers._residual_strips(Adev, x, b.astype(jnp.float64),
+                                 jnp.float64)
+    rel = float(jnp.linalg.norm(r) / jnp.linalg.norm(b.astype(jnp.float64)))
+    flag = "PASS" if rel <= 1e-6 else "----"
+    print(f"_solve_residual_ refine={sweeps} rel={rel:.3e} [{flag} <=1e-6]")
+    return rel
+
+
 class WallTimer:
     def __enter__(self):
         self.t0 = time.perf_counter()

@@ -191,15 +191,9 @@ def main(argv=None) -> int:
         # (`src/conflux/lu/blas.cpp:15-123`); the TPU-native answer is
         # cheap factors + refinement to the same <=1e-6 solve bar.
         from conflux_tpu import solvers
-        from conflux_tpu.ops import blas as _blas
+        from conflux_tpu.cli.common import refine_report
 
         with profiler.region("refine_solve"):
-            b = jnp.ones((geom.N,), dtype)
-            # residuals against the matrix actually factored, in its own
-            # dtype (an f32 round-trip of an f64 A would certify the
-            # wrong system); corrections ride the factors' compute dtype
-            Adev = jnp.asarray(A)
-            corr_dtype = _blas.compute_dtype(jnp.asarray(out).dtype)
             if single:
                 def solve(r):
                     return solvers.lu_solve(out, perm_dev, r)
@@ -207,15 +201,7 @@ def main(argv=None) -> int:
                 def solve(r):
                     return solvers.lu_solve_distributed(
                         out, perm_dev, geom, mesh, r)
-            x = solvers.refine_classic(solve, Adev, b, args.refine,
-                                       jnp.float64, corr_dtype)
-            r = solvers._residual_strips(Adev, x, b.astype(jnp.float64),
-                                         jnp.float64)
-            rel = float(jnp.linalg.norm(r)
-                        / jnp.linalg.norm(b.astype(jnp.float64)))
-        flag = "PASS" if rel <= 1e-6 else "----"
-        print(f"_solve_residual_ refine={args.refine} rel={rel:.3e} "
-              f"[{flag} <=1e-6]")
+            refine_report(solve, A, jnp.asarray(out).dtype, args.refine)
 
     if args.profile:
         if not single:

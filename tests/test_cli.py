@@ -207,3 +207,35 @@ def test_bench_cli_smoke():
         capture_output=True, text=True, timeout=120, cwd=root, env=env,
     )
     assert bad.returncode != 0 and "multiple" in bad.stderr
+
+
+def test_cholesky_miniapp_refine(capsys):
+    from conflux_tpu.cli import cholesky_miniapp
+
+    out = run_cli(
+        cholesky_miniapp.main,
+        ["--dim", "64", "--tile", "16", "--grid", "2,1,1", "--run", "1",
+         "--refine", "2"],
+        capsys,
+    )
+    line = [l for l in out.splitlines()
+            if l.startswith("_solve_residual_")][0]
+    assert "[PASS <=1e-6]" in line, line
+    assert float(line.split("rel=")[1].split()[0]) <= 1e-6
+    with pytest.raises(SystemExit):
+        cholesky_miniapp.main(["--dim", "64", "--tile", "16", "--run", "1",
+                               "--refine", "-1"])
+
+
+def test_conflux_miniapp_refine(capsys):
+    from conflux_tpu.cli import conflux_miniapp
+
+    out = run_cli(
+        conflux_miniapp.main,
+        ["-N", "64", "-b", "16", "--p_grid", "2,1,1", "-r", "1",
+         "--refine", "2"],
+        capsys,
+    )
+    line = [l for l in out.splitlines()
+            if l.startswith("_solve_residual_")][0]
+    assert "[PASS <=1e-6]" in line, line
