@@ -313,11 +313,17 @@ def transform(shards, src, dst):
     moving between layouts *without* materializing the global matrix
     (`src/conflux/lu/layout.cpp:48`), so peak extra memory here is one
     destination-coordinate buffer (block-cyclic) or one tile (custom),
-    never (M, N).
+    never (M, N). Exception: for uniform-square-tile transforms below
+    `_NATIVE_TRANSFORM_MAX_BYTES`, an OpenMP fast path trades ~2x the
+    matrix of transient memory for one native pass (conflux_tpu.native);
+    larger matrices keep the constant-memory walk.
     """
     if (src.M, src.N) != (dst.M, dst.N):
         raise ValueError(f"layout shapes differ: {(src.M, src.N)} vs {(dst.M, dst.N)}")
     if isinstance(dst, CustomLayout):
+        fast = _native_bc_to_custom(shards, src, dst)
+        if fast is not None:
+            return fast
         dtype = _src_dtype(shards, src)
         out: dict = {}
         Mt, Nt = dst.tile_counts()
@@ -329,10 +335,83 @@ def transform(shards, src, dst):
                              tj * dst.vc, tj * dst.vc + w, tile, 0, 0)
                 out.setdefault(dst.owner(ti, tj), {})[(ti, tj)] = tile
         return out
+    fast = _native_custom_to_bc(shards, src, dst)
+    if fast is not None:
+        return fast
     return [
         [_build_local(shards, src, dst, p, q) for q in range(dst.Pcols)]
         for p in range(dst.Prows)
     ]
+
+
+# above this source size the native fast paths (which stage ~2x the
+# matrix of transient buffers) yield to the constant-extra-memory walk
+_NATIVE_TRANSFORM_MAX_BYTES = 1 << 30
+
+
+def _uniform_square_tiles(src, dst) -> bool:
+    """One tile size throughout and exact tiling on both grids — the
+    regime the native tile-pack kernel handles (conflux's own layouts;
+    everything else falls back to the Python region walk)."""
+    bc, cl = (src, dst) if isinstance(dst, CustomLayout) else (dst, src)
+    v = bc.vr
+    return (bc.vr == bc.vc == cl.vr == cl.vc
+            and bc.M % (v * bc.Prows) == 0 and bc.N % (v * bc.Pcols) == 0)
+
+
+def _native_bc_to_custom(shards, src, dst):
+    """Native fast path: block-cyclic -> packed tiles (one OpenMP pass),
+    then per-owner VIEWS of the packed buffer — owner-array-agnostic."""
+    from conflux_tpu import native
+
+    if not isinstance(src, BlockCyclicLayout) or not _uniform_square_tiles(src, dst):
+        return None
+    dtype = np.dtype(_src_dtype(shards, src))
+    # probe everything cheap BEFORE staging O(M*N) buffers: a missing
+    # .so or unsupported dtype must not double the fallback's cost
+    if (not native.available() or not native._TILES_OK
+            or dtype not in (np.float32, np.float64)
+            or src.M * src.N * dtype.itemsize > _NATIVE_TRANSFORM_MAX_BYTES):
+        return None
+    stacked = np.stack([np.stack([np.ascontiguousarray(shards[p][q])
+                                  for q in range(src.Pcols)])
+                        for p in range(src.Prows)])
+    tiles = native.bc_to_tiles(stacked, src.vr, src.Prows, src.Pcols)
+    if tiles is None:
+        return None
+    Mt, Nt = dst.tile_counts()
+    out: dict = {}
+    for ti in range(Mt):
+        for tj in range(Nt):
+            out.setdefault(dst.owner(ti, tj), {})[(ti, tj)] = (
+                tiles[ti * Nt + tj])
+    return out
+
+
+def _native_custom_to_bc(store, src, dst):
+    """Native fast path for the reverse direction: pack the tile stores
+    into global order, then one OpenMP pass into the block-cyclic
+    buffer."""
+    from conflux_tpu import native
+
+    if not isinstance(src, CustomLayout) or not _uniform_square_tiles(src, dst):
+        return None
+    dtype = np.dtype(_src_dtype(store, src))
+    if (not native.available() or not native._TILES_OK
+            or dtype not in (np.float32, np.float64)
+            or src.M * src.N * dtype.itemsize > _NATIVE_TRANSFORM_MAX_BYTES):
+        return None
+    Mt, Nt = src.tile_counts()
+    v = src.vr
+    tiles = np.empty((Mt * Nt, v, v), dtype)
+    for ti in range(Mt):
+        for tj in range(Nt):
+            tiles[ti * Nt + tj] = store[src.owner(ti, tj)][(ti, tj)]
+    out4 = native.tiles_to_bc(tiles, dst.M, dst.N, v, dst.Prows, dst.Pcols)
+    if out4 is None:
+        return None
+    return [[out4[p, q] for q in range(dst.Pcols)]
+            for p in range(dst.Prows)]
 
 
 def _src_dtype(shards, src):

@@ -21,6 +21,7 @@ import numpy as np
 _LIB = None
 _TRIED = False
 _FILE_OK = False
+_TILES_OK = False
 
 _SO_PATH = os.path.join(os.path.dirname(__file__), "libconflux_layout.so")
 
@@ -66,6 +67,28 @@ def _load():
                 stacklevel=2,
             )
             _FILE_OK = False
+        # tile-pack symbols are newer still (round 3): same stale-.so
+        # degradation story as the file IO block
+        global _TILES_OK
+        try:
+            for name in ("conflux_bc_to_tiles_f32", "conflux_bc_to_tiles_f64",
+                         "conflux_tiles_to_bc_f32", "conflux_tiles_to_bc_f64"):
+                fn = getattr(lib, name)
+                fn.restype = None
+                ptr = (ctypes.c_float if name.endswith("f32")
+                       else ctypes.c_double)
+                fn.argtypes = ([ctypes.POINTER(ptr), ctypes.POINTER(ptr)]
+                               + [ctypes.c_int64] * 5)
+            _TILES_OK = True
+        except AttributeError:
+            import warnings
+
+            warnings.warn(
+                "stale libconflux_layout.so lacks the tile-pack symbols; "
+                "rebuild with `python -m conflux_tpu.native.build`",
+                stacklevel=2,
+            )
+            _TILES_OK = False
     return _LIB
 
 
@@ -157,4 +180,51 @@ def gather(shards: np.ndarray, v: int, Px: int, Py: int) -> np.ndarray | None:
     out = np.empty((M, N), dtype=shards.dtype)
     fn = lib.conflux_gather_f32 if shards.dtype == np.float32 else lib.conflux_gather_f64
     fn(_ptr(shards), _ptr(out), M, N, v, Px, Py)
+    return out
+
+
+def bc_to_tiles(shards: np.ndarray, v: int, Px: int, Py: int
+                ) -> np.ndarray | None:
+    """(Px, Py, Ml, Nl) block-cyclic shards -> (Mt*Nt, v, v) tiles packed
+    in global (ti, tj) row-major order. Owner-agnostic: the custom-layout
+    transform slices per-owner VIEWS of the result, so one native kernel
+    serves every `costa::custom_layout` owner array. None when the
+    native engine can't handle it (fallback to the Python walk)."""
+    lib = _load()
+    if lib is None or not _TILES_OK \
+            or shards.dtype not in (np.float32, np.float64):
+        return None
+    if shards.ndim != 4 or shards.shape[:2] != (Px, Py):
+        raise ValueError(f"shards shape {shards.shape} does not match grid "
+                         f"({Px}, {Py}, Ml, Nl)")
+    _, _, Ml, Nl = shards.shape
+    if Ml % v or Nl % v:
+        return None
+    M, N = Ml * Px, Nl * Py
+    shards = np.ascontiguousarray(shards)
+    out = np.empty(((M // v) * (N // v), v, v), dtype=shards.dtype)
+    fn = (lib.conflux_bc_to_tiles_f32 if shards.dtype == np.float32
+          else lib.conflux_bc_to_tiles_f64)
+    fn(_ptr(shards), _ptr(out), M, N, v, Px, Py)
+    return out
+
+
+def tiles_to_bc(tiles: np.ndarray, M: int, N: int, v: int, Px: int, Py: int
+                ) -> np.ndarray | None:
+    """Inverse of :func:`bc_to_tiles`: (Mt*Nt, v, v) packed tiles ->
+    (Px, Py, Ml, Nl) block-cyclic shards. None when not applicable."""
+    lib = _load()
+    if lib is None or not _TILES_OK \
+            or tiles.dtype not in (np.float32, np.float64):
+        return None
+    if M % (v * Px) or N % (v * Py):
+        return None
+    if tiles.shape != ((M // v) * (N // v), v, v):
+        raise ValueError(f"tiles shape {tiles.shape} does not match "
+                         f"{M}x{N} at tile {v}")
+    tiles = np.ascontiguousarray(tiles)
+    out = np.empty((Px, Py, M // Px, N // Py), dtype=tiles.dtype)
+    fn = (lib.conflux_tiles_to_bc_f32 if tiles.dtype == np.float32
+          else lib.conflux_tiles_to_bc_f64)
+    fn(_ptr(tiles), _ptr(out), M, N, v, Px, Py)
     return out

@@ -42,3 +42,24 @@ int conflux_native_nthreads() {
 }
 
 }  // extern "C"
+
+extern "C" {
+
+void conflux_bc_to_tiles_f32(const float* shards, float* tiles, int64_t M,
+                             int64_t N, int64_t v, int64_t Px, int64_t Py) {
+  conflux_native::bc_to_tiles_impl(shards, tiles, M, N, v, Px, Py);
+}
+void conflux_bc_to_tiles_f64(const double* shards, double* tiles, int64_t M,
+                             int64_t N, int64_t v, int64_t Px, int64_t Py) {
+  conflux_native::bc_to_tiles_impl(shards, tiles, M, N, v, Px, Py);
+}
+void conflux_tiles_to_bc_f32(const float* tiles, float* shards, int64_t M,
+                             int64_t N, int64_t v, int64_t Px, int64_t Py) {
+  conflux_native::tiles_to_bc_impl(tiles, shards, M, N, v, Px, Py);
+}
+void conflux_tiles_to_bc_f64(const double* tiles, double* shards, int64_t M,
+                             int64_t N, int64_t v, int64_t Px, int64_t Py) {
+  conflux_native::tiles_to_bc_impl(tiles, shards, M, N, v, Px, Py);
+}
+
+}  // extern "C"
