@@ -57,7 +57,8 @@ def parse_args(argv=None):
     p.add_argument(
         "--election", default="gather", choices=["gather", "butterfly"],
         help="cross-x pivot election: one all_gather tournament, or the "
-        "reference's log2(Px) ppermute hypercube (power-of-two Px)",
+        "reference's log2(Px) ppermute hypercube (any Px; odd grids "
+        "fold their overflow ranks with two extra rounds)",
     )
     p.add_argument(
         "--segs", default=None, metavar="RxC", type=segs_arg,
@@ -78,7 +79,10 @@ def parse_args(argv=None):
     p.add_argument(
         "--swap", default="xla", choices=["xla", "dma"],
         help="row-swap path: XLA scatter, or the experimental pipelined "
-        "DMA kernel (TPU only; falls back to XLA off-TPU)",
+        "DMA kernel (TPU only, hardware-unverified; falls back to XLA "
+        "off-TPU; requires unique destination rows — the LU swap "
+        "guarantees this, duplicates are undefined for dma where the "
+        "XLA path is last-writer-deterministic)",
     )
     p.add_argument(
         "--refine", type=int, default=None, metavar="K",
@@ -208,11 +212,15 @@ def main(argv=None) -> int:
             from conflux_tpu.cli.common import phase_profile
             from conflux_tpu.lu.distributed import build_program
 
+            # dtype rides along so the profiled program IS the cached one
+            # just timed (the panel_chunk default + flat-tree guard are
+            # compute-dtype-resolved; a dtype-blind build would profile a
+            # different program under --dtype float64)
             phase_profile(
                 build_program(geom, mesh, lookahead=args.lookahead,
                               election=args.election, tree=args.tree,
                               update=args.update, swap=args.swap,
-                              **seg_kw), dev)
+                              dtype=dtype, **seg_kw), dev)
         profiler.report()
     return 0
 

@@ -46,13 +46,15 @@ def parse_args(argv=None):
                    help="tall-mode election (QR tree vs Gram/CholeskyQR2)")
     p.add_argument("--tree", default="gather", choices=["gather", "butterfly"],
                    help="tsqr cross-x reduction: one all_gather, or the "
-                   "log2(Px) ppermute hypercube (power-of-two Px)")
+                   "log2(Px) ppermute hypercube (any Px; odd grids fold "
+                   "their overflow ranks with two extra rounds)")
     p.add_argument("--full", action="store_true",
                    help="general block-cyclic QR on the (x, y, z) mesh")
     p.add_argument("--lookahead", action="store_true",
                    help="software-pipelined --full loop: overlap the next "
                    "panel's election with the trailing update (P8; "
-                   "bitwise-identical results)")
+                   "value-equivalent results — bitwise-verified on CPU "
+                   "only)")
     p.add_argument("--csegs", type=positive_int, default=None, metavar="C",
                    help="trailing-update column segment count for --full "
                    "(default: tuned library value)")
@@ -193,7 +195,10 @@ def main(argv=None) -> int:
             from conflux_tpu.cli.common import phase_profile
             from conflux_tpu.qr.distributed import build_program
 
-            phase_profile(build_program(geom, mesh, **seg_kw), dev)
+            # dtype rides along so the chunk default resolves like the
+            # timed run's (see lu miniapp --profile note)
+            phase_profile(build_program(geom, mesh, dtype=dtype, **seg_kw),
+                          dev)
         profiler.report()
     return 0
 

@@ -194,9 +194,10 @@ def _build(geom: LUGeometry, mesh_key, precision, backend: str,
                 # each reducing a pair-ordered (2v, v) stack — only v
                 # rows ever cross the interconnect per round, vs the
                 # all_gather's Px*v. The ordering/replication invariant
-                # lives in `butterfly_allreduce`; power-of-two Px is
-                # enforced in build_program (the reference patches odd
-                # grids with extra sends; here gather covers them).
+                # lives in `butterfly_allreduce`, which also handles
+                # non-power-of-two Px (overflow-rank fold/unfold — the
+                # SPMD form of the reference's odd-grid compensating
+                # sends, `conflux_opt.hpp:266-280`).
                 # lu00 rides the tuple so the final round's packed
                 # factor comes out replicated with the winners.
                 def reduce_pair(top, bot):
@@ -591,7 +592,8 @@ def build_program(geom: LUGeometry, mesh, precision=None,
                   donate: bool = False, resumable: bool = False,
                   lookahead: bool = False, election: str = "gather",
                   segs: tuple = (16, 16), tree: str = "pairwise",
-                  swap: str = "xla", update: str = "segments"):
+                  swap: str = "xla", update: str = "segments",
+                  dtype=None):
     """The jitted distributed-LU program itself (cached per config).
 
     The single point resolving the trace-time defaults (precision/backend/
@@ -599,25 +601,24 @@ def build_program(geom: LUGeometry, mesh, precision=None,
     here too. Direct use is for callers that need the compile artifacts —
     e.g. the miniapp's `--profile`, which joins an XPlane trace with the
     optimized HLO's named-scope metadata (`profiler.phase_table`) to print
-    the per-phase device-time table.
+    the per-phase device-time table. Such callers should pass the input
+    `dtype` they will run with: the panel_chunk default and the
+    tree='flat' VMEM guard resolve chunk ceilings with its compute dtype
+    (f64 halves the safe call heights vs the f32 default), so a
+    dtype-blind build can cache a different program than the one the
+    entry points time — or pass a flat-stack height the chip cannot
+    compile.
     """
     precision = blas.matmul_precision() if precision is None else precision
     backend = blas.get_backend() if backend is None else backend
+    cdtype = blas.compute_dtype(jnp.dtype(dtype)) if dtype is not None \
+        else jnp.float32
     if panel_chunk is None:
-        # dtype-blind fallback (no shards in scope): f32 compute is the
-        # TPU reality for real dtypes; the entry points that hold shards
-        # resolve with the true compute dtype before calling
-        panel_chunk = blas.single_call_rows(geom.v)
+        panel_chunk = blas.single_call_rows(geom.v, cdtype)
     if donate and next(iter(mesh.devices.flat)).platform == "cpu":
         donate = False  # CPU PJRT has no buffer donation (warns per call)
     if election not in ("gather", "butterfly"):
         raise ValueError(f"unknown election {election!r} (gather|butterfly)")
-    Px = geom.grid.Px
-    if election == "butterfly" and Px > 1 and (Px & (Px - 1)):
-        raise ValueError(
-            f"butterfly election needs a power-of-two Px, got {Px} "
-            "(a missing hypercube partner strands candidate subsets; "
-            "use election='gather' for this grid)")
     if len(segs) != 2 or segs[0] < 1 or segs[1] < 1:
         raise ValueError(
             f"segs must be two positive segment counts, got {segs!r} "
@@ -640,16 +641,20 @@ def build_program(geom: LUGeometry, mesh, precision=None,
         if nch > 1:
             stacks.append(nch * v)
         if geom.grid.Px > 1 and election == "gather":
+            # same compute-dtype ceiling the traced election uses (the
+            # elect() call site) — an f32-blind guard would admit f64
+            # flat stacks twice the true single-call-safe height
             _, nch2 = blas.chunk_layout(
                 geom.grid.Px * v, v,
-                min(panel_chunk, blas.batched_call_rows(v)))
+                min(panel_chunk, blas.batched_call_rows(v, cdtype)))
             if nch2 > 1:
                 stacks.append(nch2 * v)
-        if stacks and max(stacks) > blas.single_call_rows(v):
+        if stacks and max(stacks) > blas.single_call_rows(v, cdtype):
             raise ValueError(
                 f"tree='flat' would stack {max(stacks)} nominee rows of "
                 f"width {v} in one LU call (> the "
-                f"{blas.single_call_rows(v)}-row VMEM-safe height); "
+                f"{blas.single_call_rows(v, cdtype)}-row VMEM-safe height "
+                f"for {jnp.dtype(cdtype).name}); "
                 "raise panel_chunk or use tree='pairwise'")
     if swap not in ("xla", "dma"):
         raise ValueError(f"unknown swap {swap!r} (xla|dma)")
@@ -699,18 +704,27 @@ def lu_factor_distributed(shards, geom: LUGeometry, mesh,
     calls; see `ops.blas.tournament_winners`). Both are valid CALU
     elections; pivot choices can differ on ties, so results are
     comparable by residual, not bitwise.
+    `swap='dma'` (EXPERIMENTAL, hardware-unverified) routes the
+    displacement scatter through the pipelined Pallas row-DMA kernel
+    instead of XLA's scatter. The kernel requires unique destination
+    rows — duplicates are undefined (in-flight DMAs race) where the XLA
+    path is last-writer-deterministic. The LU swap's destinations are
+    unique by construction (a permutation fragment), so both paths
+    compute the same swap here; keep 'xla' until the staged A/B
+    (`scripts/swap_probe.py`) has passed on a real chip.
     """
     from conflux_tpu.geometry import check_shards
 
     shards = jnp.asarray(shards)
     check_shards(shards, geom)
-    if panel_chunk is None:
-        panel_chunk = blas.single_call_rows(
-            geom.v, blas.compute_dtype(shards.dtype))
+    # the default panel_chunk resolves inside build_program from the
+    # compute dtype — ONE resolution point, so a retune cannot
+    # desynchronize the entry paths from the --profile build
     fn = build_program(geom, mesh, precision=precision, backend=backend,
                        panel_chunk=panel_chunk, donate=donate,
                        lookahead=lookahead, election=election,
-                       segs=segs, tree=tree, swap=swap, update=update)
+                       segs=segs, tree=tree, swap=swap, update=update,
+                       dtype=shards.dtype)
     return fn(shards)
 
 
@@ -761,17 +775,15 @@ def lu_factor_steps(shards, geom: LUGeometry, mesh, k0: int, k1: int,
     # run keeps the tuned segmentation (math-invariant, perf-only);
     # `tree` rides through because trees may elect different winners on
     # ties — a resume must keep the uninterrupted run's pivot bracket.
-    # The default chunk resolves with the same compute dtype as
-    # lu_factor_distributed's: a dtype-blind default here would chunk a
-    # resumed f64 run differently from the run it resumes (different
-    # nomination bracket -> different pivots).
-    if panel_chunk is None:
-        panel_chunk = blas.single_call_rows(
-            geom.v, blas.compute_dtype(jnp.asarray(shards).dtype))
+    # The default chunk resolves inside build_program with the same
+    # compute dtype as lu_factor_distributed's: a dtype-blind default
+    # here would chunk a resumed f64 run differently from the run it
+    # resumes (different nomination bracket -> different pivots).
     fn = build_program(geom, mesh, precision=precision, backend=backend,
                        panel_chunk=panel_chunk, donate=donate,
                        resumable=True, election=election, segs=segs,
-                       tree=tree, update=update)
+                       tree=tree, update=update,
+                       dtype=jnp.asarray(shards).dtype)
     return fn(shards, orig, jnp.int32(k0), jnp.int32(k1))
 
 

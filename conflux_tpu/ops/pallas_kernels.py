@@ -283,13 +283,21 @@ def scatter_rows(a: jax.Array, rows: jax.Array, idx: jax.Array,
                  use_dma: bool = False) -> jax.Array:
     """a with a[idx[i], :] = rows[i, :]; idx entries >= a.shape[0] dropped.
 
-    Same contract as `a.at[idx].set(rows, mode="drop")` for unique in-range
-    indices. With `use_dma=True` (EXPERIMENTAL, TPU only, row byte length a
-    multiple of 4 KB — the 1D memref tile) the rows move as pipelined DMAs
-    through a VMEM stage instead of XLA's serial scatter loop; the input is
-    updated in place when XLA can prove `a` dead. The default path is the
-    XLA scatter — the production swap avoids this op entirely (see
-    `lu/distributed.py` step 6).
+    Same contract as `a.at[idx].set(rows, mode="drop")` for UNIQUE in-range
+    indices — uniqueness is a requirement of the DMA path, not a nicety:
+    the XLA fallback resolves duplicate destinations deterministically
+    (last writer wins), but with `use_dma=True` duplicate destinations are
+    UNDEFINED (concurrent in-flight row DMAs race; whichever lands last is
+    unspecified). The LU row swap satisfies this by construction (its
+    displacement scatter is a permutation fragment). With `use_dma=True`
+    (EXPERIMENTAL, TPU only, unverified on hardware until
+    tests/test_scatter_rows.py::test_scatter_rows_tpu has passed on a real
+    chip;
+    row byte length a multiple of 4 KB — the 1D memref tile) the rows move
+    as pipelined DMAs through a VMEM stage instead of XLA's serial scatter
+    loop; the input is updated in place when XLA can prove `a` dead. The
+    default path is the XLA scatter — the production swap avoids this op
+    entirely (see `lu/distributed.py` step 6).
     """
     if rows.shape[0] == 0:
         return a

@@ -140,26 +140,47 @@ def replicate(val, axes):
 
 
 def butterfly_allreduce(vals: tuple, Px: int, axis: str, reduce_pair):
-    """log2(Px) hypercube all-reduce over a mesh axis (the reference's
-    tournament butterfly shape, `conflux_opt.hpp:220-336`): each round,
-    partners exchange `vals` via ppermute and `reduce_pair(top, bot)`
-    combines the two tuples into the next `vals`.
+    """Hypercube all-reduce over a mesh axis (the reference's tournament
+    butterfly shape, `conflux_opt.hpp:220-336`): each round, partners
+    exchange `vals` via ppermute and `reduce_pair(top, bot)` combines
+    the two tuples into the next `vals`.
 
     The correctness-critical invariant lives here ONCE: the pair is
     ordered by the LOWER coordinate, so both partners reduce the
     bit-identical inputs and the result converges replicated across the
     axis without a broadcast (tie-stable for order-dependent reducers
-    like the CALU tournament). Power-of-two Px only — with a missing
-    partner a plain butterfly leaves device subsets that never see all
-    contributions; callers must validate.
+    like the CALU tournament).
+
+    Non-power-of-two Px is handled the way the reference patches odd
+    grids with compensating sends (`conflux_opt.hpp:266-280`, partner
+    math `conflux_opt.cpp:59-72`), recast for SPMD: with p the largest
+    power of two <= Px and r = Px - p, a pre-round folds each overflow
+    rank p+i into rank i (i < r), the log2(p) butterfly runs over the
+    [0, p) subcube, and a post-round sends the replicated result back to
+    the overflow ranks — 2 extra ppermute rounds total, still only one
+    `vals` payload per rank per round. All ranks execute every round
+    (SPMD); the off-subcube reductions operate on ppermute's zero fill
+    and are discarded by coordinate selects, so reducers must tolerate
+    (not crash on) all-zero inputs — true of the CALU/TSQR reducers,
+    whose zero-stack factorizations are well-defined garbage.
     """
     import jax.numpy as jnp
     from jax import lax
 
     x = lax.axis_index(axis)
-    for r in range(Px.bit_length() - 1):
-        bit = 1 << r
-        perm = [(i, i ^ bit) for i in range(Px)]
+    p = 1 << (Px.bit_length() - 1)  # largest power of two <= Px
+    r = Px - p
+    if r:
+        # fold: overflow rank p+i's contribution joins rank i's, ordered
+        # by the lower coordinate (rank i's own vals first)
+        perm = [(p + i, i) for i in range(r)]
+        recv = tuple(lax.ppermute(v, axis, perm) for v in vals)
+        folded = tuple(reduce_pair(vals, recv))
+        vals = tuple(jnp.where(x < r, f, v)
+                     for f, v in zip(folded, vals))
+    for rnd in range(p.bit_length() - 1):
+        bit = 1 << rnd
+        perm = [(i, i ^ bit) for i in range(p)]
         others = tuple(lax.ppermute(v, axis, perm) for v in vals)
         low_first = (x & bit) == 0
         top = tuple(jnp.where(low_first, a, b)
@@ -167,6 +188,13 @@ def butterfly_allreduce(vals: tuple, Px: int, axis: str, reduce_pair):
         bot = tuple(jnp.where(low_first, b, a)
                     for a, b in zip(vals, others))
         vals = tuple(reduce_pair(top, bot))
+    if r:
+        # unfold: the subcube result is replicated over [0, p); hand the
+        # overflow ranks their copy
+        perm = [(i, p + i) for i in range(r)]
+        recv = tuple(lax.ppermute(v, axis, perm) for v in vals)
+        vals = tuple(jnp.where(x >= p, o, v)
+                     for o, v in zip(recv, vals))
     return vals
 
 

@@ -64,8 +64,9 @@ def _two_pass_tsqr(A, Px: int, chunk: int, passes: int, prec,
     — log2(Px) `ppermute` rounds each QR-reducing a pair-ordered
     (2n, n) stack, only n rows per round; pair ordering by the lower
     x-coordinate keeps every device's reduction bit-identical, so the
-    result is replicated without a broadcast. Power-of-two Px only
-    (checked by callers exposing the option)."""
+    result is replicated without a broadcast. Any Px: non-power-of-two
+    axes fold their overflow ranks in/out with two extra ppermute
+    rounds (see `butterfly_allreduce`)."""
     n = A.shape[1]
     R = None
     for _ in range(max(1, passes)):
@@ -135,9 +136,6 @@ def _factor(shards, mesh, algo: str, chunk: int | None, passes: int,
             n, blas.compute_dtype(shards.dtype))
     if tree not in ("gather", "butterfly"):
         raise ValueError(f"unknown tree {tree!r} (gather|butterfly)")
-    if tree == "butterfly" and Px > 1 and (Px & (Px - 1)):
-        raise ValueError(
-            f"butterfly tree needs a power-of-two Px, got {Px}")
     fn = _build(mesh_cache_key(mesh), algo, (Ml, n), shards.dtype.name,
                 chunk, passes, tree)
     return fn(shards)
@@ -149,7 +147,8 @@ def tsqr_distributed(shards, mesh, chunk: int | None = None,
     reduction tree. Every QR call is height-bounded by
     max(chunk, 2n, Px*n-tree levels); robust at any conditioning.
     tree='butterfly' selects the log-depth ppermute hypercube reduction
-    (power-of-two Px; see `_two_pass_tsqr`)."""
+    (any Px — odd axes fold their overflow ranks with two extra rounds;
+    see `_two_pass_tsqr`)."""
     return _factor(shards, mesh, "tsqr", chunk, passes, tree)
 
 
@@ -236,8 +235,12 @@ def _build_full(geom, mesh_key, precision, backend: str, chunk: int,
     pre-update matrix with ONLY the Q-column write applied — value-
     identical at every done column to the post-step matrix, but with no
     dataflow edge from the trailing segment GEMMs — and (b) a panel-slab
-    GEMM mirroring the segment update operand-for-operand (bitwise-
-    identical values). XLA's scheduler can therefore overlap the
+    GEMM mirroring the segment update's z-slab operands at width v.
+    Value-equivalent to the plain loop; bitwise-verified on the CPU
+    backend only — the slab GEMM is a width-v slice of work the plain
+    loop computes at segment width, and TPU kernel accumulation order is
+    shape-dependent (same caveat as the LU block update), so the TPU
+    result may differ in final bits. XLA's scheduler can overlap the
     election collectives (panel psum, W/D psums, TSQR all_gather) with
     the trailing update on a mesh. Cost: one redundant (Ml, v)-slab GEMM
     per superstep.
@@ -493,9 +496,11 @@ def _build_full(geom, mesh_key, precision, backend: str, chunk: int,
                     lax.dynamic_update_slice(Aloc, art["qcol"],
                                              (i0, art["lj"])),
                     Aloc)
-                # panel slab of tile kn, updated by a GEMM that mirrors
-                # the segment update operand-for-operand (same z-slab
-                # operands Qps/Cs -> bitwise-identical values)
+                # panel slab of tile kn, updated by a GEMM over the same
+                # z-slab operands (Qps/Cs) as the segment update — value-
+                # equivalent; bitwise only where kernel accumulation is
+                # shape-independent (CPU yes; TPU unverified, the slab is
+                # width v vs the segment's chi-clo)
                 with jax.named_scope("qr_panel_reduce"):
                     lj1 = ((kn // Py) * v).astype(jnp.int32)
                     slab = lax.dynamic_slice(Aloc, (i0, lj1), (Ml, v))
@@ -547,18 +552,21 @@ def _build_full(geom, mesh_key, precision, backend: str, chunk: int,
 def build_program(geom, mesh, precision=None, backend: str | None = None,
                   chunk: int | None = None, donate: bool = False,
                   resumable: bool = False, csegs: int = 8,
-                  lookahead: bool = False):
+                  lookahead: bool = False, dtype=None):
     """The jitted block-cyclic QR program itself (cached per config) —
     the single point resolving trace-time defaults, mirroring
     `lu.distributed.build_program`. Direct use is for callers needing
-    the compile artifacts (the miniapp's --profile phase table)."""
+    the compile artifacts (the miniapp's --profile phase table); such
+    callers should pass the input `dtype` they will run with so the
+    chunk default resolves with its compute dtype (f64 halves the safe
+    TSQR call height) and the built program matches the one the entry
+    points cache and time."""
     precision = blas.matmul_precision() if precision is None else precision
     backend = blas.get_backend() if backend is None else backend
     if chunk is None:
-        # dtype-blind fallback (no shards in scope here): f32 compute is
-        # the TPU reality for real dtypes; the entry points that hold
-        # shards resolve with the true compute dtype before calling
-        chunk = blas.batched_call_rows(geom.v)
+        cdtype = blas.compute_dtype(jnp.dtype(dtype)) if dtype is not None \
+            else jnp.float32
+        chunk = blas.batched_call_rows(geom.v, cdtype)
     if donate and next(iter(mesh.devices.flat)).platform == "cpu":
         donate = False
     if csegs < 1:
@@ -579,18 +587,17 @@ def qr_factor_distributed(shards, geom, mesh, precision=None,
     triangular (N, N) block-cyclic over its own geometry (gather it with
     `r_geometry(geom)`). See `_build_full` for the algorithm;
     `lookahead=True` software-pipelines the loop (P8 — next panel's
-    election overlaps the trailing update on a mesh; bitwise-identical
-    results)."""
+    election overlaps the trailing update on a mesh; value-equivalent
+    results, bitwise-verified on the CPU backend only — see
+    `_build_full`'s shape-dependent-accumulation caveat)."""
     from conflux_tpu.geometry import check_shards
 
     shards = jnp.asarray(shards)
     check_shards(shards, geom)
-    if chunk is None:
-        chunk = blas.batched_call_rows(
-            geom.v, blas.compute_dtype(shards.dtype))
+    # default chunk resolves inside build_program from the compute dtype
     fn = build_program(geom, mesh, precision=precision, backend=backend,
                        chunk=chunk, donate=donate, csegs=csegs,
-                       lookahead=lookahead)
+                       lookahead=lookahead, dtype=shards.dtype)
     return fn(shards)
 
 
@@ -610,11 +617,9 @@ def qr_factor_steps(shards, geom, mesh, k0: int, k1: int, R=None,
     than bit-identical; Pz == 1 round-trips exactly."""
     if not (0 <= k0 < k1 <= geom.Nt):
         raise ValueError(f"step range [{k0}, {k1}) outside [0, {geom.Nt})")
-    if chunk is None:
-        # same compute-dtype resolution as qr_factor_distributed: a
-        # resumed run must chunk its panel TSQR like the run it resumes
-        chunk = blas.batched_call_rows(
-            geom.v, blas.compute_dtype(jnp.asarray(shards).dtype))
+    # the default chunk resolves inside build_program with the same
+    # compute dtype as qr_factor_distributed's: a resumed run must chunk
+    # its panel TSQR like the run it resumes
     if R is None:
         if k0 != 0:
             raise ValueError("resuming at k0 > 0 requires the R state "
@@ -625,7 +630,8 @@ def qr_factor_steps(shards, geom, mesh, k0: int, k1: int, R=None,
             (geom.grid.Px, geom.grid.Py, r_geometry(geom).Ml, geom.Nl),
             jnp.asarray(shards).dtype)
     fn = build_program(geom, mesh, precision=precision, backend=backend,
-                       chunk=chunk, donate=donate, resumable=True)
+                       chunk=chunk, donate=donate, resumable=True,
+                       dtype=jnp.asarray(shards).dtype)
     return fn(jnp.asarray(shards), jnp.asarray(R), jnp.int32(k0),
               jnp.int32(k1))
 

@@ -200,6 +200,16 @@ def simulate_lu(A: np.ndarray, grid: Grid3, v: int, pivoting: str = "tournament"
     `panel_chunk` defaults to the implementation's default
     (`blas.single_call_rows(v)`); pass the same value used there
     for buffer-exact cross-validation in the chunked regime.
+
+    Divergence caveat: the spec pins its chunk ceilings to the 32 MiB
+    `blas._SCOPED_VMEM_DEFAULT` so the simulation is host-independent,
+    while the implementation honors CONFLUX_TPU_SCOPED_VMEM_BYTES /
+    `set_scoped_vmem_bytes` / the device-kind table. When such an
+    override is active, default-chunk runs of the two can elect
+    different pivots (different nomination brackets). For buffer-exact
+    cross-validation either pass an explicit `panel_chunk` to BOTH, or
+    assert `blas.scoped_vmem_bytes() == blas._SCOPED_VMEM_DEFAULT`
+    first (the spec-vs-impl tests do).
     """
     if panel_chunk is None:
         from conflux_tpu.ops import blas

@@ -14,7 +14,7 @@
 # Probe = tiny reduction with a hard timeout; the tunnel wedge manifests
 # as an indefinite hang on the first device op (see bench._probe_device).
 cd "$(dirname "$0")/.." || exit 1
-LOG=data/benchmarks/round3-recovery.txt
+LOG=${RECOVERY_LOG:-data/benchmarks/round3-recovery.txt}
 echo "watch start $(date -u +%FT%TZ)" >> "$LOG"
 while true; do
   # the platform assert rejects a CPU-fallback backend: a fast plugin-init

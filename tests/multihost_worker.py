@@ -17,6 +17,7 @@ import mh_common  # noqa: F401  (must precede jax backend init)
 
 pid, nproc, port = int(sys.argv[1]), int(sys.argv[2]), sys.argv[3]
 grid_arg = sys.argv[4] if len(sys.argv) > 4 else "4,2,1"
+election_arg = sys.argv[5] if len(sys.argv) > 5 else "gather"
 
 import jax  # noqa: E402
 import numpy as np  # noqa: E402
@@ -49,7 +50,8 @@ def local_shard(px, py):
 shards = distribute_shards(
     local_shard, mesh, shape=(grid.Px, grid.Py, geom.Ml, geom.Nl),
     dtype=np.float32)
-out, perm = lu_factor_distributed(shards, geom, mesh)
+out, perm = lu_factor_distributed(shards, geom, mesh,
+                                  election=election_arg)
 res = float(lu_residual_distributed(shards, out, perm, geom, mesh))
 n_local = len(set(calls))
 mine = mh_common.my_shard_coords(mesh)

@@ -184,6 +184,55 @@ def test_lu_flat_tree_vmem_guard():
         build_program(geom, mesh, tree="bogus")
 
 
+def test_lu_flat_tree_vmem_guard_dtype_aware():
+    """The flat-tree guard must evaluate with the COMPUTE dtype's chunk
+    ceilings: an f64 run's single-call-safe height is half f32's, so a
+    config that passes for f32 can be unbuildable for f64 (ADVICE r3).
+    panel_chunk=4096 at Ml=32768/v=1024 stacks 8 nominees = 8192 rows:
+    exactly the f32 ceiling (passes), double the f64 one (must raise)."""
+    import jax
+
+    from conflux_tpu.geometry import LUGeometry
+    from conflux_tpu.lu.distributed import build_program
+    from conflux_tpu.ops import blas
+    from conflux_tpu.parallel.mesh import make_mesh
+
+    if blas.scoped_vmem_bytes() != blas._SCOPED_VMEM_DEFAULT:
+        pytest.skip("scoped-VMEM override active; pinned heights differ")
+    grid = Grid3(1, 1, 1)
+    geom = LUGeometry.create(32768, 32768, 1024, grid)
+    mesh = make_mesh(grid, devices=jax.devices()[:1])
+    # passes with f32 compute (stack == the 8192-row f32 ceiling) ...
+    build_program(geom, mesh, panel_chunk=4096, tree="flat",
+                  dtype=np.float32)
+    # ... and must refuse the same stack for f64 compute, naming the dtype
+    with pytest.raises(ValueError, match="float64"):
+        build_program(geom, mesh, panel_chunk=4096, tree="flat",
+                      dtype=np.float64)
+
+
+def test_lu_build_program_dtype_resolves_default_chunk():
+    """build_program(dtype=...) must resolve the same default panel_chunk
+    as lu_factor_distributed does from its shards, so a --profile build
+    returns the SAME cached program the timed run used (ADVICE r3: the
+    dtype-blind default built and profiled a different f64 program)."""
+    import jax
+
+    from conflux_tpu.geometry import LUGeometry
+    from conflux_tpu.lu.distributed import build_program
+    from conflux_tpu.ops import blas
+    from conflux_tpu.parallel.mesh import make_mesh
+
+    grid = Grid3(1, 1, 1)
+    geom = LUGeometry.create(256, 256, 64, grid)
+    mesh = make_mesh(grid, devices=jax.devices()[:1])
+    for dt in (np.float32, np.float64):
+        explicit = build_program(
+            geom, mesh,
+            panel_chunk=blas.single_call_rows(64, blas.compute_dtype(dt)))
+        assert build_program(geom, mesh, dtype=dt) is explicit
+
+
 def test_lu_distributed_segs_invariant():
     """Trailing-update segmentation partitions the same per-element math:
     any (row, col) segment counts — coarse, odd/ragged, tile-granular —
@@ -488,9 +537,11 @@ def test_lu_distributed_butterfly_election():
     """The ppermute hypercube election (reference `conflux_opt.hpp:220-336`
     structure: log2(Px) rounds of (2v, v) reductions) must produce a
     residual-correct factorization with a valid permutation — also under
-    lookahead (the miniapp exposes the combination); non-power-of-two Px
-    is rejected. CALU pivot sets are bracket-dependent, so butterfly and
-    gather may elect different, equally valid pivots."""
+    lookahead (the miniapp exposes the combination) and on
+    non-power-of-two Px, where the overflow ranks fold in/out of the
+    subcube (the reference's odd-grid compensating sends,
+    `conflux_opt.hpp:266-280`). CALU pivot sets are bracket-dependent,
+    so butterfly and gather may elect different, equally valid pivots."""
     import jax
     import jax.numpy as jnp
 
@@ -501,33 +552,31 @@ def test_lu_distributed_butterfly_election():
     N, v = 128, 8
     A = make_test_matrix(N, N, seed=97)
     for gridspec, la in [((2, 2, 1), False), ((4, 2, 1), False),
-                         ((2, 1, 2), False), ((4, 2, 1), True)]:
+                         ((2, 1, 2), False), ((4, 2, 1), True),
+                         ((3, 1, 1), False), ((3, 2, 1), False),
+                         ((5, 1, 1), False), ((3, 2, 1), True)]:
         grid = Grid3(*gridspec)
         geom = LUGeometry.create(N, N, v, grid)
         mesh = make_mesh(grid, devices=jax.devices()[: grid.P])
-        shards = jnp.asarray(geom.scatter(A))
+        host_shards = geom.scatter(A)
+        # odd grids pad (e.g. Px=3: M 128 -> 144 with an identity tail);
+        # validate the padded problem the kernel actually factors
+        Ap = geom.gather(host_shards)
+        shards = jnp.asarray(host_shards)
         out, perm = lu_factor_distributed(shards, geom, mesh,
                                           election="butterfly",
                                           lookahead=la)
         perm = np.asarray(perm)
-        assert sorted(perm.tolist()) == list(range(N)), (gridspec, la)
+        assert sorted(perm.tolist()) == list(range(geom.M)), (gridspec, la)
         LUp = geom.gather(np.asarray(out))
-        res = lu_residual(A, LUp, perm)
+        res = lu_residual(Ap, LUp, perm)
         assert res < residual_bound(N, np.float64), (gridspec, la, res)
         res_g = None
         if not la:
             out_g, perm_g = lu_factor_distributed(shards, geom, mesh)
-            res_g = lu_residual(A, geom.gather(np.asarray(out_g)),
+            res_g = lu_residual(Ap, geom.gather(np.asarray(out_g)),
                                 np.asarray(perm_g))
             assert res_g < residual_bound(N, np.float64), (gridspec, res_g)
-
-    grid = Grid3(3, 1, 1)
-    geom = LUGeometry.create(48, 48, 8, grid)
-    mesh = make_mesh(grid, devices=jax.devices()[:3])
-    with pytest.raises(ValueError, match="power-of-two"):
-        lu_factor_distributed(jnp.asarray(geom.scatter(
-            make_test_matrix(48, 48, seed=1))), geom, mesh,
-            election="butterfly")
 
 
 @pytest.mark.parametrize("grid", [Grid3(1, 1, 1), Grid3(2, 2, 1),

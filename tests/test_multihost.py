@@ -55,15 +55,19 @@ def _run_workers(worker: str, extra_args: list[str], nproc: int = 2,
 
 
 @pytest.mark.slow
-@pytest.mark.parametrize("gridspec,shards_per_proc", [
-    ("4,2,1", 4),   # x axis split across the two processes
-    ("2,2,2", 2),   # z-replication spans processes: 2 shards x 2 layers
+@pytest.mark.parametrize("gridspec,shards_per_proc,election", [
+    ("4,2,1", (4, 4), "gather"),  # x axis split across the two processes
+    ("2,2,2", (2, 2), "gather"),  # z-replication spans: 2 shards x 2 layers
+    # odd Px across the process boundary: the butterfly's overflow-rank
+    # fold/unfold (x=2 lives on process 1) runs over real gloo
+    # collectives; process 0 owns 4 shards, process 1 the x=2 row's 2
+    ("3,2,1", (4, 2), "butterfly"),
 ])
-def test_two_process_multihost_lu(gridspec, shards_per_proc):
-    results = _run_workers("multihost_worker.py", [gridspec])
+def test_two_process_multihost_lu(gridspec, shards_per_proc, election):
+    results = _run_workers("multihost_worker.py", [gridspec, election])
     for pid, (rc, out) in enumerate(results):
         assert rc == 0, f"proc {pid} failed:\n{out[-3000:]}"
-        assert (f"proc {pid}: local_shards={shards_per_proc} residual="
+        assert (f"proc {pid}: local_shards={shards_per_proc[pid]} residual="
                 in out)
 
 

@@ -274,7 +274,9 @@ def test_tsqr_butterfly_tree():
     but the butterfly's pair order over 4 ranks reduces (0,1),(2,3) then
     pairs of pairs — same shape as the gather path's chunked reduction
     of the 4-stack, and the positive-diag normalization makes R unique
-    regardless); non-power-of-two Px is rejected."""
+    regardless); non-power-of-two Px folds its overflow ranks through
+    the subcube (different bracket, so compare by QR validity, not
+    bitwise)."""
     rng = np.random.default_rng(101)
     Px, Ml, n = 4, 48, 16
     A = rng.standard_normal((Px * Ml, n))
@@ -285,9 +287,12 @@ def test_tsqr_butterfly_tree():
     np.testing.assert_allclose(np.asarray(Rb), np.asarray(Rg),
                                atol=1e-10 * np.abs(np.asarray(Rg)).max())
 
-    mesh3 = make_mesh(Grid3(3, 1, 1), devices=jax.devices()[:3])
-    with pytest.raises(ValueError, match="power-of-two"):
-        tsqr_distributed(np.zeros((3, 32, 8)), mesh3, tree="butterfly")
+    for Px3 in (3, 5, 6):
+        mesh3 = make_mesh(Grid3(Px3, 1, 1), devices=jax.devices()[:Px3])
+        A3 = rng.standard_normal((Px3 * 32, 8))
+        Q3, R3 = tsqr_distributed(A3.reshape(Px3, 32, 8), mesh3,
+                                  tree="butterfly")
+        _check(A3, np.asarray(Q3).reshape(-1, 8), np.asarray(R3))
 
 
 @pytest.mark.parametrize("gridspec", [(1, 1, 1), (2, 2, 1), (2, 2, 2),
@@ -317,3 +322,23 @@ def test_qr_factor_distributed_lookahead_bitwise_equal(gridspec):
                                rtol=0, atol=0)
     np.testing.assert_allclose(np.asarray(Ra), np.asarray(Rb),
                                rtol=0, atol=0)
+
+
+def test_qr_build_program_dtype_resolves_default_chunk():
+    """build_program(dtype=...) must resolve the same default TSQR chunk
+    as qr_factor_distributed does from its shards, so the qr_miniapp
+    --profile build returns the SAME cached program the timed run used
+    (ADVICE r3: the dtype-blind default profiled a different f64
+    program)."""
+    from conflux_tpu.geometry import LUGeometry
+    from conflux_tpu.ops import blas
+    from conflux_tpu.qr.distributed import build_program
+
+    grid = Grid3(1, 1, 1)
+    geom = LUGeometry.create(256, 256, 64, grid)
+    mesh = make_mesh(grid, devices=jax.devices()[:1])
+    for dt in (np.float32, np.float64):
+        explicit = build_program(
+            geom, mesh,
+            chunk=blas.batched_call_rows(64, blas.compute_dtype(dt)))
+        assert build_program(geom, mesh, dtype=dt) is explicit

@@ -33,10 +33,24 @@ def test_spec_nopivot_diag_dominant():
     assert lu_residual(A, LU[perm], perm) < residual_bound(N, np.float64)
 
 
+def _assert_no_vmem_override():
+    """Default-chunk spec-vs-impl agreement holds only when the impl's
+    scoped-VMEM budget equals the spec's pinned default: the spec pins
+    `_SCOPED_VMEM_DEFAULT` for host-independence while the impl honors
+    env/device overrides, so under an override the two would chunk (and
+    can pivot) differently. Guard rather than silently diverge."""
+    from conflux_tpu.ops import blas
+
+    assert blas.scoped_vmem_bytes() == blas._SCOPED_VMEM_DEFAULT, (
+        "scoped-VMEM override active; default-chunk spec-vs-impl "
+        "cross-validation needs an explicit shared panel_chunk")
+
+
 @pytest.mark.parametrize("grid", [Grid3(2, 2, 1), Grid3(2, 1, 2)], ids=str)
 def test_spec_matches_shard_map_implementation(grid):
     """Same algorithm, two implementations: pivot choices must be identical
     and factors must agree to fp tolerance."""
+    _assert_no_vmem_override()
     N, v = 32, 8
     A = make_test_matrix(N, N, seed=99)
     LU_spec, piv_spec = simulate_lu(A, grid, v, pivoting="tournament")
