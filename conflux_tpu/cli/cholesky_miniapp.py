@@ -51,6 +51,9 @@ def parse_args(argv=None):
         help="trailing-update row x col segment counts, e.g. 8x8 "
         "(default: tuned library value)",
     )
+    from conflux_tpu.cli.common import add_auto_arg
+
+    add_auto_arg(p)
     add_experiment_type_arg(p)
     add_common_args(p)
     return p.parse_args(argv)
@@ -82,6 +85,14 @@ def main(argv=None) -> int:
     grid = Grid3.parse(args.grid) if args.grid else choose_cholesky_grid(n_devices)
     if grid.P > n_devices:
         raise SystemExit(f"grid {grid} needs {grid.P} devices, have {n_devices}")
+    if args.auto:
+        from conflux_tpu.cli.common import apply_auto
+
+        apply_auto(args, "cholesky", args.dim, grid.P, args.dtype, {
+            "tile": ("v", None),
+            "segs": ("segs", None),
+            "lookahead": ("lookahead", False),
+        })
     v = args.tile or choose_cholesky_tile(args.dim, grid.P)
 
     dtype = np_dtype(args.dtype)

@@ -160,3 +160,40 @@ def result_line(algo: str, N: int, P: int, grid, exp_type: str,
     n_base = N // math.isqrt(P) if exp_type == "weak" else N
     return (f"_result_ {algo},conflux_tpu,{N},{n_base},{P},"
             f"{grid},time,{exp_type},{ms:.3f},{v},{dtype}")
+
+
+def add_auto_arg(p: argparse.ArgumentParser) -> None:
+    p.add_argument(
+        "--auto", action="store_true",
+        help="resolve tuning knobs you did not pass from the measured "
+        "dispatch table (conflux_tpu.autotune — the role of the "
+        "reference's hand-measured variant switch, Cholesky.cpp:857-921); "
+        "prints the applied knobs and the measurement they came from. A "
+        "flag left at its parser default counts as un-passed",
+    )
+
+
+def apply_auto(args, algo: str, N: int, P: int, dtype: str,
+               flag_knobs: dict) -> None:
+    """--auto resolution: for every (args attribute -> (knob name, parser
+    default)) in `flag_knobs`, a flag still at its default is replaced by
+    the measured recommendation's knob (None knobs never overwrite).
+    Explicitly re-passing the default value counts as un-passed — the
+    table wins; pass a different value to pin a knob. Prints `_auto_`
+    lines (knobs + provenance) in the miniapp protocol style: one
+    space-free key=value token per knob (tuples in the RxC grammar), so
+    whitespace-splitting sweep parsers stay correct."""
+    from conflux_tpu import autotune
+
+    rec = autotune.recommended(algo, N, P=P, dtype=str(dtype))
+
+    def fmt(v):
+        return "x".join(map(str, v)) if isinstance(v, tuple) else v
+
+    applied = []
+    for attr, (knob, default) in flag_knobs.items():
+        if getattr(args, attr) == default and rec.knobs.get(knob) is not None:
+            setattr(args, attr, rec.knobs[knob])
+            applied.append(f"{attr}={fmt(rec.knobs[knob])}")
+    print(f"_auto_ {' '.join(applied) if applied else '(all knobs pinned)'}")
+    print(f"_auto_provenance_ {rec.provenance}")

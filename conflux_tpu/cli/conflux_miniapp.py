@@ -22,8 +22,10 @@ import numpy as np
 
 from conflux_tpu.cli.common import (
     WallTimer,
+    add_auto_arg,
     add_common_args,
     add_experiment_type_arg,
+    apply_auto,
     np_dtype,
     result_line,
     segs_arg,
@@ -90,6 +92,7 @@ def parse_args(argv=None):
         "sweeps (f64 residual — the HPL-MxP recipe; pairs with --dtype "
         "bfloat16 for the fast-factor path) and report the solve residual",
     )
+    add_auto_arg(p)
     add_experiment_type_arg(p)
     add_common_args(p)
     return p.parse_args(argv)
@@ -117,6 +120,17 @@ def main(argv=None) -> int:
     grid = Grid3.parse(args.p_grid) if args.p_grid else choose_grid(n_devices, M, args.N)
     if grid.P > n_devices:
         raise SystemExit(f"grid {grid} needs {grid.P} devices, have {n_devices}")
+
+    if args.auto:
+        apply_auto(args, "lu", args.N, grid.P, args.dtype, {
+            "block_size": ("v", 128),
+            "election": ("election", "gather"),
+            "segs": ("segs", None),
+            "tree": ("tree", "pairwise"),
+            "update": ("update", "segments"),
+            "swap": ("swap", "xla"),
+            "lookahead": ("lookahead", False),
+        })
 
     dtype = np_dtype(args.dtype)
     geom = LUGeometry.create(M, args.N, args.block_size, grid)

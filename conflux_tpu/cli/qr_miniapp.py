@@ -61,6 +61,9 @@ def parse_args(argv=None):
     p.add_argument("-r", "--run", type=int, default=2, help="timed reps")
     p.add_argument("--validate", action="store_true",
                    help="orthogonality + reconstruction residuals")
+    from conflux_tpu.cli.common import add_auto_arg
+
+    add_auto_arg(p)
     add_experiment_type_arg(p)
     add_common_args(p)
     return p.parse_args(argv)
@@ -90,6 +93,23 @@ def main(argv=None) -> int:
     n_devices = len(jax.devices())
     dtype = np_dtype(args.dtype)
     rng = np.random.default_rng(42)
+
+    if args.auto:
+        from conflux_tpu.cli.common import apply_auto
+        from conflux_tpu.geometry import Grid3 as _G3
+
+        P = _G3.parse(args.p_grid).P if args.p_grid else n_devices
+        # mode-gate the knobs: block/csegs/lookahead are read only by the
+        # --full loop; the cross-x tree only by the tall tsqr mode
+        # (applying a knob its mode rejects — or never reads — would
+        # bypass the arg validation above or misreport an applied knob)
+        knobs = {}
+        if args.full:
+            knobs.update(block=("v", None), csegs=("csegs", None),
+                         lookahead=("lookahead", False))
+        elif args.algo == "tsqr":
+            knobs.update(tree=("tree", "gather"))
+        apply_auto(args, "qr", args.M, P, args.dtype, knobs)
 
     if args.full:
         from conflux_tpu.qr.distributed import qr_factor_distributed

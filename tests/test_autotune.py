@@ -1,0 +1,104 @@
+"""Measured variant dispatch: rule lookup, overrides, honesty contract
+(the role of the reference's hand-measured variant switch,
+`src/conflux/cholesky/Cholesky.cpp:857-921`)."""
+
+import json
+
+import pytest
+
+from conflux_tpu import autotune
+
+
+@pytest.fixture(autouse=True)
+def _clean_table():
+    autotune.reset_loaded_table()
+    yield
+    autotune.reset_loaded_table()
+
+
+def test_measured_v5e_lu_rule():
+    r = autotune.recommended("lu", 32768, device_kind="tpu v5 lite")
+    assert r.knobs["panel_chunk"] == 8192
+    assert r.knobs["tree"] == "pairwise"  # flip pending hardware A/B
+    assert "BENCH_r01" in r.provenance
+
+
+def test_cpu_rules_disable_lookahead():
+    for algo in ("lu", "cholesky", "qr"):
+        r = autotune.recommended(algo, 4096, P=8, device_kind="cpu")
+        assert r.knobs["lookahead"] is False
+        assert "CPU-mesh sweep" in r.provenance
+
+
+def test_unmeasured_configs_say_so():
+    """The honesty contract: no measurement -> the provenance admits it
+    instead of dressing defaults up as a tune."""
+    r = autotune.recommended("cholesky", 32768, device_kind="tpu v5e")
+    assert "NO hardware measurement" in r.provenance
+    r2 = autotune.recommended("lu", 1024, device_kind="some future chip")
+    assert "library defaults" in r2.provenance
+    # unmeasured rules must not pin a tile: the un-passed default is
+    # adaptive (Cholesky memory heuristic, per-miniapp defaults) and a
+    # None knob never overwrites it
+    assert r.knobs["v"] is None and r2.knobs["v"] is None
+
+
+def test_out_of_range_n_falls_through():
+    """The v5e LU rule is bounded to the measured N range; outside it the
+    query falls to the catch-all rather than extrapolating."""
+    r = autotune.recommended("lu", 4096, device_kind="tpu v5 lite")
+    assert "library defaults" in r.provenance
+
+
+def test_json_override_beats_builtin(tmp_path):
+    table = tmp_path / "tune.json"
+    table.write_text(json.dumps([{
+        "algo": "lu", "device": "v5 lite", "P": 1,
+        "n_lo": 8192, "n_hi": 32768, "dtype": "float32",
+        "knobs": {"tree": "flat", "segs": [16, 16]},
+        "provenance": "hypothetical chip session A/B",
+    }]))
+    assert autotune.load_table(str(table)) == 1
+    r = autotune.recommended("lu", 32768, device_kind="tpu v5 lite")
+    # same specificity as the built-in -> later-loaded (the override) wins
+    assert r.knobs["tree"] == "flat"
+    assert r.knobs["segs"] == (16, 16)  # JSON lists arrive as tuples
+    assert "chip session" in r.provenance
+
+
+def test_load_table_validates(tmp_path):
+    bad = tmp_path / "bad.json"
+    bad.write_text(json.dumps([{"algo": "svd", "knobs": {}}]))
+    with pytest.raises(ValueError, match="unknown algo"):
+        autotune.load_table(str(bad))
+    bad.write_text(json.dumps([{"algo": "lu", "knobs": {}, "spee": 1}]))
+    with pytest.raises(ValueError, match="unknown rule fields"):
+        autotune.load_table(str(bad))
+    bad.write_text(json.dumps({"algo": "lu"}))
+    with pytest.raises(ValueError, match="JSON list"):
+        autotune.load_table(str(bad))
+    bad.write_text(json.dumps([{"knobs": {}}]))
+    with pytest.raises(ValueError, match="algo"):
+        autotune.load_table(str(bad))
+
+
+def test_env_table(tmp_path, monkeypatch):
+    table = tmp_path / "env.json"
+    table.write_text(json.dumps([{
+        "algo": "qr", "device": "cpu", "knobs": {"v": 64},
+        "P": 4, "provenance": "env table",
+    }]))
+    monkeypatch.setenv("CONFLUX_TPU_TUNE_TABLE", str(table))
+    autotune.reset_loaded_table()  # force the env re-read
+    r = autotune.recommended("qr", 4096, P=4, device_kind="cpu")
+    assert r.knobs["v"] == 64 and "env table" in r.provenance
+    # other P still served by the built-in sweep rule
+    r2 = autotune.recommended("qr", 4096, P=8, device_kind="cpu")
+    assert r2.knobs["v"] == 128
+
+
+def test_recommended_validates():
+    with pytest.raises(ValueError, match="algo"):
+        autotune.recommended("svd", 1024, device_kind="cpu")
+    with pytest.raises(ValueError, match="positive"):
+        autotune.recommended("lu", 0, device_kind="cpu")

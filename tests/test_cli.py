@@ -239,3 +239,21 @@ def test_conflux_miniapp_refine(capsys):
     line = [l for l in out.splitlines()
             if l.startswith("_solve_residual_")][0]
     assert "[PASS <=1e-6]" in line, line
+
+
+def test_miniapps_auto_knob_resolution(capsys):
+    """--auto resolves un-passed knobs from the measured dispatch table
+    (conflux_tpu.autotune) and reports the provenance; explicit flags are
+    untouched."""
+    out = run_cli(conflux_miniapp.main,
+                  ["-N", "128", "-r", "1", "--auto", "--validate"], capsys)
+    # CPU sweep rule: tile 256 (N=128 < v is tile-rounded by geometry)
+    assert "_auto_ block_size=256" in out
+    assert "_auto_provenance_ CPU-mesh sweep" in out
+    assert "_result_" in out and "_residual_" in out
+    # an explicit flag wins over the table
+    out = run_cli(conflux_miniapp.main,
+                  ["-N", "128", "-b", "32", "-r", "1", "--auto"], capsys)
+    assert "block_size=" not in out.split("_auto_ ")[1].splitlines()[0]
+    assert [l for l in out.splitlines()
+            if l.startswith("_result_")][0].rsplit(",", 2)[1] == "32"
