@@ -37,8 +37,11 @@ done
   echo "=== election probe (LU-call cost model) $(date -u +%FT%TZ) ==="
   timeout -k 10 2400 python scripts/election_probe.py 2>&1 | grep -v WARNING
   echo "=== LU flat-tree + segmentation A/B at N=32768 $(date -u +%FT%TZ) ==="
+  # the plain highest:8192:1024 row is the all-defaults baseline every
+  # flip criterion pairs against (flat tree here, block update in the
+  # next item) — it must run in the SAME session as its flips
   timeout -k 10 4200 python scripts/tpu_tune.py -N 32768 --reps 2 \
-    --configs highest:8192:1024:-:flat,highest:8192:1024:32x16,highest:8192:1024:8x8 \
+    --configs highest:8192:1024,highest:8192:1024:-:flat,highest:8192:1024:32x16,highest:8192:1024:8x8 \
     2>&1 | grep -v WARNING
   echo "=== LU block-update A/B at N=32768 $(date -u +%FT%TZ) ==="
   timeout -k 10 3000 python scripts/tpu_tune.py -N 32768 --reps 2 \
@@ -58,7 +61,15 @@ done
   echo "=== tune LU taller nomination chunks (LAST: the round-2 wedge "
   echo "    started during the 12288 trial — quarantine the risky configs"
   echo "    behind everything else) $(date -u +%FT%TZ) ==="
+  # highest:8192:1024 rides along as the all-defaults baseline the
+  # chunk flip criterion pairs against (every other 8192 run in the
+  # queue varies some other knob, which would leave the criterion
+  # structurally NO-DATA)
   timeout -k 10 2400 python scripts/tpu_tune.py -N 32768 --reps 2 \
-    --configs highest:12288:1024,highest:10240:1024 2>&1 | grep -v WARNING
+    --configs highest:8192:1024,highest:12288:1024,highest:10240:1024 \
+    2>&1 | grep -v WARNING
+  echo "=== apply pre-decided flip criteria (docs/ROUND3.md) $(date -u +%FT%TZ) ==="
+  timeout -k 10 120 python scripts/apply_flip_criteria.py "$LOG" \
+    --emit-rules data/tune_table_r4.json 2>&1 | grep -v WARNING
   echo "=== done $(date -u +%FT%TZ) ==="
 } >> "$LOG" 2>&1

@@ -1,0 +1,124 @@
+"""The pre-decided default-flip criteria applier: parsing of tpu_tune
+log lines and deterministic ADOPT/KEEP decisions (docs/ROUND3.md)."""
+
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", "scripts"))
+
+from apply_flip_criteria import evaluate_flip, main, parse_log  # noqa: E402
+
+LOG = """\
+=== LU flat-tree + segmentation A/B at N=32768 ===
+algo=lu precision=highest chunk=8192 v=1024 segs=lib tree=pairwise \
+swap=xla update=segments: 10500.0 GFLOP/s
+    residual=2.900e-05
+algo=lu precision=highest chunk=8192 v=1024 segs=lib tree=flat \
+swap=xla update=segments: 11000.0 GFLOP/s
+    residual=2.950e-05
+algo=lu precision=highest chunk=8192 v=1024 segs=lib tree=pairwise \
+swap=xla update=block: 10600.0 GFLOP/s
+    residual=3.000e-05
+algo=lu precision=highest chunk=12288 v=1024 segs=lib tree=pairwise \
+swap=xla update=segments: 10550.0 GFLOP/s
+    residual FAILED: wedged
+"""
+
+
+def test_parse_log():
+    recs = parse_log(LOG)
+    assert len(recs) == 4
+    assert recs[0]["tree"] == "pairwise" and recs[0]["gflops"] == 10500.0
+    assert recs[1]["residual"] == 2.95e-05
+    assert recs[3]["residual"] is None  # FAILED line never attaches
+
+
+def test_flat_tree_adopted_on_gain_and_clean_residual():
+    o = evaluate_flip(parse_log(LOG), "tree", "flat", "pairwise")
+    assert o["decision"] == "ADOPT"
+    assert abs(o["gain"] - (11000 / 10500 - 1)) < 1e-9
+
+
+def test_block_update_kept_below_gain_bar():
+    o = evaluate_flip(parse_log(LOG), "update", "block", "segments")
+    assert o["decision"].startswith("KEEP (gain below")
+
+
+def test_chunk_kept_without_residual():
+    """A record whose residual line FAILED can never be adopted — the
+    at-scale residual gate is mandatory (DESIGN §14)."""
+    o = evaluate_flip(parse_log(LOG), "chunk", "12288", "8192")
+    assert o["decision"].startswith("KEEP (residual gate failed")
+
+
+def test_no_data_criterion():
+    o = evaluate_flip(parse_log(LOG), "swap", "dma", "xla")
+    assert o["decision"] == "NO-DATA"
+
+
+def test_residual_dirty_flip_rejected():
+    dirty = LOG.replace("residual=2.950e-05", "residual=5.000e-04")
+    o = evaluate_flip(parse_log(dirty), "tree", "flat", "pairwise")
+    assert o["decision"].startswith("KEEP (residual gate failed")
+
+
+def test_emit_rules_roundtrips_into_autotune(tmp_path, capsys):
+    log = tmp_path / "rec.txt"
+    log.write_text(LOG)
+    rules = tmp_path / "rules.json"
+    assert main([str(log), "--emit-rules", str(rules)]) == 0
+    out = capsys.readouterr().out
+    assert "criterion tree: ADOPT" in out
+    from conflux_tpu import autotune
+
+    autotune.reset_loaded_table()
+    try:
+        assert autotune.load_table(str(rules)) == 1
+        r = autotune.recommended("lu", 32768, device_kind="tpu v5 lite")
+        assert r.knobs["tree"] == "flat"  # best clean record wins
+        assert "chip-session A/B" in r.provenance
+    finally:
+        autotune.reset_loaded_table()
+    data = json.loads(rules.read_text())
+    assert data[0]["knobs"]["panel_chunk"] == 8192
+
+
+def test_emit_rules_encodes_decisions_not_best_record(tmp_path, capsys):
+    """A KEEP'd flip must not become a table default just because its
+    record is the global best: the emitted rule follows the printed
+    decisions (and never adopts dma/12288 — those have their own
+    criteria outside this script)."""
+    # flat gains only +1% (below the bar) yet is the best clean record
+    log = tmp_path / "rec.txt"
+    log.write_text(LOG.replace("11000.0 GFLOP/s", "10605.0 GFLOP/s"))
+    rules = tmp_path / "rules.json"
+    assert main([str(log), "--emit-rules", str(rules)]) == 0
+    out = capsys.readouterr().out
+    assert "criterion tree: KEEP (gain below" in out
+    data = json.loads(rules.read_text())
+    assert data[0]["knobs"]["tree"] == "pairwise"
+    assert data[0]["knobs"]["swap"] == "xla"
+    assert data[0]["knobs"]["panel_chunk"] == 8192
+
+
+def test_emit_rules_refuses_without_clean_record(tmp_path, capsys):
+    log = tmp_path / "rec.txt"
+    log.write_text(LOG.replace("residual=", "residual FAILED was "))
+    rules = tmp_path / "rules.json"
+    assert main([str(log), "--emit-rules", str(rules)]) == 2
+    assert "NOT writing" in capsys.readouterr().out
+    assert not rules.exists()
+
+
+def test_dirty_flip_does_not_mask_clean_pair():
+    """A FAILED-residual flip timing must not shadow a clean adoptable
+    pair of the same criterion (DESIGN §14 gates adoption, not
+    consideration of the clean record)."""
+    log = LOG + (
+        "algo=lu precision=highest chunk=8192 v=1024 segs=lib tree=flat "
+        "swap=xla update=segments: 11500.0 GFLOP/s\n"
+        "    residual FAILED: wedge\n")
+    o = evaluate_flip(parse_log(log), "tree", "flat", "pairwise")
+    assert o["decision"] == "ADOPT"       # the clean 11000 pair decides
+    assert o["flip"]["gflops"] == 11000.0
