@@ -290,7 +290,10 @@ def _probe_device(timeout_s: int = 180, retries: int = 3,
             time.sleep(retry_wait_s)
     raise SystemExit(
         f"bench: device unresponsive after {retries} probes of "
-        f"{timeout_s}s (wedged TPU program?); aborting instead of hanging")
+        f"{timeout_s}s (wedged TPU program?); aborting instead of hanging. "
+        "Recovery protocol + operator escalation: docs/ROUND4.md; the "
+        "watcher (scripts/chip_recover_measure.sh) re-runs the full "
+        "measurement queue automatically on tunnel recovery")
 
 
 def main():
