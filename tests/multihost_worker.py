@@ -32,7 +32,7 @@ from conflux_tpu.parallel.mesh import (  # noqa: E402
 from conflux_tpu.validation import lu_residual_distributed  # noqa: E402
 
 initialize_multihost(f"localhost:{port}", nproc, pid)
-assert len(jax.devices()) == 8, jax.devices()
+assert len(jax.devices()) == 4 * nproc, jax.devices()
 
 grid = Grid3.parse(grid_arg)
 v = 8

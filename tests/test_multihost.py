@@ -72,6 +72,21 @@ def test_two_process_multihost_lu(gridspec, shards_per_proc, election):
 
 
 @pytest.mark.slow
+def test_three_process_multihost_lu_butterfly():
+    """THREE host processes (4 virtual devices each, 3x2x2 grid): one
+    x-row of the grid per process, so the odd-Px butterfly's fold/unfold
+    AND the 2.5D z-psum both cross process boundaries; beyond the
+    two-process coverage, this exercises a gloo collective group larger
+    than a pair."""
+    results = _run_workers("multihost_worker.py", ["3,2,2", "butterfly"],
+                           nproc=3, timeout=360)
+    for pid, (rc, out) in enumerate(results):
+        assert rc == 0, f"proc {pid} failed:\n{out[-3000:]}"
+        # each process owns exactly its x-row's 2 (x, y) shard coords
+        assert f"proc {pid}: local_shards=2 residual=" in out
+
+
+@pytest.mark.slow
 def test_two_process_multihost_cholesky():
     """Core parity: the distributed Cholesky runs the same real
     two-process model as the LU (jax.distributed, per-process shard
