@@ -176,3 +176,27 @@ def test_cholesky_distributed_lookahead_bitwise_equal(gridspec):
     out_b = cholesky_factor_distributed(shards, geom, mesh, lookahead=True)
     np.testing.assert_allclose(np.asarray(out_a), np.asarray(out_b),
                                rtol=0, atol=0)
+
+
+def test_cholesky_factor_distributed_odd_grid():
+    """Non-power-of-two grids (3x2x1): ragged tile ownership on the x
+    axis and odd-extent psums — the same grid-shape generality the LU
+    core's odd-Px election now gates (round 4)."""
+    import jax
+
+    from conflux_tpu.geometry import CholeskyGeometry, Grid3
+    from conflux_tpu.cholesky.distributed import cholesky_factor_distributed
+    from conflux_tpu.parallel.mesh import make_mesh
+    from conflux_tpu.validation import (
+        cholesky_residual_distributed,
+        make_spd_matrix,
+    )
+
+    grid = Grid3(3, 2, 1)
+    geom = CholeskyGeometry.create(320, 32, grid)  # ragged: 10 tiles / 3
+    mesh = make_mesh(grid, devices=jax.devices()[: grid.P])
+    S = make_spd_matrix(geom.N, dtype=np.float32)
+    shards = jnp.asarray(geom.scatter(S))
+    L = cholesky_factor_distributed(shards, geom, mesh)
+    res = float(cholesky_residual_distributed(shards, L, geom, mesh))
+    assert res < 1e-6, res
