@@ -226,6 +226,31 @@ def main() -> None:
     print(f"qr_lstsq_distributed on {grid}: ||Ax-b||/||b|| = {rel:.2e}")
     assert rel < 1e-4
 
+    # -- 8. odd grids + measured dispatch (round 4) --------------------
+    # non-power-of-two grids are first-class: the hypercube election
+    # folds its overflow ranks through the power-of-two subcube (the
+    # reference patches odd grids with compensating sends), and the
+    # measured dispatch table answers "which knobs?" with provenance
+    print("\n== odd-grid butterfly election + measured dispatch")
+    from conflux_tpu import autotune
+    from conflux_tpu.lu.distributed import lu_factor_distributed
+    from conflux_tpu.validation import lu_residual
+
+    ogrid = Grid3(3, 2, 1)
+    ogeom = LUGeometry.create(384, 384, 64, ogrid)
+    omesh = make_mesh(ogrid, devices=jax.devices()[: ogrid.P])
+    oA = np.asarray(make_test_matrix(384, 384, seed=8, dtype=np.float32))
+    oout, operm = lu_factor_distributed(
+        jnp.asarray(ogeom.scatter(oA)), ogeom, omesh,
+        election="butterfly")
+    ores = lu_residual(oA, ogeom.gather(np.asarray(oout)),
+                       np.asarray(operm))
+    print(f"butterfly LU on {ogrid} (odd Px): residual = {ores:.2e}")
+    assert ores < 1e-5
+    rec = autotune.recommended("lu", 384, P=6, device_kind="cpu")
+    print(f"autotune.recommended('lu', 384, P=6) -> v={rec.knobs['v']}"
+          f"  [{rec.provenance[:48]}...]")
+
     print("\nTour complete.")
 
 
