@@ -1,0 +1,211 @@
+"""Randomized configuration soak — long-running robustness evidence.
+
+Samples random (core, N, v, grid, dtype, knob) configurations across the
+WHOLE option surface — election x tree x update x segs x lookahead x
+panel_chunk, odd and power-of-two grids, f32/f64/bf16/complex — runs the
+distributed program on the virtual CPU mesh, and checks the result
+against the residual oracles. The unit suite pins known-interesting
+points; the soak walks the cross-product the suite cannot afford,
+looking for interaction bugs (e.g. butterfly x lookahead x ragged odd
+grid x resume never co-occur in any single test).
+
+Each trial line is self-reproducing: the seed and full config are
+printed, and --replay SEED re-runs exactly one trial under the same
+sampling stream. Failures abort immediately by default (--keep-going to
+collect instead).
+
+Usage:
+    python scripts/soak.py [--trials 200] [--time-budget SECONDS]
+        [--seed 0] [--replay TRIALSEED] [--keep-going]
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+os.environ.setdefault("XLA_FLAGS",
+                      "--xla_force_host_platform_device_count=8")
+
+import numpy as np  # noqa: E402
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_enable_x64", True)
+
+import jax.numpy as jnp  # noqa: E402
+
+GRIDS = [(1, 1, 1), (2, 1, 1), (2, 2, 1), (2, 2, 2), (4, 2, 1),
+         (3, 1, 1), (3, 2, 1), (5, 1, 1), (2, 1, 2), (3, 1, 2),
+         (6, 1, 1), (4, 1, 2), (1, 2, 1), (2, 4, 1)]
+DTYPES = [np.float32, np.float64, "bfloat16", np.complex64]
+
+
+def _rand_config(rng: np.random.Generator) -> dict:
+    grid = GRIDS[rng.integers(len(GRIDS))]
+    v = int(rng.choice([4, 8, 16, 32]))
+    # tile counts chosen so every geometry regime appears: fewer tiles
+    # than ranks (degenerate), exact, ragged, and deep
+    tiles = int(rng.integers(1, 9))
+    N = v * max(1, tiles)
+    dtype = DTYPES[rng.integers(len(DTYPES))]
+    core = ["lu", "cholesky", "qr"][rng.integers(3)]
+    cfg = dict(core=core, grid=grid, v=v, N=N, dtype=dtype)
+    if core == "lu":
+        cfg.update(
+            election=["gather", "butterfly"][rng.integers(2)],
+            tree=["pairwise", "flat"][rng.integers(2)],
+            update=["segments", "block"][rng.integers(2)],
+            segs=(int(rng.integers(1, 5)), int(rng.integers(1, 5))),
+            lookahead=bool(rng.integers(2)),
+            panel_chunk=int(v * rng.integers(1, 4)),
+        )
+    elif core == "cholesky":
+        cfg.update(segs=(int(rng.integers(1, 5)), int(rng.integers(1, 5))),
+                   lookahead=bool(rng.integers(2)))
+    else:
+        cfg.update(csegs=int(rng.integers(1, 5)),
+                   lookahead=bool(rng.integers(2)))
+    return cfg
+
+
+def _np_dtype(d):
+    return jnp.bfloat16 if d == "bfloat16" else d
+
+
+def run_trial(seed: int) -> tuple[bool, str]:
+    from conflux_tpu.geometry import CholeskyGeometry, Grid3, LUGeometry
+    from conflux_tpu.parallel.mesh import make_mesh
+    from conflux_tpu.validation import (
+        lu_residual,
+        make_spd_matrix,
+        make_test_matrix,
+    )
+
+    rng = np.random.default_rng(seed)
+    cfg = _rand_config(rng)
+    grid = Grid3(*cfg["grid"])
+    if grid.P > len(jax.devices()):
+        return True, "skip (grid larger than device pool)"
+    dt = _np_dtype(cfg["dtype"])
+    # bf16/complex stress the LU/QR paths; Cholesky complex needs a
+    # Hermitian generator — covered by the unit suite, keep soak real
+    if cfg["core"] == "cholesky" and cfg["dtype"] is np.complex64:
+        cfg["dtype"] = np.float32
+        dt = np.float32
+    # residual bound: scaled to compute precision (bf16 storage factors
+    # carry f32 panels but bf16 trailing updates)
+    eps = {np.float32: 1e-4, np.float64: 1e-9}.get(cfg["dtype"], None)
+    if eps is None:
+        eps = 1e-4 if cfg["dtype"] is np.complex64 else 5e-2  # bf16
+    label = (f"seed={seed} " +
+             " ".join(f"{k}={v}" for k, v in cfg.items()))
+    mesh = make_mesh(grid, devices=jax.devices()[: grid.P])
+    N, v = cfg["N"], cfg["v"]
+    try:
+        if cfg["core"] == "lu":
+            from conflux_tpu.lu.distributed import lu_factor_distributed
+
+            geom = LUGeometry.create(N, N, v, grid)
+            A = make_test_matrix(N, N, seed=seed,
+                                 dtype=(np.complex64 if cfg["dtype"]
+                                        is np.complex64 else np.float64))
+            host = geom.scatter(A.astype(
+                np.complex64 if cfg["dtype"] is np.complex64 else dt))
+            Ap = geom.gather(host)  # padded problem incl. identity tail
+            out, perm = lu_factor_distributed(
+                jnp.asarray(host), geom, mesh,
+                election=cfg["election"], tree=cfg["tree"],
+                update=cfg["update"], segs=cfg["segs"],
+                lookahead=cfg["lookahead"],
+                panel_chunk=cfg["panel_chunk"])
+            perm = np.asarray(perm)
+            if sorted(perm.tolist()) != list(range(geom.M)):
+                return False, f"{label}: perm not a permutation"
+            res = lu_residual(np.asarray(Ap, np.float64)
+                              if cfg["dtype"] != np.complex64 else Ap,
+                              geom.gather(np.asarray(out)), perm)
+        elif cfg["core"] == "cholesky":
+            from conflux_tpu.cholesky.distributed import (
+                cholesky_factor_distributed,
+            )
+            from conflux_tpu.validation import cholesky_residual_distributed
+
+            cgeom = CholeskyGeometry.create(N, v, grid)
+            S = make_spd_matrix(cgeom.N, dtype=dt)
+            sh = jnp.asarray(cgeom.scatter(S))
+            L = cholesky_factor_distributed(
+                sh, cgeom, mesh, segs=cfg["segs"],
+                lookahead=cfg["lookahead"])
+            res = float(cholesky_residual_distributed(sh, L, cgeom, mesh))
+        else:
+            from conflux_tpu.qr.distributed import (
+                qr_factor_distributed,
+                r_geometry,
+            )
+
+            geom = LUGeometry.create(N, N, v, grid)
+            A = make_test_matrix(N, N, seed=seed, dtype=np.float64)
+            host = geom.scatter(A.astype(dt))
+            Ap = np.asarray(geom.gather(host), np.float64)
+            Qs, Rs = qr_factor_distributed(
+                jnp.asarray(host), geom, mesh, csegs=cfg["csegs"],
+                lookahead=cfg["lookahead"])
+            Q = np.asarray(geom.gather(np.asarray(Qs)), np.float64)
+            R = np.triu(np.asarray(
+                r_geometry(geom).gather(np.asarray(Rs)),
+                np.float64)[: geom.N, : geom.N])
+            res = (np.linalg.norm(Q @ R - Ap)
+                   / max(np.linalg.norm(Ap), 1e-30))
+            orth = np.linalg.norm(Q.T @ Q - np.eye(Q.shape[1]))
+            if orth > eps * 100:
+                return False, f"{label}: orthogonality {orth:.2e}"
+    except Exception as e:  # any crash is a finding
+        return False, f"{label}: EXCEPTION {type(e).__name__}: {e}"
+    bound = eps * np.sqrt(N) * 10
+    if not (res < bound):
+        return False, f"{label}: residual {res:.3e} > {bound:.1e}"
+    return True, f"{label}: ok residual={res:.2e}"
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--trials", type=int, default=200)
+    ap.add_argument("--time-budget", type=float, default=None,
+                    help="stop after this many seconds")
+    ap.add_argument("--seed", type=int, default=0,
+                    help="base seed; trial i uses seed base+i")
+    ap.add_argument("--replay", type=int, default=None,
+                    help="re-run exactly one trial seed and exit")
+    ap.add_argument("--keep-going", action="store_true")
+    args = ap.parse_args(argv)
+
+    if args.replay is not None:
+        ok, msg = run_trial(args.replay)
+        print(msg, flush=True)
+        return 0 if ok else 1
+
+    t0 = time.time()
+    fails = 0
+    for i in range(args.trials):
+        if args.time_budget and time.time() - t0 > args.time_budget:
+            print(f"time budget reached after {i} trials", flush=True)
+            break
+        ok, msg = run_trial(args.seed + i)
+        print(("PASS " if ok else "FAIL ") + msg, flush=True)
+        if not ok:
+            fails += 1
+            if not args.keep_going:
+                return 1
+    print(f"soak: {fails} failures / {i + 1} trials "
+          f"in {time.time() - t0:.0f}s", flush=True)
+    return 1 if fails else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
