@@ -150,7 +150,13 @@ def run_trial(seed: int) -> tuple[bool, str]:
             )
 
             geom = LUGeometry.create(N, N, v, grid)
-            A = make_test_matrix(N, N, seed=seed, dtype=np.float64)
+            if geom.M < geom.N:
+                # y-axis padding widened N past M (Py > Px grids); the
+                # entry point correctly rejects that — soak the valid
+                # tall problem instead of the rejection path
+                geom = LUGeometry.create(geom.N, N, v, grid)
+            A = make_test_matrix(geom.Mbase, N, seed=seed,
+                                 dtype=np.float64)
             host = geom.scatter(A.astype(dt))
             Ap = np.asarray(geom.gather(host), np.float64)
             Qs, Rs = qr_factor_distributed(
