@@ -18,8 +18,10 @@ import numpy as np
 
 from conflux_tpu.cli.common import (
     WallTimer,
+    add_auto_arg,
     add_common_args,
     add_experiment_type_arg,
+    apply_auto,
     np_dtype,
     result_line,
     segs_arg,
@@ -51,8 +53,6 @@ def parse_args(argv=None):
         help="trailing-update row x col segment counts, e.g. 8x8 "
         "(default: tuned library value)",
     )
-    from conflux_tpu.cli.common import add_auto_arg
-
     add_auto_arg(p)
     add_experiment_type_arg(p)
     add_common_args(p)
@@ -86,8 +86,6 @@ def main(argv=None) -> int:
     if grid.P > n_devices:
         raise SystemExit(f"grid {grid} needs {grid.P} devices, have {n_devices}")
     if args.auto:
-        from conflux_tpu.cli.common import apply_auto
-
         apply_auto(args, "cholesky", args.dim, grid.P, args.dtype, {
             "tile": ("v", None),
             "segs": ("segs", None),

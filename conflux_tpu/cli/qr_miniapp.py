@@ -96,9 +96,8 @@ def main(argv=None) -> int:
 
     if args.auto:
         from conflux_tpu.cli.common import apply_auto
-        from conflux_tpu.geometry import Grid3 as _G3
 
-        P = _G3.parse(args.p_grid).P if args.p_grid else n_devices
+        P = Grid3.parse(args.p_grid).P if args.p_grid else n_devices
         # mode-gate the knobs: block/csegs/lookahead are read only by the
         # --full loop; the cross-x tree only by the tall tsqr mode
         # (applying a knob its mode rejects — or never reads — would

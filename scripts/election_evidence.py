@@ -111,7 +111,6 @@ def main(argv=None):
             for t in ("pairwise", "flat")]
     for r in rows:
         # every site in the fori_loop body runs once per superstep
-        r["seq_lu_calls_per_superstep"] = r["lu_call_sites"]
         print(f"tree={r['tree']:<9} lu-primitive sites={r['lu_call_sites']} "
               f"(executed once per each of {r['n_supersteps']} supersteps)")
     pw, fl = rows

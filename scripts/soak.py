@@ -98,11 +98,10 @@ def run_trial(seed: int) -> tuple[bool, str]:
     if cfg["core"] == "cholesky" and cfg["dtype"] is np.complex64:
         cfg["dtype"] = np.float32
         dt = np.float32
-    # residual bound: scaled to compute precision (bf16 storage factors
-    # carry f32 panels but bf16 trailing updates)
-    eps = {np.float32: 1e-4, np.float64: 1e-9}.get(cfg["dtype"], None)
-    if eps is None:
-        eps = 1e-4 if cfg["dtype"] is np.complex64 else 5e-2  # bf16
+    # residual scale per storage dtype (bf16 factors carry f32 panels
+    # but bf16 trailing updates)
+    eps = {np.float32: 1e-4, np.float64: 1e-9, np.complex64: 1e-4,
+           "bfloat16": 5e-2}[cfg["dtype"]]
     label = (f"seed={seed} " +
              " ".join(f"{k}={v}" for k, v in cfg.items()))
     mesh = make_mesh(grid, devices=jax.devices()[: grid.P])
