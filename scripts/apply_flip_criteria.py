@@ -138,6 +138,23 @@ def main(argv=None) -> int:
             text += f.read() + "\n"
     records = parse_log(text)
     print(f"parsed {len(records)} tune records from {len(args.logs)} logs")
+
+    # headline check: the bench.py JSON line, vs the best prior measured
+    # rate (round-2 tune logs) — the BENCH_r04 'done' bar of VERDICT r3
+    HEADLINE_BAR = 10749.0
+    for line in text.splitlines():
+        if '"metric"' in line and "GFLOP/s" in line:
+            try:
+                d = json.loads(line)
+            except ValueError:
+                continue
+            if "LU" in d.get("metric", ""):
+                ok = (d["value"] >= HEADLINE_BAR
+                      and d.get("residual", 1) <= RESIDUAL_GATE)
+                print(f"headline: {d['value']:.0f} GFLOP/s residual "
+                      f"{d.get('residual')} -> "
+                      f"{'MEETS' if ok else 'BELOW'} the "
+                      f"{HEADLINE_BAR:.0f} prior-best bar")
     if not records:
         print("no records: the measurement queue has not produced tune "
               "lines yet (criteria cannot be applied)")

@@ -122,3 +122,14 @@ def test_dirty_flip_does_not_mask_clean_pair():
     o = evaluate_flip(parse_log(log), "tree", "flat", "pairwise")
     assert o["decision"] == "ADOPT"       # the clean 11000 pair decides
     assert o["flip"]["gflops"] == 11000.0
+
+
+def test_headline_check(tmp_path, capsys):
+    log = tmp_path / "rec.txt"
+    log.write_text(LOG + '\n{"metric": "distributed LU N=32768 v=1024 '
+                   'f32 GFLOP/s (single chip)", "value": 11892.0, '
+                   '"unit": "GFLOP/s", "vs_baseline": 1.1, '
+                   '"residual": 2.9e-05}\n')
+    main([str(log)])
+    out = capsys.readouterr().out
+    assert "headline: 11892 GFLOP/s" in out and "MEETS" in out
