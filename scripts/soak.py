@@ -71,6 +71,17 @@ def _rand_config(rng: np.random.Generator) -> dict:
     else:
         cfg.update(csegs=int(rng.integers(1, 5)),
                    lookahead=bool(rng.integers(2)))
+    # ~1/4 of trials factor in two checkpointed halves (*_factor_steps)
+    # and compare against the one-shot program — the resume wrappers
+    # carry no lookahead/swap, so those knobs are cleared for the
+    # comparison to be meaningful
+    cfg["resume"] = bool(rng.integers(4) == 0)
+    if cfg["resume"]:
+        cfg["lookahead"] = False
+        if core == "qr":
+            # qr_factor_steps carries no csegs knob: pin the default so
+            # the one-shot comparison program matches
+            cfg["csegs"] = 8
     return cfg
 
 
@@ -123,6 +134,31 @@ def run_trial(seed: int) -> tuple[bool, str]:
                 update=cfg["update"], segs=cfg["segs"],
                 lookahead=cfg["lookahead"],
                 panel_chunk=cfg["panel_chunk"])
+            if cfg["resume"] and geom.n_steps >= 2:
+                from conflux_tpu.lu.distributed import lu_factor_steps
+
+                kw = dict(election=cfg["election"], tree=cfg["tree"],
+                          update=cfg["update"], segs=cfg["segs"],
+                          panel_chunk=cfg["panel_chunk"])
+                k = geom.n_steps // 2
+                s1, o1, _ = lu_factor_steps(jnp.asarray(host), geom,
+                                            mesh, 0, k, **kw)
+                s2, _, p2 = lu_factor_steps(s1, geom, mesh, k,
+                                            geom.n_steps, orig=o1, **kw)
+                if grid.Pz == 1:  # bitwise round-trip contract
+                    if not (np.array_equal(np.asarray(s2),
+                                           np.asarray(out))
+                            and np.array_equal(np.asarray(p2),
+                                               np.asarray(perm))):
+                        return False, f"{label}: resume != one-shot"
+                else:  # Pz>1: numerically equivalent, not bit-identical
+                    rres = lu_residual(
+                        np.asarray(Ap, np.float64)
+                        if cfg["dtype"] != np.complex64 else Ap,
+                        geom.gather(np.asarray(s2)), np.asarray(p2))
+                    if not (rres < eps * np.sqrt(N) * 10):
+                        return False, (f"{label}: resume residual "
+                                       f"{rres:.3e}")
             perm = np.asarray(perm)
             if sorted(perm.tolist()) != list(range(geom.M)):
                 return False, f"{label}: perm not a permutation"
@@ -142,6 +178,25 @@ def run_trial(seed: int) -> tuple[bool, str]:
                 sh, cgeom, mesh, segs=cfg["segs"],
                 lookahead=cfg["lookahead"])
             res = float(cholesky_residual_distributed(sh, L, cgeom, mesh))
+            if cfg["resume"] and cgeom.Kappa >= 2:
+                from conflux_tpu.cholesky.distributed import (
+                    cholesky_factor_steps,
+                )
+
+                k = cgeom.Kappa // 2
+                s1 = cholesky_factor_steps(sh, cgeom, mesh, 0, k,
+                                           segs=cfg["segs"])
+                s2 = cholesky_factor_steps(s1, cgeom, mesh, k,
+                                           cgeom.Kappa, segs=cfg["segs"])
+                if grid.Pz == 1:
+                    if not np.array_equal(np.asarray(s2), np.asarray(L)):
+                        return False, f"{label}: resume != one-shot"
+                else:
+                    rres = float(cholesky_residual_distributed(
+                        sh, s2, cgeom, mesh))
+                    if not (rres < eps * np.sqrt(N) * 10):
+                        return False, (f"{label}: resume residual "
+                                       f"{rres:.3e}")
         else:
             from conflux_tpu.qr.distributed import (
                 qr_factor_distributed,
@@ -161,6 +216,20 @@ def run_trial(seed: int) -> tuple[bool, str]:
             Qs, Rs = qr_factor_distributed(
                 jnp.asarray(host), geom, mesh, csegs=cfg["csegs"],
                 lookahead=cfg["lookahead"])
+            if cfg["resume"] and geom.Nt >= 2:
+                from conflux_tpu.qr.distributed import qr_factor_steps
+
+                k = geom.Nt // 2
+                s1, R1 = qr_factor_steps(jnp.asarray(host), geom, mesh,
+                                         0, k)
+                s2, R2 = qr_factor_steps(s1, geom, mesh, k, geom.Nt,
+                                         R=R1)
+                if grid.Pz == 1:
+                    if not (np.array_equal(np.asarray(s2),
+                                           np.asarray(Qs))
+                            and np.array_equal(np.asarray(R2),
+                                               np.asarray(Rs))):
+                        return False, f"{label}: resume != one-shot"
             Q = np.asarray(geom.gather(np.asarray(Qs)), np.float64)
             R = np.triu(np.asarray(
                 r_geometry(geom).gather(np.asarray(Rs)),
