@@ -212,7 +212,10 @@ def run_trial(seed: int) -> tuple[bool, str]:
             A = make_test_matrix(geom.Mbase, N, seed=seed,
                                  dtype=np.float64)
             host = geom.scatter(A.astype(dt))
-            Ap = np.asarray(geom.gather(host), np.float64)
+            # complex64 storage holds a real-valued test matrix here
+            # (imag == 0): .real drops the zero parts without the
+            # ComplexWarning of a direct float64 cast
+            Ap = np.asarray(geom.gather(host)).real.astype(np.float64)
             Qs, Rs = qr_factor_distributed(
                 jnp.asarray(host), geom, mesh, csegs=cfg["csegs"],
                 lookahead=cfg["lookahead"])
@@ -230,10 +233,11 @@ def run_trial(seed: int) -> tuple[bool, str]:
                             and np.array_equal(np.asarray(R2),
                                                np.asarray(Rs))):
                         return False, f"{label}: resume != one-shot"
-            Q = np.asarray(geom.gather(np.asarray(Qs)), np.float64)
-            R = np.triu(np.asarray(
-                r_geometry(geom).gather(np.asarray(Rs)),
-                np.float64)[: geom.N, : geom.N])
+            Q = np.asarray(
+                geom.gather(np.asarray(Qs))).real.astype(np.float64)
+            R = np.triu(np.asarray(r_geometry(geom).gather(
+                np.asarray(Rs))).real.astype(
+                    np.float64)[: geom.N, : geom.N])
             res = (np.linalg.norm(Q @ R - Ap)
                    / max(np.linalg.norm(Ap), 1e-30))
             orth = np.linalg.norm(Q.T @ Q - np.eye(Q.shape[1]))
