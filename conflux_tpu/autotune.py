@@ -83,7 +83,7 @@ class Recommendation:
 _DEFAULTS = {
     "lu": dict(precision="highest", v=None, panel_chunk=None,
                segs=(16, 16), tree="pairwise", update="segments",
-               swap="xla", lookahead=False, election="gather"),
+               lookahead=False, election="gather"),
     "cholesky": dict(precision="highest", v=None, segs=(8, 8),
                      lookahead=False),
     "qr": dict(precision="highest", v=None, csegs=8, lookahead=False,

@@ -79,14 +79,6 @@ def parse_args(argv=None):
         "one switch-selected live-suffix block per step",
     )
     p.add_argument(
-        "--swap", default="xla", choices=["xla", "dma"],
-        help="row-swap path: XLA scatter, or the experimental pipelined "
-        "DMA kernel (TPU only, hardware-unverified; falls back to XLA "
-        "off-TPU; requires unique destination rows — the LU swap "
-        "guarantees this, duplicates are undefined for dma where the "
-        "XLA path is last-writer-deterministic)",
-    )
-    p.add_argument(
         "--refine", type=int, default=None, metavar="K",
         help="after factoring, solve A x = 1 with K iterative-refinement "
         "sweeps (f64 residual — the HPL-MxP recipe; pairs with --dtype "
@@ -128,7 +120,6 @@ def main(argv=None) -> int:
             "segs": ("segs", None),
             "tree": ("tree", "pairwise"),
             "update": ("update", "segments"),
-            "swap": ("swap", "xla"),
             "lookahead": ("lookahead", False),
         })
 
@@ -168,7 +159,7 @@ def main(argv=None) -> int:
                     out, perm_dev = lu_factor_distributed(
                         dev, geom, mesh, lookahead=args.lookahead,
                         election=args.election, tree=args.tree,
-                        update=args.update, swap=args.swap, **seg_kw)
+                        update=args.update, **seg_kw)
                 sync(out)
         if rep > 0:
             times.append(t.ms)
@@ -233,7 +224,7 @@ def main(argv=None) -> int:
             phase_profile(
                 build_program(geom, mesh, lookahead=args.lookahead,
                               election=args.election, tree=args.tree,
-                              update=args.update, swap=args.swap,
+                              update=args.update,
                               dtype=dtype, **seg_kw), dev)
         profiler.report()
     return 0

@@ -81,7 +81,7 @@ def _build(geom: LUGeometry, mesh_key, precision, backend: str,
            panel_chunk: int, donate: bool = False, resumable: bool = False,
            lookahead: bool = False, election: str = "gather",
            segs: tuple = (16, 16), tree: str = "pairwise",
-           swap: str = "xla", update: str = "segments"):
+           update: str = "segments"):
     """resumable=True builds the checkpoint/restart form: factor supersteps
     [k0, k1) given as TRACED scalars — one compile serves every segment of
     a checkpointed run — with the row-origin state as an explicit
@@ -303,19 +303,14 @@ def _build(geom: LUGeometry, mesh_key, precision, backend: str,
                 didx = loc_of(dest_disp)
                 disp_vals = jnp.where(z0, Drows.astype(dtype),
                                       jnp.zeros((), dtype))
-                if swap == "dma":
-                    # EXPERIMENTAL: pipelined row DMAs through a VMEM
-                    # stage instead of XLA's serial per-row scatter loop
-                    # (~10 ms/step at v=1024, N=32768 — the "other"
-                    # phase-table bucket). Unverified on hardware; see
-                    # ops/pallas_kernels.scatter_rows and
-                    # scripts/swap_probe.py for the A/B protocol.
-                    from conflux_tpu.ops import pallas_kernels
-
-                    Aloc = pallas_kernels.scatter_rows(
-                        Aloc, disp_vals, didx, use_dma=True)
-                else:
-                    Aloc = Aloc.at[didx].set(disp_vals, mode="drop")
+                # XLA's per-row scatter loop (~10 ms/step at v=1024,
+                # N=32768 — the "other" phase-table bucket). A pipelined
+                # Pallas row-DMA alternative existed rounds 3-4 behind
+                # swap='dma' but was deleted unadopted per the
+                # pre-decided criterion (docs/ROUND3.md #3: hardware A/B
+                # or deletion — the chip never recovered to run it; see
+                # docs/ROUND4.md); git history has the kernel.
+                Aloc = Aloc.at[didx].set(disp_vals, mode="drop")
                 orig = jnp.where(
                     own_d, lax.dynamic_update_slice(orig, worig, (li,)), orig)
                 orig = orig.at[didx].set(dorig, mode="drop")
@@ -592,8 +587,7 @@ def build_program(geom: LUGeometry, mesh, precision=None,
                   donate: bool = False, resumable: bool = False,
                   lookahead: bool = False, election: str = "gather",
                   segs: tuple = (16, 16), tree: str = "pairwise",
-                  swap: str = "xla", update: str = "segments",
-                  dtype=None):
+                  update: str = "segments", dtype=None):
     """The jitted distributed-LU program itself (cached per config).
 
     The single point resolving the trace-time defaults (precision/backend/
@@ -656,13 +650,11 @@ def build_program(geom: LUGeometry, mesh, precision=None,
                 f"{blas.single_call_rows(v, cdtype)}-row VMEM-safe height "
                 f"for {jnp.dtype(cdtype).name}); "
                 "raise panel_chunk or use tree='pairwise'")
-    if swap not in ("xla", "dma"):
-        raise ValueError(f"unknown swap {swap!r} (xla|dma)")
     if update not in ("segments", "block"):
         raise ValueError(f"unknown update {update!r} (segments|block)")
     return _build(geom, mesh_cache_key(mesh), precision, backend,
                   panel_chunk, donate, resumable, lookahead, election,
-                  tuple(segs), tree, swap, update)
+                  tuple(segs), tree, update)
 
 
 def lu_factor_distributed(shards, geom: LUGeometry, mesh,
@@ -670,7 +662,7 @@ def lu_factor_distributed(shards, geom: LUGeometry, mesh,
                           panel_chunk: int | None = None,
                           donate: bool = False, lookahead: bool = False,
                           election: str = "gather", segs: tuple = (16, 16),
-                          tree: str = "pairwise", swap: str = "xla",
+                          tree: str = "pairwise",
                           update: str = "segments"):
     """Factor block-cyclic shards (Px, Py, Ml, Nl) in place on a mesh.
 
@@ -704,14 +696,11 @@ def lu_factor_distributed(shards, geom: LUGeometry, mesh,
     calls; see `ops.blas.tournament_winners`). Both are valid CALU
     elections; pivot choices can differ on ties, so results are
     comparable by residual, not bitwise.
-    `swap='dma'` (EXPERIMENTAL, hardware-unverified) routes the
-    displacement scatter through the pipelined Pallas row-DMA kernel
-    instead of XLA's scatter. The kernel requires unique destination
-    rows — duplicates are undefined (in-flight DMAs race) where the XLA
-    path is last-writer-deterministic. The LU swap's destinations are
-    unique by construction (a permutation fragment), so both paths
-    compute the same swap here; keep 'xla' until the staged A/B
-    (`scripts/swap_probe.py`) has passed on a real chip.
+    (An experimental `swap='dma'` Pallas row-DMA alternative to the XLA
+    displacement scatter existed rounds 3-4; it was deleted unadopted
+    per the pre-decided hardware-A/B-or-delete criterion when the chip
+    stayed unreachable — docs/ROUND4.md. Git history has the kernel and
+    its staged probe protocol.)
     """
     from conflux_tpu.geometry import check_shards
 
@@ -723,7 +712,7 @@ def lu_factor_distributed(shards, geom: LUGeometry, mesh,
     fn = build_program(geom, mesh, precision=precision, backend=backend,
                        panel_chunk=panel_chunk, donate=donate,
                        lookahead=lookahead, election=election,
-                       segs=segs, tree=tree, swap=swap, update=update,
+                       segs=segs, tree=tree, update=update,
                        dtype=shards.dtype)
     return fn(shards)
 

@@ -173,140 +173,20 @@ def _lu_block(a, alive, interpret: bool):
 
 
 # --------------------------------------------------------------------------- #
-# Row scatter: write v rows into an (M, N) matrix at dynamic row indices
+# Row scatter (REMOVED, round 4)
 # --------------------------------------------------------------------------- #
 #
-# EXPERIMENTAL — not on any default path. The distributed LU's LAPACK-order
-# row swaps scatter v full rows per superstep; XLA lowers a row scatter to a
-# serial per-row while loop (~10 ms/step at v=1024, N=32768 — measured
-# 339 ms of a 2.2 s factorization). The production fix expresses the swap
-# as segment-level gather+selects fused into the trailing update
-# (`lu/distributed.py` step 6). This kernel is the DMA alternative: copy
-# each row HBM -> VMEM -> HBM with scalar-prefetched destination indices
-# and in-place output aliasing, so only the touched rows cost bandwidth.
-# Direct HBM->HBM local DMA is NOT used: a first attempt wedged the chip
-# (the copy never signaled its semaphore — local copies want a VMEM side).
-# Treat as unverified-on-hardware until the bring-up test in
-# tests/test_pallas.py::test_scatter_rows_tpu runs on a real chip.
-
-_SCATTER_INFLIGHT = 8  # outstanding row DMAs per direction
-
-
-def _scatter_rows_kernel(N, v, M, idx_ref, rows_ref, a_ref, out_ref,
-                         stage_ref, in_sems, out_sems):
-    del a_ref  # aliased into out_ref; untouched rows keep their values
-    W = min(_SCATTER_INFLIGHT, v)
-
-    def load(i):
-        # rows_ref is flattened to 1D: tiled (v, N) memrefs reject 1-row
-        # slices (sublane alignment); 1D slices only need lane alignment,
-        # which N % 1024-tile == 0 guarantees (see scatter_rows)
-        return pltpu.make_async_copy(
-            rows_ref.at[pl.ds(i * N, N)],
-            stage_ref.at[i % W],
-            in_sems.at[i % W],
-        )
-
-    def store(i):
-        # sentinel indices (>= M) mean "drop this row": the store never
-        # starts, so its wait is skipped under the same predicate; the
-        # clamp only keeps the never-issued address in bounds
-        t = jnp.minimum(idx_ref[i], M - 1)
-        return pltpu.make_async_copy(
-            stage_ref.at[i % W],
-            out_ref.at[pl.ds(t * N, N)],
-            out_sems.at[i % W],
-        )
-
-    def body(i, carry):
-        # retire the store that used this slot, then refill it. The index
-        # read is clamped because Mosaic does not bounds-check dynamic SMEM
-        # indexing — the (i >= W) conjunct gates execution, not the read.
-        prev = jnp.maximum(i - W, 0)
-
-        @pl.when((i >= W) & (idx_ref[prev] < M))
-        def _():
-            store(prev).wait()
-
-        load(i).start()
-        load(i).wait()
-
-        @pl.when(idx_ref[i] < M)
-        def _():
-            store(i).start()
-
-        return carry
-
-    jax.lax.fori_loop(0, v, body, 0, unroll=False)
-
-    def tail(i, carry):
-        @pl.when(idx_ref[i] < M)
-        def _():
-            store(i).wait()
-
-        return carry
-
-    jax.lax.fori_loop(max(v - W, 0), v, tail, 0, unroll=False)
-
-
-@functools.partial(jax.jit)
-def _scatter_rows(a, rows, idx):
-    M, N = a.shape
-    v = rows.shape[0]
-    W = min(_SCATTER_INFLIGHT, v)
-    grid_spec = pltpu.PrefetchScalarGridSpec(
-        num_scalar_prefetch=1,
-        grid=(1,),
-        in_specs=[
-            # HBM explicitly (ANY may pick VMEM); 1D so row slices need
-            # only lane alignment — see _scatter_rows_kernel
-            pl.BlockSpec(memory_space=pltpu.MemorySpace.HBM),
-            pl.BlockSpec(memory_space=pltpu.MemorySpace.HBM),
-        ],
-        out_specs=pl.BlockSpec(memory_space=pltpu.MemorySpace.HBM),
-        scratch_shapes=[
-            pltpu.VMEM((W, N), a.dtype),
-            pltpu.SemaphoreType.DMA((W,)),
-            pltpu.SemaphoreType.DMA((W,)),
-        ],
-    )
-    out = pl.pallas_call(
-        functools.partial(_scatter_rows_kernel, N, v, M),
-        grid_spec=grid_spec,
-        out_shape=jax.ShapeDtypeStruct((M * N,), a.dtype),
-        input_output_aliases={2: 0},  # a -> out (indices count scalar args)
-    )(idx, rows.reshape(v * N), a.reshape(M * N))
-    return out.reshape(M, N)
-
-
-def scatter_rows(a: jax.Array, rows: jax.Array, idx: jax.Array,
-                 use_dma: bool = False) -> jax.Array:
-    """a with a[idx[i], :] = rows[i, :]; idx entries >= a.shape[0] dropped.
-
-    Same contract as `a.at[idx].set(rows, mode="drop")` for UNIQUE in-range
-    indices — uniqueness is a requirement of the DMA path, not a nicety:
-    the XLA fallback resolves duplicate destinations deterministically
-    (last writer wins), but with `use_dma=True` duplicate destinations are
-    UNDEFINED (concurrent in-flight row DMAs race; whichever lands last is
-    unspecified). The LU row swap satisfies this by construction (its
-    displacement scatter is a permutation fragment). With `use_dma=True`
-    (EXPERIMENTAL, TPU only, unverified on hardware until
-    tests/test_scatter_rows.py::test_scatter_rows_tpu has passed on a real
-    chip;
-    row byte length a multiple of 4 KB — the 1D memref tile) the rows move
-    as pipelined DMAs through a VMEM stage instead of XLA's serial scatter
-    loop; the input is updated in place when XLA can prove `a` dead. The
-    default path is the XLA scatter — the production swap avoids this op
-    entirely (see `lu/distributed.py` step 6).
-    """
-    if rows.shape[0] == 0:
-        return a
-    # 1D HBM memrefs are tiled at 1024 elements (4 KB for f32): row offsets
-    # i*N are slice-aligned iff the row byte length divides into 4 KB tiles
-    if (not use_dma or jax.default_backend() != "tpu"
-            or (a.shape[1] * a.dtype.itemsize) % 4096):
-        return a.at[idx].set(rows, mode="drop")
-    return _scatter_rows(a, rows, idx.astype(jnp.int32))
+# An experimental pipelined row-DMA scatter (HBM -> VMEM -> HBM with
+# scalar-prefetched destination indices, in-place aliasing) lived here in
+# rounds 3-4 as the `swap='dma'` alternative to XLA's serial per-row
+# scatter loop (~10 ms/step at v=1024, N=32768). The pre-decided adoption
+# criterion (docs/ROUND3.md #3) required a staged hardware A/B with a
+# full-scale residual gate; the TPU tunnel never recovered to run it, so
+# the kernel was deleted unadopted per VERDICT r3 item 3 ("no third
+# state") — see docs/ROUND4.md. Git history (rounds 3-4) has the kernel,
+# its bring-up protocol (scripts/swap_probe.py), and the lesson that
+# direct HBM->HBM local DMA wedges the chip (local copies want a VMEM
+# side).
 
 
 def lu_block(a: jax.Array, alive: jax.Array):

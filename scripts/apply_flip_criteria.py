@@ -16,8 +16,9 @@ config except the flipped knob):
      full-scale residual (<= 3.2e-5, the f32-HIGHEST level — DESIGN §14:
      a hot-loop rewrite is adopted ONLY with an at-scale residual gate).
   2. update='block' likewise.
-  3. swap='dma' only via scripts/swap_probe.py --full (bring-up + gate);
-     a dma-swap tune row alone is evidence, not adoption.
+  3. (historical) swap='dma' was decided only by its staged probe; the
+     kernel was deleted unadopted in round 4 when the chip never
+     recovered (docs/ROUND4.md) — any dma rows in old logs are ignored.
   4. panel_chunk=12288 as a bench-local override if it survives + wins.
 
 Output: a decision per criterion (ADOPT / KEEP / NO-DATA, with the
@@ -43,7 +44,7 @@ GAIN_BAR = 0.02
 _LINE = re.compile(
     r"algo=(?P<algo>\w+) precision=(?P<precision>\w+) "
     r"chunk=(?P<chunk>\w+) v=(?P<v>\d+) segs=(?P<segs>[\w|x]+) "
-    r"tree=(?P<tree>\w+) swap=(?P<swap>\w+) update=(?P<update>\w+): "
+    r"tree=(?P<tree>\w+) (?:swap=(?P<swap>\w+) )?update=(?P<update>\w+): "
     r"(?P<gflops>[\d.]+) GFLOP/s")
 _RES = re.compile(r"residual=(?P<res>[\d.eE+-]+)")
 
@@ -56,6 +57,10 @@ def parse_log(text: str) -> list[dict]:
         m = _LINE.search(line)
         if m:
             d = m.groupdict()
+            # pre-round-4 logs carry a swap field; post-removal lines
+            # don't. Normalize so cross-era records still pair (the
+            # only swap value a surviving record can mean is 'xla').
+            d["swap"] = d["swap"] or "xla"
             d["gflops"] = float(d["gflops"])
             d["residual"] = None
             records.append(d)
@@ -169,10 +174,11 @@ def main(argv=None) -> int:
         print(f"criterion {o['knob']}: {o['decision']}")
         if "detail" in o:
             print(f"    {o['detail']}")
-    dma = [r for r in records if r["swap"] == "dma"]
-    print("criterion swap=dma: decided by scripts/swap_probe.py --full "
-          f"only ({len(dma)} dma tune rows here are supporting evidence, "
-          "not adoption)")
+    dma = [r for r in records if r.get("swap") == "dma"]
+    if dma:
+        print(f"note: {len(dma)} swap=dma rows in the logs are historical "
+              "— the kernel was deleted unadopted in round 4 "
+              "(docs/ROUND4.md)")
 
     best = _best(records)  # LU only: the emitted rule is an LU rule
     if best:
@@ -189,21 +195,19 @@ def main(argv=None) -> int:
                   "or is missing) — criteria cannot adopt anything")
             return 2
         # the rule encodes the printed DECISIONS, not the raw best
-        # record: a KEEP'd flip (or a dma/12288 row that merely timed
-        # well) must not become a table default through the back door.
+        # record: a KEEP'd flip (or a 12288 row that merely timed well)
+        # must not become a table default through the back door.
         # precision/v come from the best clean LU record (the measured
-        # headline family); tree/update follow their criterion; swap is
-        # decided only by swap_probe (criterion 3) and chunk=12288 only
-        # as a bench-local override (criterion 4) — both stay default
-        # here, with the outcome recorded in the provenance.
+        # headline family); tree/update follow their criterion;
+        # chunk=12288 is bench-local only (criterion 4) so the rule
+        # keeps 8192, with the outcome recorded in the provenance.
         tree_o, update_o, chunk_o = outcomes
         knobs = {"precision": best["precision"], "v": int(best["v"]),
                  "panel_chunk": 8192,
                  "tree": "flat" if tree_o["decision"] == "ADOPT"
                  else "pairwise",
                  "update": "block" if update_o["decision"] == "ADOPT"
-                 else "segments",
-                 "swap": "xla"}
+                 else "segments"}
         rules = [{
             "algo": "lu", "device": ["v5e", "v5 lite"], "P": 1,
             "n_lo": 8192, "n_hi": 32768, "dtype": "float32",
@@ -213,8 +217,7 @@ def main(argv=None) -> int:
                            f"residual {best['residual']:.2e}; criteria: "
                            + "; ".join(f"{o['knob']}={o['decision']}"
                                        for o in outcomes)
-                           + "; swap=dma decided by swap_probe only; "
-                           "chunk=12288 bench-local only (ROUND3.md)"),
+                           + "; chunk=12288 bench-local only (ROUND3.md)"),
         }]
         with open(args.emit_rules, "w") as f:
             json.dump(rules, f, indent=1)

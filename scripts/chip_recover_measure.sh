@@ -8,7 +8,7 @@
 #   4. LU block-update A/B (one switch-selected suffix GEMM per step)
 #   5. the zero-hardware-data cores: cholesky 32k, qr 16k
 #   6. HPL-MxP end-to-end (bf16x3 + GMRES-IR)
-#   7. swap_probe (DMA row scatter bring-up + full-scale residual gate)
+#   7. (removed round 4: DMA swap deleted unadopted — docs/ROUND4.md)
 #   8. chunk 12288/10240 trials LAST (the round-2 wedge began during the
 #      12288 trial; quarantine the risky configs behind everything else)
 # Probe = tiny reduction with a hard timeout; the tunnel wedge manifests
@@ -56,8 +56,9 @@ done
     --reps 2 --configs highest:0:1024 2>&1 | grep -v WARNING
   echo "=== HPL-MxP end-to-end (bf16x3 factor + GMRES-IR to 1e-6) $(date -u +%FT%TZ) ==="
   timeout -k 10 3000 python bench.py --mode mxp --ir gmres 2>&1 | grep -v WARNING
-  echo "=== swap_probe (DMA row scatter bring-up + full-scale gate) $(date -u +%FT%TZ) ==="
-  timeout -k 10 4200 python scripts/swap_probe.py --full 2>&1 | grep -v WARNING
+  echo "=== (swap_probe step removed: the DMA swap kernel was deleted"
+  echo "    unadopted per criterion 3 when the chip never recovered —"
+  echo "    docs/ROUND4.md) ==="
   echo "=== tune LU taller nomination chunks (LAST: the round-2 wedge "
   echo "    started during the 12288 trial — quarantine the risky configs"
   echo "    behind everything else) $(date -u +%FT%TZ) ==="

@@ -93,12 +93,12 @@ def main() -> None:
                     "lattice vs one switch-selected live-suffix block "
                     "(applies to every LU config in this invocation)")
     ap.add_argument("--configs", default=None,
-                    help="comma list precision:chunk:v[:RxC[:tree[:swap]]], "
+                    help="comma list precision:chunk:v[:RxC[:tree]], "
                     "e.g. highest:8192:1024,highest:8192:1024:16x16:flat "
                     "(chunk ignored for cholesky/qr; pass 0; RxC = LU "
                     "trailing-update row x col segment counts, '-' for the "
                     "library default; tree = pairwise|flat election "
-                    "reduction; swap = xla|dma row-swap path — LU only)")
+                    "reduction — LU only)")
     args = ap.parse_args()
     if args.update != "segments" and args.algo != "lu":
         ap.error("--update applies to --algo lu only")
@@ -115,10 +115,10 @@ def main() -> None:
             parts = c.split(":")
             if not 3 <= len(parts) <= 6 or parts[0] not in prec_names:
                 ap.error(f"bad config {c!r}: want "
-                         "precision:chunk:v[:RxC[:tree[:swap]]] with "
+                         "precision:chunk:v[:RxC[:tree]] with "
                          f"precision in {sorted(prec_names)}, RxC segment "
                          "counts ('-' = library default), tree in "
-                         "pairwise|flat, swap in xla|dma")
+                         "pairwise|flat")
             p, chunk, v = parts[:3]
             segs = None  # None = the library default for the algorithm
             if len(parts) > 3 and parts[3] not in ("", "-"):
@@ -132,16 +132,14 @@ def main() -> None:
             if tree not in ("pairwise", "flat"):
                 ap.error(f"bad tree field {tree!r} in config {c!r}: "
                          "want pairwise|flat (or '-' for the default)")
-            swap = parts[5] if len(parts) > 5 else "xla"
-            if swap in ("", "-"):
-                swap = "xla"
-            if swap not in ("xla", "dma"):
-                ap.error(f"bad swap field {swap!r} in config {c!r}: "
-                         "want xla|dma (or '-' for the default)")
-            if args.algo != "lu" and (tree != "pairwise" or swap != "xla"):
+            if len(parts) > 5:
+                ap.error(f"config {c!r}: the 6th (swap) field was removed "
+                         "in round 4 — the DMA swap kernel was deleted "
+                         "unadopted (docs/ROUND4.md)")
+            if args.algo != "lu" and tree != "pairwise":
                 # known at parse time: do not burn a (possibly wedged)
                 # device probe before saying so
-                ap.error(f"config {c!r}: tree/swap fields are LU-only "
+                ap.error(f"config {c!r}: the tree field is LU-only "
                          f"(algo={args.algo})")
             if not re.fullmatch(r"\d+", chunk) or not re.fullmatch(r"\d+", v) \
                     or int(v) < 1:
@@ -151,7 +149,7 @@ def main() -> None:
             # chunk 0 means "library default": panel_chunk=None downstream
             # (passing 0 through would clamp to v-tall chunks — a silently
             # pathological nomination, not the default)
-            configs.append((p, int(chunk) or None, int(v), segs, tree, swap))
+            configs.append((p, int(chunk) or None, int(v), segs, tree))
     else:
         configs = None
 
@@ -180,23 +178,23 @@ def main() -> None:
         pass
     elif args.algo == "lu":
         configs = [
-            ("highest", 8192, 1024, None, "pairwise", "xla"),
-            ("high", 8192, 1024, None, "pairwise", "xla"),
-            ("highest", 12288, 1024, None, "pairwise", "xla"),
-            ("highest", 4096, 1024, None, "pairwise", "xla"),
-            ("highest", 8192, 2048, None, "pairwise", "xla"),
-            ("high", 8192, 2048, None, "pairwise", "xla"),
-            ("highest", 8192, 512, None, "pairwise", "xla"),
+            ("highest", 8192, 1024, None, "pairwise"),
+            ("high", 8192, 1024, None, "pairwise"),
+            ("highest", 12288, 1024, None, "pairwise"),
+            ("highest", 4096, 1024, None, "pairwise"),
+            ("highest", 8192, 2048, None, "pairwise"),
+            ("high", 8192, 2048, None, "pairwise"),
+            ("highest", 8192, 512, None, "pairwise"),
         ]
     else:
         configs = [
-            ("highest", 0, 1024, None, "pairwise", "xla"),
-            ("high", 0, 1024, None, "pairwise", "xla"),
-            ("highest", 0, 512, None, "pairwise", "xla"),
-            ("highest", 0, 2048, None, "pairwise", "xla"),
+            ("highest", 0, 1024, None, "pairwise"),
+            ("high", 0, 1024, None, "pairwise"),
+            ("highest", 0, 512, None, "pairwise"),
+            ("highest", 0, 2048, None, "pairwise"),
         ]
 
-    for pname, chunk, v, segs, tree, swap in configs:
+    for pname, chunk, v, segs, tree in configs:
         chunk_lbl = "default" if chunk is None else chunk
         cfg_lbl = (f"algo={args.algo} precision={pname} chunk={chunk_lbl} "
                    f"v={v}")
@@ -219,11 +217,11 @@ def main() -> None:
                 geom = LUGeometry.create(N, N, v, grid)
 
                 def factor(s, geom=geom, chunk=chunk, pname=pname,
-                           seg_kw=seg_kw, tree=tree, swap=swap):
+                           seg_kw=seg_kw, tree=tree):
                     return lu_factor_distributed(
                         s, geom, mesh, precision=prec[pname],
                         panel_chunk=chunk, donate=True, tree=tree,
-                        swap=swap, update=args.update, **seg_kw)
+                        update=args.update, **seg_kw)
 
                 def make(geom=geom):
                     # bench's generator, not a copy: the residual oracle
@@ -288,7 +286,7 @@ def main() -> None:
                 times.append(time.time() - t0)
             dim = geom.N if args.algo == "cholesky" else geom.M
             gflops = flop_coeff * dim**3 / (sum(times) / len(times)) / 1e9
-            print(f"{cfg_lbl} segs={seg_lbl} tree={tree} swap={swap} "
+            print(f"{cfg_lbl} segs={seg_lbl} tree={tree} "
                   f"update={args.update}: {gflops:.1f} GFLOP/s", flush=True)
             try:  # residual separately: never discard a good timing
                 res = residual(out, aux)
@@ -296,7 +294,7 @@ def main() -> None:
             except Exception as e:
                 print(f"    residual FAILED: {e}", flush=True)
         except Exception as e:  # OOM / VMEM overflow at some configs
-            print(f"{cfg_lbl} segs={seg_lbl} tree={tree} swap={swap}: "
+            print(f"{cfg_lbl} segs={seg_lbl} tree={tree}: "
                   f"FAILED {e}", flush=True)
 
 

@@ -53,6 +53,8 @@ def test_chunk_kept_without_residual():
 
 
 def test_no_data_criterion():
+    # 'swap' survives only in historical logs (the knob was removed in
+    # round 4); a flip with no matching rows must report NO-DATA
     o = evaluate_flip(parse_log(LOG), "swap", "dma", "xla")
     assert o["decision"] == "NO-DATA"
 
@@ -98,7 +100,7 @@ def test_emit_rules_encodes_decisions_not_best_record(tmp_path, capsys):
     assert "criterion tree: KEEP (gain below" in out
     data = json.loads(rules.read_text())
     assert data[0]["knobs"]["tree"] == "pairwise"
-    assert data[0]["knobs"]["swap"] == "xla"
+    assert "swap" not in data[0]["knobs"]  # knob removed in round 4
     assert data[0]["knobs"]["panel_chunk"] == 8192
 
 
