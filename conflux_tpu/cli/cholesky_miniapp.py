@@ -23,6 +23,7 @@ from conflux_tpu.cli.common import (
     add_experiment_type_arg,
     apply_auto,
     np_dtype,
+    resolve_knob_defaults,
     result_line,
     segs_arg,
     setup_platform,
@@ -44,7 +45,7 @@ def parse_args(argv=None):
         "bfloat16) and report the solve residual",
     )
     p.add_argument(
-        "--lookahead", action="store_true",
+        "--lookahead", action="store_true", default=None,
         help="software-pipelined loop: overlap the next panel reduce "
         "with the trailing update (multi-chip meshes; P8)",
     )
@@ -85,12 +86,14 @@ def main(argv=None) -> int:
     grid = Grid3.parse(args.grid) if args.grid else choose_cholesky_grid(n_devices)
     if grid.P > n_devices:
         raise SystemExit(f"grid {grid} needs {grid.P} devices, have {n_devices}")
+    knob_map = {
+        "tile": ("v", None),
+        "segs": ("segs", None),
+        "lookahead": ("lookahead", False),
+    }
     if args.auto:
-        apply_auto(args, "cholesky", args.dim, grid.P, args.dtype, {
-            "tile": ("v", None),
-            "segs": ("segs", None),
-            "lookahead": ("lookahead", False),
-        })
+        apply_auto(args, "cholesky", args.dim, grid.P, args.dtype, knob_map)
+    resolve_knob_defaults(args, knob_map)
     v = args.tile or choose_cholesky_tile(args.dim, grid.P)
 
     dtype = np_dtype(args.dtype)

@@ -168,21 +168,35 @@ def add_auto_arg(p: argparse.ArgumentParser) -> None:
         help="resolve tuning knobs you did not pass from the measured "
         "dispatch table (conflux_tpu.autotune — the role of the "
         "reference's hand-measured variant switch, Cholesky.cpp:857-921); "
-        "prints the applied knobs and the measurement they came from. A "
-        "flag left at its parser default counts as un-passed",
+        "prints the applied knobs and the measurement they came from. Any "
+        "explicitly passed flag pins its knob, even at the library "
+        "default value",
     )
 
 
 def apply_auto(args, algo: str, N: int, P: int, dtype: str,
                flag_knobs: dict) -> None:
-    """--auto resolution: for every (args attribute -> (knob name, parser
-    default)) in `flag_knobs`, a flag still at its default is replaced by
-    the measured recommendation's knob (None knobs never overwrite).
-    Explicitly re-passing the default value counts as un-passed — the
-    table wins; pass a different value to pin a knob. Prints `_auto_`
-    lines (knobs + provenance) in the miniapp protocol style: one
-    space-free key=value token per knob (tuples in the RxC grammar), so
-    whitespace-splitting sweep parsers stay correct."""
+    """--auto resolution: for every (args attribute -> (knob name, library
+    default)) in `flag_knobs`, an un-passed flag is replaced by the
+    measured recommendation's knob (None knobs never overwrite).
+
+    Auto-eligible flags are declared with a `default=None` SENTINEL, so
+    "un-passed" is detected as `is None` — an explicitly passed flag
+    always pins its knob, even when the passed value equals the library
+    default (ADVICE r4 #1: `--auto --election gather` must run gather).
+    Callers must follow apply_auto with resolve_knob_defaults(), which
+    fills any attribute still None with its library default.
+
+    With an empty `flag_knobs` (a mode with nothing auto-tunable) the
+    dispatch table is not consulted and a distinct line says so
+    (ADVICE r4 #4 — "(all knobs pinned)" would misreport).
+
+    Prints `_auto_` lines (knobs + provenance) in the miniapp protocol
+    style: one space-free key=value token per knob (tuples in the RxC
+    grammar), so whitespace-splitting sweep parsers stay correct."""
+    if not flag_knobs:
+        print("_auto_ (no auto-tunable knobs for this mode)")
+        return
     from conflux_tpu import autotune
 
     rec = autotune.recommended(algo, N, P=P, dtype=str(dtype))
@@ -191,9 +205,19 @@ def apply_auto(args, algo: str, N: int, P: int, dtype: str,
         return "x".join(map(str, v)) if isinstance(v, tuple) else v
 
     applied = []
-    for attr, (knob, default) in flag_knobs.items():
-        if getattr(args, attr) == default and rec.knobs.get(knob) is not None:
+    for attr, (knob, _default) in flag_knobs.items():
+        if getattr(args, attr) is None and rec.knobs.get(knob) is not None:
             setattr(args, attr, rec.knobs[knob])
             applied.append(f"{attr}={fmt(rec.knobs[knob])}")
     print(f"_auto_ {' '.join(applied) if applied else '(all knobs pinned)'}")
     print(f"_auto_provenance_ {rec.provenance}")
+
+
+def resolve_knob_defaults(args, flag_knobs: dict) -> None:
+    """Fill every auto-eligible attribute still at its None sentinel with
+    its library default — run after apply_auto (or instead of it when
+    --auto is off). Kept separate so apply_auto can tell "un-passed"
+    from "explicitly passed at the default value"."""
+    for attr, (_knob, default) in flag_knobs.items():
+        if getattr(args, attr) is None:
+            setattr(args, attr, default)

@@ -27,6 +27,7 @@ from conflux_tpu.cli.common import (
     add_experiment_type_arg,
     apply_auto,
     np_dtype,
+    resolve_knob_defaults,
     result_line,
     segs_arg,
     setup_platform,
@@ -38,7 +39,9 @@ def parse_args(argv=None):
     p = argparse.ArgumentParser("conflux_miniapp", description=__doc__)
     p.add_argument("-M", type=int, default=None, help="rows (default: N)")
     p.add_argument("-N", type=int, default=2048, help="matrix dimension")
-    p.add_argument("-b", "--block_size", type=int, default=128, help="tile size v")
+    p.add_argument("-b", "--block_size", type=int, default=None,
+                   help="tile size v (default 128; un-passed = "
+                   "auto-eligible under --auto)")
     p.add_argument(
         "--p_grid", default=None,
         help="Px,Py,Pz (default: auto-pick over all available devices)",
@@ -52,15 +55,16 @@ def parse_args(argv=None):
     )
     p.add_argument("--validate", action="store_true", help="residual ||PA-LU||_F check")
     p.add_argument(
-        "--lookahead", action="store_true",
+        "--lookahead", action="store_true", default=None,
         help="software-pipelined loop: overlap the next step's pivot "
         "election with the trailing update (multi-chip meshes; P8)",
     )
     p.add_argument(
-        "--election", default="gather", choices=["gather", "butterfly"],
-        help="cross-x pivot election: one all_gather tournament, or the "
-        "reference's log2(Px) ppermute hypercube (any Px; odd grids "
-        "fold their overflow ranks with two extra rounds)",
+        "--election", default=None, choices=["gather", "butterfly"],
+        help="cross-x pivot election (default gather): one all_gather "
+        "tournament, or the reference's log2(Px) ppermute hypercube "
+        "(any Px; odd grids fold their overflow ranks with two extra "
+        "rounds)",
     )
     p.add_argument(
         "--segs", default=None, metavar="RxC", type=segs_arg,
@@ -69,14 +73,16 @@ def parse_args(argv=None):
         "overshoot at the cost of more per-step conds",
     )
     p.add_argument(
-        "--tree", default="pairwise", choices=["pairwise", "flat"],
-        help="pivot election reduction: pairwise binary tree, or one "
-        "stacked LU call (fewer sequential latency-bound custom calls)",
+        "--tree", default=None, choices=["pairwise", "flat"],
+        help="pivot election reduction (default pairwise): pairwise "
+        "binary tree, or one stacked LU call (fewer sequential "
+        "latency-bound custom calls)",
     )
     p.add_argument(
-        "--update", default="segments", choices=["segments", "block"],
-        help="trailing-update partitioning: cond'd segment lattice, or "
-        "one switch-selected live-suffix block per step",
+        "--update", default=None, choices=["segments", "block"],
+        help="trailing-update partitioning (default segments): cond'd "
+        "segment lattice, or one switch-selected live-suffix block per "
+        "step",
     )
     p.add_argument(
         "--refine", type=int, default=None, metavar="K",
@@ -113,15 +119,19 @@ def main(argv=None) -> int:
     if grid.P > n_devices:
         raise SystemExit(f"grid {grid} needs {grid.P} devices, have {n_devices}")
 
+    # auto-eligible knobs: parser sentinel None = un-passed (an explicit
+    # flag always pins its knob, even at the library default value)
+    knob_map = {
+        "block_size": ("v", 128),
+        "election": ("election", "gather"),
+        "segs": ("segs", None),
+        "tree": ("tree", "pairwise"),
+        "update": ("update", "segments"),
+        "lookahead": ("lookahead", False),
+    }
     if args.auto:
-        apply_auto(args, "lu", args.N, grid.P, args.dtype, {
-            "block_size": ("v", 128),
-            "election": ("election", "gather"),
-            "segs": ("segs", None),
-            "tree": ("tree", "pairwise"),
-            "update": ("update", "segments"),
-            "lookahead": ("lookahead", False),
-        })
+        apply_auto(args, "lu", args.N, grid.P, args.dtype, knob_map)
+    resolve_knob_defaults(args, knob_map)
 
     dtype = np_dtype(args.dtype)
     geom = LUGeometry.create(M, args.N, args.block_size, grid)

@@ -44,13 +44,13 @@ def parse_args(argv=None):
     p.add_argument("--p_grid", default=None, help="Px,Py,Pz (default: auto)")
     p.add_argument("--algo", default="tsqr", choices=["tsqr", "cholesky"],
                    help="tall-mode election (QR tree vs Gram/CholeskyQR2)")
-    p.add_argument("--tree", default="gather", choices=["gather", "butterfly"],
-                   help="tsqr cross-x reduction: one all_gather, or the "
+    p.add_argument("--tree", default=None, choices=["gather", "butterfly"],
+                   help="tsqr cross-x reduction (default gather): one all_gather, or the "
                    "log2(Px) ppermute hypercube (any Px; odd grids fold "
                    "their overflow ranks with two extra rounds)")
     p.add_argument("--full", action="store_true",
                    help="general block-cyclic QR on the (x, y, z) mesh")
-    p.add_argument("--lookahead", action="store_true",
+    p.add_argument("--lookahead", action="store_true", default=None,
                    help="software-pipelined --full loop: overlap the next "
                    "panel's election with the trailing update (P8; "
                    "value-equivalent results — bitwise-verified on CPU "
@@ -82,7 +82,7 @@ def main(argv=None) -> int:
 
     if args.cols > args.M:
         raise SystemExit(f"--cols {args.cols} > rows {args.M}: QR needs M >= n")
-    if args.tree != "gather" and (args.full or args.algo != "tsqr"):
+    if args.tree not in (None, "gather") and (args.full or args.algo != "tsqr"):
         raise SystemExit(
             "--tree applies to the tall tsqr mode only (the Gram and "
             "block-cyclic paths have no cross-x R tree)")
@@ -94,21 +94,35 @@ def main(argv=None) -> int:
     dtype = np_dtype(args.dtype)
     rng = np.random.default_rng(42)
 
+    # single source of truth for the auto-eligible knobs and their
+    # library defaults; --auto consults a mode-gated SUBSET
+    # (block/csegs/lookahead are read only by the --full loop; the
+    # cross-x tree only by the tall tsqr mode — applying a knob its
+    # mode rejects, or never reads, would bypass the arg validation
+    # above or misreport an applied knob). apply_auto itself reports
+    # the empty-subset case as "no auto-tunable knobs for this mode"
+    # rather than "(all knobs pinned)".
+    knob_map = {"block": ("v", None), "csegs": ("csegs", None),
+                "lookahead": ("lookahead", False),
+                "tree": ("tree", "gather")}
+    if args.full:
+        mode_knobs = {k: knob_map[k]
+                      for k in ("block", "csegs", "lookahead")}
+    elif args.algo == "tsqr":
+        mode_knobs = {"tree": knob_map["tree"]}
+    else:
+        mode_knobs = {}
     if args.auto:
         from conflux_tpu.cli.common import apply_auto
 
         P = Grid3.parse(args.p_grid).P if args.p_grid else n_devices
-        # mode-gate the knobs: block/csegs/lookahead are read only by the
-        # --full loop; the cross-x tree only by the tall tsqr mode
-        # (applying a knob its mode rejects — or never reads — would
-        # bypass the arg validation above or misreport an applied knob)
-        knobs = {}
-        if args.full:
-            knobs.update(block=("v", None), csegs=("csegs", None),
-                         lookahead=("lookahead", False))
-        elif args.algo == "tsqr":
-            knobs.update(tree=("tree", "gather"))
-        apply_auto(args, "qr", args.M, P, args.dtype, knobs)
+        apply_auto(args, "qr", args.M, P, args.dtype, mode_knobs)
+    from conflux_tpu.cli.common import resolve_knob_defaults
+
+    # resolve the FULL sentinel set (not just this mode's): every
+    # un-passed auto-eligible flag must leave parse with its library
+    # default regardless of mode
+    resolve_knob_defaults(args, knob_map)
 
     if args.full:
         from conflux_tpu.qr.distributed import qr_factor_distributed

@@ -200,6 +200,17 @@ def _build(geom: LUGeometry, mesh_key, precision, backend: str,
                 # sends, `conflux_opt.hpp:266-280`).
                 # lu00 rides the tuple so the final round's packed
                 # factor comes out replicated with the winners.
+                # ZERO-FILL CONTRACT (butterfly_allreduce): on odd-Px
+                # folds every rank runs this reducer, and off-subcube
+                # lanes receive ppermute's zero fill — an all-zero
+                # stack and ids=0. tournament_winners on zeros is
+                # well-defined garbage (getrf of 0 = 0, finite, no
+                # NaN/Inf), and the garbage lanes are discarded by the
+                # coordinate selects inside butterfly_allreduce. Keep
+                # it that way: never gather by the received ids or
+                # branch on the values here — only select-by-winner on
+                # the local stack (tests/test_ops.py pins this with
+                # the real reducers at odd Px).
                 def reduce_pair(top, bot):
                     stack = jnp.concatenate([top[0], bot[0]], axis=0)
                     ids = jnp.concatenate([top[1], bot[1]])

@@ -72,6 +72,13 @@ def _two_pass_tsqr(A, Px: int, chunk: int, passes: int, prec,
     for _ in range(max(1, passes)):
         Ri = _tree_r(A, chunk)
         if tree == "butterfly":
+            # ZERO-FILL CONTRACT (butterfly_allreduce): on odd-Px folds
+            # the off-subcube lanes reduce ppermute's zero fill; the
+            # reducer must stay total on an all-zero stack. _tree_r of
+            # zeros is R=0 (geqrf of 0: finite, no NaN/Inf), and the
+            # garbage is discarded by the coordinate selects — never
+            # branch on the received values here (tests/test_ops.py
+            # pins this with the real reducer at odd Px).
             (Ri,) = butterfly_allreduce(
                 (Ri,), Px, AXIS_X,
                 lambda top, bot: (_tree_r(

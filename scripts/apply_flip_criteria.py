@@ -41,6 +41,20 @@ import sys
 RESIDUAL_GATE = 3.2e-5  # f32-HIGHEST level at N=32768 (DESIGN §14)
 GAIN_BAR = 0.02
 
+# The all-defaults baseline config every flip criterion pairs against
+# (the watcher queue's plain highest:8192:1024 row). A decisive pair
+# must match this on every knob except the flipped one — a flip may
+# not be adopted off a pairing that varies some OTHER knob (e.g.
+# tree=flat winning only under segs=32x16), per ADVICE r4 #2.
+BASELINE_CONFIG = {"algo": "lu", "precision": "highest", "chunk": "8192",
+                   "v": "1024", "segs": "lib", "tree": "pairwise",
+                   "update": "segments", "swap": "xla"}
+
+
+def _on_baseline(rec: dict, knob: str) -> bool:
+    return all(rec.get(k) == v for k, v in BASELINE_CONFIG.items()
+               if k != knob)
+
 _LINE = re.compile(
     r"algo=(?P<algo>\w+) precision=(?P<precision>\w+) "
     r"chunk=(?P<chunk>\w+) v=(?P<v>\d+) segs=(?P<segs>[\w|x]+) "
@@ -72,11 +86,6 @@ def parse_log(text: str) -> list[dict]:
     return records
 
 
-def _key(rec: dict, ignore: str) -> tuple:
-    return tuple(v for k, v in sorted(rec.items())
-                 if k not in (ignore, "gflops", "residual"))
-
-
 def _clean(r: dict) -> bool:
     return r["residual"] is not None and r["residual"] <= RESIDUAL_GATE
 
@@ -91,27 +100,36 @@ def evaluate_flip(records: list[dict], knob: str, flipped: str,
     """Criterion outcome for one knob: best matched pair (same config
     modulo `knob`), gain, and the ADOPT/KEEP/NO-DATA decision.
 
-    Pair choice prefers residual-CLEAN flip records: a timing whose
-    residual check failed can never be adopted (DESIGN §14), so it must
-    not mask a clean adoptable pair either — dirty flips are considered
-    only when no clean one has a matched baseline."""
-    flips = [r for r in records if r[knob] == flipped and r["algo"] == "lu"]
+    The decisive pair is restricted to the ALL-DEFAULTS baseline
+    config (BASELINE_CONFIG modulo `knob`): a flip that wins only in
+    combination with some other non-default knob must not flip the
+    global default (ADVICE r4 #2). Off-baseline flip rows never decide;
+    they are surfaced in the detail line as context (a NO-DATA mention,
+    or a re-measure hint when one out-gains the decisive pair).
 
-    def pairs_of(cands):
-        out = []
-        for f in cands:
-            base = [r for r in records if r[knob] == baseline
-                    and _key(r, knob) == _key(f, knob)]
-            if base:
-                out.append((f, max(base, key=lambda r: r["gflops"])))
-        return out
-
-    pairs = pairs_of([f for f in flips if _clean(f)]) or pairs_of(flips)
-    if not pairs:
+    BOTH sides of the pair prefer residual-CLEAN records: a timing
+    whose residual check failed can never be adopted (DESIGN §14), and
+    a dirty baseline timing is equally untrustworthy (the §14 forensics
+    saw corrupted runs time fast) — so a clean flip is judged against
+    the best CLEAN baseline, and dirty records on either side are
+    considered only when no clean one exists."""
+    flips = [r for r in records if r[knob] == flipped and r["algo"] == "lu"
+             and _on_baseline(r, knob)]
+    bases = [r for r in records if r[knob] == baseline and r["algo"] == "lu"
+             and _on_baseline(r, knob)]
+    off = [r for r in records if r[knob] == flipped and r["algo"] == "lu"
+           and not _on_baseline(r, knob)]
+    if not flips or not bases:
+        extra = (f"; {len(off)} off-baseline {flipped} row(s) observed "
+                 "(informational only — cannot decide a default)"
+                 if off else "")
         return {"knob": knob, "decision": "NO-DATA",
-                "detail": f"no matched {flipped}-vs-{baseline} pair in "
-                "the logs (queue item not yet run?)"}
-    f, b = max(pairs, key=lambda p: p[0]["gflops"] / p[1]["gflops"])
+                "detail": f"no all-defaults {flipped}-vs-{baseline} pair "
+                f"in the logs (queue item not yet run?){extra}"}
+    clean_flips = [f for f in flips if _clean(f)]
+    f = max(clean_flips or flips, key=lambda r: r["gflops"])
+    b = max([r for r in bases if _clean(r)] or bases,
+            key=lambda r: r["gflops"])
     gain = f["gflops"] / b["gflops"] - 1.0
     res_ok = _clean(f)
     adopt = gain >= GAIN_BAR and res_ok
@@ -119,6 +137,16 @@ def evaluate_flip(records: list[dict], knob: str, flipped: str,
               f"{b['gflops']:.0f} GFLOP/s ({gain:+.1%}); residual "
               f"{f['residual'] if f['residual'] is not None else 'MISSING'}"
               f" (gate {RESIDUAL_GATE})")
+    best_off = max((r for r in off if _clean(r)),
+                   key=lambda r: r["gflops"], default=None)
+    if best_off is not None and best_off["gflops"] > f["gflops"]:
+        diffs = " ".join(f"{k}={best_off[k]}" for k, v in
+                         BASELINE_CONFIG.items()
+                         if k != knob and best_off.get(k) != v)
+        detail += (f"; off-baseline context: {flipped} reached "
+                   f"{best_off['gflops']:.0f} GFLOP/s under {diffs} "
+                   "(cannot decide a default — consider a re-measure "
+                   "with that config as the new baseline)")
     if adopt:
         decision = "ADOPT"
     elif not res_ok:

@@ -257,3 +257,39 @@ def test_miniapps_auto_knob_resolution(capsys):
     assert "block_size=" not in out.split("_auto_ ")[1].splitlines()[0]
     assert [l for l in out.splitlines()
             if l.startswith("_result_")][0].rsplit(",", 2)[1] == "32"
+
+
+def test_auto_explicit_default_value_pins(capsys):
+    """A flag explicitly passed AT the library default value still pins
+    its knob — the table must not silently override it (ADVICE r4 #1:
+    sentinel None parser defaults distinguish un-passed from
+    passed-at-default)."""
+    out = run_cli(conflux_miniapp.main,
+                  ["-N", "128", "-b", "128", "-r", "1", "--auto"], capsys)
+    # table says 256, but -b 128 (the library default) was explicit
+    assert "block_size=" not in out.split("_auto_ ")[1].splitlines()[0]
+    assert [l for l in out.splitlines()
+            if l.startswith("_result_")][0].rsplit(",", 2)[1] == "128"
+
+
+def test_auto_without_flag_resolves_library_defaults(capsys):
+    """Without --auto, sentinel-None knobs resolve to library defaults
+    (the pre-sentinel behavior must be unchanged for plain runs)."""
+    out = run_cli(conflux_miniapp.main,
+                  ["-N", "128", "-r", "1"], capsys)
+    assert [l for l in out.splitlines()
+            if l.startswith("_result_")][0].rsplit(",", 2)[1] == "128"
+    assert "_auto_" not in out
+
+
+def test_qr_auto_empty_mode_reports_no_knobs(capsys):
+    """qr tall CholeskyQR2 mode has no auto-tunable knobs; --auto must
+    say so rather than print "(all knobs pinned)" (ADVICE r4 #4)."""
+    from conflux_tpu.cli import qr_miniapp
+
+    out = run_cli(qr_miniapp.main,
+                  ["-M", "256", "--cols", "64", "--algo", "cholesky",
+                   "-r", "1", "--auto"], capsys)
+    assert "_auto_ (no auto-tunable knobs for this mode)" in out
+    assert "(all knobs pinned)" not in out
+    assert "_auto_provenance_" not in out

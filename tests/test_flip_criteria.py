@@ -126,6 +126,65 @@ def test_dirty_flip_does_not_mask_clean_pair():
     assert o["flip"]["gflops"] == 11000.0
 
 
+def test_off_baseline_pair_cannot_decide():
+    """A flip winning only under some OTHER non-default knob (here
+    segs=32x16) must not flip the global default: the decisive pair is
+    restricted to the all-defaults baseline config (ADVICE r4 #2)."""
+    # flat gains +20% under segs=32x16 but only +1% on the baseline
+    log = LOG.replace("11000.0", "10605.0") + (
+        "algo=lu precision=highest chunk=8192 v=1024 segs=32x16 "
+        "tree=pairwise swap=xla update=segments: 10000.0 GFLOP/s\n"
+        "    residual=2.900e-05\n"
+        "algo=lu precision=highest chunk=8192 v=1024 segs=32x16 "
+        "tree=flat swap=xla update=segments: 12000.0 GFLOP/s\n"
+        "    residual=2.900e-05\n")
+    o = evaluate_flip(parse_log(log), "tree", "flat", "pairwise")
+    assert o["decision"].startswith("KEEP (gain below")
+    assert o["flip"]["gflops"] == 10605.0  # the baseline-config pair
+
+
+def test_dirty_baseline_does_not_block_adoption():
+    """BOTH pair sides prefer residual-clean records: a FAILED-residual
+    baseline timing (untrustworthy — DESIGN §14 saw corrupted runs time
+    fast) must not out-shout the clean baseline and mask a real
+    adoptable gain."""
+    log = LOG + (
+        "algo=lu precision=highest chunk=8192 v=1024 segs=lib "
+        "tree=pairwise swap=xla update=segments: 12000.0 GFLOP/s\n"
+        "    residual FAILED: wedge\n")
+    o = evaluate_flip(parse_log(log), "tree", "flat", "pairwise")
+    assert o["decision"] == "ADOPT"          # judged vs the clean 10500
+    assert o["base"]["gflops"] == 10500.0
+
+
+def test_off_baseline_win_is_surfaced_as_context():
+    """When an off-baseline flip row out-gains the decisive pair, the
+    detail line says so (a re-measure hint) — without deciding."""
+    log = LOG.replace("11000.0", "10605.0") + (
+        "algo=lu precision=highest chunk=8192 v=1024 segs=32x16 "
+        "tree=flat swap=xla update=segments: 12000.0 GFLOP/s\n"
+        "    residual=2.900e-05\n")
+    o = evaluate_flip(parse_log(log), "tree", "flat", "pairwise")
+    assert o["decision"].startswith("KEEP (gain below")
+    assert "off-baseline context" in o["detail"]
+    assert "segs=32x16" in o["detail"]
+
+
+def test_off_baseline_only_reports_no_data():
+    """With ONLY off-baseline flip rows, the criterion is NO-DATA (and
+    says the off-baseline rows exist), never an adoption."""
+    log = (
+        "algo=lu precision=highest chunk=8192 v=1024 segs=32x16 "
+        "tree=flat swap=xla update=segments: 12000.0 GFLOP/s\n"
+        "    residual=2.900e-05\n"
+        "algo=lu precision=highest chunk=8192 v=1024 segs=lib "
+        "tree=pairwise swap=xla update=segments: 10000.0 GFLOP/s\n"
+        "    residual=2.900e-05\n")
+    o = evaluate_flip(parse_log(log), "tree", "flat", "pairwise")
+    assert o["decision"] == "NO-DATA"
+    assert "off-baseline" in o["detail"]
+
+
 def test_headline_check(tmp_path, capsys):
     log = tmp_path / "rec.txt"
     log.write_text(LOG + '\n{"metric": "distributed LU N=32768 v=1024 '

@@ -1,0 +1,146 @@
+"""Independent-library oracle leg (VERDICT r4 item 6).
+
+The repo's usual validation path (`conflux_tpu.validation`) is
+self-built; the reference instead validates against a DIFFERENT
+library's code path — ScaLAPACK `pdgemm_` via COSTA transforms
+(`examples/conflux_miniapp.cpp:404-500`). This module is that leg for
+the TPU framework: the full distributed pipeline (scatter → factor →
+gather) at the largest CPU-feasible sizes, judged ONLY with
+numpy/scipy primitives computed in this file —
+
+  * factors are unpacked with plain numpy (no `validation.py` import),
+  * residuals are formed with plain numpy matmuls in float64,
+  * the quality bar is RELATIVE to scipy/LAPACK's own same-precision
+    factorization of the same matrix (ours must be within 10x of
+    scipy's residual — the independent library sets the bar, exactly
+    the spirit of the reference's pdgemm_ oracle),
+  * unique factors (Cholesky L; QR's positive-diagonal R) are compared
+    ELEMENTWISE against scipy's.
+"""
+
+import numpy as np
+import pytest
+import scipy.linalg
+import jax
+import jax.numpy as jnp
+
+from conflux_tpu.geometry import CholeskyGeometry, Grid3, LUGeometry
+from conflux_tpu.parallel.mesh import make_mesh
+
+GRID = Grid3(4, 2, 1)
+
+
+def _fro(x):
+    return float(np.linalg.norm(np.asarray(x, dtype=np.float64)))
+
+
+@pytest.mark.slow
+def test_lu_pipeline_vs_scipy_at_4096():
+    """scatter → lu_factor_distributed → gather at N=4096 f32 on an
+    8-device mesh, judged against scipy.linalg.lu_factor of the SAME
+    f32 matrix: our ||A[perm] - L U||_F (unpacked and multiplied here
+    with numpy, in f64) must be within 10x of scipy's."""
+    from conflux_tpu.lu.distributed import lu_factor_distributed
+
+    N, v = 4096, 256
+    rng = np.random.default_rng(4096)
+    A = rng.standard_normal((N, N)).astype(np.float32)
+    A += 2 * np.eye(N, dtype=np.float32)
+
+    geom = LUGeometry.create(N, N, v, GRID)
+    mesh = make_mesh(GRID, devices=jax.devices()[: GRID.P])
+    out, perm = lu_factor_distributed(jnp.asarray(geom.scatter(A)),
+                                      geom, mesh)
+    LU = geom.gather(np.asarray(out))
+    perm = np.asarray(perm)
+
+    # unpack + residual with numpy only (f64)
+    L = np.tril(LU, -1).astype(np.float64) + np.eye(N)
+    U = np.triu(LU).astype(np.float64)
+    ours = _fro(A.astype(np.float64)[perm] - L @ U) / _fro(A)
+
+    # scipy's own f32 factorization of the same matrix, same metric
+    slu, piv = scipy.linalg.lu_factor(A)
+    sperm = np.arange(N)
+    for i, p in enumerate(piv):
+        sperm[i], sperm[p] = sperm[p], sperm[i]
+    Ls = np.tril(slu, -1).astype(np.float64) + np.eye(N)
+    Us = np.triu(slu).astype(np.float64)
+    theirs = _fro(A.astype(np.float64)[sperm] - Ls @ Us) / _fro(A)
+
+    assert np.isfinite(ours)
+    assert ours <= 10 * theirs, (ours, theirs)
+
+
+@pytest.mark.slow
+def test_cholesky_pipeline_vs_scipy_at_4096():
+    """Cholesky's factor is UNIQUE (SPD, positive diagonal), so beyond
+    the 10x-residual bar the gathered L is compared elementwise against
+    scipy.linalg.cholesky of the same matrix in f64."""
+    from conflux_tpu.cholesky.distributed import cholesky_factor_distributed
+
+    N, v = 4096, 256
+    # the repo's SPD recipe reproduced inline (diagonally dominant —
+    # reconstructions are well-conditioned, so comparisons stay tight)
+    rng = np.random.default_rng(7)
+    B = rng.uniform(-1.0, 1.0, size=(N, N)).astype(np.float32)
+    S = (B + B.T) / 2
+    S[np.arange(N), np.arange(N)] += N
+
+    geom = CholeskyGeometry.create(N, v, GRID)
+    mesh = make_mesh(GRID, devices=jax.devices()[: GRID.P])
+    out = cholesky_factor_distributed(jnp.asarray(geom.scatter(S)),
+                                      geom, mesh)
+    L = np.tril(geom.gather(np.asarray(out))).astype(np.float64)
+    S64 = S.astype(np.float64)
+
+    ours = _fro(S64 - L @ L.T) / _fro(S64)
+    Ls = scipy.linalg.cholesky(S, lower=True).astype(np.float64)
+    theirs = _fro(S64 - Ls @ Ls.T) / _fro(S64)
+    assert np.isfinite(ours)
+    assert ours <= 10 * theirs, (ours, theirs)
+
+    # unique-factor elementwise check vs scipy's f64 factorization
+    Lref = scipy.linalg.cholesky(S64, lower=True)
+    rel = _fro(L - Lref) / _fro(Lref)
+    assert rel <= 1e-5, rel
+
+
+@pytest.mark.slow
+def test_qr_pipeline_vs_scipy_at_2048():
+    """Full block-cyclic QR at N=2048 f32: reconstruction within 10x of
+    scipy's same-precision QR, orthogonality judged with plain numpy,
+    and the positive-diagonal R (unique for full-rank A) compared
+    normwise against scipy's sign-normalized R."""
+    from conflux_tpu.qr.distributed import qr_factor_distributed, r_geometry
+
+    N, v = 2048, 256
+    rng = np.random.default_rng(2048)
+    A = rng.standard_normal((N, N)).astype(np.float32)
+
+    grid = Grid3(2, 2, 1)
+    geom = LUGeometry.create(N, N, v, grid)
+    mesh = make_mesh(grid, devices=jax.devices()[: grid.P])
+    Qd, Rd = qr_factor_distributed(jnp.asarray(geom.scatter(A)),
+                                   geom, mesh)
+    Q = geom.gather(np.asarray(Qd)).astype(np.float64)
+    R = np.triu(r_geometry(geom).gather(np.asarray(Rd))).astype(np.float64)
+    A64 = A.astype(np.float64)
+
+    ours = _fro(A64 - Q @ R) / _fro(A64)
+    Qs, Rs = scipy.linalg.qr(A)
+    theirs = _fro(A64 - Qs.astype(np.float64) @ Rs.astype(np.float64)) \
+        / _fro(A64)
+    assert np.isfinite(ours)
+    assert ours <= 10 * theirs, (ours, theirs)
+
+    orth = _fro(Q.T @ Q - np.eye(N)) / np.sqrt(N)
+    assert orth <= 1e-5, orth
+
+    s = np.sign(np.diag(Rs)).astype(np.float64)
+    s[s == 0] = 1.0
+    rel = _fro(R - Rs.astype(np.float64) * s[:, None]) / _fro(Rs)
+    # R's columnwise sensitivity scales with cond(A) (~1e3 for square
+    # gaussian at this size), so the factor bar is looser than the
+    # backward-error bars above
+    assert rel <= 5e-3, rel

@@ -109,6 +109,66 @@ def test_vmem_derived_ceilings_pin_v5e():
         blas.set_scoped_vmem_bytes(1000)
 
 
+@pytest.mark.parametrize("Px", [3, 5, 7])
+def test_butterfly_zero_fill_contract_real_reducers(Px):
+    """The odd-Px fold/unfold path makes EVERY rank reduce ppermute's
+    zero fill on off-subcube lanes; correctness rests on the reducers
+    being total on all-zero inputs with the garbage discarded by the
+    coordinate selects (zero-fill contract, `butterfly_allreduce`).
+    Pin it with the REAL hot-loop reducers — the CALU tournament
+    (lu/distributed.py) and the TSQR R-tree (qr/distributed.py) — at
+    odd Px: results must be NaN/Inf-free and bitwise-replicated across
+    the axis, and elected ids must come from real rows, never from the
+    zero fill."""
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import PartitionSpec as P
+
+    from conflux_tpu.geometry import Grid3
+    from conflux_tpu.ops import blas
+    from conflux_tpu.parallel.mesh import butterfly_allreduce, make_mesh
+    from conflux_tpu.qr.single import _tree_r
+
+    v = 4
+    mesh = make_mesh(Grid3(Px, 1, 1), devices=jax.devices()[:Px])
+    rng = np.random.default_rng(100 + Px)
+    data = rng.standard_normal((Px, v, v)).astype(np.float32)
+    ids = np.arange(Px * v, dtype=np.int32).reshape(Px, v)
+
+    def calu_pair(top, bot):
+        stack = jnp.concatenate([top[0], bot[0]], axis=0)
+        sid = jnp.concatenate([top[1], bot[1]])
+        lu00, wid = blas.tournament_winners(stack, chunk=2 * v)
+        return (jnp.take(stack, wid, axis=0, mode="fill", fill_value=0),
+                jnp.take(sid, wid, mode="fill",
+                         fill_value=np.iinfo(np.int32).max),
+                lu00)
+
+    def fn(blk, bid):
+        nom, nid, lu00 = butterfly_allreduce(
+            (blk[0], bid[0], jnp.zeros((v, v), jnp.float32)),
+            Px, "x", calu_pair)
+        (r,) = butterfly_allreduce(
+            (_tree_r(blk[0], 2 * v),), Px, "x",
+            lambda top, bot: (_tree_r(
+                jnp.concatenate([top[0], bot[0]], axis=0), 2 * v),))
+        return nom[None], nid[None], lu00[None], r[None]
+
+    nom, nid, lu00, r = jax.jit(jax.shard_map(
+        fn, mesh=mesh, in_specs=(P("x", None, None), P("x", None)),
+        out_specs=(P("x", None, None), P("x", None),
+                   P("x", None, None), P("x", None, None))))(data, ids)
+    nom, nid, lu00, r = map(np.asarray, (nom, nid, lu00, r))
+    for out in (nom, nid, lu00, r):
+        assert np.all(np.isfinite(out)), "zero-fill garbage leaked NaN/Inf"
+        for px in range(1, Px):  # bitwise replication across the axis
+            np.testing.assert_array_equal(out[px], out[0])
+    # every elected id is a real row, never the fold's zero-fill ids
+    assert set(nid[0].tolist()) <= set(range(Px * v))
+    flat = data.reshape(Px * v, v)
+    np.testing.assert_array_equal(nom[0], flat[nid[0]])
+
+
 @pytest.mark.parametrize("Px", [1, 2, 3, 4, 5, 6, 7, 8])
 def test_butterfly_allreduce_any_px(Px):
     """The hypercube all-reduce must deliver every rank's contribution to
