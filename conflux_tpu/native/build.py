@@ -25,6 +25,10 @@ def build(verbose: bool = True) -> str:
 
 
 if __name__ == "__main__":
+    # support direct-path invocation (python conflux_tpu/native/build.py)
+    # as well as the documented -m form
+    sys.path.insert(0, os.path.dirname(os.path.dirname(
+        os.path.dirname(os.path.abspath(__file__)))))
     path = build()
     from conflux_tpu import native
 

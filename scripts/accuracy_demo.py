@@ -33,7 +33,14 @@ def main() -> int:
     p.add_argument("--refine", type=int, nargs="+", default=[0, 2, 4])
     p.add_argument("--factor_dtype", default="float32",
                    choices=["float32", "bfloat16"])
+    p.add_argument("--platform", default=None, choices=["cpu"],
+                   help="force the CPU backend via jax.config (the env-var "
+                   "path blocks against a busy/wedged tunnel — ROUND4.md); "
+                   "the IR-convergence recipe is platform-independent even "
+                   "though absolute timings are not")
     args = p.parse_args()
+    if args.platform == "cpu":
+        jax.config.update("jax_platforms", "cpu")
 
     from conflux_tpu.geometry import Grid3, LUGeometry
     from conflux_tpu.lu.distributed import lu_factor_distributed

@@ -48,7 +48,8 @@ GAIN_BAR = 0.02
 # tree=flat winning only under segs=32x16), per ADVICE r4 #2.
 BASELINE_CONFIG = {"algo": "lu", "precision": "highest", "chunk": "8192",
                    "v": "1024", "segs": "lib", "tree": "pairwise",
-                   "update": "segments", "swap": "xla"}
+                   "update": "segments", "swap": "xla",
+                   "lookahead": "off"}
 
 
 def _on_baseline(rec: dict, knob: str) -> bool:
@@ -58,7 +59,8 @@ def _on_baseline(rec: dict, knob: str) -> bool:
 _LINE = re.compile(
     r"algo=(?P<algo>\w+) precision=(?P<precision>\w+) "
     r"chunk=(?P<chunk>\w+) v=(?P<v>\d+) segs=(?P<segs>[\w|x]+) "
-    r"tree=(?P<tree>\w+) (?:swap=(?P<swap>\w+) )?update=(?P<update>\w+): "
+    r"tree=(?P<tree>\w+) (?:swap=(?P<swap>\w+) )?"
+    r"(?:lookahead=(?P<lookahead>\w+) )?update=(?P<update>\w+): "
     r"(?P<gflops>[\d.]+) GFLOP/s")
 _RES = re.compile(r"residual=(?P<res>[\d.eE+-]+)")
 
@@ -75,6 +77,9 @@ def parse_log(text: str) -> list[dict]:
             # don't. Normalize so cross-era records still pair (the
             # only swap value a surviving record can mean is 'xla').
             d["swap"] = d["swap"] or "xla"
+            # pre-round-5 logs predate the lookahead token; the only
+            # value those lines can mean is the library default (off)
+            d["lookahead"] = d["lookahead"] or "off"
             d["gflops"] = float(d["gflops"])
             d["residual"] = None
             records.append(d)
@@ -197,6 +202,10 @@ def main(argv=None) -> int:
         evaluate_flip(records, "tree", "flat", "pairwise"),
         evaluate_flip(records, "update", "block", "segments"),
         evaluate_flip(records, "chunk", "12288", "8192"),
+        # round-5 criterion (VERDICT r4 item 8): lookahead stays off
+        # unless a single-chip A/B shows a real gain with a clean
+        # residual (the CPU mesh measured it +15% SLOWER on LU)
+        evaluate_flip(records, "lookahead", "on", "off"),
     ]
     for o in outcomes:
         print(f"criterion {o['knob']}: {o['decision']}")
@@ -229,13 +238,14 @@ def main(argv=None) -> int:
         # headline family); tree/update follow their criterion;
         # chunk=12288 is bench-local only (criterion 4) so the rule
         # keeps 8192, with the outcome recorded in the provenance.
-        tree_o, update_o, chunk_o = outcomes
+        tree_o, update_o, chunk_o, la_o = outcomes
         knobs = {"precision": best["precision"], "v": int(best["v"]),
                  "panel_chunk": 8192,
                  "tree": "flat" if tree_o["decision"] == "ADOPT"
                  else "pairwise",
                  "update": "block" if update_o["decision"] == "ADOPT"
-                 else "segments"}
+                 else "segments",
+                 "lookahead": la_o["decision"] == "ADOPT"}
         rules = [{
             "algo": "lu", "device": ["v5e", "v5 lite"], "P": 1,
             "n_lo": 8192, "n_hi": 32768, "dtype": "float32",

@@ -1,76 +1,89 @@
 #!/bin/bash
-# Round 3: wait for the (wedged-since-round-2) TPU tunnel to recover, then
-# run the queued measurements once, logging to data/benchmarks/.
-# Order = strict priority (a re-wedge mid-queue loses everything after it):
-#   1. headline bench (BENCH_r03's number MUST exist)
-#   2. election probe (the cost model that picks the election structure)
-#   3. LU election/segmentation A/B at scale (flat tree, segs variants)
-#   4. LU block-update A/B (one switch-selected suffix GEMM per step)
-#   5. the zero-hardware-data cores: cholesky 32k, qr 16k
-#   6. HPL-MxP end-to-end (bf16x3 + GMRES-IR)
-#   7. (removed round 4: DMA swap deleted unadopted — docs/ROUND4.md)
-#   8. chunk 12288/10240 trials LAST (the round-2 wedge began during the
-#      12288 trial; quarantine the risky configs behind everything else)
-# Probe = tiny reduction with a hard timeout; the tunnel wedge manifests
-# as an indefinite hang on the first device op (see bench._probe_device).
+# One-shot TPU measurement queue + recovery watcher.
+# Procedure: docs/CHIP_PLAYBOOK.md (bounded sentinel probe, go/no-go,
+# value-at-risk ordering, session-close decision steps). Round-5 queue.
+#
+# Order = strict priority (a re-wedge mid-queue loses everything after
+# it). Round-5 lessons encoded:
+#   - the election probe is REMOVED from the queue: it is slow (>40 min
+#     round 5 — any feasible timeout SIGTERMs it mid-device-program,
+#     the prime suspect for the 16:28Z re-wedge, same pattern as the
+#     round-2 wedge during a killed 12288 trial), and its cost-model
+#     data is secondary to the direct A/Bs. Run it manually with no
+#     timeout (and never kill it mid-program) if the cost model is
+#     wanted: python scripts/election_probe.py;
+#   - every DEVICE item passes a health gate first: after an item
+#     aborts on an unresponsive device, plowing on would burn each
+#     later item's full ~17-min probe cycle against a dead chip —
+#     instead the gate waits (5-min re-probes) until the chip answers,
+#     then runs the item;
+#   - apply_flip_criteria runs TWICE — once after the core measurements
+#     and once at the end — and both passes are UNGATED (pure log
+#     parsing, no device): a late wedge must never leave the session
+#     as logs-without-decisions.
 cd "$(dirname "$0")/.." || exit 1
-LOG=${RECOVERY_LOG:-data/benchmarks/round3-recovery.txt}
+LOG=${RECOVERY_LOG:-data/benchmarks/round5-recovery.txt}
 echo "watch start $(date -u +%FT%TZ)" >> "$LOG"
-while true; do
-  # the platform assert rejects a CPU-fallback backend: a fast plugin-init
-  # failure would otherwise count as "healthy" and burn the one-shot
-  # measurements against a dead device
-  if timeout -k 10 90 python -c "
+
+probe_ok() {
+  # the platform assert rejects a CPU-fallback backend: a fast
+  # plugin-init failure would otherwise count as "healthy" and burn the
+  # one-shot measurements against a dead device
+  timeout -k 10 90 python -c "
 import jax
 assert jax.devices()[0].platform != 'cpu', 'cpu fallback'
 print(float(jax.numpy.ones((8,)).sum()))
-" >/dev/null 2>&1; then
-    echo "chip healthy $(date -u +%FT%TZ)" >> "$LOG"
-    break
-  fi
-  echo "still wedged $(date -u +%FT%TZ)" >> "$LOG"
-  sleep 300
-done
-{
-  echo "=== bench.py (headline LU at-scale gate) $(date -u +%FT%TZ) ==="
-  timeout -k 10 3000 python bench.py 2>&1 | grep -v WARNING
-  echo "=== election probe (LU-call cost model) $(date -u +%FT%TZ) ==="
-  timeout -k 10 2400 python scripts/election_probe.py 2>&1 | grep -v WARNING
-  echo "=== LU flat-tree + segmentation A/B at N=32768 $(date -u +%FT%TZ) ==="
-  # the plain highest:8192:1024 row is the all-defaults baseline every
-  # flip criterion pairs against (flat tree here, block update in the
-  # next item) — it must run in the SAME session as its flips
-  timeout -k 10 4200 python scripts/tpu_tune.py -N 32768 --reps 2 \
-    --configs highest:8192:1024,highest:8192:1024:-:flat,highest:8192:1024:32x16,highest:8192:1024:8x8 \
-    2>&1 | grep -v WARNING
-  echo "=== LU block-update A/B at N=32768 $(date -u +%FT%TZ) ==="
-  timeout -k 10 3000 python scripts/tpu_tune.py -N 32768 --reps 2 \
-    --update block --configs highest:8192:1024,highest:8192:1024:-:flat \
-    2>&1 | grep -v WARNING
-  echo "=== cholesky N=32768 (triangle-skip at-scale gate) $(date -u +%FT%TZ) ==="
-  timeout -k 10 3000 python scripts/tpu_tune.py --algo cholesky -N 32768 \
-    --reps 2 --configs highest:0:1024,high:0:1024,highest:0:1024:16x16 \
-    2>&1 | grep -v WARNING
-  echo "=== qr N=16384 $(date -u +%FT%TZ) ==="
-  timeout -k 10 2400 python scripts/tpu_tune.py --algo qr -N 16384 \
-    --reps 2 --configs highest:0:1024 2>&1 | grep -v WARNING
-  echo "=== HPL-MxP end-to-end (bf16x3 factor + GMRES-IR to 1e-6) $(date -u +%FT%TZ) ==="
-  timeout -k 10 3000 python bench.py --mode mxp --ir gmres 2>&1 | grep -v WARNING
-  echo "=== (swap_probe step removed: the DMA swap kernel was deleted"
-  echo "    unadopted per criterion 3 when the chip never recovered —"
-  echo "    docs/ROUND4.md) ==="
-  echo "=== tune LU taller nomination chunks (LAST: the round-2 wedge "
-  echo "    started during the 12288 trial — quarantine the risky configs"
-  echo "    behind everything else) $(date -u +%FT%TZ) ==="
-  # highest:8192:1024 rides along as the all-defaults baseline the
-  # chunk flip criterion pairs against (every other 8192 run in the
-  # queue varies some other knob, which would leave the criterion
-  # structurally NO-DATA)
-  timeout -k 10 2400 python scripts/tpu_tune.py -N 32768 --reps 2 \
-    --configs highest:8192:1024,highest:12288:1024,highest:10240:1024 \
-    2>&1 | grep -v WARNING
-  echo "=== apply pre-decided flip criteria (docs/ROUND3.md) $(date -u +%FT%TZ) ==="
-  timeout -k 10 120 python scripts/apply_flip_criteria.py "$LOG" \
-    --emit-rules data/tune_table_r4.json 2>&1 | grep -v WARNING
-  echo "=== done $(date -u +%FT%TZ) ==="
-} >> "$LOG" 2>&1
+" >/dev/null 2>&1
+}
+
+wait_healthy() {
+  until probe_ok; do
+    echo "still wedged $(date -u +%FT%TZ)" >> "$LOG"
+    sleep 300
+  done
+  echo "chip healthy $(date -u +%FT%TZ)" >> "$LOG"
+}
+
+item() {  # item <timeout_s> <label> <cmd...>
+  local t=$1 label=$2; shift 2
+  wait_healthy
+  {
+    echo "=== $label $(date -u +%FT%TZ) ==="
+    timeout -k 10 "$t" "$@" 2>&1 | grep -v WARNING
+  } >> "$LOG" 2>&1
+}
+
+item 3000 "bench.py (headline LU at-scale gate)" python bench.py
+# the plain highest:8192:1024 row is the all-defaults baseline every
+# flip criterion pairs against (flat tree here, block update and
+# lookahead below) — it must run in the SAME session as its flips
+item 4200 "LU flat-tree + segmentation A/B at N=32768" \
+  python scripts/tpu_tune.py -N 32768 --reps 2 \
+  --configs highest:8192:1024,highest:8192:1024:-:flat,highest:8192:1024:32x16,highest:8192:1024:8x8
+item 3000 "LU block-update A/B at N=32768" \
+  python scripts/tpu_tune.py -N 32768 --reps 2 --update block \
+  --configs highest:8192:1024,highest:8192:1024:-:flat
+item 3000 "LU lookahead A/B at N=32768 (single-chip leg of P8)" \
+  python scripts/tpu_tune.py -N 32768 --reps 2 --lookahead \
+  --configs highest:8192:1024
+item 3000 "cholesky N=32768 (triangle-skip at-scale gate)" \
+  python scripts/tpu_tune.py --algo cholesky -N 32768 --reps 2 \
+  --configs highest:0:1024,high:0:1024,highest:0:1024:16x16
+item 2400 "qr N=16384" \
+  python scripts/tpu_tune.py --algo qr -N 16384 --reps 2 \
+  --configs highest:0:1024
+item 3000 "HPL-MxP end-to-end (bf16x3 factor + GMRES-IR to 1e-6)" \
+  python bench.py --mode mxp --ir gmres
+apply_pass() {  # apply_pass <label> — UNGATED: pure log parsing, no device
+  {
+    echo "=== apply pre-decided flip criteria, $1 $(date -u +%FT%TZ) ==="
+    timeout -k 10 120 python scripts/apply_flip_criteria.py "$LOG" \
+      --emit-rules data/tune_table_r5.json 2>&1 | grep -v WARNING
+  } >> "$LOG" 2>&1
+}
+apply_pass "pass 1 (core data)"
+item 2400 "tune LU taller nomination chunks (QUARANTINED LAST: the round-2 wedge began during a 12288 trial)" \
+  python scripts/tpu_tune.py -N 32768 --reps 2 \
+  --configs highest:8192:1024,highest:12288:1024,highest:10240:1024
+apply_pass "final (full log)"
+echo "=== done $(date -u +%FT%TZ) ===" >> "$LOG"

@@ -16,6 +16,14 @@ Each measurement reports ms/iteration; the loop carries a data dependence
 calls. Writes one line per config; run on a healthy chip:
 
     python scripts/election_probe.py [--reps 8]
+
+OPERATIONAL WARNING (round 5): the full matrix took >40 min through the
+tunnel, and killing this probe mid-device-program (e.g. a wrapping
+`timeout`) is the prime suspect for the round-5 re-wedge at 16:28Z —
+the same killed-client pattern as the round-2 wedge. It was removed
+from the watcher queue for exactly that reason (CHIP_PLAYBOOK.md): run
+it manually, with NO timeout, only when nothing else needs the chip,
+and let it finish.
 """
 
 from __future__ import annotations

@@ -92,6 +92,11 @@ def main() -> None:
                     help="LU trailing-update partitioning: cond'd segment "
                     "lattice vs one switch-selected live-suffix block "
                     "(applies to every LU config in this invocation)")
+    ap.add_argument("--lookahead", action="store_true",
+                    help="software-pipelined loop (P8): overlap the next "
+                    "panel's election/reduce with the trailing update "
+                    "(applies to every config in this invocation; all "
+                    "three cores)")
     ap.add_argument("--configs", default=None,
                     help="comma list precision:chunk:v[:RxC[:tree]], "
                     "e.g. highest:8192:1024,highest:8192:1024:16x16:flat "
@@ -221,7 +226,8 @@ def main() -> None:
                     return lu_factor_distributed(
                         s, geom, mesh, precision=prec[pname],
                         panel_chunk=chunk, donate=True, tree=tree,
-                        update=args.update, **seg_kw)
+                        update=args.update, lookahead=args.lookahead,
+                        **seg_kw)
 
                 def make(geom=geom):
                     # bench's generator, not a copy: the residual oracle
@@ -248,7 +254,8 @@ def main() -> None:
                     # are not comparable across cores
                     return cholesky_factor_distributed(
                         s, geom, mesh, precision=prec[pname],
-                        donate=True, **seg_kw), None
+                        donate=True, lookahead=args.lookahead,
+                        **seg_kw), None
 
                 def make(geom=geom):
                     return jax.device_put(_spd_n(geom.N), sharding)
@@ -265,7 +272,7 @@ def main() -> None:
                 def factor(s, geom=geom, pname=pname, seg_kw=seg_kw):
                     return qr_factor_distributed(
                         s, geom, mesh, precision=prec[pname], donate=True,
-                        **seg_kw)
+                        lookahead=args.lookahead, **seg_kw)
 
                 def make(geom=geom):
                     return jax.device_put(bench_mod._make_n(geom.M), sharding)
@@ -286,7 +293,9 @@ def main() -> None:
                 times.append(time.time() - t0)
             dim = geom.N if args.algo == "cholesky" else geom.M
             gflops = flop_coeff * dim**3 / (sum(times) / len(times)) / 1e9
+            la = "on" if args.lookahead else "off"
             print(f"{cfg_lbl} segs={seg_lbl} tree={tree} "
+                  f"lookahead={la} "
                   f"update={args.update}: {gflops:.1f} GFLOP/s", flush=True)
             try:  # residual separately: never discard a good timing
                 res = residual(out, aux)

@@ -185,6 +185,30 @@ def test_off_baseline_only_reports_no_data():
     assert "off-baseline" in o["detail"]
 
 
+def test_lookahead_token_and_criterion():
+    """Round-5 lines carry a lookahead=on|off token (older logs parse as
+    'off'); the lookahead criterion decides on the all-defaults pair
+    like any other knob and the emitted rule encodes the decision."""
+    log = LOG + (
+        "algo=lu precision=highest chunk=8192 v=1024 segs=lib "
+        "tree=pairwise lookahead=on update=segments: 10400.0 GFLOP/s\n"
+        "    residual=2.900e-05\n")
+    recs = parse_log(log)
+    assert all(r["lookahead"] == "off" for r in recs[:4])  # legacy lines
+    assert recs[4]["lookahead"] == "on"
+    o = evaluate_flip(recs, "lookahead", "on", "off")
+    assert o["decision"].startswith("KEEP (gain below")  # 10400 < 10500*1.02
+
+
+def test_emit_rules_lookahead_knob(tmp_path, capsys):
+    log = tmp_path / "rec.txt"
+    log.write_text(LOG)
+    rules = tmp_path / "rules.json"
+    assert main([str(log), "--emit-rules", str(rules)]) == 0
+    data = json.loads(rules.read_text())
+    assert data[0]["knobs"]["lookahead"] is False  # NO-DATA -> stays off
+
+
 def test_headline_check(tmp_path, capsys):
     log = tmp_path / "rec.txt"
     log.write_text(LOG + '\n{"metric": "distributed LU N=32768 v=1024 '
