@@ -66,6 +66,19 @@ def __getattr__(name):
             "conflux_tpu.qr.distributed", "cholesky_qr2_distributed"),
         "qr_distributed_host": (
             "conflux_tpu.qr.distributed", "qr_distributed_host"),
+        # serving / batched layer (ISSUE 1)
+        "lu_factor_batched": ("conflux_tpu.batched", "lu_factor_batched"),
+        "cholesky_factor_batched": (
+            "conflux_tpu.batched", "cholesky_factor_batched"),
+        "lu_solve_batched": ("conflux_tpu.batched", "lu_solve_batched"),
+        "cholesky_solve_batched": (
+            "conflux_tpu.batched", "cholesky_solve_batched"),
+        "solve_batched": ("conflux_tpu.batched", "solve_batched"),
+        "batch_mesh": ("conflux_tpu.batched", "batch_mesh"),
+        "FactorPlan": ("conflux_tpu.serve", "FactorPlan"),
+        "SolveSession": ("conflux_tpu.serve", "SolveSession"),
+        "enable_persistent_cache": (
+            "conflux_tpu.cache", "enable_persistent_cache"),
     }
     if name in _lazy:
         import importlib
@@ -114,4 +127,13 @@ __all__ = [
     "qr_factor_steps",
     "cholesky_qr2_distributed",
     "qr_distributed_host",
+    "lu_factor_batched",
+    "cholesky_factor_batched",
+    "lu_solve_batched",
+    "cholesky_solve_batched",
+    "solve_batched",
+    "batch_mesh",
+    "FactorPlan",
+    "SolveSession",
+    "enable_persistent_cache",
 ]

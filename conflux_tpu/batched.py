@@ -1,0 +1,312 @@
+"""Batched factorization/solve: many independent systems, one program.
+
+The serving workload (ROADMAP north star) is *many medium problems at high
+throughput*, not one giant factorization: requests arrive as batches of
+same-shape (N, N) systems. Factoring them one `solvers.solve` call at a
+time serializes B factorizations on one device and pays per-call dispatch
+overhead B times. Here the blocked single-device paths (`lu/single.py`,
+`cholesky/single.py`, the `solvers` substitutions) are `vmap`-ed over a
+leading batch axis and the batch is sharded across the mesh as data
+parallelism — B/P whole problems per device, ONE compiled program for the
+whole fleet. All underlying tile kernels (`lax.linalg.lu`, `cholesky`,
+`triangular_solve`, the masked gathers/scatters) carry batching rules, so
+the vmap costs no generality; the XLA partitioner never communicates
+because the batch axis is the only sharded axis.
+
+Ragged batches are handled by padding: when B is not a multiple of the
+mesh size the batch is padded with copies of element 0 (well-conditioned
+by construction — identity padding would be equally valid but a copy
+reuses an array we already hold) and the results sliced back.
+
+The plan/session layer (`conflux_tpu.serve`) builds on these entry points
+and adds program caching + device-resident factors; use these directly for
+one-shot batched calls.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from conflux_tpu.ops import blas
+from conflux_tpu.parallel.mesh import lookup_mesh, mesh_cache_key
+
+AXIS_B = "b"  # the data-parallel batch axis of `batch_mesh`
+
+
+def batch_mesh(devices=None) -> jax.sharding.Mesh:
+    """A flat 1D mesh over all (or the given) devices, axis name 'b'.
+
+    The serving counterpart of `make_mesh`: factorization model
+    parallelism uses the ('x', 'y', 'z') grid; batched serving shards
+    independent problems over one axis instead.
+    """
+    devs = np.asarray(jax.devices() if devices is None else devices)
+    return jax.sharding.Mesh(devs.reshape(-1), (AXIS_B,))
+
+
+def _batch_spec(mesh, ndim: int):
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    return NamedSharding(mesh, P(AXIS_B, *([None] * (ndim - 1))))
+
+
+def _shard_batch(arrs, mesh):
+    """Reshard (B, ...) arrays onto the batch mesh (device_put reshards
+    committed arrays; jit's in_shardings would reject them instead)."""
+    if mesh is None:
+        return arrs
+    return tuple(
+        jax.device_put(a, _batch_spec(mesh, a.ndim)) for a in arrs)
+
+
+def _pad_batch(arrs, B: int, nshards: int):
+    """Pad every (B, ...) array to the next multiple of nshards with
+    copies of its element 0; returns (padded_arrs, Bp)."""
+    Bp = nshards * (-(-B // nshards))
+    if Bp == B:
+        return arrs, B
+    out = []
+    for a in arrs:
+        fill = jnp.broadcast_to(a[:1], (Bp - B,) + a.shape[1:])
+        out.append(jnp.concatenate([a, fill], axis=0))
+    return out, Bp
+
+
+def _check_batched_square(A, what: str = "A") -> None:
+    if A.ndim != 3 or A.shape[1] != A.shape[2]:
+        raise ValueError(
+            f"{what} must be a (B, N, N) batch of square systems, got "
+            f"{A.shape}")
+
+
+def _rhs_3d(b, B: int, N: int):
+    """Normalize a batched rhs to (B, N, k); returns (b3, squeeze)."""
+    b = jnp.asarray(b)
+    if b.ndim == 2:
+        if b.shape != (B, N):
+            raise ValueError(f"rhs {b.shape} does not match batch ({B}, {N})")
+        return b[:, :, None], True
+    if b.ndim == 3:
+        if b.shape[:2] != (B, N):
+            raise ValueError(
+                f"rhs {b.shape} does not match batch ({B}, {N}, k)")
+        return b, False
+    raise ValueError(f"rhs must be (B, N) or (B, N, k), got {b.shape}")
+
+
+# --------------------------------------------------------------------------- #
+# Compiled-program builders (one per shape/config, shared by all callers)
+# --------------------------------------------------------------------------- #
+
+
+@functools.lru_cache(maxsize=32)
+def _build_lu_factor(B: int, M: int, N: int, dtype_name: str, v: int,
+                     precision, backend: str, panel_algo: str, mesh_key):
+    from conflux_tpu.lu.single import _lu_factor_blocked
+
+    fn = jax.vmap(
+        lambda A: _lu_factor_blocked(A, v, precision, backend, panel_algo))
+    if mesh_key is None:
+        return jax.jit(fn)
+    mesh = lookup_mesh(mesh_key)
+    return jax.jit(
+        fn, out_shardings=(_batch_spec(mesh, 3), _batch_spec(mesh, 2)))
+
+
+@functools.lru_cache(maxsize=32)
+def _build_cholesky_factor(B: int, N: int, dtype_name: str, v: int,
+                           precision, backend: str, mesh_key):
+    from conflux_tpu.cholesky.single import _cholesky_blocked
+
+    fn = jax.vmap(lambda A: _cholesky_blocked(A, v, precision, backend))
+    if mesh_key is None:
+        return jax.jit(fn)
+    mesh = lookup_mesh(mesh_key)
+    return jax.jit(fn, out_shardings=_batch_spec(mesh, 3))
+
+
+@functools.lru_cache(maxsize=32)
+def _build_lu_solve(B: int, N: int, k: int, dtype_name: str, mesh_key):
+    from conflux_tpu.solvers import lu_solve
+
+    fn = jax.vmap(lu_solve)
+    if mesh_key is None:
+        return jax.jit(fn)
+    mesh = lookup_mesh(mesh_key)
+    return jax.jit(fn, out_shardings=_batch_spec(mesh, 3))
+
+
+@functools.lru_cache(maxsize=32)
+def _build_cholesky_solve(B: int, N: int, k: int, dtype_name: str, mesh_key):
+    from conflux_tpu.solvers import cholesky_solve
+
+    fn = jax.vmap(cholesky_solve)
+    if mesh_key is None:
+        return jax.jit(fn)
+    mesh = lookup_mesh(mesh_key)
+    return jax.jit(fn, out_shardings=_batch_spec(mesh, 3))
+
+
+def _resolve(precision, backend):
+    precision = blas.matmul_precision() if precision is None else precision
+    backend = blas.get_backend() if backend is None else backend
+    return precision, backend
+
+
+def _mesh_key(mesh):
+    return None if mesh is None else mesh_cache_key(mesh)
+
+
+# --------------------------------------------------------------------------- #
+# Public batched entry points
+# --------------------------------------------------------------------------- #
+
+
+def lu_factor_batched(A, v: int, *, mesh=None, precision=None,
+                      backend: str | None = None):
+    """Pivoted LU of a (B, N, N) batch: returns (LU (B, N, N), perm (B, N))
+    with A[i][perm[i]] == L_i @ U_i (the `lu_factor_blocked` contract per
+    element). With a `batch_mesh`, the batch is sharded over its devices."""
+    A = jnp.asarray(A)
+    _check_batched_square(A)
+    B, N = A.shape[0], A.shape[1]
+    if N % v:
+        raise ValueError(f"N={N} not a multiple of tile size v={v}")
+    precision, backend = _resolve(precision, backend)
+    key = _mesh_key(mesh)
+    nsh = 1 if mesh is None else mesh.devices.size
+    (Ap,), Bp = _pad_batch((A,), B, nsh)
+    (Ap,) = _shard_batch((Ap,), mesh)
+    fn = _build_lu_factor(Bp, N, N, A.dtype.name, v, precision, backend,
+                          blas.get_panel_algo(), key)
+    LU, perm = fn(Ap)
+    return LU[:B], perm[:B]
+
+
+def cholesky_factor_batched(A, v: int, *, mesh=None, precision=None,
+                            backend: str | None = None):
+    """Lower Cholesky factors of a (B, N, N) SPD batch: returns L
+    (B, N, N), strictly-upper parts zeroed."""
+    A = jnp.asarray(A)
+    _check_batched_square(A)
+    B, N = A.shape[0], A.shape[1]
+    if N % v:
+        raise ValueError(f"N={N} not a multiple of tile size v={v}")
+    precision, backend = _resolve(precision, backend)
+    key = _mesh_key(mesh)
+    nsh = 1 if mesh is None else mesh.devices.size
+    (Ap,), Bp = _pad_batch((A,), B, nsh)
+    (Ap,) = _shard_batch((Ap,), mesh)
+    fn = _build_cholesky_factor(Bp, N, A.dtype.name, v, precision, backend,
+                                key)
+    return fn(Ap)[:B]
+
+
+def lu_solve_batched(LU, perm, b, *, mesh=None):
+    """Batched substitution through packed LU factors: b is (B, N) or
+    (B, N, k); returns x of b's shape."""
+    LU = jnp.asarray(LU)
+    _check_batched_square(LU, "LU")
+    B, N = LU.shape[0], LU.shape[1]
+    b3, squeeze = _rhs_3d(b, B, N)
+    key = _mesh_key(mesh)
+    nsh = 1 if mesh is None else mesh.devices.size
+    (LUp, permp, bp), Bp = _pad_batch(
+        (LU, jnp.asarray(perm), b3), B, nsh)
+    LUp, permp, bp = _shard_batch((LUp, permp, bp), mesh)
+    fn = _build_lu_solve(Bp, N, b3.shape[2], LU.dtype.name, key)
+    x = fn(LUp, permp, bp)[:B]
+    return x[:, :, 0] if squeeze else x
+
+
+def cholesky_solve_batched(L, b, *, mesh=None):
+    """Batched substitution through lower Cholesky factors."""
+    L = jnp.asarray(L)
+    _check_batched_square(L, "L")
+    B, N = L.shape[0], L.shape[1]
+    b3, squeeze = _rhs_3d(b, B, N)
+    key = _mesh_key(mesh)
+    nsh = 1 if mesh is None else mesh.devices.size
+    (Lp, bp), Bp = _pad_batch((L, b3), B, nsh)
+    Lp, bp = _shard_batch((Lp, bp), mesh)
+    fn = _build_cholesky_solve(Bp, N, b3.shape[2], L.dtype.name, key)
+    x = fn(Lp, bp)[:B]
+    return x[:, :, 0] if squeeze else x
+
+
+@functools.lru_cache(maxsize=32)
+def _build_solve(B: int, N: int, k: int, dtype_name: str,
+                 fdtype_name: str, v: int, refine: int, spd: bool,
+                 precision, backend: str, panel_algo: str, mesh_key):
+    """One compiled program for the whole batched pipeline: factor (in the
+    factor dtype) + substitution + `refine` classic-IR sweeps, vmapped and
+    batch-sharded. Keeping factor and solve in a single program lets XLA
+    fuse the dtype casts and skip materializing intermediates the solve
+    does not need."""
+    from conflux_tpu.cholesky.single import _cholesky_blocked
+    from conflux_tpu.lu.single import _lu_factor_blocked
+    from conflux_tpu.solvers import cholesky_solve, lu_solve
+
+    fdtype = jnp.dtype(fdtype_name)
+
+    def one(A, b2):
+        Af = A.astype(fdtype)
+        if spd:
+            L = _cholesky_blocked(Af, v, precision, backend)
+            solve_corr = lambda r: cholesky_solve(L, r)
+        else:
+            LUf, perm = _lu_factor_blocked(Af, v, precision, backend,
+                                           panel_algo)
+            solve_corr = lambda r: lu_solve(LUf, perm, r)
+        cdtype = blas.compute_dtype(A.dtype)
+        Ac = A.astype(cdtype)
+        bc = b2.astype(cdtype)
+        x = solve_corr(b2).astype(cdtype)
+        for _ in range(refine):
+            r = bc - jnp.matmul(Ac, x, precision=lax.Precision.HIGHEST)
+            x = x + solve_corr(r).astype(cdtype)
+        return x
+
+    fn = jax.vmap(one)
+    if mesh_key is None:
+        return jax.jit(fn)
+    mesh = lookup_mesh(mesh_key)
+    return jax.jit(fn, out_shardings=_batch_spec(mesh, 3))
+
+
+def solve_batched(A, b, *, v: int = 256, factor_dtype=None, refine: int = 0,
+                  spd: bool = False, mesh=None, precision=None,
+                  backend: str | None = None):
+    """Solve B independent systems A[i] x[i] = b[i] in one program.
+
+    The batched counterpart of `solvers.solve` (same `factor_dtype` /
+    `refine` HPL-MxP recipe, same `spd` Cholesky switch): A is (B, N, N),
+    b is (B, N) or (B, N, k); returns x of b's shape. With a `batch_mesh`
+    the batch rides data-parallel across its devices.
+    """
+    A = jnp.asarray(A)
+    _check_batched_square(A)
+    B, N = A.shape[0], A.shape[1]
+    v = min(v, N)
+    if N % v:
+        raise ValueError(
+            f"N={N} not a multiple of tile size v={v}; pre-pad the batch "
+            "with an identity extension (cf. solvers.solve)")
+    b3, squeeze = _rhs_3d(b, B, N)
+    fdtype = A.dtype if factor_dtype is None else jnp.dtype(factor_dtype)
+    precision, backend = _resolve(precision, backend)
+    key = _mesh_key(mesh)
+    nsh = 1 if mesh is None else mesh.devices.size
+    (Ap, bp), Bp = _pad_batch((A, b3), B, nsh)
+    Ap, bp = _shard_batch((Ap, bp), mesh)
+    fn = _build_solve(Bp, N, b3.shape[2], A.dtype.name, fdtype.name, v,
+                      refine, spd, precision, backend,
+                      blas.get_panel_algo(), key)
+    x = fn(Ap, bp)[:B]
+    return x[:, :, 0] if squeeze else x

@@ -1,0 +1,74 @@
+"""Persistent XLA compilation cache, promoted from bench.py into the library.
+
+The at-scale programs cost minutes of compile each (the N=32768 LU is
+4-6 min per config) and every process historically re-paid that cost:
+bench.py carried a private `_enable_compile_cache` while the CLIs, the
+serve layer, and the tuning scripts compiled from scratch. This module is
+the single switch-on point: the serve path (`conflux_tpu.serve`) and the
+miniapp CLIs call :func:`enable_persistent_cache` at startup so cold-start
+compiles amortize across processes — a second process hitting the same
+(geometry, knobs) config deserializes the executable in seconds.
+
+The cache location resolves, in order:
+
+1. an explicit `path=` argument,
+2. `$CONFLUX_TPU_CACHE_DIR`,
+3. `~/.cache/conflux_tpu/xla` (created on demand).
+
+Enabling is idempotent and *guarded*: on a backend/jax combination without
+persistent-cache support the call degrades to a no-op instead of raising —
+a missing cache only costs compile time, never correctness.
+"""
+
+from __future__ import annotations
+
+import os
+
+_ENABLED_AT: str | None = None
+
+
+def default_cache_dir() -> str:
+    """The resolved default cache directory (no filesystem side effects)."""
+    env = os.environ.get("CONFLUX_TPU_CACHE_DIR")
+    if env:
+        return env
+    return os.path.join(os.path.expanduser("~"), ".cache", "conflux_tpu",
+                        "xla")
+
+
+def enable_persistent_cache(path: str | None = None, *,
+                            min_compile_secs: float = 10.0) -> str | None:
+    """Point jax's persistent compilation cache at a durable directory.
+
+    `min_compile_secs` filters trivial programs out of the cache (the
+    default 10 s keeps every at-scale factorization but skips the
+    sub-second host utilities); the min-entry-size filter is zeroed so the
+    time threshold is the only admission rule — bench.py measured small
+    serialized executables for multi-minute compiles, and the byte filter
+    silently dropped them.
+
+    Returns the cache directory actually enabled, or None when the
+    environment does not support it. Safe to call many times (first call
+    wins; later calls with a different path are ignored rather than
+    re-pointing a live cache).
+    """
+    global _ENABLED_AT
+    if _ENABLED_AT is not None:
+        return _ENABLED_AT
+    cache = path or default_cache_dir()
+    try:
+        import jax
+
+        os.makedirs(cache, exist_ok=True)
+        jax.config.update("jax_compilation_cache_dir", cache)
+        jax.config.update("jax_persistent_cache_min_compile_time_secs",
+                          min_compile_secs)
+        jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)
+    except Exception:
+        return None
+    _ENABLED_AT = cache
+    return cache
+
+
+def cache_enabled() -> bool:
+    return _ENABLED_AT is not None

@@ -41,6 +41,7 @@ from conflux_tpu.parallel.mesh import (
     lookup_mesh,
     make_mesh,
     mesh_cache_key,
+    shard_map,
 )
 
 
@@ -278,7 +279,7 @@ def _build(geom: CholeskyGeometry, mesh_key, precision, backend: str,
         in_specs, out_specs = (shard_spec, P(), P()), shard_spec
     else:
         in_specs, out_specs = shard_spec, shard_spec
-    fn = jax.shard_map(device_fn, mesh=mesh, in_specs=in_specs,
+    fn = shard_map(device_fn, mesh=mesh, in_specs=in_specs,
                        out_specs=out_specs)
     return jax.jit(fn, donate_argnums=(0,) if donate else ())
 

@@ -55,6 +55,11 @@ def segs_arg(text: str) -> tuple[int, int]:
 
 def setup_platform(args) -> None:
     """Must run before any JAX backend initializes."""
+    from conflux_tpu import cache
+
+    # persistent XLA compile cache (conflux_tpu.cache): at-scale programs
+    # cost minutes of compile; every CLI process shares the warmed cache
+    cache.enable_persistent_cache()
     if args.platform == "cpu":
         import os
 
@@ -202,6 +207,13 @@ def apply_auto(args, algo: str, N: int, P: int, dtype: str,
     rec = autotune.recommended(algo, N, P=P, dtype=str(dtype))
 
     def fmt(v):
+        # one token vocabulary for sweep parsers: tuples in the RxC
+        # grammar, bools as on/off (the tune-log grammar and
+        # apply_flip_criteria vocabulary — a Python bool repr here would
+        # hand parsers a second spelling of the same knob state; bool
+        # check first, bool is an int subclass)
+        if isinstance(v, bool):
+            return "on" if v else "off"
         return "x".join(map(str, v)) if isinstance(v, tuple) else v
 
     applied = []

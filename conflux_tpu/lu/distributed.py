@@ -64,6 +64,8 @@ from conflux_tpu.parallel.mesh import (
     lookup_mesh,
     make_mesh,
     mesh_cache_key,
+    pvary,
+    shard_map,
 )
 
 _GRI_SENTINEL = np.iinfo(np.int32).max
@@ -368,9 +370,9 @@ def _build(geom: LUGeometry, mesh_key, precision, backend: str,
                     pieces.append(lax.cond(
                         seg_c_live(chi),
                         lambda p: blas.trsm_left_lower_unit(L00, p),
-                        # pcast matches the solve branch's varying axes
+                        # pvary matches the solve branch's varying axes
                         # (L00 varies over x) for the cond output type
-                        lambda p: lax.pcast(p, AXIS_X, to="varying"),
+                        lambda p: pvary(p, (AXIS_X,)),
                         Prows_c[:, clo:chi],
                     ))
                 U01 = (jnp.concatenate(pieces, axis=1)
@@ -587,7 +589,7 @@ def _build(geom: LUGeometry, mesh_key, precision, backend: str,
         out_specs = (shard_spec, P(AXIS_X, None), P())
     else:
         in_specs, out_specs = shard_spec, (shard_spec, P())
-    fn = jax.shard_map(device_fn, mesh=mesh, in_specs=in_specs,
+    fn = shard_map(device_fn, mesh=mesh, in_specs=in_specs,
                        out_specs=out_specs)
     return jax.jit(fn, donate_argnums=(0,) if donate else ())
 

@@ -47,6 +47,8 @@ from conflux_tpu.parallel.mesh import (
     lookup_mesh,
     make_mesh,
     mesh_cache_key,
+    pvary,
+    shard_map,
     replicate,
 )
 from conflux_tpu.qr.single import _positive_diag, _tree_r
@@ -120,7 +122,7 @@ def _build(mesh_key, algo: str, shape, dtype_name: str, chunk: int,
         R = replicate(R, tuple(mesh.axis_names))
         return Q.astype(dtype)[None], R.astype(dtype)
 
-    fn = jax.shard_map(device_fn, mesh=mesh,
+    fn = shard_map(device_fn, mesh=mesh,
                        in_specs=P(AXIS_X, None, None),
                        out_specs=(P(AXIS_X, None, None), P()))
     return jax.jit(fn)
@@ -273,9 +275,8 @@ def _build_full(geom, mesh_key, precision, backend: str, chunk: int,
     def _vary(val):
         # mark a literal as varying over every mesh axis so lax.cond
         # branch output types match the mask-dependent compute branches
-        for ax in (AXIS_X, AXIS_Y, AXIS_Z):
-            val = lax.pcast(val, ax, to="varying")
-        return val
+        # (identity on old jax, where check_rep handles this — see pvary)
+        return pvary(val, (AXIS_X, AXIS_Y, AXIS_Z))
 
     def device_fn(blk, rblk=None, k0=0, k_end=n_steps):
         x = lax.axis_index(AXIS_X)
@@ -340,7 +341,7 @@ def _build_full(geom, mesh_key, precision, backend: str, chunk: int,
                         jnp.where(m[:, None],
                                   a.conj().T.astype(cdtype), 0.0),
                         P_, precision=prec),
-                    # pcast matches the compute branch's varying
+                    # pvary matches the compute branch's varying
                     # axes (a: x/z, m: y) for the cond output type
                     lambda a, m: _vary(jnp.zeros((a.shape[1], v),
                                                  cdtype)),
@@ -548,7 +549,7 @@ def _build_full(geom, mesh_key, precision, backend: str, chunk: int,
         in_specs = (shard_spec, shard_spec, P(), P())
     else:
         in_specs = shard_spec
-    fn = jax.shard_map(device_fn, mesh=mesh, in_specs=in_specs,
+    fn = shard_map(device_fn, mesh=mesh, in_specs=in_specs,
                        out_specs=(shard_spec, shard_spec))
     # resumable mode donates the O(N^2) R state too — unlike LU's O(M)
     # orig map, holding input and output R simultaneously is matrix-sized

@@ -25,7 +25,7 @@ import jax.numpy as jnp
 from jax import lax
 
 from conflux_tpu.ops import blas
-from conflux_tpu.parallel.mesh import mesh_cache_key
+from conflux_tpu.parallel.mesh import mesh_cache_key, shard_map
 
 
 def _as_2d(b: jax.Array) -> tuple[jax.Array, bool]:
@@ -182,7 +182,7 @@ def _build_lu_solve(geom, mesh_key):
         from conflux_tpu.parallel.mesh import replicate
         return replicate(xv, (AXIS_X, AXIS_Y, AXIS_Z))
 
-    fn = jax.shard_map(
+    fn = shard_map(
         device_fn,
         mesh=mesh,
         in_specs=(P(AXIS_X, AXIS_Y, None, None), P(), P()),
@@ -277,7 +277,7 @@ def _build_cholesky_solve(geom, mesh_key):
         from conflux_tpu.parallel.mesh import replicate
         return replicate(xv, (AXIS_X, AXIS_Y, AXIS_Z))
 
-    fn = jax.shard_map(
+    fn = shard_map(
         device_fn,
         mesh=mesh,
         in_specs=(P(AXIS_X, AXIS_Y, None, None), P()),
@@ -708,7 +708,7 @@ def _build_qtb(mesh_key, cdtype_name: str):
                        precision=lax.Precision.HIGHEST), AXIS_X)
         return replicate(c, tuple(mesh.axis_names))
 
-    return jax.jit(jax.shard_map(
+    return jax.jit(shard_map(
         device_fn, mesh=mesh,
         in_specs=(P(AXIS_X, None, None), P(AXIS_X, None, None)),
         out_specs=P()))
@@ -923,7 +923,7 @@ def _build_qr_lstsq(geom, mesh_key):
 
         return replicate(xv, (AXIS_X, AXIS_Y, AXIS_Z))
 
-    fn = jax.shard_map(
+    fn = shard_map(
         device_fn,
         mesh=mesh,
         in_specs=(P(AXIS_X, AXIS_Y, None, None),

@@ -71,7 +71,7 @@ def _build_lu_residual(geom, mesh_key):
     from jax.sharding import PartitionSpec as P
 
     from conflux_tpu.parallel.mesh import (
-        AXIS_X, AXIS_Y, AXIS_Z, lookup_mesh,
+        AXIS_X, AXIS_Y, AXIS_Z, lookup_mesh, pvary, shard_map,
     )
 
     mesh = lookup_mesh(mesh_key)
@@ -111,8 +111,8 @@ def _build_lu_residual(geom, mesh_key):
             return acc + jnp.matmul(Lcol, Urow,
                                     precision=lax.Precision.HIGHEST)
 
-        zero0 = lax.pcast(jnp.zeros((Ml, Nl), dtype),
-                          (AXIS_X, AXIS_Y, AXIS_Z), to="varying")
+        zero0 = pvary(jnp.zeros((Ml, Nl), dtype),
+                      (AXIS_X, AXIS_Y, AXIS_Z))
         prod = lax.fori_loop(0, Nt, summa, zero0)
 
         # ---- pass 2: assemble A[perm] rows at their positions --------- #
@@ -134,8 +134,8 @@ def _build_lu_residual(geom, mesh_key):
 
         Ap = lax.fori_loop(
             0, Mt, permrows,
-            lax.pcast(jnp.zeros((Ml, Nl), dtype),
-                      (AXIS_X, AXIS_Y, AXIS_Z), to="varying"))
+            pvary(jnp.zeros((Ml, Nl), dtype),
+                  (AXIS_X, AXIS_Y, AXIS_Z)))
 
         R = Ap - prod
         rss = lax.psum(jnp.sum((R * jnp.conj(R)).real), (AXIS_X, AXIS_Y))
@@ -144,7 +144,7 @@ def _build_lu_residual(geom, mesh_key):
         # identical across z already; pmax satisfies replication
         return (lax.pmax(rss, AXIS_Z), lax.pmax(ass, AXIS_Z))
 
-    fn = jax.shard_map(
+    fn = shard_map(
         device_fn,
         mesh=mesh,
         in_specs=(P(AXIS_X, AXIS_Y, None, None),
@@ -185,7 +185,7 @@ def _build_cholesky_residual(geom, mesh_key):
     from jax.sharding import PartitionSpec as P
 
     from conflux_tpu.parallel.mesh import (
-        AXIS_X, AXIS_Y, AXIS_Z, lookup_mesh,
+        AXIS_X, AXIS_Y, AXIS_Z, lookup_mesh, pvary, shard_map,
     )
 
     mesh = lookup_mesh(mesh_key)
@@ -229,8 +229,8 @@ def _build_cholesky_residual(geom, mesh_key):
             return acc + jnp.matmul(Lcol, LrowT,
                                     precision=lax.Precision.HIGHEST)
 
-        zero0 = lax.pcast(jnp.zeros((Ml, Nl), dtype),
-                          (AXIS_X, AXIS_Y, AXIS_Z), to="varying")
+        zero0 = pvary(jnp.zeros((Ml, Nl), dtype),
+                      (AXIS_X, AXIS_Y, AXIS_Z))
         prod = lax.fori_loop(0, Nt, summa, zero0)
 
         R = Aloc - prod
@@ -238,7 +238,7 @@ def _build_cholesky_residual(geom, mesh_key):
         ass = lax.psum(jnp.sum((Aloc * jnp.conj(Aloc)).real), (AXIS_X, AXIS_Y))
         return (lax.pmax(rss, AXIS_Z), lax.pmax(ass, AXIS_Z))
 
-    fn = jax.shard_map(
+    fn = shard_map(
         device_fn,
         mesh=mesh,
         in_specs=(P(AXIS_X, AXIS_Y, None, None),
@@ -311,7 +311,7 @@ def _build_qr_residual(geom, mesh_key):
     from jax.sharding import PartitionSpec as P
 
     from conflux_tpu.parallel.mesh import (
-        AXIS_X, AXIS_Y, AXIS_Z, lookup_mesh,
+        AXIS_X, AXIS_Y, AXIS_Z, lookup_mesh, pvary, shard_map,
     )
 
     mesh = lookup_mesh(mesh_key)
@@ -359,10 +359,10 @@ def _build_qr_residual(geom, mesh_key):
             return prod, oss
 
         rdtype = jnp.zeros((), dtype).real.dtype
-        zero = lax.pcast(jnp.zeros((Ml, Nl), dtype),
-                         (AXIS_X, AXIS_Y, AXIS_Z), to="varying")
-        zoss = lax.pcast(jnp.zeros((), rdtype),
-                         (AXIS_X, AXIS_Y, AXIS_Z), to="varying")
+        zero = pvary(jnp.zeros((Ml, Nl), dtype),
+                     (AXIS_X, AXIS_Y, AXIS_Z))
+        zoss = pvary(jnp.zeros((), rdtype),
+                     (AXIS_X, AXIS_Y, AXIS_Z))
         prod, oss = lax.fori_loop(0, Nt, body, (zero, zoss))
         E = Aloc - prod
         rss = lax.psum(jnp.sum((E * jnp.conj(E)).real), (AXIS_X, AXIS_Y))
@@ -371,7 +371,7 @@ def _build_qr_residual(geom, mesh_key):
         return (lax.pmax(rss, AXIS_Z), lax.pmax(ass, AXIS_Z),
                 lax.pmax(oss, AXIS_Z))
 
-    fn = jax.shard_map(
+    fn = shard_map(
         device_fn,
         mesh=mesh,
         in_specs=(P(AXIS_X, AXIS_Y, None, None),

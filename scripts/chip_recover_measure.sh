@@ -16,13 +16,20 @@
 #     aborts on an unresponsive device, plowing on would burn each
 #     later item's full ~17-min probe cycle against a dead chip —
 #     instead the gate waits (5-min re-probes) until the chip answers,
-#     then runs the item;
+#     then runs the item. The gate is BOUNDED by a session-wide wedge
+#     budget (WEDGE_BUDGET_S, default 4 h of cumulative waiting): a
+#     persistent wedge eventually falls through and the remaining
+#     device items are skipped with an explicit log line, instead of
+#     the old unbounded `until` loop parking the watcher forever;
 #   - apply_flip_criteria runs TWICE — once after the core measurements
-#     and once at the end — and both passes are UNGATED (pure log
-#     parsing, no device): a late wedge must never leave the session
-#     as logs-without-decisions.
+#     and once from a `trap ... EXIT` handler (pure log parsing, no
+#     device): the final decisions pass now runs on EVERY exit path —
+#     wedge-budget fall-through, a crashed item, SIGTERM of the watcher
+#     itself — so no session can end as logs-without-decisions.
 cd "$(dirname "$0")/.." || exit 1
 LOG=${RECOVERY_LOG:-data/benchmarks/round5-recovery.txt}
+WEDGE_BUDGET_S=${WEDGE_BUDGET_S:-14400}  # total wedge-wait across the session
+wedge_spent=0
 echo "watch start $(date -u +%FT%TZ)" >> "$LOG"
 
 probe_ok() {
@@ -36,22 +43,48 @@ print(float(jax.numpy.ones((8,)).sum()))
 " >/dev/null 2>&1
 }
 
-wait_healthy() {
+wait_healthy() {  # rc 0: chip answered; rc 1: wedge budget exhausted
   until probe_ok; do
+    if [ "$wedge_spent" -ge "$WEDGE_BUDGET_S" ]; then
+      echo "wedge budget exhausted (${wedge_spent}s >= ${WEDGE_BUDGET_S}s) $(date -u +%FT%TZ)" >> "$LOG"
+      return 1
+    fi
     echo "still wedged $(date -u +%FT%TZ)" >> "$LOG"
     sleep 300
+    wedge_spent=$((wedge_spent + 300))
   done
   echo "chip healthy $(date -u +%FT%TZ)" >> "$LOG"
 }
 
 item() {  # item <timeout_s> <label> <cmd...>
   local t=$1 label=$2; shift 2
-  wait_healthy
+  if ! wait_healthy; then
+    echo "=== SKIPPED (wedge budget exhausted): $label $(date -u +%FT%TZ) ===" >> "$LOG"
+    return 1
+  fi
   {
     echo "=== $label $(date -u +%FT%TZ) ==="
     timeout -k 10 "$t" "$@" 2>&1 | grep -v WARNING
   } >> "$LOG" 2>&1
 }
+
+apply_pass() {  # apply_pass <label> — UNGATED: pure log parsing, no device
+  {
+    echo "=== apply pre-decided flip criteria, $1 $(date -u +%FT%TZ) ==="
+    timeout -k 10 120 python scripts/apply_flip_criteria.py "$LOG" \
+      --emit-rules data/tune_table_r5.json 2>&1 | grep -v WARNING
+  } >> "$LOG" 2>&1
+}
+
+# the final decisions pass runs on EVERY exit path (normal completion,
+# skipped items, a crash, SIGTERM/SIGINT of the watcher): a late wedge
+# must never leave the session as logs-without-decisions
+final_pass() {
+  apply_pass "final (full log, on exit)"
+  echo "=== done $(date -u +%FT%TZ) ===" >> "$LOG"
+}
+trap final_pass EXIT
+trap 'exit 143' TERM INT
 
 item 3000 "bench.py (headline LU at-scale gate)" python bench.py
 # the plain highest:8192:1024 row is the all-defaults baseline every
@@ -74,16 +107,8 @@ item 2400 "qr N=16384" \
   --configs highest:0:1024
 item 3000 "HPL-MxP end-to-end (bf16x3 factor + GMRES-IR to 1e-6)" \
   python bench.py --mode mxp --ir gmres
-apply_pass() {  # apply_pass <label> — UNGATED: pure log parsing, no device
-  {
-    echo "=== apply pre-decided flip criteria, $1 $(date -u +%FT%TZ) ==="
-    timeout -k 10 120 python scripts/apply_flip_criteria.py "$LOG" \
-      --emit-rules data/tune_table_r5.json 2>&1 | grep -v WARNING
-  } >> "$LOG" 2>&1
-}
 apply_pass "pass 1 (core data)"
 item 2400 "tune LU taller nomination chunks (QUARANTINED LAST: the round-2 wedge began during a 12288 trial)" \
   python scripts/tpu_tune.py -N 32768 --reps 2 \
   --configs highest:8192:1024,highest:12288:1024,highest:10240:1024
-apply_pass "final (full log)"
-echo "=== done $(date -u +%FT%TZ) ===" >> "$LOG"
+# final decisions pass + done marker: the EXIT trap (final_pass) emits both

@@ -126,7 +126,8 @@ def test_butterfly_zero_fill_contract_real_reducers(Px):
 
     from conflux_tpu.geometry import Grid3
     from conflux_tpu.ops import blas
-    from conflux_tpu.parallel.mesh import butterfly_allreduce, make_mesh
+    from conflux_tpu.parallel.mesh import (butterfly_allreduce, make_mesh,
+                                        shard_map)
     from conflux_tpu.qr.single import _tree_r
 
     v = 4
@@ -154,7 +155,7 @@ def test_butterfly_zero_fill_contract_real_reducers(Px):
                 jnp.concatenate([top[0], bot[0]], axis=0), 2 * v),))
         return nom[None], nid[None], lu00[None], r[None]
 
-    nom, nid, lu00, r = jax.jit(jax.shard_map(
+    nom, nid, lu00, r = jax.jit(shard_map(
         fn, mesh=mesh, in_specs=(P("x", None, None), P("x", None)),
         out_specs=(P("x", None, None), P("x", None),
                    P("x", None, None), P("x", None, None))))(data, ids)
@@ -181,7 +182,8 @@ def test_butterfly_allreduce_any_px(Px):
     from jax.sharding import PartitionSpec as P
 
     from conflux_tpu.geometry import Grid3
-    from conflux_tpu.parallel.mesh import butterfly_allreduce, make_mesh
+    from conflux_tpu.parallel.mesh import (butterfly_allreduce, make_mesh,
+                                        shard_map)
 
     mesh = make_mesh(Grid3(Px, 1, 1), devices=jax.devices()[:Px])
     rng = np.random.default_rng(Px)
@@ -194,7 +196,7 @@ def test_butterfly_allreduce_any_px(Px):
             (blk[0],), Px, "x", lambda top, bot: (top[0],))
         return s[None], w[None]
 
-    ssum, wtop = jax.jit(jax.shard_map(
+    ssum, wtop = jax.jit(shard_map(
         fn, mesh=mesh, in_specs=P("x", None),
         out_specs=(P("x", None), P("x", None))))(data)
     for px in range(Px):
