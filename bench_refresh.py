@@ -1,0 +1,222 @@
+"""Refresh-vs-refactor benchmark: prints ONE JSON line, writes BENCH_REFRESH.json.
+
+The ISSUE 2 claim measured, not asserted. Workload: a served system
+drifts by a rank-k correction (A <- A + U V^H) before each solve — the
+streaming/online traffic shape. Two ways to absorb each drift:
+
+  refactor — materialize the drifted matrix and pay a full O(N^3)
+             refactorization through the cached `FactorPlan` factor
+             program, then solve (the only option before ISSUE 2).
+  refresh  — `SolveSession.update(U, V, replace=True)`: O(N^2 k)
+             Sherman-Morrison-Woodbury capacitance refresh against the
+             resident base factors, then a corrected solve
+             (`conflux_tpu.update`). Zero refactorizations, zero
+             recompiles after the first round (asserted via the plan's
+             trace counters).
+
+Two legs ride by default: a single-system plan (N=1024, k=16 — the
+ISSUE 2 acceptance shape) and a batched plan (B=32, N=256, k=16, the
+bench_serve fleet shape; batched plans invert their triangular factors
+at factor time, so the refactor leg pays that too — exactly what a
+drifting fleet would pay). Headline value is refreshed drift+solve
+rounds/s; `speedup_vs_refactor` is the ratio on identical work, and the
+refreshed residuals are held within 10x of the full-refactor oracle's
+(f32) — a throughput number from wrong answers is worthless.
+
+`--smoke` shrinks to N=512, k=8, single leg, and exits nonzero unless
+the refresh path actually beats the refactor path — the CI gate.
+
+Runs on the CPU backend by default (reproducible anywhere, the tier-1
+topology); amortization counters come from `profiler.serve_stats()`.
+"""
+
+import argparse
+import json
+import os
+import time
+
+
+def parse_args():
+    ap = argparse.ArgumentParser("bench_refresh")
+    ap.add_argument("-N", type=int, default=1024,
+                    help="single-leg system size")
+    ap.add_argument("-k", type=int, default=16, help="drift rank")
+    ap.add_argument("-v", type=int, default=256,
+                    help="single-leg tile size")
+    ap.add_argument("--batch", type=int, default=32,
+                    help="batched-leg fleet size (0 skips the leg)")
+    ap.add_argument("--batch-n", type=int, default=256,
+                    help="batched-leg system size")
+    ap.add_argument("--batch-v", type=int, default=128,
+                    help="batched-leg tile size")
+    ap.add_argument("--rounds", type=int, default=8,
+                    help="drift+solve rounds per workload")
+    ap.add_argument("--reps", type=int, default=3,
+                    help="timed repetitions per leg (mean reported)")
+    ap.add_argument("--smoke", action="store_true",
+                    help="CI gate: N=512 k=8 single leg, assert the "
+                    "refresh path beats full refactor")
+    ap.add_argument("--out", default="BENCH_REFRESH.json",
+                    help="JSON output path")
+    return ap.parse_args()
+
+
+def main():
+    args = parse_args()
+    os.environ["JAX_PLATFORMS"] = "cpu"
+
+    import numpy as np
+
+    import jax
+    import jax.numpy as jnp
+
+    jax.config.update("jax_platforms", "cpu")
+
+    from conflux_tpu import cache, profiler, serve
+    from conflux_tpu.update import apply_update
+
+    cache.enable_persistent_cache()
+    profiler.clear()
+
+    if args.smoke:
+        args.N, args.k, args.v = 512, 8, 128
+        args.batch, args.rounds, args.reps = 0, 4, 1
+
+    rng = np.random.default_rng(0)
+
+    def systems(shape_n, b=None):
+        lead = () if b is None else (b,)
+        A = (rng.standard_normal(lead + (shape_n, shape_n))
+             / np.sqrt(shape_n)
+             + 2.0 * np.eye(shape_n)).astype(np.float32)
+        return A
+
+    def drift(shape_n, k, b=None):
+        lead = () if b is None else (b,)
+        # scaled so the drifted systems stay well-conditioned (the same
+        # matrix class as the base batch)
+        U = (rng.standard_normal(lead + (shape_n, k))
+             / np.sqrt(shape_n)).astype(np.float32)
+        V = (rng.standard_normal(lead + (shape_n, k))
+             / np.sqrt(shape_n)).astype(np.float32)
+        return U, V
+
+    def sync(x):
+        return float(jnp.sum(x))
+
+    def residuals(A_np, x, b_np):
+        x64 = np.asarray(x, np.float64)
+        A64, b64 = A_np.astype(np.float64), b_np.astype(np.float64)
+        if A_np.ndim == 2:
+            r = A64 @ x64 - b64
+            return np.linalg.norm(r) / np.linalg.norm(b64)
+        r = np.einsum("bij,bj->bi", A64, x64) - b64
+        return float(np.max(np.linalg.norm(r, axis=1)
+                            / np.linalg.norm(b64, axis=1)))
+
+    apply_fn = jax.jit(apply_update)
+
+    def run_leg(name, B, N, k, v):
+        batched_leg = B > 0
+        shape = (B, N, N) if batched_leg else (N, N)
+        lead = B if batched_leg else None
+        A = systems(N, lead)
+        drifts = [drift(N, k, lead) for _ in range(args.rounds)]
+        rhs = [rng.standard_normal(((B, N) if batched_leg else (N,)))
+               .astype(np.float32) for _ in range(args.rounds)]
+        Ad = jnp.asarray(A)
+        drifts_d = [(jnp.asarray(U), jnp.asarray(V)) for U, V in drifts]
+        rhs_d = [jnp.asarray(r) for r in rhs]
+
+        plan = serve.FactorPlan.create(shape, jnp.float32, v=v)
+        session = plan.factor(Ad)
+
+        # ---- warm-up: compile both paths fully ----------------------- #
+        session.update(*drifts_d[0], replace=True)
+        sync(session.solve(rhs_d[0]))
+        sync(plan.factor(apply_fn(Ad, *drifts_d[0])).solve(rhs_d[0]))
+        traces = dict(plan.trace_counts)
+
+        # ---- refresh leg: SMW update + corrected solve per round ----- #
+        t_refresh = 0.0
+        for _ in range(args.reps):
+            t0 = time.perf_counter()
+            for (U, V), bd in zip(drifts_d, rhs_d):
+                session.update(U, V, replace=True)
+                x_refresh = session.solve(bd)
+            sync(x_refresh)
+            t_refresh += time.perf_counter() - t0
+        t_refresh /= args.reps
+        assert plan.trace_counts == traces, \
+            "refresh leg recompiled mid-workload"
+        assert session.refactors == 0, "drift policy refactored in-bench"
+
+        # ---- refactor leg: full factor per round through the plan ---- #
+        t_refactor = 0.0
+        for _ in range(args.reps):
+            t0 = time.perf_counter()
+            for (U, V), bd in zip(drifts_d, rhs_d):
+                s = plan.factor(apply_fn(Ad, U, V))
+                x_refactor = s.solve(bd)
+            sync(x_refactor)
+            t_refactor += time.perf_counter() - t0
+        t_refactor /= args.reps
+        assert plan.trace_counts == traces, \
+            "refactor leg recompiled mid-workload"
+
+        # ---- residual oracle: last round's drifted system ------------ #
+        A_last = np.asarray(apply_fn(Ad, *drifts_d[-1]))
+        res_refresh = residuals(A_last, x_refresh, rhs[-1])
+        res_refactor = residuals(A_last, x_refactor, rhs[-1])
+        bar = 10.0 * max(float(res_refactor), 1e-8)
+        ok = bool(res_refresh <= bar)
+
+        solves = args.rounds * (B if batched_leg else 1)
+        return {
+            "workload": (f"B={B or 1} N={N} k={k} v={v} "
+                         f"rounds={args.rounds} f32"),
+            "refresh_solves_per_s": round(solves / t_refresh, 2),
+            "refactor_solves_per_s": round(solves / t_refactor, 2),
+            "speedup_vs_refactor": round(t_refactor / t_refresh, 2),
+            "refresh_round_ms": round(1e3 * t_refresh / args.rounds, 3),
+            "refactor_round_ms": round(1e3 * t_refactor / args.rounds, 3),
+            "residual_refresh": float(res_refresh),
+            "residual_refactor_oracle": float(res_refactor),
+            "residual_within_10x": ok,
+        }
+
+    legs = {"single": run_leg("single", 0, args.N, args.k, args.v)}
+    if args.batch:
+        legs["batched"] = run_leg("batched", args.batch, args.batch_n,
+                                  args.k, args.batch_v)
+
+    stats = profiler.serve_stats()
+    out = {
+        "metric": (f"refresh vs refactor N={args.N} k={args.k} "
+                   f"({jax.devices()[0].platform} backend"
+                   + (", smoke" if args.smoke else "") + ")"),
+        "value": legs["single"]["speedup_vs_refactor"],
+        "unit": "x refresh speedup over full refactor",
+        **{f"{name}_{key}": val for name, leg in legs.items()
+           for key, val in leg.items()},
+        "serve_counters": {ph: stats[ph] for ph in profiler.SERVE_PHASES},
+        "solves_per_factor": round(stats["solves_per_factor"], 2),
+    }
+    with open(args.out, "w") as f:
+        json.dump(out, f, indent=2)
+        f.write("\n")
+    print(json.dumps(out))
+
+    bad = [name for name, leg in legs.items()
+           if not leg["residual_within_10x"]]
+    if bad:
+        raise SystemExit(
+            f"refreshed residuals exceed 10x the refactor oracle: {bad}")
+    if args.smoke and legs["single"]["speedup_vs_refactor"] <= 1.0:
+        raise SystemExit(
+            "smoke gate: refresh did not beat full refactor at "
+            f"N={args.N}, k={args.k}")
+
+
+if __name__ == "__main__":
+    main()

@@ -79,6 +79,11 @@ def __getattr__(name):
         "SolveSession": ("conflux_tpu.serve", "SolveSession"),
         "enable_persistent_cache": (
             "conflux_tpu.cache", "enable_persistent_cache"),
+        # incremental low-rank refresh (ISSUE 2)
+        "solve_updated": ("conflux_tpu.solvers", "solve_updated"),
+        "solve_updated_batched": (
+            "conflux_tpu.batched", "solve_updated_batched"),
+        "DriftPolicy": ("conflux_tpu.update", "DriftPolicy"),
     }
     if name in _lazy:
         import importlib
@@ -136,4 +141,7 @@ __all__ = [
     "FactorPlan",
     "SolveSession",
     "enable_persistent_cache",
+    "solve_updated",
+    "solve_updated_batched",
+    "DriftPolicy",
 ]

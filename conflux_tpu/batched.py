@@ -280,6 +280,79 @@ def _build_solve(B: int, N: int, k: int, dtype_name: str,
     return jax.jit(fn, out_shardings=_batch_spec(mesh, 3))
 
 
+@functools.lru_cache(maxsize=32)
+def _build_solve_updated(B: int, N: int, k: int, nrhs: int, dtype_name: str,
+                         fdtype_name: str, v: int, refine: int, spd: bool,
+                         precision, backend: str, panel_algo: str, mesh_key):
+    """One compiled program for a fleet of drifting systems: factor each
+    base A[i], then solve (A[i] + U[i] V[i]^H) x[i] = b[i] through the
+    Woodbury capacitance correction — vmapped and batch-sharded like
+    `_build_solve`, so B rank-k drifts update together without any
+    per-element dispatch."""
+    from conflux_tpu.cholesky.single import _cholesky_blocked
+    from conflux_tpu.lu.single import _lu_factor_blocked
+    from conflux_tpu.solvers import cholesky_solve, lu_solve
+    from conflux_tpu.update import woodbury_solve
+
+    fdtype = jnp.dtype(fdtype_name)
+
+    def one(A, U, V, b2):
+        Af = A.astype(fdtype)
+        if spd:
+            L = _cholesky_blocked(Af, v, precision, backend)
+            base = lambda r: cholesky_solve(L, r)
+        else:
+            LUf, perm = _lu_factor_blocked(Af, v, precision, backend,
+                                           panel_algo)
+            base = lambda r: lu_solve(LUf, perm, r)
+        return woodbury_solve(base, A if refine else None, U, V, b2,
+                              refine=refine)
+
+    fn = jax.vmap(one)
+    if mesh_key is None:
+        return jax.jit(fn)
+    mesh = lookup_mesh(mesh_key)
+    return jax.jit(fn, out_shardings=_batch_spec(mesh, 3))
+
+
+def solve_updated_batched(A, U, V, b, *, v: int = 256, factor_dtype=None,
+                          refine: int = 0, spd: bool = False, mesh=None,
+                          precision=None, backend: str | None = None):
+    """Solve B drifted systems (A[i] + U[i] V[i]^H) x[i] = b[i] in one
+    program — the batched counterpart of `solvers.solve_updated` for
+    fleets whose systems drift by a low-rank correction together. A is
+    (B, N, N), U/V are (B, N, k) with k << N, b is (B, N) or (B, N, nrhs);
+    only the BASE matrices are factored (O(N^3) each), the corrections
+    ride k x k capacitance systems. With a `batch_mesh` the batch is
+    data-parallel across its devices; `spd` refers to the base matrices.
+    """
+    A = jnp.asarray(A)
+    _check_batched_square(A)
+    B, N = A.shape[0], A.shape[1]
+    U, V = jnp.asarray(U), jnp.asarray(V)
+    if U.shape != V.shape or U.ndim != 3 or U.shape[:2] != (B, N):
+        raise ValueError(
+            f"update factors must both be ({B}, {N}, k), got {U.shape} "
+            f"and {V.shape}")
+    v = min(v, N)
+    if N % v:
+        raise ValueError(
+            f"N={N} not a multiple of tile size v={v}; pre-pad the batch "
+            "with an identity extension (cf. solvers.solve)")
+    b3, squeeze = _rhs_3d(b, B, N)
+    fdtype = A.dtype if factor_dtype is None else jnp.dtype(factor_dtype)
+    precision, backend = _resolve(precision, backend)
+    key = _mesh_key(mesh)
+    nsh = 1 if mesh is None else mesh.devices.size
+    (Ap, Up, Vp, bp), Bp = _pad_batch((A, U, V, b3), B, nsh)
+    Ap, Up, Vp, bp = _shard_batch((Ap, Up, Vp, bp), mesh)
+    fn = _build_solve_updated(Bp, N, U.shape[-1], b3.shape[2], A.dtype.name,
+                              fdtype.name, v, refine, spd, precision,
+                              backend, blas.get_panel_algo(), key)
+    x = fn(Ap, Up, Vp, bp)[:B]
+    return x[:, :, 0] if squeeze else x
+
+
 def solve_batched(A, b, *, v: int = 256, factor_dtype=None, refine: int = 0,
                   spd: bool = False, mesh=None, precision=None,
                   backend: str | None = None):

@@ -89,6 +89,42 @@ def trace(logdir: str):
 
 
 # --------------------------------------------------------------------------- #
+# Serving-phase counters (the amortization view)
+# --------------------------------------------------------------------------- #
+
+# the serve layer (conflux_tpu/serve.py) wraps its call sites in
+# region("serve.<phase>"), so bench/ops read amortization ratios here
+# without instrumenting anything themselves
+SERVE_PHASES = ("factor", "solve", "update", "refactor")
+
+
+def serve_stats() -> dict:
+    """Per-phase serving counters from the `serve.*` regions.
+
+    Returns {phase: {'count', 'wall_s'}} for factor / solve / update /
+    refactor plus two derived amortization ratios: 'solves_per_factor'
+    (how many substitutions each O(N^3) factorization amortized over —
+    the serving win) and 'updates_per_refactor' (how many O(N^2 k)
+    refreshes each drift-policy refactorization amortized over). Phases
+    never entered report zero; `clear()` resets alongside everything
+    else.
+    """
+    out: dict = {}
+    for ph in SERVE_PHASES:
+        key = f"serve.{ph}"
+        out[ph] = {"count": _counts.get(key, 0),
+                   "wall_s": _times.get(key, 0.0)}
+    factors = out["factor"]["count"] + out["refactor"]["count"]
+    out["solves_per_factor"] = (out["solve"]["count"] / factors
+                                if factors else 0.0)
+    refac = out["refactor"]["count"]
+    out["updates_per_refactor"] = (out["update"]["count"] / refac
+                                   if refac else float("inf")
+                                   if out["update"]["count"] else 0.0)
+    return out
+
+
+# --------------------------------------------------------------------------- #
 # Device-side per-phase timing (the reference's per-step semiprof table)
 # --------------------------------------------------------------------------- #
 

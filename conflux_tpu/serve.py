@@ -32,10 +32,20 @@ per call on one device. Every traced program bumps a plan-level trace
 counter at trace time, so tests (and monitoring) can assert the
 "zero recompiles after the first call" contract instead of trusting it.
 
+Sessions also absorb *drift*: ``session.update(U, V)`` applies a rank-k
+change A <- A + U V^H through a Sherman-Morrison-Woodbury correction
+(`conflux_tpu.update`) instead of a refactorization — O(N^2 k) refresh,
+O(N^2 + N k) per later solve, all device-resident, compiled once per
+(rank bucket, RHS bucket) — and a :class:`~conflux_tpu.update.DriftPolicy`
+triggers one true refactor through the plan's cached factor program when
+accumulated rank or capacitance conditioning stops paying.
+
     plan = FactorPlan.create((32, 256, 256), jnp.float32, v=128, mesh=mesh)
     session = plan.factor(A)          # O(N^3), once
     x1 = session.solve(b1)            # O(N^2) substitution only
     x2 = session.solve(b2)            # same compiled program, same factors
+    session.update(U, V)              # rank-k drift, NO refactor
+    x3 = session.solve(b3)            # base factors + k x k correction
 """
 
 from __future__ import annotations
@@ -49,8 +59,16 @@ import jax.numpy as jnp
 from jax import lax
 
 from conflux_tpu.ops import blas
+from conflux_tpu import profiler
 from conflux_tpu.batched import _batch_spec, _shard_batch
 from conflux_tpu.parallel.mesh import lookup_mesh, mesh_cache_key
+from conflux_tpu.update import (
+    DriftPolicy,
+    capacitance,
+    rank_bucket,
+    updated_matvec,
+    woodbury_apply,
+)
 
 
 @dataclasses.dataclass(frozen=True)
@@ -123,6 +141,7 @@ class FactorPlan:
         self.trace_counts = {"factor": 0, "solve": 0}
         self._factor_fn = self._build_factor()
         self._solve_cache: dict[tuple, Any] = {}
+        self._update_cache: dict[tuple, Any] = {}
 
     # ------------------------------------------------------------------ #
     # cache
@@ -222,12 +241,14 @@ class FactorPlan:
             LUc, eye, left_side=True, lower=False)
         return (Li, Ui, perm)
 
-    def _one_solve(self, factors, A, b2):
-        """Per-system substitution + the plan's IR sweeps. `A` is only
-        consumed when refine > 0 (the residual matvec)."""
+    def _base_corr(self, factors):
+        """The per-system base substitution r -> A0^{-1} r through the
+        resident factor pytree — shared by the solve program and the
+        Woodbury update programs (which wrap it in the capacitance
+        correction). Traceable; factors carry no batch axis here (vmap
+        adds it outside)."""
         from conflux_tpu.solvers import cholesky_solve, lu_solve
 
-        self.trace_counts["solve"] += 1  # trace-time, not per call
         k = self.key
         if k.substitution == "inv":
             hi = lax.Precision.HIGHEST
@@ -244,10 +265,17 @@ class FactorPlan:
                     y = jnp.matmul(Li, r.astype(Li.dtype)[perm],
                                    precision=hi)
                     return jnp.matmul(Ui, y, precision=hi)
-        elif k.spd:
-            corr = lambda r: cholesky_solve(factors[0], r)
-        else:
-            corr = lambda r: lu_solve(factors[0], factors[1], r)
+            return corr
+        if k.spd:
+            return lambda r: cholesky_solve(factors[0], r)
+        return lambda r: lu_solve(factors[0], factors[1], r)
+
+    def _one_solve(self, factors, A, b2):
+        """Per-system substitution + the plan's IR sweeps. `A` is only
+        consumed when refine > 0 (the residual matvec)."""
+        self.trace_counts["solve"] += 1  # trace-time, not per call
+        k = self.key
+        corr = self._base_corr(factors)
         cdtype = blas.compute_dtype(jnp.dtype(k.dtype))
         x = corr(b2).astype(cdtype)
         for _ in range(k.refine):
@@ -276,8 +304,18 @@ class FactorPlan:
         return jax.jit(fn, out_shardings=out_shardings)
 
     def _solve_fn(self, nrhs: int):
-        """The jitted substitution program for a given RHS width (cached
-        per width; serving traffic with one width compiles once)."""
+        """The jitted substitution program for a given RHS-width BUCKET.
+
+        `SolveSession.solve` rounds the request width up to the next
+        power of two (pad + slice — columns are independent through every
+        substitution/GEMM/IR step, so padded answers are bitwise those of
+        the unpadded width), so a traffic mix of widths compiles O(log)
+        programs. The bucket contract is asserted here and in
+        tests/test_serve.py."""
+        if nrhs & (nrhs - 1) or nrhs < 1:
+            raise AssertionError(
+                f"_solve_fn takes power-of-two RHS buckets, got {nrhs} — "
+                "route request widths through SolveSession.solve")
         fn = self._solve_cache.get(nrhs)
         if fn is None:
             one = self._one_solve
@@ -288,6 +326,85 @@ class FactorPlan:
                 fn = jax.jit(
                     f, out_shardings=_batch_spec(self.mesh, 3))
             self._solve_cache[nrhs] = fn
+        return fn
+
+    # ------------------------------------------------------------------ #
+    # incremental (Woodbury) update programs — compiled once per bucket
+    # ------------------------------------------------------------------ #
+
+    def _bump(self, name: str) -> None:
+        """Trace-time counter for the update-path programs: keys appear
+        lazily so plans that never update keep the original
+        {'factor', 'solve'} counter shape."""
+        self.trace_counts[name] = self.trace_counts.get(name, 0) + 1
+
+    def _one_update(self, factors, Up, Vp):
+        self._bump("update")
+        Y, Cinv, cond1 = capacitance(self._base_corr(factors), Up, Vp)
+        return Y, Cinv, cond1
+
+    def _one_update_solve(self, sweeps, factors, A0, Up, Vp, Y, Cinv, b2):
+        """Woodbury-corrected substitution + `sweeps` IR backstop sweeps
+        against the DRIFTED matrix (A0 x + U (V^H x) residual matvec,
+        the serve layer's refinement-loop discipline)."""
+        self._bump("update_solve")
+        corr = self._base_corr(factors)
+        cdtype = blas.compute_dtype(jnp.dtype(self.key.dtype))
+        x = woodbury_apply(corr, Y, Cinv, Vp, b2).astype(cdtype)
+        bc = b2.astype(cdtype)
+        for _ in range(sweeps):
+            r = bc - updated_matvec(A0, Up, Vp, x)
+            x = x + woodbury_apply(corr, Y, Cinv, Vp, r).astype(cdtype)
+        return x
+
+    def _update_fn(self, kb: int):
+        """Jitted capacitance-assembly program per rank bucket kb:
+        (factors, Up, Vp) -> (Y, Cinv, cond1)."""
+        key = ("update", kb)
+        fn = self._update_cache.get(key)
+        if fn is None:
+            f = jax.vmap(self._one_update) if self.batched \
+                else self._one_update
+            if self.mesh is None:
+                fn = jax.jit(f)
+            else:
+                fn = jax.jit(f, out_shardings=(
+                    _batch_spec(self.mesh, 3), _batch_spec(self.mesh, 3),
+                    _batch_spec(self.mesh, 1)))
+            self._update_cache[key] = fn
+        return fn
+
+    def _update_solve_fn(self, kb: int, nrhs: int, sweeps: int):
+        """Jitted Woodbury solve program per (rank bucket, RHS bucket,
+        backstop sweeps)."""
+        key = ("usolve", kb, nrhs, sweeps)
+        fn = self._update_cache.get(key)
+        if fn is None:
+            import functools
+
+            one = functools.partial(self._one_update_solve, sweeps)
+            f = jax.vmap(one) if self.batched else one
+            if self.mesh is None:
+                fn = jax.jit(f)
+            else:
+                fn = jax.jit(f, out_shardings=_batch_spec(self.mesh, 3))
+            self._update_cache[key] = fn
+        return fn
+
+    def _refresh_fn(self, kb: int):
+        """Jitted A0 + U V^H materialization per rank bucket — the
+        refactor trigger's input, feeding the existing factor program."""
+        from conflux_tpu.update import apply_update
+
+        key = ("refresh", kb)
+        fn = self._update_cache.get(key)
+        if fn is None:
+            f = jax.vmap(apply_update) if self.batched else apply_update
+            if self.mesh is None:
+                fn = jax.jit(f)
+            else:
+                fn = jax.jit(f, out_shardings=_batch_spec(self.mesh, 3))
+            self._update_cache[key] = fn
         return fn
 
     # ------------------------------------------------------------------ #
@@ -303,20 +420,24 @@ class FactorPlan:
             raise ValueError(f"A dtype {A.dtype} does not match the plan's "
                              f"{self.key.dtype}")
 
-    def factor(self, A) -> "SolveSession":
+    def factor(self, A, *, policy: DriftPolicy | None = None) -> "SolveSession":
         """Run the factor program on A and open a device-resident session.
 
-        The returned session holds the factors (and, when the plan
-        refines, A itself) on device; every `session.solve` afterwards is
-        substitution-only.
+        The returned session holds the factors (and A itself — the
+        refinement residual matvec and the incremental-update/refactor
+        path both consume it) on device; every `session.solve` afterwards
+        is substitution-only. `policy` governs when `session.update`
+        drifts trigger a true refactorization (default
+        :class:`DriftPolicy`).
         """
         A = jnp.asarray(A)
         self._check_A(A)
         if self.mesh is not None:
             (A,) = _shard_batch((A,), self.mesh)
-        factors = self._factor_fn(A)
+        with profiler.region("serve.factor"):
+            factors = self._factor_fn(A)
         keep_A = A if self.key.refine else None
-        return SolveSession(self, factors, keep_A)
+        return SolveSession(self, factors, keep_A, A, policy)
 
     def solve(self, A, b):
         """One-shot convenience: factor + solve in one call (a fresh
@@ -329,16 +450,31 @@ class SolveSession:
 
     Sessions are cheap handles: the heavy state lives on device. `solves`
     and `factorizations` count what this session actually ran — the
-    serving invariant (`factorizations == 1` forever, `solves` growing)
-    is asserted by tests/test_serve.py.
+    serving invariant (`factorizations == 1` under solve-only traffic,
+    `solves` growing) is asserted by tests/test_serve.py.
+
+    `update(U, V)` applies a rank-k drift A <- A + U V^H WITHOUT
+    refactoring: subsequent solves ride the base factors plus a k x k
+    capacitance correction (Sherman-Morrison-Woodbury, see
+    `conflux_tpu.update`), all device-resident and compiled once per
+    (rank bucket, RHS bucket). The session's :class:`DriftPolicy` decides
+    when accumulated rank/conditioning stops paying and triggers ONE true
+    refactorization through the plan's existing factor program
+    (`factorizations`/`refactors` record it).
     """
 
-    def __init__(self, plan: FactorPlan, factors, A):
+    def __init__(self, plan: FactorPlan, factors, A, A_base=None,
+                 policy: DriftPolicy | None = None):
         self.plan = plan
         self._factors = factors
         self._A = A
+        self._A0 = A if A_base is None else A_base
+        self.policy = DriftPolicy() if policy is None else policy
+        self._upd = None  # dict(k, kb, Up, Vp, Y, Cinv) when drifted
         self.factorizations = 1
         self.solves = 0
+        self.updates = 0
+        self.refactors = 0
 
     @property
     def factors(self):
@@ -346,6 +482,11 @@ class SolveSession:
         'trsm' plans, (Li, Ui, perm) / (Li,) triangular inverses for
         'inv' plans."""
         return self._factors
+
+    @property
+    def update_rank(self) -> int:
+        """Accumulated drift rank since the last (re)factorization."""
+        return 0 if self._upd is None else self._upd["k"]
 
     def _rhs(self, b):
         plan = self.plan
@@ -371,15 +512,115 @@ class SolveSession:
 
     def solve(self, b):
         """Solve against the resident factors: O(N^2) substitution plus
-        the plan's `refine` sweeps. b is (N,)/(N, k) for single plans,
-        (B, N)/(B, N, k) for batched ones; x comes back in b's shape."""
+        the plan's `refine` sweeps (plus the Woodbury correction when the
+        session carries an un-refactored drift). b is (N,)/(N, k) for
+        single plans, (B, N)/(B, N, k) for batched ones; x comes back in
+        b's shape. RHS widths are padded up to power-of-two buckets and
+        sliced back, so a width mix compiles O(log) programs."""
         plan = self.plan
         b2, squeeze = self._rhs(b)
+        nrhs = b2.shape[-1]
+        nb = rank_bucket(nrhs)
+        if nb != nrhs:
+            pad = [(0, 0)] * (b2.ndim - 1) + [(0, nb - nrhs)]
+            b2 = jnp.pad(b2, pad)
         if plan.mesh is not None:
             (b2,) = _shard_batch((b2,), plan.mesh)
-        fn = plan._solve_fn(b2.shape[-1])
-        x = fn(self._factors, self._A, b2)
+        with profiler.region("serve.solve"):
+            if self._upd is None:
+                x = plan._solve_fn(nb)(self._factors, self._A, b2)
+            else:
+                u = self._upd
+                sweeps = plan.key.refine + self.policy.refine
+                x = plan._update_solve_fn(u["kb"], nb, sweeps)(
+                    self._factors, self._A0, u["Up"], u["Vp"],
+                    u["Y"], u["Cinv"], b2)
         self.solves += 1
+        if nb != nrhs:
+            x = x[..., :nrhs]
         if squeeze:
             return x[..., 0]
         return x
+
+    # ------------------------------------------------------------------ #
+    # incremental drift
+    # ------------------------------------------------------------------ #
+
+    def _check_uv(self, U, V):
+        plan = self.plan
+        if U.shape != V.shape:
+            raise ValueError(f"U {U.shape} and V {V.shape} must agree")
+        lead = (plan.B, plan.N) if plan.batched else (plan.N,)
+        want_nd = len(lead) + 1
+        if U.ndim != want_nd or U.shape[:-1] != lead:
+            raise ValueError(
+                f"update factors {U.shape}, session needs {lead} (+ rank "
+                "axis)")
+        if U.shape[-1] < 1:
+            raise ValueError("update rank must be >= 1")
+
+    def update(self, U, V, *, replace: bool = False):
+        """Apply the rank-k drift A <- A + U V^H without refactoring.
+
+        U, V are (N, k) for single plans, (B, N, k) for batched ones
+        (k << N). Updates ACCUMULATE (rank adds) unless `replace=True`,
+        which measures the drift from the current base factors instead —
+        the steady-state "rank-k drift per request" traffic shape.
+        Subsequent `solve` calls apply the base factors plus the k x k
+        capacitance correction; the drift policy refactors through the
+        plan's cached factor program once accumulated rank exceeds
+        `policy.max_rank` or the capacitance conditioning exceeds
+        `policy.cond_limit`. Returns self (chainable:
+        `session.update(U, V).solve(b)`).
+        """
+        plan = self.plan
+        dtype = jnp.dtype(plan.key.dtype)
+        U = jnp.asarray(U, dtype)
+        V = jnp.asarray(V, dtype)
+        self._check_uv(U, V)
+        with profiler.region("serve.update"):
+            if self._upd is not None and not replace:
+                k0 = self._upd["k"]
+                U = jnp.concatenate([self._upd["Up"][..., :k0], U], axis=-1)
+                V = jnp.concatenate([self._upd["Vp"][..., :k0], V], axis=-1)
+            k = U.shape[-1]
+            if k > self.policy.resolved_max_rank(plan.N):
+                self._refactor(U, V)
+                return self
+            kb = rank_bucket(k)
+            if kb != k:
+                pad = [(0, 0)] * (U.ndim - 1) + [(0, kb - k)]
+                U, V = jnp.pad(U, pad), jnp.pad(V, pad)
+            if plan.mesh is not None:
+                U, V = _shard_batch((U, V), plan.mesh)
+            Y, Cinv, cond1 = plan._update_fn(kb)(self._factors, U, V)
+            cond = float(jnp.max(cond1))
+            if not (cond <= self.policy.cond_limit):  # catches NaN/inf too
+                self._refactor(U, V)
+                return self
+            self._upd = {"k": k, "kb": kb, "Up": U, "Vp": V,
+                         "Y": Y, "Cinv": Cinv}
+        self.updates += 1
+        return self
+
+    def _refactor(self, Up, Vp):
+        """Drift-policy trigger: materialize A0 + U V^H and pay one true
+        refactorization through the plan's cached factor program; the
+        session's base then absorbs the drift and the correction resets."""
+        plan = self.plan
+        with profiler.region("serve.refactor"):
+            k = Up.shape[-1]
+            kb = rank_bucket(k)
+            if kb != k:  # zero columns leave A0 + U V^H unchanged
+                pad = [(0, 0)] * (Up.ndim - 1) + [(0, kb - k)]
+                Up, Vp = jnp.pad(Up, pad), jnp.pad(Vp, pad)
+            if plan.mesh is not None:
+                Up, Vp = _shard_batch((Up, Vp), plan.mesh)
+            A_new = plan._refresh_fn(kb)(self._A0, Up, Vp)
+            self._A0 = A_new
+            if self._A is not None:
+                self._A = A_new
+            self._factors = plan._factor_fn(A_new)
+        self._upd = None
+        self.factorizations += 1
+        self.refactors += 1

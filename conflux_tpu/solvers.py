@@ -646,6 +646,59 @@ def solve(A: jax.Array, b: jax.Array, *, v: int = 256,
     return x[:N] if pad else x
 
 
+def solve_updated(A, U, V, b, *, v: int = 256, factor_dtype=None,
+                  refine: int = 0, spd: bool = False) -> jax.Array:
+    """Solve (A + U V^H) x = b through the factors of A alone.
+
+    The one-shot Sherman-Morrison-Woodbury entry point (the serving form
+    is `SolveSession.update`, see `conflux_tpu.update`): A is factored
+    once — O(N^3), same `v`/`factor_dtype`/`spd` recipe as :func:`solve`
+    — and the rank-k correction rides a k x k capacitance system, so
+    solving against MANY drifted variants of one A costs O(N^2 k) each
+    instead of a refactorization. U, V are (N, k) with k << N; `refine`
+    sweeps compute residuals against the DRIFTED matrix and correct
+    through the same Woodbury apply (the classic-IR backstop). `spd`
+    refers to A — the drifted matrix need not stay symmetric.
+    """
+    from conflux_tpu.update import woodbury_solve
+
+    N = A.shape[0]
+    if A.shape[0] != A.shape[1]:
+        raise ValueError("solve_updated needs a square A")
+    if U.shape != V.shape or U.ndim != 2 or U.shape[0] != N:
+        raise ValueError(
+            f"update factors must both be ({N}, k), got {U.shape} and "
+            f"{V.shape}")
+    v = min(v, N)
+    pad = (-N) % v
+    b2, squeeze = _as_2d(jnp.asarray(b))
+    if pad:
+        # identity-extended A (cf. solve); zero-row U/V leave the
+        # extension's unit pivots untouched
+        Np = N + pad
+        Ap = jnp.zeros((Np, Np), A.dtype).at[:N, :N].set(A)
+        A = Ap.at[jnp.arange(N, Np), jnp.arange(N, Np)].set(1)
+        U = jnp.pad(jnp.asarray(U), ((0, pad), (0, 0)))
+        V = jnp.pad(jnp.asarray(V), ((0, pad), (0, 0)))
+        b2 = jnp.pad(b2, ((0, pad), (0, 0)))
+    fdtype = A.dtype if factor_dtype is None else factor_dtype
+    Af = A.astype(fdtype)
+    if spd:
+        from conflux_tpu.cholesky.single import cholesky_blocked
+
+        L = cholesky_blocked(Af, v=v)
+        base = lambda r: cholesky_solve(L, r)
+    else:
+        from conflux_tpu.lu.single import lu_factor_blocked
+
+        LU, perm = lu_factor_blocked(Af, v=v)
+        base = lambda r: lu_solve(LU, perm, r)
+    x = woodbury_solve(base, A if refine else None, U, V, b2, refine=refine)
+    if pad:
+        x = x[:N]
+    return x[:, 0] if squeeze else x
+
+
 def lstsq(A: jax.Array, b: jax.Array, chunk: int | None = None,
           passes: int = 2, factor_dtype=None, refine: int = 0) -> jax.Array:
     """Least-squares min_x ||A x - b|| for tall full-rank A (M >= n).

@@ -1,0 +1,156 @@
+"""Incremental low-rank factor refresh: Sherman-Morrison-Woodbury solves.
+
+PR 1 made repeated solves cheap (device-resident factors, one compiled
+program per traffic shape), but any CHANGE to a served matrix still cost a
+full O(N^3) refactorization. Serving traffic whose systems drift by a
+rank-k correction between requests (streaming updates, a few changed
+rows/columns, trust-region model tweaks) wants the Woodbury identity
+instead: with A1 = A0 + U V^H (U, V of shape (N, k), k << N),
+
+    A1^{-1} b = A0^{-1} b - A0^{-1} U (I_k + V^H A0^{-1} U)^{-1} V^H A0^{-1} b
+
+so a refreshed solve is the BASE substitution (already compiled and
+device-resident in the session) plus O(N k) extra GEMM work through the
+k x k *capacitance* matrix C = I + V^H A0^{-1} U — an O(N^2 k) refresh +
+O(N^2) solves where the refactor path pays O(N^3) per drift.
+
+This module holds the traceable math (capacitance assembly, the corrected
+apply, the one-shot functional solve) and the host-side
+:class:`DriftPolicy` that decides when the correction has stopped paying
+for itself and the session should pay for one true refactorization through
+the existing `FactorPlan` factor program instead. The serving surface —
+``SolveSession.update(U, V)`` / bucketed compiled programs / refactor
+plumbing — lives in `conflux_tpu.serve`; one-shot entry points are
+`solvers.solve_updated` and `batched.solve_updated_batched`.
+
+When NOT to use the refresh path (also DESIGN.md §18): accumulated rank k
+growing toward N (the correction costs O(N^2 k) per solve — past k ~ N/8
+a refactor is cheaper and more accurate), and ill-conditioned capacitance
+(cond(C) large means A0 + U V^H is near-singular *relative to the base
+factors* and the correction amplifies rounding; the policy refactors on
+both triggers, and `refine` backstop sweeps hold the residual in between).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import jax.numpy as jnp
+from jax import lax
+
+from conflux_tpu.ops import blas
+
+_HI = lax.Precision.HIGHEST
+
+
+def rank_bucket(k: int) -> int:
+    """Next power of two >= k: the compiled-program bucket for update rank
+    (and RHS width — `serve` pads to the bucket and slices back), so a
+    traffic mix of ranks/widths compiles O(log) programs, not O(distinct)."""
+    if k < 1:
+        raise ValueError(f"bucket needs a positive size, got {k}")
+    return 1 << (int(k) - 1).bit_length()
+
+
+@dataclasses.dataclass(frozen=True)
+class DriftPolicy:
+    """When does the Woodbury correction stop paying for itself?
+
+    max_rank: accumulated-rank cap; once total update rank exceeds it the
+        session refactors (None -> max(8, N // 8): past ~N/8 the O(N^2 k)
+        correction approaches the amortized O(N^3) refactor and accuracy
+        degrades with every stacked correction).
+    cond_limit: 1-norm condition cap on the k x k capacitance matrix; a
+        large cond(C) means the drifted system is near-singular relative
+        to the base factors and the correction amplifies rounding —
+        refactor instead (non-finite estimates also trigger).
+    refine: iterative-refinement backstop sweeps ADDED to the plan's own
+        `refine` on updated solves only — the residual r = b - A1 x is
+        computed against the *drifted* matrix (A0 x + U (V^H x)) and the
+        correction rides the same Woodbury apply, the serve layer's
+        existing refinement-loop discipline.
+    """
+
+    max_rank: int | None = None
+    cond_limit: float = 1e6
+    refine: int = 0
+
+    def resolved_max_rank(self, n: int) -> int:
+        if self.max_rank is not None:
+            return int(self.max_rank)
+        return max(8, n // 8)
+
+
+def capacitance(base_apply, U, V):
+    """Assemble the Woodbury correction state against the base factors.
+
+    base_apply(r) must apply A0^{-1} (the session's substitution); U, V are
+    (N, k) — zero-padded columns are harmless (they contribute an identity
+    block to C, see below). Returns (Y, Cinv, cond1):
+
+      Y    = A0^{-1} U                      (N, k)
+      C    = I_k + V^H Y                    (k, k) capacitance
+      Cinv = C^{-1}                         (k, k), dense — k is small, and
+             an explicit inverse makes every later solve two GEMMs (the
+             same trade as the serve layer's 'inv' substitution engine)
+      cond1 = ||C||_1 ||C^{-1}||_1          the drift policy's trigger
+
+    Traceable (jit/vmap-safe): the policy decision on cond1 happens on the
+    host in the serve layer, not here.
+    """
+    Y = base_apply(U.astype(jnp.result_type(U.dtype, jnp.float32)))
+    cdtype = Y.dtype
+    Vc = V.astype(cdtype)
+    k = U.shape[-1]
+    C = jnp.eye(k, dtype=cdtype) + jnp.matmul(Vc.conj().T, Y, precision=_HI)
+    Cinv = jnp.linalg.inv(C)
+    norm1 = lambda M: jnp.max(jnp.sum(jnp.abs(M), axis=-2), axis=-1)
+    cond1 = norm1(C) * norm1(Cinv)
+    return Y, Cinv, cond1
+
+
+def woodbury_apply(base_apply, Y, Cinv, V, b):
+    """A1^{-1} b through the base factors + capacitance state:
+    z - Y (Cinv (V^H z)) with z = A0^{-1} b. b is (N, nrhs)."""
+    z = base_apply(b)
+    Vc = V.astype(z.dtype)
+    w = jnp.matmul(Vc.conj().T, z, precision=_HI)
+    return z - jnp.matmul(Y.astype(z.dtype),
+                          jnp.matmul(Cinv.astype(z.dtype), w, precision=_HI),
+                          precision=_HI)
+
+
+def updated_matvec(A0, U, V, x):
+    """(A0 + U V^H) x without materializing the drifted matrix — the
+    residual matvec of the refinement backstop, O(N^2 + N k) per column."""
+    cdtype = x.dtype
+    ax = jnp.matmul(A0.astype(cdtype), x, precision=_HI)
+    w = jnp.matmul(V.astype(cdtype).conj().T, x, precision=_HI)
+    return ax + jnp.matmul(U.astype(cdtype), w, precision=_HI)
+
+
+def woodbury_solve(base_apply, A0, U, V, b, refine: int = 0):
+    """One-shot functional form: solve (A0 + U V^H) x = b given a base
+    substitution `base_apply` (r -> A0^{-1} r). `refine` sweeps compute the
+    residual against the DRIFTED matrix and correct through the same
+    Woodbury apply. A0 is only consumed when refine > 0 (pass None
+    otherwise). Traceable; b is (N, nrhs)."""
+    Y, Cinv, _ = capacitance(base_apply, U, V)
+    x = woodbury_apply(base_apply, Y, Cinv, V, b)
+    cdtype = x.dtype
+    bc = b.astype(cdtype)
+    for _ in range(refine):
+        r = bc - updated_matvec(A0, U, V, x)
+        x = x + woodbury_apply(base_apply, Y, Cinv, V, r).astype(cdtype)
+    return x
+
+
+def apply_update(A0, U, V):
+    """Materialize the drifted matrix A0 + U V^H in A0's dtype — the
+    refactor path's input (and the bench's full-refactor oracle).
+    Batch-safe: leading axes of A0/U/V broadcast through the matmul."""
+    cdtype = blas.compute_dtype(A0.dtype)
+    Vh = jnp.swapaxes(V.astype(cdtype).conj(), -1, -2)
+    return (A0.astype(cdtype)
+            + jnp.matmul(U.astype(cdtype), Vh,
+                         precision=_HI)).astype(A0.dtype)

@@ -1,0 +1,85 @@
+"""Direct coverage for the persistent-XLA-cache switch (conflux_tpu/cache.py)
+and the plan cache's `clear_plans()` — previously exercised only
+indirectly through the serve tests (ISSUE 2 satellite).
+
+The module-level `_ENABLED_AT` latch is monkeypatched around each test so
+ordering against the serve tests (which enable the real cache) does not
+matter, and the live jax config is restored afterwards.
+"""
+
+import os
+
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from conflux_tpu import cache, serve
+
+
+@pytest.fixture
+def fresh_cache(monkeypatch, tmp_path):
+    """Un-latch the enable switch and restore the jax cache config."""
+    monkeypatch.setattr(cache, "_ENABLED_AT", None)
+    before = jax.config.jax_compilation_cache_dir
+    yield tmp_path
+    jax.config.update("jax_compilation_cache_dir", before)
+
+
+def test_default_cache_dir_env_override(monkeypatch):
+    monkeypatch.setenv("CONFLUX_TPU_CACHE_DIR", "/tmp/conflux-cache-test")
+    assert cache.default_cache_dir() == "/tmp/conflux-cache-test"
+    monkeypatch.delenv("CONFLUX_TPU_CACHE_DIR")
+    assert cache.default_cache_dir().endswith(
+        os.path.join(".cache", "conflux_tpu", "xla"))
+
+
+def test_enable_points_jax_at_directory(fresh_cache):
+    target = str(fresh_cache / "xla")
+    got = cache.enable_persistent_cache(target)
+    assert got == target
+    assert os.path.isdir(target), "cache dir must be created on demand"
+    assert jax.config.jax_compilation_cache_dir == target
+    # min-entry-size filter zeroed: admission is time-thresholded only
+    assert jax.config.jax_persistent_cache_min_entry_size_bytes == 0
+    assert cache.cache_enabled()
+
+
+def test_enable_is_idempotent_first_call_wins(fresh_cache):
+    first = cache.enable_persistent_cache(str(fresh_cache / "a"))
+    second = cache.enable_persistent_cache(str(fresh_cache / "b"))
+    assert second == first, "a live cache must not be re-pointed"
+    assert jax.config.jax_compilation_cache_dir == first
+
+
+def test_enable_degrades_to_noop_on_failure(fresh_cache, monkeypatch):
+    """A backend without persistent-cache support costs compile time,
+    never an exception."""
+    def boom(*a, **k):
+        raise RuntimeError("unsupported")
+
+    # context-scoped: the patch must be gone before fixture teardown
+    # restores the real jax config
+    with monkeypatch.context() as m:
+        m.setattr(jax.config, "update", boom)
+        assert cache.enable_persistent_cache(str(fresh_cache / "c")) is None
+    assert not cache.cache_enabled()
+
+
+def test_env_var_resolves_when_no_path_given(fresh_cache, monkeypatch):
+    target = str(fresh_cache / "from-env")
+    monkeypatch.setenv("CONFLUX_TPU_CACHE_DIR", target)
+    assert cache.enable_persistent_cache() == target
+
+
+def test_clear_plans_drops_cached_plans():
+    serve.clear_plans()
+    plan = serve.FactorPlan.create((16, 16), jnp.float32, v=16,
+                                   persistent_cache=False)
+    assert serve.FactorPlan.create((16, 16), jnp.float32, v=16,
+                                   persistent_cache=False) is plan
+    serve.clear_plans()
+    fresh = serve.FactorPlan.create((16, 16), jnp.float32, v=16,
+                                    persistent_cache=False)
+    assert fresh is not plan, "clear_plans left a stale plan behind"
+    serve.clear_plans()

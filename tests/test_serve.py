@@ -230,6 +230,76 @@ def test_single_system_plan_multi_rhs():
     assert (r < 1e-5).all()
 
 
+def test_solve_rhs_bucketing_bounds_recompiles():
+    """A traffic mix of RHS widths compiles O(log) solve programs: widths
+    round up to power-of-two buckets (pad + slice), and the bucket
+    contract is enforced at the program-cache boundary."""
+    serve.clear_plans()
+    A, b = _systems(seed=31)
+    plan = serve.FactorPlan.create((N, N), jnp.float32, v=V)
+    session = plan.factor(jnp.asarray(A[0]))
+    rng = np.random.default_rng(31)
+    widths = [1, 2, 3, 5, 8, 7, 4, 6, 1, 3]
+    for w in widths:
+        bw = rng.standard_normal((N, w)).astype(np.float32)
+        x = session.solve(jnp.asarray(bw))
+        assert x.shape == (N, w), "bucket padding leaked into the result"
+        r = _residuals(np.repeat(A[:1], w, 0), np.asarray(x).T, bw.T)
+        assert (r < 1e-5).all()
+    buckets = {1, 2, 4, 8}
+    assert plan.trace_counts["solve"] == len(buckets), \
+        f"width mix {sorted(set(widths))} should compile {len(buckets)} " \
+        f"bucketed programs, traced {plan.trace_counts['solve']}"
+    assert set(plan._solve_cache) == buckets
+    # padded-bucket answers are bitwise the unpadded ones (columns are
+    # independent through substitution, GEMM, and IR alike)
+    b3 = rng.standard_normal((N, 3)).astype(np.float32)
+    x3 = np.asarray(session.solve(jnp.asarray(b3)))
+    x4 = np.asarray(session.solve(jnp.asarray(
+        np.pad(b3, ((0, 0), (0, 1))))))
+    np.testing.assert_array_equal(x3, x4[:, :3])
+    # the contract is enforced, not just followed
+    with pytest.raises(AssertionError, match="power-of-two"):
+        plan._solve_fn(3)
+
+
+def test_serve_phase_counters():
+    """profiler.serve_stats() sees factor/solve/update/refactor counts
+    and wall time without the caller instrumenting anything."""
+    from conflux_tpu import profiler
+    from conflux_tpu.update import DriftPolicy
+
+    serve.clear_plans()
+    profiler.clear()
+    A, b = _systems(seed=37)
+    rng = np.random.default_rng(37)
+    U = (rng.standard_normal((N, 2)) / np.sqrt(N)).astype(np.float32)
+    plan = serve.FactorPlan.create((N, N), jnp.float32, v=V)
+    session = plan.factor(jnp.asarray(A[0]))
+    for _ in range(4):
+        session.solve(jnp.asarray(b[0]))
+    session.update(jnp.asarray(U), jnp.asarray(U))
+    session.solve(jnp.asarray(b[0]))
+    stats = profiler.serve_stats()
+    assert stats["factor"]["count"] == 1
+    assert stats["solve"]["count"] == 5
+    assert stats["update"]["count"] == 1
+    assert stats["refactor"]["count"] == 0
+    assert stats["solves_per_factor"] == 5.0
+    assert all(stats[ph]["wall_s"] >= 0.0 for ph in profiler.SERVE_PHASES)
+    assert stats["factor"]["wall_s"] > 0.0
+    # a policy-triggered refactor lands in its own phase
+    session2 = plan.factor(jnp.asarray(A[0]),
+                           policy=DriftPolicy(cond_limit=0.5))
+    session2.update(jnp.asarray(U), jnp.asarray(U))
+    stats = profiler.serve_stats()
+    assert stats["refactor"]["count"] == 1
+    # both update() calls count (including the one that triggered)
+    assert stats["updates_per_refactor"] == 2.0
+    profiler.clear()
+    assert profiler.serve_stats()["factor"]["count"] == 0
+
+
 def test_plan_rejects_mismatched_inputs():
     serve.clear_plans()
     A, _ = _systems()
