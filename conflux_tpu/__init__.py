@@ -84,6 +84,10 @@ def __getattr__(name):
         "solve_updated_batched": (
             "conflux_tpu.batched", "solve_updated_batched"),
         "DriftPolicy": ("conflux_tpu.update", "DriftPolicy"),
+        # async serve engine (ISSUE 3)
+        "ServeEngine": ("conflux_tpu.engine", "ServeEngine"),
+        "EngineSaturated": ("conflux_tpu.engine", "EngineSaturated"),
+        "EngineClosed": ("conflux_tpu.engine", "EngineClosed"),
     }
     if name in _lazy:
         import importlib
@@ -144,4 +148,7 @@ __all__ = [
     "solve_updated",
     "solve_updated_batched",
     "DriftPolicy",
+    "ServeEngine",
+    "EngineSaturated",
+    "EngineClosed",
 ]

@@ -78,6 +78,17 @@ def _pad_batch(arrs, B: int, nshards: int):
     return out, Bp
 
 
+def stack_trees(trees):
+    """Stack identical-structure pytrees along a new leading axis.
+
+    The serve engine's cross-session coalescing primitive: S sessions of
+    one single-system plan stack their factor pytrees into a (S, ...)
+    batch and ride ONE vmapped substitution dispatch
+    (`FactorPlan._stacked_solve_fn`). None leaves must agree across trees
+    (they stay None)."""
+    return jax.tree_util.tree_map(lambda *xs: jnp.stack(xs), *trees)
+
+
 def _check_batched_square(A, what: str = "A") -> None:
     if A.ndim != 3 or A.shape[1] != A.shape[2]:
         raise ValueError(

@@ -72,3 +72,12 @@ def enable_persistent_cache(path: str | None = None, *,
 
 def cache_enabled() -> bool:
     return _ENABLED_AT is not None
+
+
+def cache_dir() -> str | None:
+    """The directory of the live persistent cache, or None when disabled.
+
+    The serve engine reports this in its startup/bench metadata so an
+    operator can tell whether prewarmed compiles will survive the process
+    (a cold replica deserializes instead of re-paying the compile)."""
+    return _ENABLED_AT

@@ -1,0 +1,609 @@
+"""Async serve engine: request coalescing, double-buffered dispatch,
+plan prewarming, and admission control.
+
+The plan/session layer (`conflux_tpu.serve`) makes a *single* session
+fast — compile once per traffic shape, factor once per matrix,
+substitution-only solves — but every entry point is synchronous and
+per-request: a fleet of sessions under open-loop traffic still dispatches
+one device program per request, leaves the device idle between host
+round-trips, and pays a compile stall on the first request of every new
+bucket. The same trade that drives the 2.5D algorithms (a little extra
+buffering/replication for far fewer, larger device operations) applies at
+the request level, and :class:`ServeEngine` makes it:
+
+- **Coalescing** — requests arriving within a ``max_batch_delay`` window
+  are grouped and merged along the axes the compiled programs already
+  bucket. Requests against the SAME session concatenate their RHS columns
+  into one wider substitution: columns are independent through every
+  substitution/GEMM/IR step, so single-system answers are bitwise the
+  per-request ones (the bucket-padding argument of `SolveSession.solve`,
+  asserted in tests/test_engine.py); batched plans' vmapped GEMM kernel
+  changes shape with the coalesced width, so their coalesced answers are
+  allclose, bitwise only within a bucket. With ``stack_sessions=True``,
+  requests against DIFFERENT sessions of one single-system plan
+  additionally stack their factor pytrees on a new leading axis and ride
+  one vmapped dispatch (`FactorPlan._stacked_solve_fn`) — allclose to,
+  but not bitwise, the per-session programs, so it is opt-in.
+
+- **Double-buffered async dispatch** — a dispatcher thread stages and
+  dispatches batch i+1 while a drain thread waits on batch i: the
+  dispatched-batch queue is bounded at two entries, so host staging
+  overlaps device compute without unbounded in-flight growth, and the hot
+  path never calls ``block_until_ready`` (JAX async dispatch carries the
+  results; only the drain thread blocks).
+
+- **Prewarming + admission control** — :meth:`ServeEngine.prewarm`
+  compiles the declared traffic buckets (widths, stack sizes) before
+  traffic lands, so p99 never eats a compile (the persistent XLA cache is
+  switched on, so even cold processes deserialize); a bounded pending
+  count sheds (``on_full='reject'``, the default, raising
+  :class:`EngineSaturated`) or backpressures (``on_full='block'``)
+  instead of collapsing into unbounded latency.
+
+Sessions mutate under ``update``/refactor; the engine only ever calls
+``session.solve``. Do not call ``session.update`` while requests against
+that session are in flight — drain first (``engine.close()`` or wait on
+the outstanding futures).
+
+    engine = ServeEngine(max_batch_delay=0.002)
+    engine.prewarm(session, widths=(1, 2, 4))
+    futs = [engine.submit(session, b) for b in rhs]     # non-blocking
+    xs = [f.result() for f in futs]                     # coalesced device work
+    print(engine.stats())                               # p50/p95/p99, batches
+    engine.close()                                      # drains in flight
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import threading
+import time
+from collections import deque
+from concurrent.futures import Future
+from queue import Empty, Queue
+from typing import Any
+
+import numpy as np
+
+import jax.numpy as jnp
+
+from conflux_tpu import profiler
+from conflux_tpu.batched import _shard_batch, stack_trees
+from conflux_tpu.update import rank_bucket
+
+
+class EngineSaturated(RuntimeError):
+    """submit() refused: the bounded pending set is full (shed policy)."""
+
+
+class EngineClosed(RuntimeError):
+    """submit() after close()."""
+
+
+@dataclasses.dataclass
+class _Request:
+    session: Any          # the SolveSession the answer comes from
+    b2: Any               # HOST RHS normalized to a trailing width axis
+    width: int            # pre-coalescing column count
+    squeeze: bool         # drop the width axis in the result
+    future: Future        # resolved by the drain thread
+    t_submit: float       # perf_counter at admission (latency clock)
+    carried: bool = False  # deferred once already — never defer again
+
+
+def _normalize_rhs(session, b):
+    """Mirror `SolveSession._rhs` on the HOST: returns (b2, squeeze) with
+    b2 a numpy array carrying an explicit trailing width axis. Staying in
+    numpy keeps admission free of device work — the dispatcher memcpys
+    requests into one bucket-width staging buffer per batch, so the
+    device sees ONE transfer and ONE prewarmed program regardless of how
+    many requests coalesced (a per-batch `concatenate` of varying widths
+    would be a fresh XLA compile per width combination)."""
+    plan = session.plan
+    b = np.asarray(b)
+    if plan.batched:
+        want = (plan.B, plan.N)
+        if b.ndim == 2:
+            if b.shape != want:
+                raise ValueError(f"rhs {b.shape}, session needs {want}")
+            return b[:, :, None], True
+        if b.ndim != 3 or b.shape[:2] != want:
+            raise ValueError(
+                f"rhs {b.shape}, session needs {want} (+ rhs axis)")
+        return b, False
+    if b.ndim == 1:
+        if b.shape[0] != plan.N:
+            raise ValueError(f"rhs {b.shape}, session needs ({plan.N},)")
+        return b[:, None], True
+    if b.ndim != 2 or b.shape[0] != plan.N:
+        raise ValueError(f"rhs {b.shape}, session needs ({plan.N}, k)")
+    return b, False
+
+
+_STOP = object()
+
+
+def _percentile(sorted_vals, pct: float) -> float:
+    """Nearest-rank percentile of an ascending list (0.0 when empty)."""
+    if not sorted_vals:
+        return 0.0
+    idx = min(len(sorted_vals) - 1,
+              max(0, int(round(pct / 100.0 * len(sorted_vals) + 0.5)) - 1))
+    return sorted_vals[idx]
+
+
+class ServeEngine:
+    """A thread-safe request queue in front of a fleet of SolveSessions.
+
+    Knobs (the latency/throughput dial, DESIGN.md §19):
+
+    max_batch_delay: how long the dispatcher holds the first request of a
+        batch while more arrive to coalesce with it. 0 disables the wait
+        (requests still coalesce when they are already queued — the burst
+        shape); larger trades p50 latency for wider device dispatches.
+    max_pending: admission bound on un-answered requests (queued plus in
+        flight). `on_full` picks the policy at the bound: 'reject' (shed:
+        submit raises :class:`EngineSaturated`) or 'block' (backpressure
+        the submitter).
+    max_coalesce_width: cap on coalesced RHS columns per dispatch — also
+        the widest bucket `prewarm` needs to cover for a compile-free
+        steady state.
+    stack_sessions / max_stack: opt-in cross-session stacking for
+        single-system plans (see module docstring).
+    latency_window: how many completed-request latencies the percentile
+        window keeps.
+    """
+
+    def __init__(self, *, max_batch_delay: float = 0.002,
+                 max_pending: int = 1024, on_full: str = "reject",
+                 max_coalesce_width: int = 32,
+                 stack_sessions: bool = False, max_stack: int = 8,
+                 latency_window: int = 8192,
+                 persistent_cache: bool = True):
+        if on_full not in ("reject", "block"):
+            raise ValueError(f"unknown on_full {on_full!r} (reject|block)")
+        if max_pending < 1 or max_coalesce_width < 1 or max_stack < 1:
+            raise ValueError("max_pending, max_coalesce_width and "
+                             "max_stack must be >= 1")
+        if persistent_cache:
+            from conflux_tpu import cache
+
+            cache.enable_persistent_cache()
+        self.max_batch_delay = float(max_batch_delay)
+        self.max_pending = int(max_pending)
+        self.on_full = on_full
+        self.max_coalesce_width = int(max_coalesce_width)
+        self.stack_sessions = bool(stack_sessions)
+        self.max_stack = int(max_stack)
+
+        self._inq: Queue = Queue()
+        # bounded at 2: the double buffer. The dispatcher stages/dispatches
+        # batch i+1 while the drain thread waits on batch i; a third batch
+        # blocks the dispatcher instead of growing in-flight device work.
+        self._outq: Queue = Queue(maxsize=2)
+        self._lock = threading.Lock()
+        self._not_full = threading.Condition(self._lock)
+        self._closed = False
+        self._pending = 0
+        self._queue_peak = 0
+        self._requests = 0
+        self._completed = 0
+        self._failed = 0
+        self._sheds = 0
+        self._batches = 0
+        self._coalesced_requests = 0
+        self._latencies: deque = deque(maxlen=int(latency_window))
+
+        profiler.register_engine(self)
+        self._dispatcher = threading.Thread(
+            target=self._dispatch_loop, name="serve-engine-dispatch",
+            daemon=True)
+        self._drainer = threading.Thread(
+            target=self._drain_loop, name="serve-engine-drain", daemon=True)
+        self._dispatcher.start()
+        self._drainer.start()
+
+    # ------------------------------------------------------------------ #
+    # client surface
+    # ------------------------------------------------------------------ #
+
+    def submit(self, session, b) -> Future:
+        """Enqueue one solve against `session`; returns a Future whose
+        result is a HOST (numpy) array with the shape and values
+        `session.solve(b)` would have returned. A served answer crosses
+        the host boundary anyway, so the engine pays it once per
+        coalesced batch (one contiguous device->host copy on the drain
+        thread) instead of per request — the per-request scatter is then
+        numpy views, zero extra device dispatches. Raises
+        :class:`EngineSaturated` at the pending bound under the 'reject'
+        policy; blocks under 'block'."""
+        if self._closed:
+            raise EngineClosed("submit() on a closed ServeEngine")
+        b2, squeeze = _normalize_rhs(session, b)
+        req = _Request(session, b2, int(b2.shape[-1]), squeeze, Future(),
+                       time.perf_counter())
+        with self._lock:
+            if self._closed:
+                raise EngineClosed("submit() on a closed ServeEngine")
+            if self._pending >= self.max_pending:
+                if self.on_full == "reject":
+                    self._sheds += 1
+                    raise EngineSaturated(
+                        f"{self._pending} pending requests >= max_pending="
+                        f"{self.max_pending} (shed policy 'reject')")
+                while self._pending >= self.max_pending \
+                        and not self._closed:
+                    self._not_full.wait()
+                if self._closed:
+                    raise EngineClosed("engine closed while blocked")
+            self._pending += 1
+            self._requests += 1
+            if self._pending > self._queue_peak:
+                self._queue_peak = self._pending
+        self._inq.put(req)
+        return req.future
+
+    def solve(self, session, b, timeout: float | None = None):
+        """Blocking convenience: ``submit(session, b).result(timeout)``."""
+        return self.submit(session, b).result(timeout)
+
+    def close(self, timeout: float | None = None) -> None:
+        """Stop admission, drain every in-flight request, join the
+        workers. Queued requests are answered, not dropped; idempotent."""
+        with self._lock:
+            already = self._closed
+            self._closed = True
+            self._not_full.notify_all()
+        if not already:
+            self._inq.put(_STOP)
+        self._dispatcher.join(timeout)
+        self._drainer.join(timeout)
+
+    def __enter__(self) -> "ServeEngine":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()
+
+    # ------------------------------------------------------------------ #
+    # prewarming
+    # ------------------------------------------------------------------ #
+
+    def prewarm(self, session, widths=(1,), stacks=(), wait: bool = True):
+        """Compile the session's solve programs for the declared traffic
+        before it lands: `widths` are RHS widths (rounded up to
+        power-of-two buckets — include the coalesced widths you expect;
+        `max_coalesce_width` covers the worst case), `stacks` are
+        cross-session stack sizes (single-system plans only). Runs the
+        programs once on zero RHS through the plan's own cached builders,
+        so steady-state traffic observes zero compiles (asserted via
+        `plan.trace_counts` in tests and bench_engine). `wait=False`
+        compiles on a background thread (the engine-start pattern) and
+        returns the Thread."""
+
+        def run():
+            with profiler.region("engine.prewarm"):
+                for wb in sorted({rank_bucket(w) for w in widths}):
+                    self._prewarm_width(session, wb)
+                    for s in stacks:
+                        self._prewarm_stack(session, rank_bucket(s), wb)
+
+        if wait:
+            run()
+            return None
+        t = threading.Thread(target=run, name="serve-engine-prewarm",
+                             daemon=True)
+        t.start()
+        return t
+
+    def _prewarm_width(self, session, wb: int) -> None:
+        plan = session.plan
+        shape = ((plan.B, plan.N, wb) if plan.batched else (plan.N, wb))
+        b2 = jnp.zeros(shape, jnp.dtype(plan.key.dtype))
+        if plan.mesh is not None:
+            (b2,) = _shard_batch((b2,), plan.mesh)
+        plan._solve_fn(wb)(session._factors, session._A,
+                           b2).block_until_ready()
+
+    def _prewarm_stack(self, session, sb: int, wb: int) -> None:
+        plan = session.plan
+        if plan.batched:
+            raise ValueError(
+                "stacks= prewarming applies to single-system plans only")
+        F = stack_trees([session._factors] * sb)
+        A = None if session._A is None else jnp.stack([session._A] * sb)
+        b = jnp.zeros((sb, plan.N, wb), jnp.dtype(plan.key.dtype))
+        plan._stacked_solve_fn(sb, wb)(F, A, b).block_until_ready()
+
+    # ------------------------------------------------------------------ #
+    # dispatcher: collect a window, coalesce, dispatch async
+    # ------------------------------------------------------------------ #
+
+    def _dispatch_loop(self) -> None:
+        stop = False
+        carry: list = []  # small remainder chunks deferred to this round
+        while not stop:
+            if carry:
+                try:
+                    first = self._inq.get(timeout=self.max_batch_delay)
+                except Empty:
+                    first = None  # window spent waiting on the carry
+            else:
+                first = self._inq.get()
+            batch = list(carry)
+            carry = []
+            collect = True
+            if first is _STOP:
+                stop = True
+                collect = False
+            elif first is None:
+                collect = False
+            else:
+                batch.append(first)
+            if collect:
+                deadline = time.perf_counter() + self.max_batch_delay
+                while True:
+                    remaining = deadline - time.perf_counter()
+                    if remaining <= 0:
+                        # the window is over, but anything ALREADY queued
+                        # still coalesces (the burst shape: a backlog
+                        # should never dispatch one request at a time)
+                        try:
+                            r = self._inq.get_nowait()
+                        except Empty:
+                            break
+                    else:
+                        try:
+                            r = self._inq.get(timeout=remaining)
+                        except Empty:
+                            break
+                    if r is _STOP:
+                        stop = True
+                        break
+                    batch.append(r)
+                    if len(batch) >= self.max_pending:
+                        break
+            if batch:
+                carry = self._dispatch(
+                    batch,
+                    may_defer=not stop and not self._inq.empty())
+        if carry:
+            self._dispatch(carry, may_defer=False)
+        self._outq.put(_STOP)
+
+    def _dispatch(self, batch, may_defer: bool = False) -> list:
+        """Group a window's requests and dispatch each group as one
+        device program (async — nothing here blocks on device work).
+        With `may_defer` (more traffic already queued), each session's
+        small remainder chunk is handed back once to ride the next
+        window instead of wasting a whole dispatch on a sliver."""
+        groups: dict[int, list[_Request]] = {}
+        order = []
+        for r in batch:
+            key = id(r.session)
+            if key not in groups:
+                groups[key] = []
+                order.append(r.session)
+            groups[key].append(r)
+        deferred: list = []
+        stackable: dict[int, list] = {}
+        plan_order = []
+        for session in order:
+            reqs = groups[id(session)]
+            if (self.stack_sessions and not session.plan.batched
+                    and session._upd is None):
+                pk = id(session.plan)
+                if pk not in stackable:
+                    stackable[pk] = []
+                    plan_order.append(session.plan)
+                stackable[pk].append((session, reqs))
+            else:
+                deferred += self._dispatch_session(session, reqs,
+                                                   may_defer)
+        for plan in plan_order:
+            entries = stackable[id(plan)]
+            if len(entries) == 1:
+                deferred += self._dispatch_session(*entries[0], may_defer)
+            else:
+                self._dispatch_stacked(plan, entries)
+        return deferred
+
+    def _dispatch_session(self, session, reqs,
+                          may_defer: bool = False) -> list:
+        """Per-session coalescing: concatenate RHS columns up to the
+        width cap and run each chunk through `session.solve` (which
+        already buckets, pads, shards, and counts). Returns the deferred
+        remainder (at most one small chunk, each request deferred at most
+        once — the latency cost is bounded by one extra window)."""
+        chunks: list[list[_Request]] = []
+        chunk: list[_Request] = []
+        width = 0
+        for r in reqs:
+            if chunk and width + r.width > self.max_coalesce_width:
+                chunks.append(chunk)
+                chunk, width = [], 0
+            chunk.append(r)
+            width += r.width
+        deferred: list = []
+        if chunk:
+            if (may_defer and width <= self.max_coalesce_width // 2
+                    and not any(r.carried for r in chunk)):
+                for r in chunk:
+                    r.carried = True
+                deferred = chunk
+            else:
+                chunks.append(chunk)
+        for c in chunks:
+            self._run_chunk(session, c)
+        return deferred
+
+    def _stage(self, reqs):
+        """Host-stage a session chunk: memcpy every request's columns
+        into ONE bucket-width buffer (zero-padded — exactly the padding
+        `SolveSession.solve` would add, so answers stay bitwise). A numpy
+        buffer keeps staging off the device and, crucially, off the
+        compiler: the device sees one transfer of one already-bucketed
+        shape, never a fresh concatenate signature. Returns (buf, spec)
+        with spec the (request, stack-slot, column-offset) scatter plan
+        for the drain thread."""
+        W = sum(r.width for r in reqs)
+        wb = rank_bucket(W)
+        lead = reqs[0].b2.shape[:-1]
+        buf = np.zeros(lead + (wb,), reqs[0].b2.dtype)
+        spec = []
+        lo = 0
+        for r in reqs:
+            buf[..., lo:lo + r.width] = r.b2
+            spec.append((r, None, lo))
+            lo += r.width
+        return buf, spec
+
+    def _run_chunk(self, session, reqs) -> None:
+        try:
+            buf, spec = self._stage(reqs)
+            x = session.solve(buf)
+        except Exception as e:  # noqa: BLE001 — engine must survive
+            self._fail(reqs, e)
+            return
+        with self._lock:
+            self._batches += 1
+            self._coalesced_requests += len(reqs)
+        self._outq.put((spec, x))
+
+    def _dispatch_stacked(self, plan, entries) -> None:
+        """Cross-session coalescing for single-system plans: per-session
+        RHS concat first (width-capped; overflow falls back to per-session
+        dispatch), then up to `max_stack` sessions stack factors along a
+        new leading axis into one vmapped dispatch."""
+        ready = []
+        for session, reqs in entries:
+            chunk: list[_Request] = []
+            width = 0
+            rest: list[_Request] = []
+            for r in reqs:
+                if not rest and (not chunk or width + r.width
+                                 <= self.max_coalesce_width):
+                    chunk.append(r)
+                    width += r.width
+                else:
+                    rest.append(r)
+            ready.append((session, chunk, width))
+            if rest:
+                self._dispatch_session(session, rest)
+        for i in range(0, len(ready), self.max_stack):
+            part = ready[i:i + self.max_stack]
+            if len(part) == 1:
+                self._run_chunk(part[0][0], part[0][1])
+            else:
+                self._run_stack(plan, part)
+
+    def _run_stack(self, plan, part) -> None:
+        reqs_all = [r for _, reqs, _ in part for r in reqs]
+        try:
+            wb = rank_bucket(max(w for _, _, w in part))
+            sb = rank_bucket(len(part))
+            # host-stage the whole stack in one (sb, N, wb) buffer; the
+            # pad slots repeat session 0's factors against zero columns
+            buf = np.zeros((sb, plan.N, wb),
+                           part[0][1][0].b2.dtype)
+            spec = []
+            factors, As = [], []
+            for si, (session, reqs, _w) in enumerate(part):
+                lo = 0
+                for r in reqs:
+                    buf[si, :, lo:lo + r.width] = r.b2
+                    spec.append((r, si, lo))
+                    lo += r.width
+                factors.append(session._factors)
+                As.append(session._A)
+            while len(factors) < sb:
+                factors.append(factors[0])
+                As.append(As[0])
+            F = stack_trees(factors)
+            A = None if As[0] is None else jnp.stack(As)
+            with profiler.region("serve.solve"):
+                X = plan._stacked_solve_fn(sb, wb)(F, A, buf)
+        except Exception as e:  # noqa: BLE001
+            self._fail(reqs_all, e)
+            return
+        for session, _reqs, _w in part:
+            session.solves += 1
+        with self._lock:
+            self._batches += 1
+            self._coalesced_requests += len(reqs_all)
+        self._outq.put((spec, X))
+
+    def _fail(self, reqs, exc: Exception) -> None:
+        with self._lock:
+            self._pending -= len(reqs)
+            self._failed += len(reqs)
+            self._not_full.notify_all()
+        for r in reqs:
+            r.future.set_exception(exc)
+
+    # ------------------------------------------------------------------ #
+    # drain: the only thread that blocks on device work
+    # ------------------------------------------------------------------ #
+
+    def _drain_loop(self) -> None:
+        import numpy as np
+
+        while True:
+            item = self._outq.get()
+            if item is _STOP:
+                break
+            spec, block_on = item
+            try:
+                # ONE blocking device->host copy per coalesced batch; the
+                # per-request scatter is numpy views of it, so answering N
+                # requests costs zero extra device dispatches
+                xh = np.asarray(block_on)
+            except Exception as e:  # noqa: BLE001
+                self._fail([r for r, _si, _lo in spec], e)
+                continue
+            now = time.perf_counter()
+            with self._lock:
+                for r, _si, _lo in spec:
+                    self._latencies.append(now - r.t_submit)
+                self._pending -= len(spec)
+                self._completed += len(spec)
+                self._not_full.notify_all()
+            for r, si, lo in spec:
+                xs = (xh[..., lo:lo + r.width] if si is None
+                      else xh[si, :, lo:lo + r.width])
+                if r.squeeze:
+                    xs = xs[..., 0]
+                r.future.set_result(xs)
+
+    # ------------------------------------------------------------------ #
+    # observability (merged into profiler.serve_stats()['engine'])
+    # ------------------------------------------------------------------ #
+
+    def stats(self) -> dict:
+        """Engine counters: queue depth high-water mark, batches
+        dispatched, mean coalesced batch size, shed count, and
+        p50/p95/p99 request latency over the rolling window."""
+        with self._lock:
+            lats = sorted(self._latencies)
+            batches = self._batches
+            return {
+                "pending": self._pending,
+                "queue_peak": self._queue_peak,
+                "requests": self._requests,
+                "completed": self._completed,
+                "failed": self._failed,
+                "shed": self._sheds,
+                "batches": batches,
+                "coalesced_requests": self._coalesced_requests,
+                "coalesced_mean": (self._coalesced_requests / batches
+                                   if batches else 0.0),
+                "latency_p50_ms": 1e3 * _percentile(lats, 50),
+                "latency_p95_ms": 1e3 * _percentile(lats, 95),
+                "latency_p99_ms": 1e3 * _percentile(lats, 99),
+            }
+
+    def latency_samples(self) -> list:
+        """The rolling latency window in seconds (profiler merges these
+        across engines for fleet-wide percentiles)."""
+        with self._lock:
+            return list(self._latencies)

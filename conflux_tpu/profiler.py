@@ -97,6 +97,63 @@ def trace(logdir: str):
 # without instrumenting anything themselves
 SERVE_PHASES = ("factor", "solve", "update", "refactor")
 
+# live ServeEngines (conflux_tpu/engine.py) register here (weakly — an
+# engine dies with its owner) so serve_stats() can fold queue/coalescing/
+# latency counters in next to the per-phase wall times
+_ENGINE_REFS: list = []
+
+
+def register_engine(engine) -> None:
+    """Called by ServeEngine.__init__; weak so engines are collectable."""
+    import weakref
+
+    _ENGINE_REFS.append(weakref.ref(engine))
+
+
+def _live_engines() -> list:
+    alive, dead = [], []
+    for ref in _ENGINE_REFS:
+        e = ref()
+        (alive if e is not None else dead).append(e if e is not None
+                                                  else ref)
+    for ref in dead:
+        _ENGINE_REFS.remove(ref)
+    return alive
+
+
+def engine_stats() -> dict:
+    """Aggregate ServeEngine counters across live engines: queue depth
+    high-water mark (max), batches dispatched / requests / sheds (sums),
+    mean coalesced batch size (request-weighted), and p50/p95/p99 request
+    latency over the engines' merged rolling windows. Zeroes when no
+    engine is alive."""
+    engines = _live_engines()
+    out = {"engines": len(engines), "requests": 0, "completed": 0,
+           "shed": 0, "batches": 0, "queue_peak": 0,
+           "coalesced_mean": 0.0, "latency_p50_ms": 0.0,
+           "latency_p95_ms": 0.0, "latency_p99_ms": 0.0}
+    coalesced = 0
+    samples: list = []
+    for e in engines:
+        s = e.stats()
+        out["requests"] += s["requests"]
+        out["completed"] += s["completed"]
+        out["shed"] += s["shed"]
+        out["batches"] += s["batches"]
+        out["queue_peak"] = max(out["queue_peak"], s["queue_peak"])
+        coalesced += s["coalesced_requests"]
+        samples.extend(e.latency_samples())
+    if out["batches"]:
+        out["coalesced_mean"] = coalesced / out["batches"]
+    if samples:
+        from conflux_tpu.engine import _percentile
+
+        samples.sort()
+        for pct, key in ((50, "latency_p50_ms"), (95, "latency_p95_ms"),
+                         (99, "latency_p99_ms")):
+            out[key] = 1e3 * _percentile(samples, pct)
+    return out
+
 
 def serve_stats() -> dict:
     """Per-phase serving counters from the `serve.*` regions.
@@ -107,7 +164,9 @@ def serve_stats() -> dict:
     the serving win) and 'updates_per_refactor' (how many O(N^2 k)
     refreshes each drift-policy refactorization amortized over). Phases
     never entered report zero; `clear()` resets alongside everything
-    else.
+    else. An 'engine' sub-dict carries the ServeEngine counters
+    (:func:`engine_stats`) — those live on the engines themselves, so
+    `clear()` does not reset them.
     """
     out: dict = {}
     for ph in SERVE_PHASES:
@@ -121,6 +180,7 @@ def serve_stats() -> dict:
     out["updates_per_refactor"] = (out["update"]["count"] / refac
                                    if refac else float("inf")
                                    if out["update"]["count"] else 0.0)
+    out["engine"] = engine_stats()
     return out
 
 

@@ -98,6 +98,34 @@ _PLANS: dict[PlanKey, "FactorPlan"] = {}
 _PLANS_LOCK = threading.Lock()
 
 
+class _CompileOnce:
+    """Serialize the FIRST call of a jitted program; later calls bypass.
+
+    jax.jit wrappers are cheap to build but trace on first call, and two
+    engine workers hitting a cold wrapper concurrently can both pay the
+    trace (double-compiling the bucket and double-bumping the plan's
+    trace counters). Memoizing the wrapper under the plan lock is not
+    enough — the trace happens at call time — so the first execution
+    holds a per-program lock; once it completes, the hot path is
+    lock-free.
+    """
+
+    __slots__ = ("fn", "_lock", "_warm")
+
+    def __init__(self, fn):
+        self.fn = fn
+        self._lock = threading.Lock()
+        self._warm = False
+
+    def __call__(self, *args):
+        if self._warm:
+            return self.fn(*args)
+        with self._lock:
+            out = self.fn(*args)
+            self._warm = True
+        return out
+
+
 def clear_plans() -> None:
     """Drop every cached plan (tests; frees the jitted closures)."""
     with _PLANS_LOCK:
@@ -139,9 +167,27 @@ class FactorPlan:
         # trace-time side effects let tests assert "second call compiles
         # nothing" without reaching into jax internals
         self.trace_counts = {"factor": 0, "solve": 0}
-        self._factor_fn = self._build_factor()
-        self._solve_cache: dict[tuple, Any] = {}
+        # concurrent engine workers fill the memoized program caches
+        # double-checked under this lock (one built wrapper per bucket)
+        # and serialize each wrapper's first call through _CompileOnce
+        # (one TRACE per bucket) — see tests/test_serve.py's thread hammer
+        self._compile_lock = threading.Lock()
+        self._factor_fn = _CompileOnce(self._build_factor())
+        self._solve_cache: dict[Any, Any] = {}
         self._update_cache: dict[tuple, Any] = {}
+
+    def _memo(self, cache: dict, key, build):
+        """Double-checked get-or-build of a compiled-program cache entry;
+        the built wrapper is a :class:`_CompileOnce` so the bucket is
+        traced exactly once even under concurrent first callers."""
+        fn = cache.get(key)
+        if fn is None:
+            with self._compile_lock:
+                fn = cache.get(key)
+                if fn is None:
+                    fn = _CompileOnce(build())
+                    cache[key] = fn
+        return fn
 
     # ------------------------------------------------------------------ #
     # cache
@@ -316,17 +362,35 @@ class FactorPlan:
             raise AssertionError(
                 f"_solve_fn takes power-of-two RHS buckets, got {nrhs} — "
                 "route request widths through SolveSession.solve")
-        fn = self._solve_cache.get(nrhs)
-        if fn is None:
+
+        def build():
             one = self._one_solve
             f = jax.vmap(one) if self.batched else one
             if self.mesh is None:
-                fn = jax.jit(f)
-            else:
-                fn = jax.jit(
-                    f, out_shardings=_batch_spec(self.mesh, 3))
-            self._solve_cache[nrhs] = fn
-        return fn
+                return jax.jit(f)
+            return jax.jit(f, out_shardings=_batch_spec(self.mesh, 3))
+
+        return self._memo(self._solve_cache, nrhs, build)
+
+    def _stacked_solve_fn(self, ns: int, nrhs: int):
+        """The engine's cross-session program: `ns` sessions of this
+        (single-system) plan stack their factor pytrees on a new leading
+        axis and ride ONE vmapped substitution dispatch (`ServeEngine`
+        with ``stack_sessions=True``). Bucketed like everything else —
+        power-of-two session count and RHS width; the engine pads by
+        repeating a session slot / zero columns and slices back. The
+        stacked result is allclose to, but not bitwise, the per-session
+        dispatch (XLA batches the GEMMs differently under vmap)."""
+        if self.batched:
+            raise AssertionError(
+                "stacked dispatch is for single-system plans — batched "
+                "plans already amortize over their own batch axis")
+        if ns & (ns - 1) or ns < 1 or nrhs & (nrhs - 1) or nrhs < 1:
+            raise AssertionError(
+                f"_stacked_solve_fn takes power-of-two buckets, got "
+                f"({ns}, {nrhs}) — route requests through ServeEngine")
+        return self._memo(self._solve_cache, ("stacked", ns, nrhs),
+                          lambda: jax.jit(jax.vmap(self._one_solve)))
 
     # ------------------------------------------------------------------ #
     # incremental (Woodbury) update programs — compiled once per bucket
@@ -360,52 +424,55 @@ class FactorPlan:
     def _update_fn(self, kb: int):
         """Jitted capacitance-assembly program per rank bucket kb:
         (factors, Up, Vp) -> (Y, Cinv, cond1)."""
-        key = ("update", kb)
-        fn = self._update_cache.get(key)
-        if fn is None:
+        def build():
             f = jax.vmap(self._one_update) if self.batched \
                 else self._one_update
             if self.mesh is None:
-                fn = jax.jit(f)
-            else:
-                fn = jax.jit(f, out_shardings=(
-                    _batch_spec(self.mesh, 3), _batch_spec(self.mesh, 3),
-                    _batch_spec(self.mesh, 1)))
-            self._update_cache[key] = fn
-        return fn
+                return jax.jit(f)
+            return jax.jit(f, out_shardings=(
+                _batch_spec(self.mesh, 3), _batch_spec(self.mesh, 3),
+                _batch_spec(self.mesh, 1)))
+
+        return self._memo(self._update_cache, ("update", kb), build)
 
     def _update_solve_fn(self, kb: int, nrhs: int, sweeps: int):
         """Jitted Woodbury solve program per (rank bucket, RHS bucket,
         backstop sweeps)."""
-        key = ("usolve", kb, nrhs, sweeps)
-        fn = self._update_cache.get(key)
-        if fn is None:
+        def build():
             import functools
 
             one = functools.partial(self._one_update_solve, sweeps)
             f = jax.vmap(one) if self.batched else one
             if self.mesh is None:
-                fn = jax.jit(f)
-            else:
-                fn = jax.jit(f, out_shardings=_batch_spec(self.mesh, 3))
-            self._update_cache[key] = fn
-        return fn
+                return jax.jit(f)
+            return jax.jit(f, out_shardings=_batch_spec(self.mesh, 3))
 
-    def _refresh_fn(self, kb: int):
+        return self._memo(self._update_cache, ("usolve", kb, nrhs, sweeps),
+                          build)
+
+    def _refresh_fn(self, kb: int, donate: bool = False):
         """Jitted A0 + U V^H materialization per rank bucket — the
-        refactor trigger's input, feeding the existing factor program."""
+        refactor trigger's input, feeding the existing factor program.
+
+        `donate=True` hands the superseded A0 buffer to XLA (the output
+        replaces it), so a long-lived drifting session holds ONE resident
+        base matrix at the refactor peak instead of two. Only safe when
+        the session owns A0 — i.e. it came from a previous refactor, not
+        from the caller, who may still hold the array — so the session
+        tracks ownership and the donating and non-donating programs cache
+        separately."""
         from conflux_tpu.update import apply_update
 
-        key = ("refresh", kb)
-        fn = self._update_cache.get(key)
-        if fn is None:
+        def build():
             f = jax.vmap(apply_update) if self.batched else apply_update
+            donate_argnums = (0,) if donate else ()
             if self.mesh is None:
-                fn = jax.jit(f)
-            else:
-                fn = jax.jit(f, out_shardings=_batch_spec(self.mesh, 3))
-            self._update_cache[key] = fn
-        return fn
+                return jax.jit(f, donate_argnums=donate_argnums)
+            return jax.jit(f, out_shardings=_batch_spec(self.mesh, 3),
+                           donate_argnums=donate_argnums)
+
+        return self._memo(self._update_cache, ("refresh", kb, donate),
+                          build)
 
     # ------------------------------------------------------------------ #
     # serving surface
@@ -471,6 +538,10 @@ class SolveSession:
         self._A0 = A if A_base is None else A_base
         self.policy = DriftPolicy() if policy is None else policy
         self._upd = None  # dict(k, kb, Up, Vp, Y, Cinv) when drifted
+        # the base matrix is the CALLER's array until the first refactor
+        # replaces it with an engine-built one; only owned bases may be
+        # donated to the refresh program (see FactorPlan._refresh_fn)
+        self._owns_base = False
         self.factorizations = 1
         self.solves = 0
         self.updates = 0
@@ -579,10 +650,20 @@ class SolveSession:
         V = jnp.asarray(V, dtype)
         self._check_uv(U, V)
         with profiler.region("serve.update"):
-            if self._upd is not None and not replace:
-                k0 = self._upd["k"]
-                U = jnp.concatenate([self._upd["Up"][..., :k0], U], axis=-1)
-                V = jnp.concatenate([self._upd["Vp"][..., :k0], V], axis=-1)
+            if self._upd is not None:
+                if replace:
+                    # the superseded Woodbury state (Up/Vp/Y/Cinv) is dead
+                    # the moment the drift is re-measured — drop it before
+                    # the new dispatch so it never doubles peak memory
+                    self._upd = None
+                else:
+                    k0 = self._upd["k"]
+                    U = jnp.concatenate([self._upd["Up"][..., :k0], U],
+                                        axis=-1)
+                    V = jnp.concatenate([self._upd["Vp"][..., :k0], V],
+                                        axis=-1)
+                    # the concatenated copies carry the history now
+                    self._upd = None
             k = U.shape[-1]
             if k > self.policy.resolved_max_rank(plan.N):
                 self._refactor(U, V)
@@ -616,11 +697,18 @@ class SolveSession:
                 Up, Vp = jnp.pad(Up, pad), jnp.pad(Vp, pad)
             if plan.mesh is not None:
                 Up, Vp = _shard_batch((Up, Vp), plan.mesh)
-            A_new = plan._refresh_fn(kb)(self._A0, Up, Vp)
+            # the superseded drift state is dead the moment the new base
+            # exists — drop it before dispatching, and donate the old base
+            # once the session owns it, so the refactor peak holds one
+            # resident base + one factor set, not two of each
+            self._upd = None
+            A_new = plan._refresh_fn(kb, donate=self._owns_base)(
+                self._A0, Up, Vp)
             self._A0 = A_new
+            self._owns_base = True
             if self._A is not None:
                 self._A = A_new
+            self._factors = None  # release before the factor dispatch
             self._factors = plan._factor_fn(A_new)
-        self._upd = None
         self.factorizations += 1
         self.refactors += 1

@@ -1,0 +1,248 @@
+"""Serve-engine tests: the ISSUE 3 acceptance contracts, asserted.
+
+- Coalesced results are BITWISE the direct `SolveSession.solve` answers
+  under a deterministic mixed-width / mixed-session / mixed-plan trace
+  (RHS columns are independent through every substitution/GEMM/IR step,
+  and the power-of-two bucket programs agree per column — the same
+  argument `test_solve_rhs_bucketing_bounds_recompiles` established for
+  padding, extended across buckets).
+- Backpressure SHEDS (raises `EngineSaturated`) at the pending bound
+  instead of deadlocking, and every admitted request still completes.
+- Prewarming the declared buckets means steady-state traffic observes
+  ZERO compiles (the plans' trace counters, the serve layer's contract
+  hook).
+- `close()` drains in-flight requests rather than dropping them.
+- Cross-session stacking (opt-in) matches direct solves to working
+  accuracy and compiles one stacked bucket program.
+- Engine counters surface through `profiler.serve_stats()['engine']`.
+"""
+
+import threading
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from conflux_tpu import profiler, serve
+from conflux_tpu.engine import (
+    EngineClosed,
+    EngineSaturated,
+    ServeEngine,
+)
+
+B, N, V = 4, 32, 16
+
+
+def _systems(b, n=N, seed=0):
+    rng = np.random.default_rng(seed)
+    A = (rng.standard_normal((b, n, n)) / np.sqrt(n)
+         + 2.0 * np.eye(n)).astype(np.float32)
+    return A
+
+
+def _trace(rng, n_req, widths=(1, 2, 3, 4)):
+    """A deterministic mixed-width request trace: (width, rhs) pairs,
+    width-1 requests submitted in the squeeze (vector) form."""
+    out = []
+    for i in range(n_req):
+        w = widths[i % len(widths)]
+        shape = (N, w) if w > 1 else (N,)
+        out.append((w, rng.standard_normal(shape).astype(np.float32)))
+    return out
+
+
+def test_engine_bitwise_matches_direct_solve():
+    """Mixed widths, mixed sessions, mixed plans (single + batched):
+    single-system answers are BITWISE the direct session.solve ones
+    (per-column kernels agree across width buckets); batched-plan
+    answers ride vmapped GEMMs whose kernel shape changes with the
+    coalesced width, so they are held to a tight allclose instead."""
+    serve.clear_plans()
+    A = _systems(3, seed=41)
+    Ab = _systems(B, seed=43)
+    plan = serve.FactorPlan.create((N, N), jnp.float32, v=V)
+    bplan = serve.FactorPlan.create((B, N, N), jnp.float32, v=V)
+    sessions = [plan.factor(jnp.asarray(A[i])) for i in range(3)]
+    bsession = bplan.factor(jnp.asarray(Ab))
+
+    rng = np.random.default_rng(47)
+    reqs = []
+    for i, (w, b) in enumerate(_trace(rng, 12)):
+        reqs.append((sessions[i % 3], jnp.asarray(b)))
+    for _ in range(3):  # batched-plan traffic rides the same queue
+        reqs.append((bsession, jnp.asarray(
+            rng.standard_normal((B, N)).astype(np.float32))))
+
+    direct = [np.asarray(s.solve(b)) for s, b in reqs]
+    with ServeEngine(max_batch_delay=0.05, max_coalesce_width=8) as eng:
+        futs = [eng.submit(s, b) for s, b in reqs]
+        results = [np.asarray(f.result(timeout=60)) for f in futs]
+    for i, (d, r) in enumerate(zip(direct, results)):
+        assert d.shape == r.shape, (i, d.shape, r.shape)
+        if reqs[i][0] is bsession:
+            np.testing.assert_allclose(r, d, rtol=1e-5, atol=1e-6,
+                                       err_msg=f"request {i}")
+        else:
+            np.testing.assert_array_equal(d, r, err_msg=f"request {i}")
+    # a batched request alone in its window runs the very same program —
+    # bitwise, no caveat
+    with ServeEngine(max_batch_delay=0.0) as eng:
+        b1 = reqs[-1][1]
+        np.testing.assert_array_equal(
+            np.asarray(eng.solve(bsession, b1, timeout=60)),
+            np.asarray(bsession.solve(b1)))
+
+
+def test_engine_prewarm_zero_compiles_in_steady_state():
+    serve.clear_plans()
+    A = _systems(1, seed=53)
+    plan = serve.FactorPlan.create((N, N), jnp.float32, v=V)
+    session = plan.factor(jnp.asarray(A[0]))
+    rng = np.random.default_rng(53)
+    # cap coalescing at 4 so prewarming buckets {1, 2, 4} covers every
+    # width steady-state traffic can produce
+    with ServeEngine(max_batch_delay=0.02, max_coalesce_width=4) as eng:
+        eng.prewarm(session, widths=(1, 2, 4))
+        snapshot = dict(plan.trace_counts)
+        futs = [eng.submit(session, jnp.asarray(b))
+                for _, b in _trace(rng, 16, widths=(1, 2, 1, 1))]
+        for f in futs:
+            f.result(timeout=60)
+        assert plan.trace_counts == snapshot, \
+            "steady-state traffic compiled after prewarm"
+        stats = eng.stats()
+    assert stats["completed"] == 16
+    assert stats["batches"] >= 1
+    assert stats["coalesced_mean"] >= 1.0
+    assert stats["queue_peak"] >= 1
+
+
+def test_engine_backpressure_sheds_not_deadlocks():
+    serve.clear_plans()
+    A = _systems(1, seed=59)
+    plan = serve.FactorPlan.create((N, N), jnp.float32, v=V)
+    session = plan.factor(jnp.asarray(A[0]))
+    b = jnp.asarray(np.ones(N, np.float32))
+    # a huge window parks the dispatcher on its first batch, so the
+    # pending bound is hit deterministically; close() releases it
+    eng = ServeEngine(max_batch_delay=60.0, max_pending=2)
+    f1 = eng.submit(session, b)
+    f2 = eng.submit(session, b)
+    with pytest.raises(EngineSaturated, match="max_pending"):
+        eng.submit(session, b)
+    assert eng.stats()["shed"] == 1
+    eng.close(timeout=60)
+    # the shed did not poison the admitted requests
+    assert f1.done() and f2.done()
+    np.testing.assert_array_equal(np.asarray(f1.result()),
+                                  np.asarray(f2.result()))
+    with pytest.raises(EngineClosed):
+        eng.submit(session, b)
+
+
+def test_engine_block_policy_backpressures():
+    """'block' admission never deadlocks: a submitter thread pushing past
+    the bound finishes once the dispatcher drains."""
+    serve.clear_plans()
+    A = _systems(1, seed=61)
+    plan = serve.FactorPlan.create((N, N), jnp.float32, v=V)
+    session = plan.factor(jnp.asarray(A[0]))
+    rng = np.random.default_rng(61)
+    futs = []
+    with ServeEngine(max_batch_delay=0.0, max_pending=2,
+                     on_full="block") as eng:
+        def pump():
+            for _, b in _trace(rng, 12, widths=(1,)):
+                futs.append(eng.submit(session, jnp.asarray(b)))
+
+        t = threading.Thread(target=pump)
+        t.start()
+        t.join(timeout=120)
+        assert not t.is_alive(), "blocked submitter never released"
+        for f in futs:
+            f.result(timeout=60)
+    assert eng.stats()["completed"] == 12
+    assert eng.stats()["shed"] == 0
+
+
+def test_engine_close_drains_in_flight():
+    serve.clear_plans()
+    A = _systems(2, seed=67)
+    plan = serve.FactorPlan.create((N, N), jnp.float32, v=V)
+    sessions = [plan.factor(jnp.asarray(A[i])) for i in range(2)]
+    rng = np.random.default_rng(67)
+    eng = ServeEngine(max_batch_delay=60.0)  # everything queued at close
+    pairs = [(sessions[i % 2], jnp.asarray(b))
+             for i, (_, b) in enumerate(_trace(rng, 10))]
+    futs = [eng.submit(s, b) for s, b in pairs]
+    eng.close(timeout=120)
+    assert all(f.done() for f in futs), "close() dropped queued requests"
+    for (s, b), f in zip(pairs, futs):
+        np.testing.assert_array_equal(np.asarray(f.result()),
+                                      np.asarray(s.solve(b)))
+
+
+def test_engine_stacked_sessions_match_direct():
+    """Opt-in cross-session stacking: one vmapped dispatch answers many
+    single-system sessions; allclose to direct (not bitwise — XLA batches
+    the GEMMs differently under vmap), one stacked bucket program."""
+    serve.clear_plans()
+    A = _systems(3, seed=71)
+    plan = serve.FactorPlan.create((N, N), jnp.float32, v=V)
+    sessions = [plan.factor(jnp.asarray(A[i])) for i in range(3)]
+    rng = np.random.default_rng(71)
+    bs = [jnp.asarray(rng.standard_normal((N, w)).astype(np.float32))
+          for w in (1, 2, 2)]
+    direct = [np.asarray(s.solve(b)) for s, b in zip(sessions, bs)]
+    eng = ServeEngine(max_batch_delay=60.0, stack_sessions=True,
+                      max_stack=4)
+    futs = [eng.submit(s, b) for s, b in zip(sessions, bs)]
+    eng.close(timeout=120)
+    for i, f in enumerate(futs):
+        r = np.asarray(f.result())
+        assert r.shape == direct[i].shape
+        np.testing.assert_allclose(r, direct[i], rtol=2e-5, atol=1e-6)
+    # 3 sessions pad to the 4-stack bucket, widths (1, 2, 2) to bucket 2
+    assert ("stacked", 4, 2) in plan._solve_cache
+    assert eng.stats()["batches"] == 1, "stack did not coalesce"
+    # a batched plan refuses the stacked builder outright
+    bplan = serve.FactorPlan.create((B, N, N), jnp.float32, v=V)
+    with pytest.raises(AssertionError, match="single-system"):
+        bplan._stacked_solve_fn(2, 1)
+
+
+def test_engine_bad_rhs_fails_that_request_only():
+    serve.clear_plans()
+    A = _systems(1, seed=73)
+    plan = serve.FactorPlan.create((N, N), jnp.float32, v=V)
+    session = plan.factor(jnp.asarray(A[0]))
+    good = jnp.asarray(np.ones(N, np.float32))
+    with ServeEngine(max_batch_delay=0.01) as eng:
+        with pytest.raises(ValueError, match="session needs"):
+            eng.submit(session, jnp.zeros((N + 1,), jnp.float32))
+        f = eng.submit(session, good)
+        np.testing.assert_array_equal(np.asarray(f.result(timeout=60)),
+                                      np.asarray(session.solve(good)))
+
+
+def test_engine_counters_in_serve_stats():
+    serve.clear_plans()
+    A = _systems(1, seed=79)
+    plan = serve.FactorPlan.create((N, N), jnp.float32, v=V)
+    session = plan.factor(jnp.asarray(A[0]))
+    b = jnp.asarray(np.ones(N, np.float32))
+    with ServeEngine(max_batch_delay=0.01) as eng:
+        for _ in range(4):
+            eng.solve(session, b, timeout=60)
+        merged = profiler.serve_stats()["engine"]
+        mine = eng.stats()
+    assert merged["engines"] >= 1
+    assert merged["requests"] >= mine["requests"] >= 4
+    assert merged["batches"] >= mine["batches"] >= 1
+    assert merged["queue_peak"] >= mine["queue_peak"]
+    assert merged["latency_p50_ms"] > 0.0
+    assert merged["latency_p99_ms"] >= merged["latency_p50_ms"]
+    # profiler.clear() resets phases, not the engines' own counters
+    profiler.clear()
+    assert profiler.serve_stats()["engine"]["requests"] >= 4

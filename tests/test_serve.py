@@ -300,6 +300,58 @@ def test_serve_phase_counters():
     assert profiler.serve_stats()["factor"]["count"] == 0
 
 
+def test_concurrent_callers_compile_each_bucket_once():
+    """ISSUE 3 satellite: the per-plan memoized program caches are safe
+    under concurrent engine workers — a thread pool hammering one plan's
+    width mix compiles each bucket exactly once (one cached wrapper, one
+    trace), instead of double-compiling and corrupting the trace
+    counters."""
+    import threading
+
+    serve.clear_plans()
+    A, _ = _systems(seed=41)
+    plan = serve.FactorPlan.create((N, N), jnp.float32, v=V)
+    session = plan.factor(jnp.asarray(A[0]))
+    rng = np.random.default_rng(41)
+    rhs = {w: jnp.asarray(rng.standard_normal((N, w)).astype(np.float32))
+           for w in (1, 2, 3, 5, 7, 8)}
+    results: dict = {}
+    errors: list = []
+    barrier = threading.Barrier(6)
+
+    def worker(tid):
+        try:
+            barrier.wait()
+            for w, b in rhs.items():
+                results[(tid, w)] = np.asarray(session.solve(b))
+            # the builder itself is also hammered directly: every thread
+            # must get the SAME cached wrapper back
+            results[(tid, "fn")] = plan._solve_fn(8)
+        except Exception as e:  # noqa: BLE001
+            errors.append(e)
+
+    threads = [threading.Thread(target=worker, args=(t,)) for t in range(6)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=300)
+    assert not errors, errors
+    buckets = {1, 2, 4, 8}
+    assert set(plan._solve_cache) == buckets
+    assert plan.trace_counts["solve"] == len(buckets), \
+        f"concurrent callers traced {plan.trace_counts['solve']} solve " \
+        f"programs for {len(buckets)} buckets"
+    fns = {results[(t, 'fn')] for t in range(6)}
+    assert len(fns) == 1, "threads built distinct wrappers for one bucket"
+    # and every thread got the same (correct) answers
+    for w in rhs:
+        ref = results[(0, w)]
+        assert (_residuals(np.repeat(A[:1], w, 0), ref.T,
+                           np.asarray(rhs[w]).T) < 1e-5).all()
+        for t in range(1, 6):
+            np.testing.assert_array_equal(results[(t, w)], ref)
+
+
 def test_plan_rejects_mismatched_inputs():
     serve.clear_plans()
     A, _ = _systems()

@@ -264,6 +264,53 @@ def test_drift_policy_default_max_rank():
 # --------------------------------------------------------------------------- #
 
 
+def test_refactor_buffer_donation_and_live_array_parity():
+    """ISSUE 3 satellite: a long-lived drifting session holds ONE
+    resident base+factor set. The refresh program donates the superseded
+    base once the session owns it (never the caller's array), old factor
+    and Woodbury references drop before the replacement dispatch, and the
+    live-buffer count stays flat across repeated refactors."""
+    import gc
+
+    import jax
+
+    serve.clear_plans()
+    A, U, Vm, b = _systems(b=None, seed=21)
+    plan = serve.FactorPlan.create((N, N), jnp.float32, v=V)
+    # max_rank=1 < K forces a true refactor on every update
+    session = plan.factor(jnp.asarray(A), policy=DriftPolicy(max_rank=1))
+    caller_A = session._A0
+    session.update(jnp.asarray(U), jnp.asarray(Vm))
+    assert session.refactors == 1
+    # first refactor: the base was the CALLER's array — never donated
+    assert not caller_A.is_deleted()
+    owned_A = session._A0
+    assert session._owns_base
+    session.update(jnp.asarray(U), jnp.asarray(Vm))
+    assert session.refactors == 2
+    # later refactors: the session-owned base is donated to its successor
+    assert owned_A.is_deleted(), \
+        "superseded owned base survived the refresh dispatch"
+    # the session still answers correctly after donation churn
+    x = session.solve(jnp.asarray(b))
+    A1 = np.asarray(apply_update(jnp.asarray(A), jnp.asarray(U),
+                                 jnp.asarray(Vm)))
+    A2 = np.asarray(apply_update(jnp.asarray(A1), jnp.asarray(U),
+                                 jnp.asarray(Vm)))
+    assert _res(A2, x, b) <= float(_bars(A2, b))
+    # live-array parity: more refactors may not grow resident state
+    x.block_until_ready()
+    gc.collect()
+    n0 = len(jax.live_arrays())
+    for _ in range(4):
+        session.update(jnp.asarray(U), jnp.asarray(Vm))
+    session.solve(jnp.asarray(b)).block_until_ready()
+    gc.collect()
+    n1 = len(jax.live_arrays())
+    assert n1 <= n0, \
+        f"live buffers grew across refactors: {n0} -> {n1}"
+
+
 def test_solve_updated_matches_refactor_oracle():
     A, U, Vm, b = _systems(b=None, seed=11)
     x = solvers.solve_updated(jnp.asarray(A), jnp.asarray(U),
