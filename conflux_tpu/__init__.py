@@ -88,6 +88,15 @@ def __getattr__(name):
         "ServeEngine": ("conflux_tpu.engine", "ServeEngine"),
         "EngineSaturated": ("conflux_tpu.engine", "EngineSaturated"),
         "EngineClosed": ("conflux_tpu.engine", "EngineClosed"),
+        # serve-path resilience (ISSUE 4)
+        "HealthPolicy": ("conflux_tpu.resilience", "HealthPolicy"),
+        "FaultPlan": ("conflux_tpu.resilience", "FaultPlan"),
+        "FaultSpec": ("conflux_tpu.resilience", "FaultSpec"),
+        "SolveUnhealthy": ("conflux_tpu.resilience", "SolveUnhealthy"),
+        "DeadlineExceeded": ("conflux_tpu.resilience", "DeadlineExceeded"),
+        "SessionQuarantined": (
+            "conflux_tpu.resilience", "SessionQuarantined"),
+        "RhsNonFinite": ("conflux_tpu.resilience", "RhsNonFinite"),
     }
     if name in _lazy:
         import importlib
@@ -151,4 +160,11 @@ __all__ = [
     "ServeEngine",
     "EngineSaturated",
     "EngineClosed",
+    "HealthPolicy",
+    "FaultPlan",
+    "FaultSpec",
+    "SolveUnhealthy",
+    "DeadlineExceeded",
+    "SessionQuarantined",
+    "RhsNonFinite",
 ]

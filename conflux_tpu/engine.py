@@ -1,5 +1,5 @@
 """Async serve engine: request coalescing, double-buffered dispatch,
-plan prewarming, and admission control.
+plan prewarming, admission control, and the serve-path resilience layer.
 
 The plan/session layer (`conflux_tpu.serve`) makes a *single* session
 fast — compile once per traffic shape, factor once per matrix,
@@ -37,15 +37,35 @@ the request level, and :class:`ServeEngine` makes it:
   traffic lands, so p99 never eats a compile (the persistent XLA cache is
   switched on, so even cold processes deserialize); a bounded pending
   count sheds (``on_full='reject'``, the default, raising
-  :class:`EngineSaturated`) or backpressures (``on_full='block'``)
-  instead of collapsing into unbounded latency.
+  :class:`EngineSaturated` with an exponential-backoff ``retry_after``
+  hint) or backpressures (``on_full='block'``) instead of collapsing
+  into unbounded latency.
+
+- **Resilience** (`conflux_tpu.resilience`, DESIGN.md §20) — with
+  ``health=HealthPolicy()``: every request's RHS is finite-guarded at
+  ``submit()`` and again at staging, so a poisoned request fails its OWN
+  future instead of corrupting the coalesced batch; every dispatched
+  solve carries a fused finite/spot-residual verdict, and an unhealthy
+  batch re-dispatches the innocent survivors individually while the sick
+  request climbs the escalation ladder (forced refactor through the
+  cached factor program, one iterative-refinement sweep, then a
+  structured `SolveUnhealthy`); a session failing the whole ladder
+  `quarantine_after` times in a row is quarantined by a circuit breaker
+  (fast `SessionQuarantined`, half-open probe after the cooldown).
+  Independent of the policy: per-request ``deadline=`` with lazy
+  eviction (`DeadlineExceeded` frees the pending slot), a watchdog that
+  fails pending work when a worker thread dies instead of queueing
+  forever, and ``close(timeout)`` that reports wedged workers and fails
+  still-pending futures. `fault_plan=` injects deterministic faults at
+  the named sites (tests, `scripts/soak.py --serve`).
 
 Sessions mutate under ``update``/refactor; the engine only ever calls
-``session.solve``. Do not call ``session.update`` while requests against
-that session are in flight — drain first (``engine.close()`` or wait on
-the outstanding futures).
+``session.solve``/``solve_checked`` (under the session's lock, so the
+escalation ladder's factor swaps are atomic against the dispatcher). Do
+not call ``session.update`` while requests against that session are in
+flight — drain first (``engine.close()`` or wait on the futures).
 
-    engine = ServeEngine(max_batch_delay=0.002)
+    engine = ServeEngine(max_batch_delay=0.002, health=HealthPolicy())
     engine.prewarm(session, widths=(1, 2, 4))
     futs = [engine.submit(session, b) for b in rhs]     # non-blocking
     xs = [f.result() for f in futs]                     # coalesced device work
@@ -60,24 +80,38 @@ import threading
 import time
 from collections import deque
 from concurrent.futures import Future
-from queue import Empty, Queue
+from queue import Empty, Full, Queue
 from typing import Any
 
 import numpy as np
 
 import jax.numpy as jnp
 
-from conflux_tpu import profiler
+from conflux_tpu import profiler, resilience
 from conflux_tpu.batched import _shard_batch, stack_trees
+from conflux_tpu.resilience import (
+    DeadlineExceeded,
+    HealthPolicy,
+    RhsNonFinite,
+    SessionQuarantined,
+)
 from conflux_tpu.update import rank_bucket
 
 
 class EngineSaturated(RuntimeError):
-    """submit() refused: the bounded pending set is full (shed policy)."""
+    """submit() refused: the bounded pending set is full (shed policy).
+    `retry_after` is an exponential-backoff hint in seconds — it doubles
+    with every consecutive shed and resets on the next admission, so a
+    retrying client herd spreads out instead of hammering the bound."""
+
+    def __init__(self, msg: str, retry_after: float = 0.0):
+        super().__init__(msg)
+        self.retry_after = retry_after
 
 
 class EngineClosed(RuntimeError):
-    """submit() after close()."""
+    """submit() after close(), or pending work failed because the engine
+    shut down (wedged close, dead worker thread)."""
 
 
 @dataclasses.dataclass
@@ -88,7 +122,10 @@ class _Request:
     squeeze: bool         # drop the width axis in the result
     future: Future        # resolved by the drain thread
     t_submit: float       # perf_counter at admission (latency clock)
+    expiry: float | None = None  # perf_counter deadline (lazy eviction)
     carried: bool = False  # deferred once already — never defer again
+
+    __hash__ = object.__hash__
 
 
 def _normalize_rhs(session, b):
@@ -135,7 +172,7 @@ def _percentile(sorted_vals, pct: float) -> float:
 class ServeEngine:
     """A thread-safe request queue in front of a fleet of SolveSessions.
 
-    Knobs (the latency/throughput dial, DESIGN.md §19):
+    Knobs (the latency/throughput dial, DESIGN.md §19; resilience §20):
 
     max_batch_delay: how long the dispatcher holds the first request of a
         batch while more arrive to coalesce with it. 0 disables the wait
@@ -143,8 +180,8 @@ class ServeEngine:
         shape); larger trades p50 latency for wider device dispatches.
     max_pending: admission bound on un-answered requests (queued plus in
         flight). `on_full` picks the policy at the bound: 'reject' (shed:
-        submit raises :class:`EngineSaturated`) or 'block' (backpressure
-        the submitter).
+        submit raises :class:`EngineSaturated` with a backoff hint) or
+        'block' (backpressure the submitter).
     max_coalesce_width: cap on coalesced RHS columns per dispatch — also
         the widest bucket `prewarm` needs to cover for a compile-free
         steady state.
@@ -152,6 +189,18 @@ class ServeEngine:
         single-system plans (see module docstring).
     latency_window: how many completed-request latencies the percentile
         window keeps.
+    health: a :class:`~conflux_tpu.resilience.HealthPolicy` switches on
+        the numerical guards (RHS finite checks, fused output verdicts,
+        escalation, quarantine). None (default) keeps the dispatch path
+        byte-identical to the unguarded engine — the checked programs
+        are *different* compiled programs, so guarded answers are
+        allclose, not bitwise, the unguarded ones.
+    fault_plan: a :class:`~conflux_tpu.resilience.FaultPlan` consulted at
+        the instrumented sites (staging, dispatch, drain, d2h, solve) —
+        deterministic chaos for tests/soak; None costs one comparison.
+    watchdog_interval: poll period of the worker-liveness watchdog
+        (0 disables; a worker dying by exception still trips the same
+        path directly).
     """
 
     def __init__(self, *, max_batch_delay: float = 0.002,
@@ -159,7 +208,10 @@ class ServeEngine:
                  max_coalesce_width: int = 32,
                  stack_sessions: bool = False, max_stack: int = 8,
                  latency_window: int = 8192,
-                 persistent_cache: bool = True):
+                 persistent_cache: bool = True,
+                 health: HealthPolicy | None = None,
+                 fault_plan=None,
+                 watchdog_interval: float = 0.2):
         if on_full not in ("reject", "block"):
             raise ValueError(f"unknown on_full {on_full!r} (reject|block)")
         if max_pending < 1 or max_coalesce_width < 1 or max_stack < 1:
@@ -175,6 +227,9 @@ class ServeEngine:
         self.max_coalesce_width = int(max_coalesce_width)
         self.stack_sessions = bool(stack_sessions)
         self.max_stack = int(max_stack)
+        self.health = health
+        self._faults = fault_plan
+        self.watchdog_interval = float(watchdog_interval)
 
         self._inq: Queue = Queue()
         # bounded at 2: the double buffer. The dispatcher stages/dispatches
@@ -190,9 +245,17 @@ class ServeEngine:
         self._completed = 0
         self._failed = 0
         self._sheds = 0
+        self._consec_sheds = 0
         self._batches = 0
         self._coalesced_requests = 0
         self._latencies: deque = deque(maxlen=int(latency_window))
+        # every admitted, unanswered request. Resolution OWNERSHIP: a
+        # request's future is only ever resolved by the path that removed
+        # it from this set under the lock (`_take`), so a wedged worker
+        # finishing late after close()/watchdog failed its request can
+        # never double-resolve the Future.
+        self._live: set = set()
+        self._dead: tuple | None = None  # (thread name, exc) post-mortem
 
         profiler.register_engine(self)
         self._dispatcher = threading.Thread(
@@ -202,54 +265,100 @@ class ServeEngine:
             target=self._drain_loop, name="serve-engine-drain", daemon=True)
         self._dispatcher.start()
         self._drainer.start()
+        self._watchdog = None
+        if self.watchdog_interval > 0:
+            self._watchdog = threading.Thread(
+                target=self._watchdog_loop, name="serve-engine-watchdog",
+                daemon=True)
+            self._watchdog.start()
 
     # ------------------------------------------------------------------ #
     # client surface
     # ------------------------------------------------------------------ #
 
-    def submit(self, session, b) -> Future:
+    def submit(self, session, b, *, deadline: float | None = None) -> Future:
         """Enqueue one solve against `session`; returns a Future whose
         result is a HOST (numpy) array with the shape and values
         `session.solve(b)` would have returned. A served answer crosses
         the host boundary anyway, so the engine pays it once per
         coalesced batch (one contiguous device->host copy on the drain
         thread) instead of per request — the per-request scatter is then
-        numpy views, zero extra device dispatches. Raises
-        :class:`EngineSaturated` at the pending bound under the 'reject'
-        policy; blocks under 'block'."""
+        numpy views, zero extra device dispatches.
+
+        `deadline` (seconds from now) bounds how long the request may
+        wait: a request still queued past its deadline is lazily evicted
+        — its pending slot is released and its future fails with
+        :class:`DeadlineExceeded` (an abandoned `result(timeout)` alone
+        would leak the slot). Raises :class:`EngineSaturated` at the
+        pending bound under the 'reject' policy (with a `retry_after`
+        backoff hint); blocks under 'block'. With a
+        :class:`HealthPolicy`, a non-finite RHS raises
+        :class:`RhsNonFinite` here and a quarantined session
+        :class:`SessionQuarantined`."""
         if self._closed:
             raise EngineClosed("submit() on a closed ServeEngine")
+        if self._dead is not None:
+            name, exc = self._dead
+            raise EngineClosed(f"engine worker {name} died: {exc!r}")
+        if self.health is not None:
+            br = resilience.breaker_for(session, self.health)
+            ok, retry = br.allow()
+            if not ok:
+                raise SessionQuarantined(
+                    f"session quarantined after repeated escalation "
+                    f"failures (breaker open; probe in ~{retry:.2f}s)",
+                    retry_after=retry)
         b2, squeeze = _normalize_rhs(session, b)
+        if (self.health is not None and self.health.check_rhs
+                and not resilience.rhs_finite(
+                    b2, sample=self.health.submit_guard_sample)):
+            resilience.bump("rhs_rejects")
+            raise RhsNonFinite(
+                "rhs contains NaN/Inf — rejected at admission (a poisoned "
+                "request would corrupt every co-batched answer)")
+        now = time.perf_counter()
         req = _Request(session, b2, int(b2.shape[-1]), squeeze, Future(),
-                       time.perf_counter())
+                       now, None if deadline is None else now + deadline)
         with self._lock:
             if self._closed:
                 raise EngineClosed("submit() on a closed ServeEngine")
             if self._pending >= self.max_pending:
                 if self.on_full == "reject":
                     self._sheds += 1
+                    self._consec_sheds += 1
+                    hint = min(1.0, 1e-3 * (1 << min(self._consec_sheds - 1,
+                                                     10)))
                     raise EngineSaturated(
                         f"{self._pending} pending requests >= max_pending="
-                        f"{self.max_pending} (shed policy 'reject')")
+                        f"{self.max_pending} (shed policy 'reject'; "
+                        f"retry in ~{1e3 * hint:.0f}ms, backoff hint "
+                        f"doubles per consecutive shed)", retry_after=hint)
                 while self._pending >= self.max_pending \
                         and not self._closed:
                     self._not_full.wait()
                 if self._closed:
                     raise EngineClosed("engine closed while blocked")
+            self._consec_sheds = 0
             self._pending += 1
             self._requests += 1
+            self._live.add(req)
             if self._pending > self._queue_peak:
                 self._queue_peak = self._pending
         self._inq.put(req)
         return req.future
 
-    def solve(self, session, b, timeout: float | None = None):
+    def solve(self, session, b, timeout: float | None = None,
+              deadline: float | None = None):
         """Blocking convenience: ``submit(session, b).result(timeout)``."""
-        return self.submit(session, b).result(timeout)
+        return self.submit(session, b, deadline=deadline).result(timeout)
 
-    def close(self, timeout: float | None = None) -> None:
+    def close(self, timeout: float | None = None) -> list:
         """Stop admission, drain every in-flight request, join the
-        workers. Queued requests are answered, not dropped; idempotent."""
+        workers. Queued requests are answered, not dropped; idempotent.
+        Returns the names of wedged worker threads ([] normally): when a
+        join times out, still-pending futures are failed with
+        :class:`EngineClosed` naming the wedged thread instead of being
+        left hanging forever."""
         with self._lock:
             already = self._closed
             self._closed = True
@@ -258,6 +367,16 @@ class ServeEngine:
             self._inq.put(_STOP)
         self._dispatcher.join(timeout)
         self._drainer.join(timeout)
+        wedged = [t.name for t in (self._dispatcher, self._drainer)
+                  if t.is_alive()]
+        if wedged:
+            with self._lock:
+                leftover = list(self._live)
+            self._fail(leftover, EngineClosed(
+                f"close(timeout={timeout}) gave up: worker thread(s) "
+                f"{wedged} wedged; {len(leftover)} pending request(s) "
+                "failed instead of hanging"))
+        return wedged
 
     def __enter__(self) -> "ServeEngine":
         return self
@@ -274,12 +393,12 @@ class ServeEngine:
         before it lands: `widths` are RHS widths (rounded up to
         power-of-two buckets — include the coalesced widths you expect;
         `max_coalesce_width` covers the worst case), `stacks` are
-        cross-session stack sizes (single-system plans only). Runs the
-        programs once on zero RHS through the plan's own cached builders,
-        so steady-state traffic observes zero compiles (asserted via
-        `plan.trace_counts` in tests and bench_engine). `wait=False`
-        compiles on a background thread (the engine-start pattern) and
-        returns the Thread."""
+        cross-session stack sizes (single-system plans only). Warms the
+        CHECKED programs instead when the engine's health policy checks
+        outputs — whatever program steady-state traffic will actually
+        ride observes zero compiles (asserted via `plan.trace_counts` in
+        tests and bench_engine). `wait=False` compiles on a background
+        thread (the engine-start pattern) and returns the Thread."""
 
         def run():
             with profiler.region("engine.prewarm"):
@@ -302,8 +421,13 @@ class ServeEngine:
         b2 = jnp.zeros(shape, jnp.dtype(plan.key.dtype))
         if plan.mesh is not None:
             (b2,) = _shard_batch((b2,), plan.mesh)
-        plan._solve_fn(wb)(session._factors, session._A,
-                           b2).block_until_ready()
+        if self.health is not None and self.health.check_output:
+            x, _ = plan._solve_health_fn(wb)(
+                session._factors, session._A0, session._probe_row(), b2)
+            x.block_until_ready()
+        else:
+            plan._solve_fn(wb)(session._factors, session._A,
+                               b2).block_until_ready()
 
     def _prewarm_stack(self, session, sb: int, wb: int) -> None:
         plan = session.plan
@@ -320,12 +444,47 @@ class ServeEngine:
     # ------------------------------------------------------------------ #
 
     def _dispatch_loop(self) -> None:
+        try:
+            self._dispatch_inner()
+        except BaseException as e:  # noqa: BLE001 — post-mortem + watchdog
+            self._thread_died(self._dispatcher.name, e)
+
+    def _wait_bound(self, reqs, remaining: float) -> float:
+        """Cap a collect wait at the soonest request deadline, so lazy
+        eviction runs when a deadline passes mid-window instead of after
+        the whole `max_batch_delay` (or a blocked slot's whole wait)."""
+        exps = [r.expiry for r in reqs if r.expiry is not None]
+        if not exps:
+            return remaining
+        return min(remaining,
+                   max(0.0, min(exps) - time.perf_counter()) + 1e-4)
+
+    def _prune_expired(self, reqs) -> list:
+        """Lazy deadline eviction: fail expired requests with
+        :class:`DeadlineExceeded` (releasing their pending slots — this
+        is what un-wedges an `on_full='block'` submitter whose queue is
+        full of abandoned work) and return the survivors."""
+        now = time.perf_counter()
+        live = []
+        for r in reqs:
+            if r.expiry is not None and now > r.expiry:
+                resilience.bump("evictions")
+                self._fail([r], DeadlineExceeded(
+                    f"deadline passed {now - r.expiry:.3f}s before "
+                    "dispatch (lazily evicted; pending slot released)"))
+            else:
+                live.append(r)
+        return live
+
+    def _dispatch_inner(self) -> None:
         stop = False
         carry: list = []  # small remainder chunks deferred to this round
         while not stop:
             if carry:
                 try:
-                    first = self._inq.get(timeout=self.max_batch_delay)
+                    first = self._inq.get(
+                        timeout=self._wait_bound(carry,
+                                                 self.max_batch_delay))
                 except Empty:
                     first = None  # window spent waiting on the carry
             else:
@@ -343,6 +502,7 @@ class ServeEngine:
             if collect:
                 deadline = time.perf_counter() + self.max_batch_delay
                 while True:
+                    batch = self._prune_expired(batch)
                     remaining = deadline - time.perf_counter()
                     if remaining <= 0:
                         # the window is over, but anything ALREADY queued
@@ -354,9 +514,13 @@ class ServeEngine:
                             break
                     else:
                         try:
-                            r = self._inq.get(timeout=remaining)
+                            r = self._inq.get(
+                                timeout=self._wait_bound(batch, remaining))
                         except Empty:
-                            break
+                            # the wait may have been truncated by a batch
+                            # member's deadline — loop: prune, recompute,
+                            # and let the remaining<=0 path end the window
+                            continue
                     if r is _STOP:
                         stop = True
                         break
@@ -364,11 +528,17 @@ class ServeEngine:
                     if len(batch) >= self.max_pending:
                         break
             if batch:
-                carry = self._dispatch(
-                    batch,
-                    may_defer=not stop and not self._inq.empty())
+                batch = self._prune_expired(batch)
+            if batch:
+                try:
+                    resilience.maybe_fault(self._faults, "dispatch")
+                    carry = self._dispatch(
+                        batch,
+                        may_defer=not stop and not self._inq.empty())
+                except Exception as e:  # noqa: BLE001 — engine survives
+                    self._fail(batch, e)
         if carry:
-            self._dispatch(carry, may_defer=False)
+            self._dispatch(self._prune_expired(carry), may_defer=False)
         self._outq.put(_STOP)
 
     def _dispatch(self, batch, may_defer: bool = False) -> list:
@@ -437,6 +607,39 @@ class ServeEngine:
             self._run_chunk(session, c)
         return deferred
 
+    def _admit_stage(self, reqs) -> list:
+        """Pre-staging admission on the dispatch path: lazy deadline
+        eviction and the 'staging' fault site (poisons the request's OWN
+        host copy, upstream of the guard — exactly what a corrupted
+        staging write looks like)."""
+        reqs = self._prune_expired(reqs)
+        if self._faults is not None or resilience.active_faults():
+            for r in reqs:
+                if resilience.data_fault(self._faults, "staging",
+                                         "nan") is not None:
+                    poisoned = np.array(r.b2, copy=True)
+                    poisoned[..., 0] = np.nan
+                    r.b2 = poisoned
+        return reqs
+
+    def _isolate_poisoned(self, reqs) -> list:
+        """The SECOND finite guard (staging): one summation over the
+        coalesced buffer answers 'is anything poisoned?' per BATCH; only
+        on suspicion does the per-request scan run to fail the culprits
+        alone. Requests poisoned after submit-time admission (or by an
+        injected fault) therefore never reach the device, and the
+        co-batched answers stay exactly what they would have been."""
+        live = []
+        for r in reqs:
+            if resilience.rhs_finite(r.b2):
+                live.append(r)
+                continue
+            resilience.bump("staging_isolations")
+            self._fail([r], RhsNonFinite(
+                "rhs went non-finite after admission — isolated at "
+                "staging (co-batched requests unaffected)"))
+        return live
+
     def _stage(self, reqs):
         """Host-stage a session chunk: memcpy every request's columns
         into ONE bucket-width buffer (zero-padded — exactly the padding
@@ -458,25 +661,69 @@ class ServeEngine:
             lo += r.width
         return buf, spec
 
-    def _run_chunk(self, session, reqs) -> None:
+    def _solve_session(self, session, buf):
+        """One dispatch through the session, checked when the policy
+        says so. Holds the session lock so a drain-thread escalation
+        (factor swap) is atomic against this dispatcher."""
+        with session._lock:
+            if self.health is not None and self.health.check_output:
+                return session.solve_checked(buf)
+            return session.solve(buf), None
+
+    def _run_chunk(self, session, reqs, solo: bool = False) -> None:
+        reqs = self._admit_stage(reqs)
+        if not reqs:
+            return
         try:
             buf, spec = self._stage(reqs)
-            x = session.solve(buf)
+            if (self.health is not None and self.health.check_rhs
+                    and not self.health.check_output
+                    and not resilience.rhs_finite(buf)):
+                # no fused output verdict to backstop the staging guard:
+                # one per-BATCH summation here; the per-request scan
+                # runs only on suspicion. (With check_output on, the
+                # device-side finite verdict detects staged poison for
+                # FREE — NaN stays in its own answer column — and the
+                # drain isolates the culprit with the same exact scan,
+                # so the clean path stages without re-reading a byte.)
+                reqs = self._isolate_poisoned(reqs)
+                if not reqs:
+                    return
+                buf, spec = self._stage(reqs)
+            x, verdict = self._solve_session(session, buf)
         except Exception as e:  # noqa: BLE001 — engine must survive
-            self._fail(reqs, e)
+            self._redispatch_survivors(reqs, e, solo)
             return
         with self._lock:
             self._batches += 1
             self._coalesced_requests += len(reqs)
-        self._outq.put((spec, x))
+        self._outq.put((spec, x, verdict, buf))
+
+    def _redispatch_survivors(self, reqs, exc, solo: bool = False) -> None:
+        """A batch-attributable failure (dispatch exception, failed d2h
+        copy, unhealthy verdict on a multi-request batch) re-dispatches
+        each member INDIVIDUALLY instead of failing all of them with the
+        same exception: the innocent co-batched requests get their
+        answers; only the actually-sick request fails (possibly after
+        its own escalation ladder). One level deep — a solo request that
+        fails again fails for real."""
+        if solo or len(reqs) == 1:
+            self._fail(reqs, exc)
+            return
+        resilience.bump("survivor_redispatches", len(reqs))
+        for r in reqs:
+            self._run_chunk(r.session, [r], solo=True)
 
     def _dispatch_stacked(self, plan, entries) -> None:
         """Cross-session coalescing for single-system plans: per-session
         RHS concat first (width-capped; overflow falls back to per-session
         dispatch), then up to `max_stack` sessions stack factors along a
-        new leading axis into one vmapped dispatch."""
+        new leading axis into one vmapped dispatch. The health verdict is
+        not fused into the stacked program — stacked batches still get
+        exception-level survivor re-dispatch, and stacking is opt-in."""
         ready = []
         for session, reqs in entries:
+            reqs = self._admit_stage(reqs)
             chunk: list[_Request] = []
             width = 0
             rest: list[_Request] = []
@@ -487,7 +734,8 @@ class ServeEngine:
                     width += r.width
                 else:
                     rest.append(r)
-            ready.append((session, chunk, width))
+            if chunk:
+                ready.append((session, chunk, width))
             if rest:
                 self._dispatch_session(session, rest)
         for i in range(0, len(ready), self.max_stack):
@@ -524,56 +772,216 @@ class ServeEngine:
             with profiler.region("serve.solve"):
                 X = plan._stacked_solve_fn(sb, wb)(F, A, buf)
         except Exception as e:  # noqa: BLE001
-            self._fail(reqs_all, e)
+            self._redispatch_survivors(reqs_all, e)
             return
         for session, _reqs, _w in part:
             session.solves += 1
         with self._lock:
             self._batches += 1
             self._coalesced_requests += len(reqs_all)
-        self._outq.put((spec, X))
+        self._outq.put((spec, X, None, None))
+
+    # ------------------------------------------------------------------ #
+    # resolution ownership + failure bookkeeping
+    # ------------------------------------------------------------------ #
+
+    def _take(self, reqs) -> set:
+        """Atomically claim resolution ownership: only requests still in
+        `_live` are returned, and their pending slots are released. The
+        claimer — and nobody else — resolves their futures."""
+        with self._lock:
+            owned = {r for r in reqs if r in self._live}
+            self._live.difference_update(owned)
+            self._pending -= len(owned)
+            self._not_full.notify_all()
+        return owned
 
     def _fail(self, reqs, exc: Exception) -> None:
+        owned = self._take(reqs)
         with self._lock:
-            self._pending -= len(reqs)
-            self._failed += len(reqs)
-            self._not_full.notify_all()
-        for r in reqs:
+            self._failed += len(owned)
+        for r in owned:
             r.future.set_exception(exc)
+
+    def _settle(self, spec, xh) -> None:
+        """Resolve a drained batch: per-request scatter as numpy views
+        of the one host copy (zero extra device dispatches)."""
+        now = time.perf_counter()
+        owned = self._take([r for r, _si, _lo in spec])
+        with self._lock:
+            for r in owned:
+                self._latencies.append(now - r.t_submit)
+            self._completed += len(owned)
+        for r, si, lo in spec:
+            if r not in owned:
+                continue
+            xs = (xh[..., lo:lo + r.width] if si is None
+                  else xh[si, :, lo:lo + r.width])
+            if r.squeeze:
+                xs = xs[..., 0]
+            r.future.set_result(xs)
 
     # ------------------------------------------------------------------ #
     # drain: the only thread that blocks on device work
     # ------------------------------------------------------------------ #
 
     def _drain_loop(self) -> None:
-        import numpy as np
+        try:
+            self._drain_inner()
+        except BaseException as e:  # noqa: BLE001 — post-mortem + watchdog
+            self._thread_died(self._drainer.name, e)
 
+    def _drain_inner(self) -> None:
         while True:
             item = self._outq.get()
             if item is _STOP:
                 break
-            spec, block_on = item
+            spec, block_on, verdict, buf = item
+            reqs = [r for r, _si, _lo in spec]
             try:
+                resilience.maybe_fault(self._faults, "drain")
+                resilience.maybe_fault(self._faults, "d2h")
                 # ONE blocking device->host copy per coalesced batch; the
                 # per-request scatter is numpy views of it, so answering N
                 # requests costs zero extra device dispatches
                 xh = np.asarray(block_on)
             except Exception as e:  # noqa: BLE001
-                self._fail([r for r, _si, _lo in spec], e)
+                # satellite: batch-attributable drain failure routes
+                # through survivor re-dispatch, not batch-wide _fail
+                self._drain_redispatch(reqs, e)
                 continue
-            now = time.perf_counter()
-            with self._lock:
-                for r, _si, _lo in spec:
-                    self._latencies.append(now - r.t_submit)
-                self._pending -= len(spec)
-                self._completed += len(spec)
-                self._not_full.notify_all()
-            for r, si, lo in spec:
-                xs = (xh[..., lo:lo + r.width] if si is None
-                      else xh[si, :, lo:lo + r.width])
-                if r.squeeze:
-                    xs = xs[..., 0]
-                r.future.set_result(xs)
+            if verdict is not None:
+                session = reqs[0].session
+                limit = self._limit(session)
+                healthy, finite, res = resilience.evaluate(verdict, limit)
+                if resilience.data_fault(self._faults, "solve",
+                                         "unhealthy") is not None:
+                    healthy = False
+                if not healthy:
+                    resilience.bump("output_failures")
+                    self._drain_unhealthy(session, spec, buf, finite, res)
+                    continue
+                if session._breaker is not None:
+                    session._breaker.record_success()
+            self._settle(spec, xh)
+
+    def _limit(self, session) -> float:
+        return self.health.resolved_residual_limit(
+            np.dtype(session.plan.key.dtype), session.plan.N)
+
+    def _drain_redispatch(self, reqs, exc) -> None:
+        """Survivor re-dispatch from the drain side: re-solve each
+        request solo, synchronously (this is the rare failure path — the
+        drain thread may block)."""
+        if len(reqs) == 1:
+            self._fail(reqs, exc)
+            return
+        resilience.bump("survivor_redispatches", len(reqs))
+        for r in reqs:
+            self._solo_drain(r)
+
+    def _solo_drain(self, r) -> None:
+        """One request, re-dispatched and drained inline, with its own
+        health verdict and (if needed) escalation ladder."""
+        session = r.session
+        if not self._admit_stage([r]):
+            return
+        try:
+            buf, spec = self._stage([r])
+            if (self.health is not None and self.health.check_rhs
+                    and not self._isolate_poisoned([r])):
+                return
+            x, verdict = self._solve_session(session, buf)
+            if verdict is not None:
+                limit = self._limit(session)
+                healthy, finite, res = resilience.evaluate(verdict, limit)
+                if resilience.data_fault(self._faults, "solve",
+                                         "unhealthy") is not None:
+                    healthy = False
+                if not healthy:
+                    resilience.bump("output_failures")
+                    self._escalate_settle(session, spec, buf, finite, res)
+                    return
+                if session._breaker is not None:
+                    session._breaker.record_success()
+            self._settle(spec, np.asarray(x))
+        except Exception as e:  # noqa: BLE001
+            self._fail([r], e)
+
+    def _drain_unhealthy(self, session, spec, buf, finite, res) -> None:
+        """An unhealthy verdict on a drained batch: multi-request
+        batches isolate first (solo re-dispatch finds the sick request —
+        a poisoned column fails alone, the survivors answer); a solo
+        batch climbs the escalation ladder directly."""
+        reqs = [r for r, _si, _lo in spec]
+        if len(reqs) > 1:
+            resilience.bump("survivor_redispatches", len(reqs))
+            for r in reqs:
+                self._solo_drain(r)
+            return
+        self._escalate_settle(session, spec, buf, finite, res)
+
+    def _escalate_settle(self, session, spec, buf, finite, res) -> None:
+        """Run the ladder for one request's staged buffer; settle on
+        recovery, fail with the structured evidence (and count toward
+        quarantine) otherwise."""
+        reqs = [r for r, _si, _lo in spec]
+        br = session._breaker
+        try:
+            xh = resilience.escalate(
+                session, buf, self.health, self._limit(session),
+                evidence0={"rung": "dispatch", "finite": finite,
+                           "residual": res},
+                faults=self._faults)
+        except Exception as e:  # noqa: BLE001 — SolveUnhealthy et al.
+            if br is not None:
+                br.record_failure()
+            self._fail(reqs, e)
+            return
+        if br is not None:
+            br.record_success()
+        self._settle(spec, xh)
+
+    # ------------------------------------------------------------------ #
+    # watchdog: a dead worker fails pending work instead of queueing
+    # ------------------------------------------------------------------ #
+
+    def _thread_died(self, name: str, exc: BaseException) -> None:
+        """Post-mortem hook run ON the dying worker thread: record the
+        cause and trip the watchdog path immediately (the polling
+        watchdog is the backstop for silent deaths)."""
+        self._dead = (name, exc)
+        self._watchdog_trip([name], exc)
+
+    def _watchdog_trip(self, names, exc) -> None:
+        resilience.bump("watchdog_trips")
+        with self._lock:
+            self._closed = True
+            self._not_full.notify_all()
+            leftover = list(self._live)
+        self._fail(leftover, EngineClosed(
+            f"engine worker thread(s) {names} died"
+            + (f" ({exc!r})" if exc is not None else "")
+            + f" — {len(leftover)} pending request(s) failed by the "
+            "watchdog instead of queueing forever"))
+        # unwedge whichever worker survived
+        self._inq.put(_STOP)
+        try:
+            self._outq.put_nowait(_STOP)
+        except Full:
+            pass
+
+    def _watchdog_loop(self) -> None:
+        while True:
+            time.sleep(self.watchdog_interval)
+            if self._closed:
+                return
+            dead = [t.name for t in (self._dispatcher, self._drainer)
+                    if not t.is_alive()]
+            if dead:
+                exc = self._dead[1] if self._dead is not None else None
+                self._watchdog_trip(dead, exc)
+                return
 
     # ------------------------------------------------------------------ #
     # observability (merged into profiler.serve_stats()['engine'])
@@ -582,7 +990,9 @@ class ServeEngine:
     def stats(self) -> dict:
         """Engine counters: queue depth high-water mark, batches
         dispatched, mean coalesced batch size, shed count, and
-        p50/p95/p99 request latency over the rolling window."""
+        p50/p95/p99 request latency over the rolling window. (Health
+        outcomes — guard trips, escalations, evictions, quarantines —
+        are global counters: `profiler.serve_stats()['health']`.)"""
         with self._lock:
             lats = sorted(self._latencies)
             batches = self._batches

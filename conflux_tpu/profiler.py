@@ -77,6 +77,12 @@ def report() -> str:
 def clear() -> None:
     _times.clear()
     _counts.clear()
+    # the resilience outcome counters are global like the region tables,
+    # so they reset together (engine counters live on the engines and
+    # survive — see serve_stats)
+    from conflux_tpu import resilience
+
+    resilience.clear_health()
 
 
 def timings() -> dict[str, tuple[int, float]]:
@@ -166,7 +172,12 @@ def serve_stats() -> dict:
     never entered report zero; `clear()` resets alongside everything
     else. An 'engine' sub-dict carries the ServeEngine counters
     (:func:`engine_stats`) — those live on the engines themselves, so
-    `clear()` does not reset them.
+    `clear()` does not reset them. A 'health' sub-dict carries the
+    resilience outcome counters (`conflux_tpu.resilience.health_stats`:
+    guard trips, staging isolations, survivor re-dispatches, escalation
+    rungs, deadline evictions, quarantine transitions, watchdog trips,
+    injected faults) — global like the region tables, so `clear()`
+    resets them too. Reliability and throughput read off ONE surface.
     """
     out: dict = {}
     for ph in SERVE_PHASES:
@@ -181,6 +192,9 @@ def serve_stats() -> dict:
                                    if refac else float("inf")
                                    if out["update"]["count"] else 0.0)
     out["engine"] = engine_stats()
+    from conflux_tpu import resilience
+
+    out["health"] = resilience.health_stats()
     return out
 
 

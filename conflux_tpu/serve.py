@@ -65,6 +65,9 @@ from conflux_tpu.parallel.mesh import lookup_mesh, mesh_cache_key
 from conflux_tpu.update import (
     DriftPolicy,
     capacitance,
+    health_spot_check,
+    probe_row,
+    probe_vector,
     rank_bucket,
     updated_matvec,
     woodbury_apply,
@@ -393,6 +396,127 @@ class FactorPlan:
                           lambda: jax.jit(jax.vmap(self._one_solve)))
 
     # ------------------------------------------------------------------ #
+    # checked (health-guarded) solve programs — the resilience layer
+    # ------------------------------------------------------------------ #
+
+    @property
+    def probe_w(self):
+        """The plan's fixed Rademacher probe w (`update.probe_vector`):
+        one vector per plan size keeps every checked program and every
+        session's cached probe row wA = w^T A0 consistent."""
+        w = getattr(self, "_probe_w_cache", None)
+        if w is None:
+            w = jnp.asarray(probe_vector(self.N))
+            self._probe_w_cache = w
+        return w
+
+    def _probe_fn(self):
+        """Jitted wA = w^T A0 program — the once-per-base half of the
+        Freivalds-style residual check (`update.probe_row`); sessions
+        cache its output next to the factors and invalidate on
+        refactor."""
+        w = self.probe_w
+
+        def build():
+            one = lambda A0: probe_row(w, A0)  # noqa: E731
+            f = jax.vmap(one) if self.batched else one
+            if self.mesh is None:
+                return jax.jit(f)
+            return jax.jit(f, out_shardings=_batch_spec(self.mesh, 2))
+
+        return self._memo(self._solve_cache, ("probe",), build)
+
+    def _checked(self, inner):
+        """Wrap a per-system (factors, A0, b2) solve body into the
+        checked-program shape (factors, A0, wA, b2) -> (x, (2,) verdict).
+        The body is vmapped for batched plans; the verdict
+        (`update.health_spot_check`) is computed OUTSIDE the vmap on the
+        whole batched block — XLA CPU charges fixed per-op overhead next
+        to these small dispatches, so the check stays a handful of
+        batched reductions, and the clean path pays no extra dispatch
+        (the verdict rides the same program as the answer)."""
+        w = self.probe_w
+        body = jax.vmap(inner) if self.batched else inner
+
+        def f(factors, A0, wA, b2):
+            self._bump("health")  # trace-time, not per call
+            x = body(factors, A0, b2)
+            return x, health_spot_check(w, wA, x, b2)
+
+        return f
+
+    def _jit_checked(self, f):
+        if self.mesh is None:
+            return jax.jit(f)
+        return jax.jit(f, out_shardings=(_batch_spec(self.mesh, 3),
+                                         None))
+
+    def _solve_health_fn(self, nrhs: int):
+        """The checked substitution program per RHS bucket — what
+        `SolveSession.solve_checked` (and the engine with output guards
+        on) dispatches instead of `_solve_fn`. Signature:
+        (factors, A0, wA, b2) -> (x, verdict); A0 feeds the plan's
+        `refine` sweeps exactly like the plain program's `A`, wA is the
+        session's cached probe row."""
+        if nrhs & (nrhs - 1) or nrhs < 1:
+            raise AssertionError(
+                f"_solve_health_fn takes power-of-two RHS buckets, got "
+                f"{nrhs} — route request widths through solve_checked")
+        return self._memo(
+            self._solve_cache, ("health", nrhs),
+            lambda: self._jit_checked(self._checked(self._one_solve)))
+
+    def _update_solve_health_fn(self, kb: int, nrhs: int, sweeps: int):
+        """Checked Woodbury solve program: the projected residual routes
+        through the DRIFTED matrix (w^T A1 = wA + (w^T Up) Vp^H, padded
+        columns inert), so SMW garbage from an ill-conditioned
+        capacitance trips the verdict."""
+        def build():
+            import functools
+
+            one = functools.partial(self._one_update_solve, sweeps)
+            w = self.probe_w
+            body = jax.vmap(one) if self.batched else one
+
+            def f(factors, A0, Up, Vp, Y, Cinv, wA, b2):
+                self._bump("health")  # trace-time, not per call
+                x = body(factors, A0, Up, Vp, Y, Cinv, b2)
+                return x, health_spot_check(w, wA, x, b2, Up, Vp)
+
+            return self._jit_checked(f)
+
+        return self._memo(self._update_cache,
+                          ("uhealth", kb, nrhs, sweeps), build)
+
+    def _one_refine(self, factors, A0, x, b2):
+        """One iterative-refinement sweep against the CURRENT base
+        factors — escalation rung 2's body (the forced refactor of rung
+        1 already absorbed any drift, so the TRUE residual matvec runs
+        against A0; only the re-check verdict rides the probe)."""
+        self._bump("refine")
+        corr = self._base_corr(factors)
+        cdtype = blas.compute_dtype(jnp.dtype(self.key.dtype))
+        xc = x.astype(cdtype)
+        r = (b2.astype(cdtype)
+             - jnp.matmul(A0.astype(cdtype), xc,
+                          precision=lax.Precision.HIGHEST))
+        return xc + corr(r).astype(cdtype)
+
+    def _refine_fn(self, nrhs: int):
+        def build():
+            w = self.probe_w
+            one = self._one_refine
+            body = jax.vmap(one) if self.batched else one
+
+            def f(factors, A0, wA, x, b2):
+                x2 = body(factors, A0, x, b2)
+                return x2, health_spot_check(w, wA, x2, b2)
+
+            return self._jit_checked(f)
+
+        return self._memo(self._solve_cache, ("refine", nrhs), build)
+
+    # ------------------------------------------------------------------ #
     # incremental (Woodbury) update programs — compiled once per bucket
     # ------------------------------------------------------------------ #
 
@@ -542,6 +666,19 @@ class SolveSession:
         # replaces it with an engine-built one; only owned bases may be
         # donated to the refresh program (see FactorPlan._refresh_fn)
         self._owns_base = False
+        # resilience state: the escalation ladder swaps factors under
+        # this lock (the engine's dispatch path takes it too, so a
+        # drain-thread refactor never races a dispatcher solve); the
+        # breaker is attached lazily by resilience.breaker_for; last_cond
+        # is the latest capacitance condition estimate — SolveUnhealthy
+        # evidence
+        self._lock = threading.RLock()
+        self._breaker = None
+        self.last_cond = None
+        # wA = w^T A0, the once-per-base half of the projected-residual
+        # check — computed lazily on the first checked solve, dropped
+        # whenever a refactor replaces the base
+        self._probe = None
         self.factorizations = 1
         self.solves = 0
         self.updates = 0
@@ -614,6 +751,109 @@ class SolveSession:
         return x
 
     # ------------------------------------------------------------------ #
+    # checked solves + escalation rungs (the resilience layer's surface)
+    # ------------------------------------------------------------------ #
+
+    def _rhs_bucketed(self, b):
+        plan = self.plan
+        b2, squeeze = self._rhs(b)
+        nrhs = b2.shape[-1]
+        nb = rank_bucket(nrhs)
+        if nb != nrhs:
+            pad = [(0, 0)] * (b2.ndim - 1) + [(0, nb - nrhs)]
+            b2 = jnp.pad(b2, pad)
+        if plan.mesh is not None:
+            (b2,) = _shard_batch((b2,), plan.mesh)
+        return b2, nb, nrhs, squeeze
+
+    def _probe_row(self):
+        """The session's cached probe row wA = w^T A0 (device-resident,
+        like the factors; O(N^2) once per base, invalidated by
+        refactors)."""
+        if self._probe is None:
+            self._probe = self.plan._probe_fn()(self._A0)
+        return self._probe
+
+    def solve_checked(self, b):
+        """`solve` plus the fused finite/projected-residual health
+        verdict, in the SAME dispatched program. Returns (x, verdict)
+        with verdict a (2,) float32 device array
+        [finite_flag, residual] — nothing here blocks; the engine's
+        drain thread (or `resilience.evaluate`) reads the verdict with
+        the answer. The answer keeps `solve`'s shape contract (bucket
+        pad + slice, squeeze)."""
+        plan = self.plan
+        b2, nb, nrhs, squeeze = self._rhs_bucketed(b)
+        wA = self._probe_row()
+        with profiler.region("serve.solve"):
+            if self._upd is None:
+                x, verdict = plan._solve_health_fn(nb)(
+                    self._factors, self._A0, wA, b2)
+            else:
+                u = self._upd
+                sweeps = plan.key.refine + self.policy.refine
+                x, verdict = plan._update_solve_health_fn(
+                    u["kb"], nb, sweeps)(
+                    self._factors, self._A0, u["Up"], u["Vp"],
+                    u["Y"], u["Cinv"], wA, b2)
+        self.solves += 1
+        if nb != nrhs:
+            x = x[..., :nrhs]
+        if squeeze:
+            x = x[..., 0]
+        return x, verdict
+
+    def refine_checked(self, b, x):
+        """One iterative-refinement sweep of a previous answer `x`
+        against the CURRENT base factors, re-checked — escalation rung 2
+        (`resilience.escalate`). `b` and `x` carry the same (bucketed)
+        solve shapes; sessions with un-refactored drift must refactor
+        first (rung 1 always precedes this one)."""
+        if self._upd is not None:
+            raise AssertionError(
+                "refine_checked rides the base factors — refactor() the "
+                "drifted session first (escalation rung order)")
+        plan = self.plan
+        b2, nb, nrhs, squeeze = self._rhs_bucketed(b)
+        x2 = jnp.asarray(x)
+        if squeeze:
+            x2 = x2[..., None]
+        if nb != nrhs:
+            pad = [(0, 0)] * (x2.ndim - 1) + [(0, nb - nrhs)]
+            x2 = jnp.pad(x2, pad)
+        if plan.mesh is not None:
+            (x2,) = _shard_batch((x2,), plan.mesh)
+        with profiler.region("serve.solve"):
+            x2, verdict = plan._refine_fn(nb)(
+                self._factors, self._A0, self._probe_row(), x2, b2)
+        if nb != nrhs:
+            x2 = x2[..., :nrhs]
+        if squeeze:
+            x2 = x2[..., 0]
+        return x2, verdict
+
+    def refactor(self):
+        """Force one true refactorization through the plan's CACHED
+        factor program — escalation rung 1. Absorbs any accumulated
+        drift into a fresh base (the `_refactor` path, donation and
+        all); an un-drifted session re-runs the factor program on its
+        resident base, replacing possibly-corrupt factors. Chainable."""
+        if self._upd is not None:
+            u = self._upd
+            k = u["k"]
+            self._refactor(u["Up"][..., :k], u["Vp"][..., :k])
+            return self
+        with profiler.region("serve.refactor"):
+            from conflux_tpu import resilience
+
+            resilience.maybe_fault(None, "refresh")
+            self._factors = None  # release before the factor dispatch
+            self._factors = self.plan._factor_fn(self._A0)
+        self.factorizations += 1
+        self.refactors += 1
+        return self
+
+    # ------------------------------------------------------------------ #
     # incremental drift
     # ------------------------------------------------------------------ #
 
@@ -676,7 +916,11 @@ class SolveSession:
                 U, V = _shard_batch((U, V), plan.mesh)
             Y, Cinv, cond1 = plan._update_fn(kb)(self._factors, U, V)
             cond = float(jnp.max(cond1))
+            self.last_cond = cond
             if not (cond <= self.policy.cond_limit):  # catches NaN/inf too
+                from conflux_tpu import resilience
+
+                resilience.bump("cond_refactors")
                 self._refactor(U, V)
                 return self
             self._upd = {"k": k, "kb": kb, "Up": U, "Vp": V,
@@ -690,6 +934,9 @@ class SolveSession:
         session's base then absorbs the drift and the correction resets."""
         plan = self.plan
         with profiler.region("serve.refactor"):
+            from conflux_tpu import resilience
+
+            resilience.maybe_fault(None, "refresh")
             k = Up.shape[-1]
             kb = rank_bucket(k)
             if kb != k:  # zero columns leave A0 + U V^H unchanged
@@ -705,6 +952,7 @@ class SolveSession:
             A_new = plan._refresh_fn(kb, donate=self._owns_base)(
                 self._A0, Up, Vp)
             self._A0 = A_new
+            self._probe = None  # wA was against the superseded base
             self._owns_base = True
             if self._A is not None:
                 self._A = A_new

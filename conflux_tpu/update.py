@@ -145,6 +145,82 @@ def woodbury_solve(base_apply, A0, U, V, b, refine: int = 0):
     return x
 
 
+def probe_vector(n: int):
+    """The resilience layer's fixed Rademacher probe w (host numpy,
+    float32 +-1, deterministic): E[(w . r)^2] = ||r||^2 exactly, so the
+    projected residual below estimates the true one at the same relative
+    scale. One fixed w per size keeps every checked program and every
+    cached wA consistent."""
+    import numpy as _np
+
+    rng = _np.random.default_rng(0xC0FFEE)
+    return rng.choice(_np.float32([-1.0, 1.0]), size=n)
+
+
+def probe_row(w, A0):
+    """wA = w^T A0 — the session-resident half of the Freivalds-style
+    residual check, paid ONCE per base matrix (O(N^2), amortized like
+    the factors; `SolveSession` caches it and invalidates on refactor).
+    Traceable; per-system."""
+    cdtype = blas.compute_dtype(A0.dtype)
+    return jnp.matmul(w.astype(cdtype), A0.astype(cdtype), precision=_HI)
+
+
+def health_spot_check(w, wA, x, b, Up=None, Vp=None):
+    """Fused finite/projected-residual health verdict for one solve —
+    the resilience layer's output guard (`conflux_tpu.resilience`),
+    fused into the checked solve programs so the clean path pays no
+    extra dispatch. Returns a (2,) float32 verdict
+    [finite_flag, residual]:
+
+      finite_flag — 1.0 iff EVERY element of x is finite. RHS columns
+          are independent through the substitution, so a NaN/Inf column
+          corrupts only its own answer column: the all-element finite
+          check IS the per-column guard.
+      residual    — |w . (b0 - A x0)| / ||b0|| on column 0, computed
+          Freivalds-style through the precomputed probe row wA = w^T A0
+          (:func:`probe_row`): w.b0 - wA.x0 costs two O(N) dots where
+          the true residual matvec costs O(N^2) — which is comparable to
+          the solve itself, and the clean-path overhead gate (<5%,
+          BENCH_RESILIENCE.json) forbids that (XLA CPU also runs skinny
+          batched matvecs far off peak, the §17 trsm lesson). With the
+          Rademacher w the projection estimates ||r||/||b|| at the same
+          relative scale; systemic garbage (factor corruption, an
+          ill-conditioned SMW correction) is an O(1) relative error in
+          essentially every component, so it cannot hide from the
+          projection except on a measure-zero set. A tripwire for
+          catastrophic failures, not an accuracy certificate — `refine`
+          sweeps are the accuracy tool.
+
+    Up/Vp (the session's padded drift factors) extend the projection to
+    the DRIFTED matrix: w^T A1 = wA + (w^T Up) Vp^H, two more O(N k)
+    dots; zero-padded columns are inert.
+
+    Batch-generic and deliberately op-lean: XLA CPU charges microseconds
+    of fixed overhead PER OP next to tiny dispatches, so the verdict is
+    built from a handful of batched reductions on the whole (B, N, w)
+    block — never per-vmap-lane — and the finite flag rides one
+    summation (NaN/Inf poisons the accumulator; an overflow false
+    positive merely triggers one escalation whose exact re-check then
+    passes). Traceable; call OUTSIDE any vmap."""
+    cdtype = x[..., 0].dtype
+    finite = jnp.isfinite(jnp.sum(x))
+    x0 = x[..., 0].astype(cdtype)                       # (..., N)
+    b0 = b[..., 0].astype(cdtype)
+    wc = w.astype(cdtype)
+    ax = jnp.sum(wA.astype(cdtype) * x0, axis=-1)       # (...,)
+    if Up is not None:
+        wU = jnp.sum(wc[:, None] * Up.astype(cdtype), axis=-2)
+        vx = jnp.sum(Vp.astype(cdtype).conj()
+                     * x0[..., :, None], axis=-2)       # (..., k)
+        ax = ax + jnp.sum(wU * vx, axis=-1)
+    num = jnp.abs(jnp.sum(wc * b0, axis=-1) - ax)
+    den = (jnp.sqrt(jnp.sum(jnp.abs(b0) ** 2, axis=-1))
+           + jnp.finfo(cdtype).tiny)
+    return jnp.stack([finite.astype(jnp.float32),
+                      jnp.max(num / den).astype(jnp.float32)])
+
+
 def apply_update(A0, U, V):
     """Materialize the drifted matrix A0 + U V^H in A0's dtype — the
     refactor path's input (and the bench's full-refactor oracle).

@@ -9,6 +9,17 @@ points; the soak walks the cross-product the suite cannot afford,
 looking for interaction bugs (e.g. butterfly x lookahead x ragged odd
 grid x resume never co-occur in any single test).
 
+`--serve` switches to the CHAOS soak of the serving stack (ISSUE 4):
+each trial builds a fleet of (possibly drifted) `SolveSession`s behind a
+`ServeEngine` with the full `HealthPolicy` on, installs a randomly
+sampled seeded `FaultPlan` (NaN at staging, delay/crash at dispatch /
+drain / d2h / refresh, forced-unhealthy solve verdicts), fires mixed
+clean / poisoned / zero-deadline traffic from the trial's rng, and then
+asserts the graceful-degradation invariants: every future resolves with
+an answer or a STRUCTURED resilience error, clean answers match the
+numpy oracle, no pending slot leaks, the engine closes un-wedged, and
+the health counters stay coherent.
+
 Each trial line is self-reproducing: the seed and full config are
 printed, and --replay SEED re-runs exactly one trial under the same
 sampling stream. Failures abort immediately by default (--keep-going to
@@ -16,7 +27,7 @@ collect instead).
 
 Usage:
     python scripts/soak.py [--trials 200] [--time-budget SECONDS]
-        [--seed 0] [--replay TRIALSEED] [--keep-going]
+        [--seed 0] [--replay TRIALSEED] [--keep-going] [--serve]
 """
 
 from __future__ import annotations
@@ -251,6 +262,123 @@ def run_trial(seed: int) -> tuple[bool, str]:
     return True, f"{label}: ok residual={res:.2e}"
 
 
+def run_serve_trial(seed: int) -> tuple[bool, str]:
+    """One chaos trial of the serving stack under injected faults.
+
+    Invariants checked (graceful degradation, never silent corruption):
+    every admitted request's future resolves; failures are one of the
+    STRUCTURED resilience errors; successful answers match the f64 numpy
+    oracle of the session's (possibly drifted) matrix; the engine closes
+    un-wedged with zero pending and coherent counters."""
+    import jax.numpy as jnp
+
+    from conflux_tpu import resilience, serve
+    from conflux_tpu.engine import EngineSaturated, ServeEngine
+    from conflux_tpu.resilience import (
+        DeadlineExceeded,
+        FaultPlan,
+        FaultSpec,
+        HealthPolicy,
+        InjectedFault,
+        RhsNonFinite,
+        SessionQuarantined,
+        SolveUnhealthy,
+    )
+
+    rng = np.random.default_rng(seed)
+    serve.clear_plans()
+    N = int(rng.choice([32, 64]))
+    S = int(rng.integers(1, 4))
+    plan = serve.FactorPlan.create((N, N), jnp.float32, v=16)
+    As, sessions = [], []
+    for _ in range(S):
+        A = (rng.standard_normal((N, N)) / np.sqrt(N)
+             + 2.0 * np.eye(N)).astype(np.float32)
+        sess = plan.factor(jnp.asarray(A))
+        if rng.integers(2):  # pre-traffic SMW drift on this session
+            k = int(rng.integers(1, 4))
+            U = (0.01 * rng.standard_normal((N, k))).astype(np.float32)
+            Vm = (0.01 * rng.standard_normal((N, k))).astype(np.float32)
+            sess.update(U, Vm)
+            A = A + U @ Vm.T
+        As.append(A.astype(np.float64))
+        sessions.append(sess)
+    # sample the fault menu AFTER the fleet is built, so setup is clean
+    menu = [
+        FaultSpec("staging", "nan", prob=0.3,
+                  count=int(rng.integers(1, 4))),
+        FaultSpec("dispatch", "delay", prob=0.3, delay_s=0.002, count=3),
+        FaultSpec("drain", "crash", prob=0.5, count=1),
+        FaultSpec("d2h", "delay", prob=0.3, delay_s=0.002, count=3),
+        FaultSpec("d2h", "crash", prob=0.5, count=1),
+        FaultSpec("solve", "unhealthy", prob=0.4,
+                  count=int(rng.integers(1, 3))),
+        FaultSpec("refresh", "delay", prob=0.5, delay_s=0.002, count=2),
+    ]
+    picks = [m for m in menu if rng.integers(2)]
+    faults = FaultPlan(picks, seed=seed)
+    label = (f"seed={seed} serve N={N} S={S} "
+             f"faults={[(f.site, f.kind) for f in picks]}")
+    eng = ServeEngine(
+        max_batch_delay=float(rng.choice([0.0, 0.002])),
+        max_pending=64, max_coalesce_width=8,
+        health=HealthPolicy(quarantine_after=2, quarantine_cooldown=0.05),
+        fault_plan=faults, watchdog_interval=0.05)
+    resilience.install_faults(faults)  # the serve-layer 'refresh' site
+    reqs = []
+    try:
+        for i in range(24):
+            si = int(rng.integers(S))
+            w = int(rng.choice([1, 1, 2, 3]))
+            b = rng.standard_normal((N, w)).astype(np.float32)
+            kind = int(rng.integers(8))
+            deadline = None
+            if kind == 0:  # poisoned at the source: admission guard food
+                b[int(rng.integers(N)), 0] = np.nan
+            elif kind == 1:  # born expired: lazy-eviction food
+                deadline = 0.0
+            try:
+                fut = eng.submit(sessions[si], b, deadline=deadline)
+            except (RhsNonFinite, SessionQuarantined, EngineSaturated):
+                continue  # structured admission outcomes are fine
+            reqs.append((si, b, fut))
+        wedged = eng.close(timeout=120)
+        if wedged:
+            return False, f"{label}: close() wedged {wedged}"
+    finally:
+        resilience.install_faults(None)
+        eng.close(timeout=10)
+    ok_exc = (RhsNonFinite, DeadlineExceeded, SolveUnhealthy,
+              SessionQuarantined, InjectedFault)
+    answered = 0
+    for si, b, fut in reqs:
+        if not fut.done():
+            return False, f"{label}: close() left a future unresolved"
+        try:
+            x = np.asarray(fut.result(0))
+        except ok_exc:
+            continue
+        except Exception as e:  # noqa: BLE001 — any other leak is a bug
+            return False, (f"{label}: UNSTRUCTURED "
+                           f"{type(e).__name__}: {e}")
+        want = np.linalg.solve(As[si], b.astype(np.float64))
+        err = (np.linalg.norm(x - want)
+               / max(np.linalg.norm(want), 1e-30))
+        if not (err < 1e-3):
+            return False, f"{label}: answer off oracle ({err:.2e})"
+        answered += 1
+    stats = eng.stats()
+    if stats["pending"] != 0:
+        return False, f"{label}: {stats['pending']} pending slots leaked"
+    if stats["completed"] + stats["failed"] != stats["requests"]:
+        return False, f"{label}: counters incoherent {stats}"
+    h = resilience.health_stats()
+    return True, (f"{label}: ok {answered}/{len(reqs)} answered, "
+                  f"injected={sum(faults.injected.values())}, "
+                  f"redispatches={h['survivor_redispatches']}, "
+                  f"evictions={h['evictions']}")
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser()
     ap.add_argument("--trials", type=int, default=200)
@@ -261,10 +389,15 @@ def main(argv=None) -> int:
     ap.add_argument("--replay", type=int, default=None,
                     help="re-run exactly one trial seed and exit")
     ap.add_argument("--keep-going", action="store_true")
+    ap.add_argument("--serve", action="store_true",
+                    help="chaos-soak the serving stack (engine + "
+                    "resilience layer) instead of the factor cores")
     args = ap.parse_args(argv)
 
+    trial = run_serve_trial if args.serve else run_trial
+
     if args.replay is not None:
-        ok, msg = run_trial(args.replay)
+        ok, msg = trial(args.replay)
         print(msg, flush=True)
         return 0 if ok else 1
 
@@ -274,7 +407,7 @@ def main(argv=None) -> int:
         if args.time_budget and time.time() - t0 > args.time_budget:
             print(f"time budget reached after {i} trials", flush=True)
             break
-        ok, msg = run_trial(args.seed + i)
+        ok, msg = trial(args.seed + i)
         print(("PASS " if ok else "FAIL ") + msg, flush=True)
         if not ok:
             fails += 1
