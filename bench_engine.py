@@ -34,6 +34,16 @@ the measured sequential leg).
 `--smoke` shrinks the shapes, skips the Poisson leg, and exits nonzero
 unless the engine actually beats the sequential loop — the CI gate.
 
+`--factor` measures the ISSUE 5 cold-start claim instead: a churn
+workload (every unit opens a session via the factor lane and issues
+`--solves-per-session` solve requests against a warm fleet) through the
+engine's `submit_factor` coalescing versus the sequential `plan.factor`
+loop, headline sessions/s, gate >= 2x at the production shape
+(B=32 coalesced factorizations, N=256), engine-factored sessions
+checked BITWISE against `plan.factor` sessions, zero compiles after
+`prewarm(..., factor_batches=...)` asserted (`BENCH_COLDSTART.json`;
+`--factor --smoke` shrinks shapes and gates >1x — the CI step).
+
 `--resilience` measures the ISSUE 4 guard overhead instead: the same
 trace through a guarded (`HealthPolicy()`) and an unguarded engine,
 paired+alternating legs, median of pair ratios, gate <5% solves/s
@@ -85,6 +95,17 @@ def parse_args():
     ap.add_argument("--smoke", action="store_true",
                     help="CI gate: shrink shapes, skip the Poisson leg, "
                     "assert engine >= sequential")
+    ap.add_argument("--factor", action="store_true",
+                    help="measure the coalesced cold-start (factor lane) "
+                    "win instead: churn workload sessions/s vs the "
+                    "sequential plan.factor loop, gate >= --factor-gate, "
+                    "write BENCH_COLDSTART.json")
+    ap.add_argument("--solves-per-session", type=int, default=2,
+                    help="solve requests per opened session in the churn "
+                    "trace (--factor)")
+    ap.add_argument("--factor-gate", type=float, default=2.0,
+                    help="min sessions/s speedup vs the sequential "
+                    "plan.factor loop (--factor, full shape)")
     ap.add_argument("--resilience", action="store_true",
                     help="measure the HealthPolicy guard overhead on the "
                     "clean path instead: interleaved guarded vs unguarded "
@@ -124,7 +145,156 @@ def main():
     profiler.clear()
     if args.out is None:
         args.out = ("BENCH_RESILIENCE.json" if args.resilience
+                    else "BENCH_COLDSTART.json" if args.factor
                     else "BENCH_ENGINE.json")
+
+    # ---------------- factor mode: coalesced cold-start gate ------------ #
+    # the ISSUE 5 acceptance number: session churn through the engine's
+    # factor lane (submit_factor coalescing same-plan requests into one
+    # vmapped batched factor dispatch, double-buffered with the drain
+    # thread's slice-out) must open sessions >= --factor-gate x faster
+    # than the sequential plan.factor loop on the same mixed
+    # solve+factor churn trace. Engine-factored sessions must be BITWISE
+    # plan.factor sessions, and prewarmed buckets must leave the whole
+    # trace compile-free.
+    if args.factor:
+        if args.smoke:
+            args.batch, args.N, args.v = 8, 128, 64
+            args.sessions, args.reps = 2, 3
+            args.max_width = 8
+        B, N, v, S = args.batch, args.N, args.v, args.sessions
+        if B & (B - 1):
+            raise SystemExit("--batch must be a power of two in --factor "
+                             "mode (the coalesced batch bucket)")
+        spc = args.solves_per_session
+        from conflux_tpu.serve import SolveSession
+
+        plan = serve.FactorPlan.create((N, N), jnp.float32, v=v)
+        rng = np.random.default_rng(0)
+        Amats = [(rng.standard_normal((N, N)) / np.sqrt(N)
+                  + 2.0 * np.eye(N)).astype(np.float32)
+                 for _ in range(B)]
+        fleet = [plan.factor(jnp.asarray(
+            (rng.standard_normal((N, N)) / np.sqrt(N)
+             + 2.0 * np.eye(N)).astype(np.float32))) for _ in range(S)]
+        # the churn trace: open B sessions, each opening followed by spc
+        # width-1 solve requests against the warm fleet (host-resident,
+        # like every served request)
+        trace = []
+        for i in range(B):
+            trace.append(("factor", i, None))
+            for j in range(spc):
+                trace.append(("solve", (i * spc + j) % S,
+                              rng.standard_normal((N, 1)).astype(
+                                  np.float32)))
+
+        eng = ServeEngine(max_batch_delay=args.delay_ms * 1e-3,
+                          max_pending=max(4 * len(trace), 64),
+                          max_coalesce_width=args.max_width,
+                          max_factor_batch=B)
+        factor_buckets = [1 << p for p in range(B.bit_length())
+                          if 1 << p <= B]
+        prewarm_widths = sorted(
+            {1} | {1 << p for p in range(args.max_width.bit_length())
+                   if 1 << p <= args.max_width})
+        eng.prewarm(fleet[0], widths=prewarm_widths,
+                    factor_batches=factor_buckets)
+
+        def leg_seq():
+            t0 = time.perf_counter()
+            opened = []
+            for kind, i, b in trace:
+                if kind == "factor":
+                    s = plan.factor(jnp.asarray(Amats[i]))
+                    jax.block_until_ready(s._factors)  # session readiness
+                    opened.append(s)
+                else:
+                    fleet[i].solve(b).block_until_ready()
+            return time.perf_counter() - t0, opened
+
+        def leg_eng():
+            t0 = time.perf_counter()
+            futs = []
+            for kind, i, b in trace:
+                if kind == "factor":
+                    futs.append(eng.submit_factor(plan, Amats[i]))
+                else:
+                    futs.append(eng.submit(fleet[i], b))
+            out = [f.result(timeout=300) for f in futs]
+            dt = time.perf_counter() - t0
+            return dt, [o for o in out if isinstance(o, SolveSession)]
+
+        # warm both legs (thread handoff, future machinery, numpy paths)
+        leg_seq()
+        leg_eng()
+        traces0 = dict(plan.trace_counts)
+        t_seq_reps, t_eng_reps, ratios = [], [], []
+        eng_sessions = []
+        for rep in range(args.reps):  # interleaved + alternating order
+            if rep % 2 == 0:
+                ts, _ = leg_seq()
+                te, eng_sessions = leg_eng()
+            else:
+                te, eng_sessions = leg_eng()
+                ts, _ = leg_seq()
+            t_seq_reps.append(ts)
+            t_eng_reps.append(te)
+            ratios.append(ts / te)
+
+        def median(xs):
+            xs = sorted(xs)
+            return xs[len(xs) // 2]
+
+        t_seq, t_eng = median(t_seq_reps), median(t_eng_reps)
+        speedup = median(ratios)
+        assert plan.trace_counts == traces0, \
+            "churn traffic compiled after prewarm — the bucket set is wrong"
+
+        # engine-factored sessions must BE plan.factor sessions, bitwise
+        # (same stacked program family, bucket- and pad-invariant)
+        bchk = rng.standard_normal((N, 1)).astype(np.float32)
+        for i, s in enumerate(eng_sessions):
+            ref = plan.factor(jnp.asarray(Amats[i]))
+            if not np.array_equal(np.asarray(s.solve(bchk)),
+                                  np.asarray(ref.solve(bchk))):
+                raise SystemExit(
+                    f"engine-factored session {i} diverged from "
+                    "plan.factor (bitwise contract)")
+        st = eng.stats()
+        eng.close()
+        gate = 1.0 if args.smoke else args.factor_gate
+        out = {
+            "metric": (f"cold-start churn sessions/s B={B} N={N} v={v} "
+                       f"fleet={S} solves/session={spc} f32 "
+                       f"({jax.device_count()} "
+                       f"{jax.devices()[0].platform} devices"
+                       + (", smoke" if args.smoke else "") + ")"),
+            "value": round(B / t_eng, 2),
+            "unit": "sessions/s",
+            "sequential_sessions_per_s": round(B / t_seq, 2),
+            "speedup_vs_sequential": round(speedup, 2),
+            "speedup_gate_x": gate,
+            "reps": args.reps,
+            "factor_batches": st["factor_batches"],
+            "factor_coalesced_mean": round(st["factor_coalesced_mean"], 2),
+            "factor_pad_waste": round(st["factor_pad_waste"], 4),
+            "factor_latency_p50_ms": round(st["factor_latency_p50_ms"], 3),
+            "factor_latency_p95_ms": round(st["factor_latency_p95_ms"], 3),
+            "factor_latency_p99_ms": round(st["factor_latency_p99_ms"], 3),
+            "compiles_after_prewarm": 0,   # asserted above
+            "bitwise_vs_plan_factor": f"{len(eng_sessions)}/{B}",
+            "baseline": "sequential plan.factor + blocking solves loop",
+            "persistent_cache": cache.cache_dir(),
+        }
+        with open(args.out, "w") as f:
+            json.dump(out, f, indent=2)
+            f.write("\n")
+        print(json.dumps(out))
+        if speedup < gate or len(eng_sessions) != B:
+            raise SystemExit(
+                f"gate: factor-lane speedup {speedup:.2f}x < {gate}x over "
+                "the sequential plan.factor loop (or sessions missing)")
+        return
 
     if args.smoke and not args.resilience:
         args.batch, args.N, args.v = 8, 128, 64

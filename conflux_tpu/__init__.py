@@ -97,6 +97,9 @@ def __getattr__(name):
         "SessionQuarantined": (
             "conflux_tpu.resilience", "SessionQuarantined"),
         "RhsNonFinite": ("conflux_tpu.resilience", "RhsNonFinite"),
+        # factor lane / coalesced cold-start (ISSUE 5)
+        "stack_trees": ("conflux_tpu.batched", "stack_trees"),
+        "unstack_tree": ("conflux_tpu.batched", "unstack_tree"),
     }
     if name in _lazy:
         import importlib
@@ -167,4 +170,6 @@ __all__ = [
     "DeadlineExceeded",
     "SessionQuarantined",
     "RhsNonFinite",
+    "stack_trees",
+    "unstack_tree",
 ]

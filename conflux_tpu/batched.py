@@ -65,16 +65,29 @@ def _shard_batch(arrs, mesh):
         jax.device_put(a, _batch_spec(mesh, a.ndim)) for a in arrs)
 
 
-def _pad_batch(arrs, B: int, nshards: int):
-    """Pad every (B, ...) array to the next multiple of nshards with
-    copies of its element 0; returns (padded_arrs, Bp)."""
+def _pad_batch(arrs, B: int, nshards: int, fill: str = "first"):
+    """Pad every (B, ...) array to the next multiple of nshards; returns
+    (padded_arrs, Bp). `fill='first'` pads with copies of element 0
+    (well-conditioned because it is a real problem we already hold) —
+    the data-parallel sharding pad. `fill='eye'` pads square (B, N, N)
+    batches with identity matrices instead — the factor lane's pad: an
+    identity slot is well-conditioned by CONSTRUCTION, so a poisoned
+    element 0 can never leak into the padding (the engine's host-side
+    staging buffer mirrors this fill in numpy)."""
     Bp = nshards * (-(-B // nshards))
     if Bp == B:
         return arrs, B
     out = []
     for a in arrs:
-        fill = jnp.broadcast_to(a[:1], (Bp - B,) + a.shape[1:])
-        out.append(jnp.concatenate([a, fill], axis=0))
+        if fill == "eye":
+            if a.ndim < 2 or a.shape[-1] != a.shape[-2]:
+                raise ValueError(
+                    f"fill='eye' pads square trailing dims, got {a.shape}")
+            one = jnp.eye(a.shape[-1], dtype=a.dtype)
+            pad = jnp.broadcast_to(one, (Bp - B,) + a.shape[1:])
+        else:
+            pad = jnp.broadcast_to(a[:1], (Bp - B,) + a.shape[1:])
+        out.append(jnp.concatenate([a, pad], axis=0))
     return out, Bp
 
 
@@ -87,6 +100,23 @@ def stack_trees(trees):
     (`FactorPlan._stacked_solve_fn`). None leaves must agree across trees
     (they stay None)."""
     return jax.tree_util.tree_map(lambda *xs: jnp.stack(xs), *trees)
+
+
+def unstack_tree(tree, B: int):
+    """Split the first `B` slots of a stacked pytree back into a list of
+    per-slot trees — the inverse of :func:`stack_trees` (bitwise: slot i
+    of the stack IS tree i, no arithmetic happens), asserted as a
+    round-trip property in tests/test_factor_lane.py.
+
+    The factor lane's slice-out primitive: one coalesced batched factor
+    dispatch produces a (bb, ...)-stacked factor pytree, and each
+    request's `SolveSession` takes slot i DEVICE-side — the slices are
+    lazy device indexing of arrays that already exist, so no factor data
+    ever crosses the host boundary. `B` may be smaller than the leading
+    axis (the engine slices only the live slots and leaves the identity
+    padding untouched)."""
+    return [jax.tree_util.tree_map(lambda l, i=i: l[i], tree)
+            for i in range(B)]
 
 
 def _check_batched_square(A, what: str = "A") -> None:

@@ -32,6 +32,27 @@ the request level, and :class:`ServeEngine` makes it:
   path never calls ``block_until_ready`` (JAX async dispatch carries the
   results; only the drain thread blocks).
 
+- **A factor lane (coalesced cold-start)** — session churn (millions of
+  users means sessions open constantly) used to pay one narrow O(N^3)
+  dispatch per matrix through the synchronous ``plan.factor``.
+  :meth:`ServeEngine.submit_factor` enqueues factorizations instead: the
+  dispatcher coalesces same-plan requests inside the same
+  ``max_batch_delay`` window into ONE vmapped batched factor dispatch at
+  power-of-two batch buckets (host-staged A stacking mirroring the RHS
+  staging — one transfer, one prewarmed program; pad slots carry
+  identity matrices), and the drain thread slices the stacked factor
+  pytree device-side into independent resident
+  :class:`~conflux_tpu.serve.SolveSession`s (``batched.unstack_tree``) —
+  downstream solve/update/refresh/health behavior is exactly a
+  ``plan.factor`` session's, and the answers are BITWISE identical
+  (``plan.factor`` rides bucket 1 of the same program family, and the
+  vmapped factor body is bucket- and pad-invariant). With a health
+  policy, the staged A stack is finite-guarded (a poisoned matrix fails
+  its OWN future) and every coalesced factorization carries a fused
+  per-slot post-factor verdict (probe-row residual through a probe
+  solve, computed in the same dispatch); sick slots re-dispatch solo
+  and fail with structured evidence, healthy neighbours are untouched.
+
 - **Prewarming + admission control** — :meth:`ServeEngine.prewarm`
   compiles the declared traffic buckets (widths, stack sizes) before
   traffic lands, so p99 never eats a compile (the persistent XLA cache is
@@ -85,16 +106,19 @@ from typing import Any
 
 import numpy as np
 
+import jax
 import jax.numpy as jnp
 
 from conflux_tpu import profiler, resilience
-from conflux_tpu.batched import _shard_batch, stack_trees
+from conflux_tpu.batched import _shard_batch, stack_trees, unstack_tree
 from conflux_tpu.resilience import (
     DeadlineExceeded,
     HealthPolicy,
     RhsNonFinite,
     SessionQuarantined,
+    SolveUnhealthy,
 )
+from conflux_tpu.serve import FactorPlan, SolveSession
 from conflux_tpu.update import rank_bucket
 
 
@@ -126,6 +150,40 @@ class _Request:
     carried: bool = False  # deferred once already — never defer again
 
     __hash__ = object.__hash__
+
+
+@dataclasses.dataclass
+class _FactorRequest:
+    """One cold-start request in the factor lane. Shares the generic
+    request surface (`future`/`expiry`/`carried`/`t_submit`) with
+    :class:`_Request` so pruning, deadline capping, carry-over and
+    resolution ownership treat both lanes uniformly."""
+
+    plan: Any             # the FactorPlan whose program factors A
+    A: Any                # HOST matrix (numpy), plan-shaped, plan dtype
+    policy: Any           # DriftPolicy for the opened session (or None)
+    future: Future        # resolves to a device-resident SolveSession
+    t_submit: float       # perf_counter at admission (latency clock)
+    expiry: float | None = None  # perf_counter deadline (lazy eviction)
+    carried: bool = False  # deferred once already — never defer again
+
+    __hash__ = object.__hash__
+
+
+@dataclasses.dataclass
+class _FactorBatch:
+    """A dispatched coalesced factor batch in flight to the drain
+    thread: the stacked factor pytree (and, when checked, the stacked
+    probe rows + the (2, bucket) per-slot verdict) plus the staged
+    device A stack the sessions slice their bases from."""
+
+    plan: Any
+    reqs: list            # live requests, packed into slots 0..n-1
+    factors: Any          # stacked factor pytree, leading axis = bucket
+    wA: Any               # stacked probe rows (checked) or None
+    verdict: Any          # (2, bucket) device verdict (checked) or None
+    A: Any                # the staged (bucket,)+shape device A stack
+    solo: bool = False    # a solo re-dispatch: no second retry
 
 
 def _normalize_rhs(session, b):
@@ -185,6 +243,9 @@ class ServeEngine:
     max_coalesce_width: cap on coalesced RHS columns per dispatch — also
         the widest bucket `prewarm` needs to cover for a compile-free
         steady state.
+    max_factor_batch: cap on coalesced factorizations per factor-lane
+        dispatch (rounded up to a power of two — the batch buckets) and
+        the widest `factor_batches` bucket `prewarm` needs to cover.
     stack_sessions / max_stack: opt-in cross-session stacking for
         single-system plans (see module docstring).
     latency_window: how many completed-request latencies the percentile
@@ -206,6 +267,7 @@ class ServeEngine:
     def __init__(self, *, max_batch_delay: float = 0.002,
                  max_pending: int = 1024, on_full: str = "reject",
                  max_coalesce_width: int = 32,
+                 max_factor_batch: int = 32,
                  stack_sessions: bool = False, max_stack: int = 8,
                  latency_window: int = 8192,
                  persistent_cache: bool = True,
@@ -214,9 +276,10 @@ class ServeEngine:
                  watchdog_interval: float = 0.2):
         if on_full not in ("reject", "block"):
             raise ValueError(f"unknown on_full {on_full!r} (reject|block)")
-        if max_pending < 1 or max_coalesce_width < 1 or max_stack < 1:
-            raise ValueError("max_pending, max_coalesce_width and "
-                             "max_stack must be >= 1")
+        if max_pending < 1 or max_coalesce_width < 1 or max_stack < 1 \
+                or max_factor_batch < 1:
+            raise ValueError("max_pending, max_coalesce_width, max_stack "
+                             "and max_factor_batch must be >= 1")
         if persistent_cache:
             from conflux_tpu import cache
 
@@ -225,6 +288,7 @@ class ServeEngine:
         self.max_pending = int(max_pending)
         self.on_full = on_full
         self.max_coalesce_width = int(max_coalesce_width)
+        self.max_factor_batch = rank_bucket(int(max_factor_batch))
         self.stack_sessions = bool(stack_sessions)
         self.max_stack = int(max_stack)
         self.health = health
@@ -249,6 +313,15 @@ class ServeEngine:
         self._batches = 0
         self._coalesced_requests = 0
         self._latencies: deque = deque(maxlen=int(latency_window))
+        # factor-lane (cold-start) counters: batches dispatched, requests
+        # coalesced into them, total bucket slots vs pad slots (the
+        # pad-waste ratio), and the session-open latency window
+        self._factor_requests = 0
+        self._factor_batches = 0
+        self._factor_coalesced = 0
+        self._factor_slots = 0
+        self._factor_pad = 0
+        self._factor_latencies: deque = deque(maxlen=int(latency_window))
         # every admitted, unanswered request. Resolution OWNERSHIP: a
         # request's future is only ever resolved by the path that removed
         # it from this set under the lock (`_take`), so a wedged worker
@@ -319,6 +392,12 @@ class ServeEngine:
         now = time.perf_counter()
         req = _Request(session, b2, int(b2.shape[-1]), squeeze, Future(),
                        now, None if deadline is None else now + deadline)
+        return self._admit(req)
+
+    def _admit(self, req) -> Future:
+        """Shared admission control for both lanes: the bounded pending
+        set (shed with a backoff hint, or block), registration in the
+        `_live` resolution-ownership set, and the queue push."""
         with self._lock:
             if self._closed:
                 raise EngineClosed("submit() on a closed ServeEngine")
@@ -341,11 +420,79 @@ class ServeEngine:
             self._consec_sheds = 0
             self._pending += 1
             self._requests += 1
+            if isinstance(req, _FactorRequest):
+                self._factor_requests += 1
             self._live.add(req)
             if self._pending > self._queue_peak:
                 self._queue_peak = self._pending
         self._inq.put(req)
         return req.future
+
+    def submit_factor(self, plan, A, *, policy=None,
+                      deadline: float | None = None) -> Future:
+        """Enqueue one factorization against `plan`; returns a Future
+        whose result is a device-resident
+        :class:`~conflux_tpu.serve.SolveSession` — exactly what
+        ``plan.factor(A, policy=policy)`` would have opened, down to the
+        bits (both ride the same stacked factor program family; see
+        `FactorPlan._stacked_factor_fn`). Same-plan requests landing in
+        one ``max_batch_delay`` window coalesce into ONE vmapped batched
+        factor dispatch at a power-of-two batch bucket, so session churn
+        pays the per-dispatch overhead once per batch instead of once
+        per matrix.
+
+        `A` is host-staged (numpy memcpy into the stacked buffer — one
+        transfer per batch); pad slots carry identity matrices. Shares
+        the solve lane's admission control (:class:`EngineSaturated` /
+        'block', `deadline=` lazy eviction, close semantics). With a
+        :class:`HealthPolicy`, a non-finite `A` raises
+        :class:`RhsNonFinite` here (sampled guard; the staging guard
+        re-checks exactly), and every coalesced factorization carries a
+        fused per-slot post-factor finite/probe-residual verdict —
+        a sick slot re-dispatches solo and fails alone with structured
+        evidence (:class:`SolveUnhealthy`), its co-batched neighbours
+        untouched. Mesh-sharded plans are rejected: their factor program
+        is batch-sharded already — call ``plan.factor`` directly."""
+        if self._closed:
+            raise EngineClosed("submit_factor() on a closed ServeEngine")
+        if self._dead is not None:
+            name, exc = self._dead
+            raise EngineClosed(f"engine worker {name} died: {exc!r}")
+        if not isinstance(plan, FactorPlan):
+            raise TypeError(f"submit_factor takes a FactorPlan, got "
+                            f"{type(plan).__name__} (submit() serves "
+                            "sessions)")
+        if plan.mesh is not None:
+            raise ValueError(
+                "the factor lane serves unsharded plans only (the stacked "
+                "cold-start program has no mesh variant) — factor "
+                "mesh-sharded plans through plan.factor directly")
+        A2 = np.asarray(A)
+        if tuple(A2.shape) != plan.key.shape:
+            raise ValueError(f"A shape {A2.shape} does not match the "
+                             f"plan's {plan.key.shape}")
+        want = np.dtype(plan.key.dtype)
+        if A2.dtype != want:
+            A2 = A2.astype(want)  # mirror jnp.asarray's implicit cast
+        if (self.health is not None and self.health.check_rhs
+                and not resilience.rhs_finite(
+                    A2, sample=self.health.submit_guard_sample)):
+            resilience.bump("factor_rejects")
+            raise RhsNonFinite(
+                "matrix contains NaN/Inf — rejected at admission (a "
+                "poisoned system would waste a coalesced factor dispatch)")
+        now = time.perf_counter()
+        req = _FactorRequest(plan, A2, policy, Future(), now,
+                             None if deadline is None else now + deadline)
+        return self._admit(req)
+
+    def factor(self, plan, A, timeout: float | None = None, *,
+               policy=None, deadline: float | None = None):
+        """Blocking convenience (the mirror of :meth:`solve`):
+        ``submit_factor(plan, A).result(timeout)`` — returns the opened
+        :class:`~conflux_tpu.serve.SolveSession`."""
+        return self.submit_factor(plan, A, policy=policy,
+                                  deadline=deadline).result(timeout)
 
     def solve(self, session, b, timeout: float | None = None,
               deadline: float | None = None):
@@ -388,24 +535,38 @@ class ServeEngine:
     # prewarming
     # ------------------------------------------------------------------ #
 
-    def prewarm(self, session, widths=(1,), stacks=(), wait: bool = True):
-        """Compile the session's solve programs for the declared traffic
-        before it lands: `widths` are RHS widths (rounded up to
-        power-of-two buckets — include the coalesced widths you expect;
+    def prewarm(self, target, widths=(1,), stacks=(), factor_batches=(),
+                wait: bool = True):
+        """Compile the declared traffic's programs before it lands.
+
+        `target` is a SolveSession (solve-lane warming) or a FactorPlan
+        (factor-lane warming only — no session exists yet at cold
+        start). `widths` are RHS widths (rounded up to power-of-two
+        buckets — include the coalesced widths you expect;
         `max_coalesce_width` covers the worst case), `stacks` are
-        cross-session stack sizes (single-system plans only). Warms the
-        CHECKED programs instead when the engine's health policy checks
-        outputs — whatever program steady-state traffic will actually
-        ride observes zero compiles (asserted via `plan.trace_counts` in
-        tests and bench_engine). `wait=False` compiles on a background
-        thread (the engine-start pattern) and returns the Thread."""
+        cross-session stack sizes (single-system plans only), and
+        `factor_batches` are coalesced cold-start batch sizes (rounded
+        up likewise; `(1, 2, ..., max_factor_batch)` covers every bucket
+        churn traffic can produce, INCLUDING the bucket-1 program that
+        `plan.factor` itself rides). Warms the CHECKED programs instead
+        when the engine's health policy checks outputs — whatever
+        program steady-state traffic will actually ride observes zero
+        compiles (asserted via `plan.trace_counts` in tests and
+        bench_engine). `wait=False` compiles on a background thread (the
+        engine-start pattern) and returns the Thread."""
+        plan = target if isinstance(target, FactorPlan) else target.plan
+        session = None if isinstance(target, FactorPlan) else target
 
         def run():
             with profiler.region("engine.prewarm"):
-                for wb in sorted({rank_bucket(w) for w in widths}):
-                    self._prewarm_width(session, wb)
-                    for s in stacks:
-                        self._prewarm_stack(session, rank_bucket(s), wb)
+                if session is not None:
+                    for wb in sorted({rank_bucket(w) for w in widths}):
+                        self._prewarm_width(session, wb)
+                        for s in stacks:
+                            self._prewarm_stack(session, rank_bucket(s),
+                                                wb)
+                for fbk in sorted({rank_bucket(n) for n in factor_batches}):
+                    self._prewarm_factor(plan, fbk)
 
         if wait:
             run()
@@ -438,6 +599,22 @@ class ServeEngine:
         A = None if session._A is None else jnp.stack([session._A] * sb)
         b = jnp.zeros((sb, plan.N, wb), jnp.dtype(plan.key.dtype))
         plan._stacked_solve_fn(sb, wb)(F, A, b).block_until_ready()
+
+    def _prewarm_factor(self, plan, bb: int) -> None:
+        if plan.mesh is not None:
+            raise ValueError(
+                "the factor lane serves unsharded plans only — factor "
+                "mesh-sharded plans through plan.factor directly")
+        # identity stacks: well-conditioned in every mode (LU, Cholesky,
+        # trsm and inv substitution) — the same filler the pad slots use
+        buf = np.empty((bb,) + plan.key.shape, np.dtype(plan.key.dtype))
+        buf[:] = np.eye(plan.N, dtype=buf.dtype)
+        Ad = jnp.asarray(buf)
+        if self.health is not None and self.health.check_output:
+            _f, _w, v = plan._factor_health_fn(bb)(Ad)
+            v.block_until_ready()
+        else:
+            jax.block_until_ready(plan._stacked_factor_fn(bb)(Ad))
 
     # ------------------------------------------------------------------ #
     # dispatcher: collect a window, coalesce, dispatch async
@@ -546,7 +723,14 @@ class ServeEngine:
         device program (async — nothing here blocks on device work).
         With `may_defer` (more traffic already queued), each session's
         small remainder chunk is handed back once to ride the next
-        window instead of wasting a whole dispatch on a sliver."""
+        window instead of wasting a whole dispatch on a sliver. Factor
+        requests ride the same window: they group per PLAN and coalesce
+        into stacked factor dispatches."""
+        freqs = [r for r in batch if isinstance(r, _FactorRequest)]
+        deferred: list = []
+        if freqs:
+            deferred += self._dispatch_factors(freqs, may_defer)
+            batch = [r for r in batch if not isinstance(r, _FactorRequest)]
         groups: dict[int, list[_Request]] = {}
         order = []
         for r in batch:
@@ -555,7 +739,6 @@ class ServeEngine:
                 groups[key] = []
                 order.append(r.session)
             groups[key].append(r)
-        deferred: list = []
         stackable: dict[int, list] = {}
         plan_order = []
         for session in order:
@@ -714,6 +897,150 @@ class ServeEngine:
         for r in reqs:
             self._run_chunk(r.session, [r], solo=True)
 
+    # ------------------------------------------------------------------ #
+    # the factor lane: coalesced cold-start dispatch
+    # ------------------------------------------------------------------ #
+
+    def _dispatch_factors(self, reqs, may_defer: bool = False) -> list:
+        """Per-plan coalescing of factor requests: same-plan requests
+        stack into chunks of up to `max_factor_batch` matrices, each
+        chunk one vmapped batched factor dispatch. Returns the deferred
+        remainder (with `may_defer`, a small trailing chunk rides the
+        next window once instead of wasting a whole bucket on a
+        sliver — the solve lane's carry-over discipline)."""
+        groups: dict[int, list] = {}
+        order = []
+        for r in reqs:
+            key = id(r.plan)
+            if key not in groups:
+                groups[key] = []
+                order.append(r.plan)
+            groups[key].append(r)
+        deferred: list = []
+        for plan in order:
+            greqs = groups[id(plan)]
+            chunks = [greqs[i:i + self.max_factor_batch]
+                      for i in range(0, len(greqs), self.max_factor_batch)]
+            last = chunks[-1]
+            if (may_defer and len(last) <= self.max_factor_batch // 2
+                    and not any(r.carried for r in last)):
+                for r in last:
+                    r.carried = True
+                deferred += last
+                chunks = chunks[:-1]
+            for c in chunks:
+                self._run_factor_chunk(plan, c)
+        return deferred
+
+    def _admit_stage_factor(self, reqs) -> list:
+        """Pre-staging admission for the factor lane: lazy deadline
+        eviction plus the 'factor' nan fault site (poisons the request's
+        OWN host matrix, upstream of the staging guard — a corrupted
+        staging write)."""
+        reqs = self._prune_expired(reqs)
+        if self._faults is not None or resilience.active_faults():
+            for r in reqs:
+                if resilience.data_fault(self._faults, "factor",
+                                         "nan") is not None:
+                    poisoned = np.array(r.A, copy=True)
+                    poisoned[..., 0, 0] = np.nan
+                    r.A = poisoned
+        return reqs
+
+    def _isolate_poisoned_A(self, reqs) -> list:
+        """Factor-lane staging guard: a matrix gone non-finite after
+        admission fails its OWN future and is dropped from the staged
+        stack; co-batched factorizations are untouched (the vmapped
+        factor body never mixes slots). One per-batch summation answers
+        'anything poisoned?'; the per-request scan runs only on
+        suspicion."""
+        live = []
+        for r in reqs:
+            if resilience.rhs_finite(r.A):
+                live.append(r)
+                continue
+            resilience.bump("factor_isolations")
+            self._fail([r], RhsNonFinite(
+                "matrix went non-finite after admission — isolated at "
+                "staging (co-batched factorizations unaffected)"))
+        return live
+
+    def _stage_factor(self, plan, reqs):
+        """Host-stage a factor chunk: memcpy every request's matrix into
+        ONE (bucket,)+shape staging buffer — the factor-lane mirror of
+        `_stage`, with `_pad_batch`'s fill='eye' discipline in numpy:
+        pad slots carry identity matrices (well-conditioned by
+        construction, never a copy of a request that might itself be
+        poisoned). The device sees one transfer and one prewarmed
+        program per batch regardless of how many requests coalesced."""
+        bb = rank_bucket(len(reqs))
+        buf = np.empty((bb,) + plan.key.shape, np.dtype(plan.key.dtype))
+        for i, r in enumerate(reqs):
+            buf[i] = r.A
+        if bb != len(reqs):
+            buf[len(reqs):] = np.eye(plan.N, dtype=buf.dtype)
+        return buf
+
+    def _run_factor_chunk(self, plan, reqs, solo: bool = False) -> None:
+        fb = self._build_factor_batch(plan, reqs, solo)
+        if fb is not None:
+            self._outq.put(fb)
+
+    def _build_factor_batch(self, plan, reqs, solo: bool = False):
+        """Stage and dispatch one coalesced factor chunk (async —
+        nothing blocks on device work here); returns the
+        :class:`_FactorBatch` for the drain thread, or None when every
+        request was already failed/evicted. A batch-attributable
+        exception re-dispatches the members solo (`_redispatch_factor_
+        survivors`), mirroring `_run_chunk`."""
+        reqs = self._admit_stage_factor(reqs)
+        if not reqs:
+            return None
+        try:
+            buf = self._stage_factor(plan, reqs)
+            if (self.health is not None and self.health.check_rhs
+                    and not resilience.rhs_finite(buf)):
+                # exact per-batch guard (one summation of the staged
+                # stack — noise next to the O(N^3) factor): poisoned
+                # matrices fail alone BEFORE burning a factor dispatch,
+                # and always as RhsNonFinite (exact attribution), even
+                # when the fused verdict would also have caught them
+                reqs = self._isolate_poisoned_A(reqs)
+                if not reqs:
+                    return None
+                buf = self._stage_factor(plan, reqs)
+            checked = (self.health is not None
+                       and self.health.check_output)
+            Ad = jnp.asarray(buf)
+            with profiler.region("serve.factor"):
+                if checked:
+                    F, wA, verdict = plan._factor_health_fn(
+                        buf.shape[0])(Ad)
+                else:
+                    F = plan._stacked_factor_fn(buf.shape[0])(Ad)
+                    wA = verdict = None
+        except Exception as e:  # noqa: BLE001 — engine must survive
+            self._redispatch_factor_survivors(reqs, e, solo)
+            return None
+        with self._lock:
+            self._factor_batches += 1
+            self._factor_coalesced += len(reqs)
+            self._factor_slots += buf.shape[0]
+            self._factor_pad += buf.shape[0] - len(reqs)
+        return _FactorBatch(plan, reqs, F, wA, verdict, Ad, solo)
+
+    def _redispatch_factor_survivors(self, reqs, exc,
+                                     solo: bool = False) -> None:
+        """Batch-attributable factor-dispatch failure: re-dispatch each
+        member individually (one level deep) so innocents still get
+        their sessions; a solo retry that fails again fails for real."""
+        if solo or len(reqs) == 1:
+            self._fail(reqs, exc)
+            return
+        resilience.bump("survivor_redispatches", len(reqs))
+        for r in reqs:
+            self._run_factor_chunk(r.plan, [r], solo=True)
+
     def _dispatch_stacked(self, plan, entries) -> None:
         """Cross-session coalescing for single-system plans: per-session
         RHS concat first (width-capped; overflow falls back to per-session
@@ -836,6 +1163,9 @@ class ServeEngine:
             item = self._outq.get()
             if item is _STOP:
                 break
+            if isinstance(item, _FactorBatch):
+                self._drain_factor(item)
+                continue
             spec, block_on, verdict, buf = item
             reqs = [r for r, _si, _lo in spec]
             try:
@@ -866,8 +1196,114 @@ class ServeEngine:
             self._settle(spec, xh)
 
     def _limit(self, session) -> float:
+        return self._plan_limit(session.plan)
+
+    def _plan_limit(self, plan) -> float:
         return self.health.resolved_residual_limit(
-            np.dtype(session.plan.key.dtype), session.plan.N)
+            np.dtype(plan.key.dtype), plan.N)
+
+    # ------------------------------------------------------------------ #
+    # the factor lane: drain, per-slot health, slice-out
+    # ------------------------------------------------------------------ #
+
+    def _drain_factor(self, fb: _FactorBatch) -> None:
+        """Drain one coalesced factor batch: ONE block on the dispatched
+        program (the factors never cross to the host — only the tiny
+        verdict does, when checked), per-slot health evaluation, then
+        device-side slice-out into independent resident sessions. Slot
+        verdicts are independent, so — unlike the solve lane, which must
+        re-dispatch to ATTRIBUTE a batch verdict — healthy neighbours of
+        a sick slot settle in place; only the sick slot re-runs solo
+        (distinguishing transient staged corruption from a genuinely
+        unfactorable matrix) and fails alone with evidence."""
+        reqs = fb.reqs
+        try:
+            resilience.maybe_fault(self._faults, "drain")
+            verdicts = None
+            if fb.verdict is not None:
+                limit = self._plan_limit(fb.plan)
+                verdicts = resilience.evaluate_slots(fb.verdict, limit)
+                if resilience.data_fault(self._faults, "factor",
+                                         "unhealthy") is not None:
+                    verdicts = [(False, fin, res)
+                                for _h, fin, res in verdicts]
+            else:
+                jax.block_until_ready(fb.factors)
+        except Exception as e:  # noqa: BLE001
+            self._drain_factor_redispatch(reqs, e)
+            return
+        entries = list(enumerate(reqs))
+        if verdicts is not None:
+            sick = [(i, r) for i, r in entries if not verdicts[i][0]]
+            entries = [(i, r) for i, r in entries if verdicts[i][0]]
+            for i, r in sick:
+                resilience.bump("factor_unhealthy")
+                _h, finite, res = verdicts[i]
+                if fb.solo:
+                    limit = self._plan_limit(fb.plan)
+                    self._fail([r], SolveUnhealthy(
+                        f"coalesced factorization unhealthy after solo "
+                        f"re-dispatch: finite={finite} res={res:.3e} "
+                        f"(limit {limit:.3e})",
+                        {"rungs": [{"rung": "factor", "finite": finite,
+                                    "residual": res}],
+                         "residual_limit": limit}))
+                else:
+                    self._solo_factor_drain(fb.plan, r)
+        if entries:
+            self._settle_factor(fb, entries)
+
+    def _drain_factor_redispatch(self, reqs, exc) -> None:
+        """Drain-side batch-attributable factor failure: re-run each
+        request solo, inline (the rare path — the drain thread may
+        block)."""
+        if len(reqs) == 1:
+            self._fail(reqs, exc)
+            return
+        resilience.bump("survivor_redispatches", len(reqs))
+        for r in reqs:
+            self._solo_factor_drain(r.plan, r)
+
+    def _solo_factor_drain(self, plan, r) -> None:
+        """One factor request, re-dispatched and drained inline on the
+        drain thread with its own per-slot verdict (solo, so a second
+        failure is final)."""
+        fb = self._build_factor_batch(plan, [r], solo=True)
+        if fb is not None:
+            self._drain_factor(fb)
+
+    def _settle_factor(self, fb: _FactorBatch, entries) -> None:
+        """Resolve a drained factor batch: slice each live slot's factor
+        pytree, base matrix, and (when checked) probe row out of the
+        stacked device arrays — `batched.unstack_tree`, lazy device
+        indexing, zero host copies — and open one independent resident
+        :class:`~conflux_tpu.serve.SolveSession` per request. The
+        session is constructed exactly as `plan.factor` constructs it
+        (same keep-A rule, same policy plumbing), so every downstream
+        path — solve, update, drift refactor, the §20 health ladder —
+        behaves identically."""
+        now = time.perf_counter()
+        owned = self._take([r for _i, r in entries])
+        with self._lock:
+            for _i, r in entries:
+                if r in owned:
+                    self._factor_latencies.append(now - r.t_submit)
+            self._completed += len(owned)
+        plan = fb.plan
+        trees = unstack_tree(fb.factors, len(fb.reqs))
+        for i, r in entries:
+            if r not in owned:
+                continue
+            A_i = fb.A[i]
+            session = SolveSession(plan, trees[i],
+                                   A_i if plan.key.refine else None,
+                                   A_i, r.policy)
+            if fb.wA is not None:
+                # the probe row wA = w^T A0 came out of the checked
+                # factor dispatch — the session opens with its half of
+                # the Freivalds check already resident
+                session._probe = fb.wA[i]
+            r.future.set_result(session)
 
     def _drain_redispatch(self, reqs, exc) -> None:
         """Survivor re-dispatch from the drain side: re-solve each
@@ -990,12 +1426,18 @@ class ServeEngine:
     def stats(self) -> dict:
         """Engine counters: queue depth high-water mark, batches
         dispatched, mean coalesced batch size, shed count, and
-        p50/p95/p99 request latency over the rolling window. (Health
-        outcomes — guard trips, escalations, evictions, quarantines —
-        are global counters: `profiler.serve_stats()['health']`.)"""
+        p50/p95/p99 request latency over the rolling window, plus the
+        factor lane's cold-start counters — factor batches dispatched,
+        mean coalesced factor-batch size, pad-waste ratio (identity pad
+        slots / total bucket slots dispatched), and session-open
+        latency percentiles. (Health outcomes — guard trips,
+        escalations, evictions, quarantines — are global counters:
+        `profiler.serve_stats()['health']`.)"""
         with self._lock:
             lats = sorted(self._latencies)
+            flats = sorted(self._factor_latencies)
             batches = self._batches
+            fbatches = self._factor_batches
             return {
                 "pending": self._pending,
                 "queue_peak": self._queue_peak,
@@ -1010,6 +1452,18 @@ class ServeEngine:
                 "latency_p50_ms": 1e3 * _percentile(lats, 50),
                 "latency_p95_ms": 1e3 * _percentile(lats, 95),
                 "latency_p99_ms": 1e3 * _percentile(lats, 99),
+                "factor_requests": self._factor_requests,
+                "factor_batches": fbatches,
+                "factor_coalesced_requests": self._factor_coalesced,
+                "factor_coalesced_mean": (self._factor_coalesced / fbatches
+                                          if fbatches else 0.0),
+                "factor_slots": self._factor_slots,
+                "factor_pad_slots": self._factor_pad,
+                "factor_pad_waste": (self._factor_pad / self._factor_slots
+                                     if self._factor_slots else 0.0),
+                "factor_latency_p50_ms": 1e3 * _percentile(flats, 50),
+                "factor_latency_p95_ms": 1e3 * _percentile(flats, 95),
+                "factor_latency_p99_ms": 1e3 * _percentile(flats, 99),
             }
 
     def latency_samples(self) -> list:
@@ -1017,3 +1471,9 @@ class ServeEngine:
         across engines for fleet-wide percentiles)."""
         with self._lock:
             return list(self._latencies)
+
+    def factor_latency_samples(self) -> list:
+        """The factor lane's rolling session-open latency window in
+        seconds (submit_factor admission -> session resolved)."""
+        with self._lock:
+            return list(self._factor_latencies)

@@ -131,15 +131,23 @@ def engine_stats() -> dict:
     """Aggregate ServeEngine counters across live engines: queue depth
     high-water mark (max), batches dispatched / requests / sheds (sums),
     mean coalesced batch size (request-weighted), and p50/p95/p99 request
-    latency over the engines' merged rolling windows. Zeroes when no
-    engine is alive."""
+    latency over the engines' merged rolling windows — plus the factor
+    lane's cold-start counters (factor batches, mean coalesced factor
+    batch size, pad-waste ratio, session-open latency percentiles),
+    merged the same way. Zeroes when no engine is alive."""
     engines = _live_engines()
     out = {"engines": len(engines), "requests": 0, "completed": 0,
            "shed": 0, "batches": 0, "queue_peak": 0,
            "coalesced_mean": 0.0, "latency_p50_ms": 0.0,
-           "latency_p95_ms": 0.0, "latency_p99_ms": 0.0}
+           "latency_p95_ms": 0.0, "latency_p99_ms": 0.0,
+           "factor_requests": 0, "factor_batches": 0,
+           "factor_coalesced_mean": 0.0, "factor_pad_waste": 0.0,
+           "factor_latency_p50_ms": 0.0, "factor_latency_p95_ms": 0.0,
+           "factor_latency_p99_ms": 0.0}
     coalesced = 0
+    fcoalesced = fslots = fpad = 0
     samples: list = []
+    fsamples: list = []
     for e in engines:
         s = e.stats()
         out["requests"] += s["requests"]
@@ -148,16 +156,29 @@ def engine_stats() -> dict:
         out["batches"] += s["batches"]
         out["queue_peak"] = max(out["queue_peak"], s["queue_peak"])
         coalesced += s["coalesced_requests"]
+        out["factor_requests"] += s["factor_requests"]
+        out["factor_batches"] += s["factor_batches"]
+        fcoalesced += s["factor_coalesced_requests"]
+        fslots += s["factor_slots"]
+        fpad += s["factor_pad_slots"]
         samples.extend(e.latency_samples())
+        fsamples.extend(e.factor_latency_samples())
     if out["batches"]:
         out["coalesced_mean"] = coalesced / out["batches"]
-    if samples:
+    if out["factor_batches"]:
+        out["factor_coalesced_mean"] = fcoalesced / out["factor_batches"]
+    if fslots:
+        out["factor_pad_waste"] = fpad / fslots
+    if samples or fsamples:
         from conflux_tpu.engine import _percentile
 
-        samples.sort()
-        for pct, key in ((50, "latency_p50_ms"), (95, "latency_p95_ms"),
-                         (99, "latency_p99_ms")):
-            out[key] = 1e3 * _percentile(samples, pct)
+        for xs, prefix in ((samples, "latency"),
+                           (fsamples, "factor_latency")):
+            if not xs:
+                continue
+            xs.sort()
+            for pct in (50, 95, 99):
+                out[f"{prefix}_p{pct}_ms"] = 1e3 * _percentile(xs, pct)
     return out
 
 

@@ -113,6 +113,9 @@ _HEALTH_KEYS = (
     "staging_isolations",     # poisoned requests failed alone at staging
     "output_failures",        # dispatched solves that failed the check
     "survivor_redispatches",  # innocent requests re-dispatched solo
+    "factor_rejects",         # submit_factor()-time A finite-guard trips
+    "factor_isolations",      # poisoned A matrices failed alone at staging
+    "factor_unhealthy",       # coalesced factorizations failing the verdict
     "refactor_escalations",   # ladder rung 1 runs
     "refine_escalations",     # ladder rung 2 runs
     "unhealthy",              # SolveUnhealthy raised (ladder exhausted)
@@ -335,7 +338,8 @@ def breaker_for(session, policy: HealthPolicy,
 # deterministic fault injection
 # --------------------------------------------------------------------------- #
 
-FAULT_SITES = ("staging", "dispatch", "drain", "d2h", "solve", "refresh")
+FAULT_SITES = ("staging", "dispatch", "drain", "d2h", "solve", "refresh",
+               "factor")
 FAULT_KINDS = ("nan", "delay", "crash", "kill", "unhealthy")
 
 
@@ -344,7 +348,10 @@ class FaultSpec:
     """One injection rule. Sites: 'staging' (kind 'nan' poisons a
     request's staged RHS), 'dispatch'/'drain'/'d2h'/'refresh' (kinds
     'delay'/'crash'/'kill'), 'solve' (kind 'unhealthy' forces the health
-    verdict false). 'crash' raises :class:`InjectedFault` where the
+    verdict false), 'factor' (the cold-start lane: kind 'nan' poisons a
+    factor request's staged A matrix upstream of the staging guard,
+    kind 'unhealthy' forces the post-factor verdict false). 'crash'
+    raises :class:`InjectedFault` where the
     engine's per-item handling catches it (survivor re-dispatch / batch
     failure, thread survives); 'kill' escapes the loop entirely so the
     watchdog path runs. `prob` draws from the plan's seeded stream;
@@ -453,6 +460,24 @@ def evaluate(verdict, limit: float) -> tuple[bool, bool, float]:
     finite = bool(v[0] >= 0.5)
     res = float(v[1])
     return finite and res <= limit, finite, res
+
+
+def evaluate_slots(verdict, limit: float) -> list[tuple[bool, bool, float]]:
+    """Host-side read of a factor-lane (2, bb) verdict block — row 0 the
+    per-slot finite flags, row 1 the per-slot post-factor probe
+    residuals (`FactorPlan._factor_health_fn`). Returns one
+    (healthy, finite, residual) triple per slot so the drain thread can
+    settle the healthy sessions and isolate the sick ones individually
+    (slot verdicts are independent by construction). A NaN residual
+    (non-finite factors poison their own probe solve) compares unhealthy
+    through the same `res <= limit` predicate `evaluate` uses."""
+    v = np.asarray(verdict)
+    out = []
+    for i in range(v.shape[-1]):
+        finite = bool(v[0, i] >= 0.5)
+        res = float(v[1, i])
+        out.append((finite and res <= limit, finite, res))
+    return out
 
 
 def escalate(session, buf, policy: HealthPolicy, limit: float,

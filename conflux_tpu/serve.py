@@ -60,7 +60,7 @@ from jax import lax
 
 from conflux_tpu.ops import blas
 from conflux_tpu import profiler
-from conflux_tpu.batched import _batch_spec, _shard_batch
+from conflux_tpu.batched import _batch_spec, _shard_batch, unstack_tree
 from conflux_tpu.parallel.mesh import lookup_mesh, mesh_cache_key
 from conflux_tpu.update import (
     DriftPolicy,
@@ -178,6 +178,9 @@ class FactorPlan:
         self._factor_fn = _CompileOnce(self._build_factor())
         self._solve_cache: dict[Any, Any] = {}
         self._update_cache: dict[tuple, Any] = {}
+        # the factor lane's stacked cold-start programs, keyed by batch
+        # bucket (kept apart from _solve_cache, whose keys tests assert)
+        self._factor_cache: dict[tuple, Any] = {}
 
     def _memo(self, cache: dict, key, build):
         """Double-checked get-or-build of a compiled-program cache entry;
@@ -394,6 +397,120 @@ class FactorPlan:
                 f"({ns}, {nrhs}) — route requests through ServeEngine")
         return self._memo(self._solve_cache, ("stacked", ns, nrhs),
                           lambda: jax.jit(jax.vmap(self._one_solve)))
+
+    # ------------------------------------------------------------------ #
+    # stacked (cold-start) factor programs — the engine's factor lane
+    # ------------------------------------------------------------------ #
+
+    def _stacked_factor_fn(self, bb: int):
+        """The factor lane's coalesced cold-start program: `bb` systems
+        of this plan stack on a new leading axis — (bb,) + key.shape —
+        and factor in ONE vmapped dispatch, at power-of-two batch
+        buckets so a traffic mix of coalesced sizes compiles O(log)
+        programs (pad slots carry identity matrices, well-conditioned by
+        construction). Per-slot factors are BITWISE invariant to the
+        bucket size and to the pad contents (slots never interact —
+        asserted in tests/test_factor_lane.py), which is why
+        :meth:`factor` itself rides this program at bucket 1: a session
+        opened by `plan.factor` and one opened by a coalesced engine
+        dispatch are the same bits. (The UNvmapped factor body differs
+        from its vmapped form at rounding level, so routing both paths
+        through one program family is what makes the contract hold.)"""
+        if self.mesh is not None:
+            raise AssertionError(
+                "the stacked factor program is unsharded — mesh plans "
+                "factor through the batch-sharded _factor_fn")
+        if bb & (bb - 1) or bb < 1:
+            raise AssertionError(
+                f"_stacked_factor_fn takes power-of-two batch buckets, "
+                f"got {bb} — route requests through ServeEngine")
+
+        def build():
+            one = self._one_factor
+            f = jax.vmap(jax.vmap(one)) if self.batched else jax.vmap(one)
+            return jax.jit(f)
+
+        return self._memo(self._factor_cache, ("factor", bb), build)
+
+    def _factor_health_fn(self, bb: int):
+        """Checked cold-start program: factor the stack AND produce the
+        post-factor health evidence in the SAME dispatch —
+        (bb,)+shape A -> (factors, wA, verdict (2, bb)).
+
+        wA[i] = w^T A_i is each session's Freivalds probe row
+        (`update.probe_row`), computed here so coalesced sessions open
+        with their probe already device-resident (no later lazy probe
+        dispatch). The verdict solves A_i x = w through the fresh
+        factors — one O(N^2) substitution per system next to the O(N^3)
+        factor — and projects the residual through wA; slot i's verdict
+        depends only on slot i's matrix, so one sick system can never
+        contaminate its co-batched slots' evidence (blast-radius
+        isolation at the verdict level). Per-slot reductions run OUTSIDE
+        the vmaps as a handful of batched ops (the XLA-CPU fixed-op-cost
+        rule, §20)."""
+        if self.mesh is not None:
+            raise AssertionError(
+                "the checked stacked factor program is unsharded — mesh "
+                "plans factor through the batch-sharded _factor_fn")
+        if bb & (bb - 1) or bb < 1:
+            raise AssertionError(
+                f"_factor_health_fn takes power-of-two batch buckets, "
+                f"got {bb} — route requests through ServeEngine")
+
+        def build():
+            w = self.probe_w
+            inner_factor = (jax.vmap(jax.vmap(self._one_factor))
+                            if self.batched else jax.vmap(self._one_factor))
+            probe_one = lambda A0: probe_row(w, A0)  # noqa: E731
+            inner_probe = (jax.vmap(jax.vmap(probe_one))
+                           if self.batched else jax.vmap(probe_one))
+            solve_one = jax.vmap(self._one_solve, in_axes=(0, 0, None))
+            if self.batched:
+                solve_one = jax.vmap(solve_one, in_axes=(0, 0, None))
+
+            def f(Ast):
+                self._bump("factor_health")  # trace-time, not per call
+                F = inner_factor(Ast)
+                wA = inner_probe(Ast)
+                w2 = w[:, None].astype(jnp.dtype(self.key.dtype))
+                x = solve_one(F, Ast, w2)
+                # per-slot verdict, batched reductions outside the vmaps:
+                # finite flag rides one summation per slot (factor NaNs
+                # propagate into x), residual is the probe projection
+                # |w.w - wA.x0| / ||w|| per system, max-reduced over the
+                # plan's own batch axis for batched plans
+                cdtype = x[..., 0].dtype
+                xs = jnp.sum(x, axis=tuple(range(1, x.ndim)))
+                finite = jnp.isfinite(xs)
+                x0 = x[..., 0].astype(cdtype)
+                wc = w.astype(cdtype)
+                num = jnp.abs(jnp.sum(wc * wc)
+                              - jnp.sum(wA.astype(cdtype) * x0, axis=-1))
+                den = (jnp.sqrt(jnp.sum(jnp.abs(wc) ** 2))
+                       + jnp.finfo(cdtype).tiny)
+                res = num / den
+                if self.batched:
+                    res = jnp.max(res, axis=-1)
+                verdict = jnp.stack([finite.astype(jnp.float32),
+                                     res.astype(jnp.float32)])
+                return F, wA, verdict
+
+            return jax.jit(f)
+
+        return self._memo(self._factor_cache, ("factor_health", bb), build)
+
+    def _factor_once(self, A):
+        """Factor ONE system (or one (B, N, N) batch for batched plans)
+        through the bucket-1 slot of the stacked factor program —
+        `factor()`, `refactor()` and the drift-policy `_refactor` all
+        route here, so every session of a non-mesh plan carries factors
+        from the SAME program family as the engine's coalesced factor
+        lane (bitwise, see :meth:`_stacked_factor_fn`). Mesh plans keep
+        the batch-sharded unvmapped program."""
+        if self.mesh is not None:
+            return self._factor_fn(A)
+        F = self._stacked_factor_fn(1)(A[None])
+        return unstack_tree(F, 1)[0]
 
     # ------------------------------------------------------------------ #
     # checked (health-guarded) solve programs — the resilience layer
@@ -626,7 +743,7 @@ class FactorPlan:
         if self.mesh is not None:
             (A,) = _shard_batch((A,), self.mesh)
         with profiler.region("serve.factor"):
-            factors = self._factor_fn(A)
+            factors = self._factor_once(A)
         keep_A = A if self.key.refine else None
         return SolveSession(self, factors, keep_A, A, policy)
 
@@ -848,7 +965,7 @@ class SolveSession:
 
             resilience.maybe_fault(None, "refresh")
             self._factors = None  # release before the factor dispatch
-            self._factors = self.plan._factor_fn(self._A0)
+            self._factors = self.plan._factor_once(self._A0)
         self.factorizations += 1
         self.refactors += 1
         return self
@@ -957,6 +1074,6 @@ class SolveSession:
             if self._A is not None:
                 self._A = A_new
             self._factors = None  # release before the factor dispatch
-            self._factors = plan._factor_fn(A_new)
+            self._factors = plan._factor_once(A_new)
         self.factorizations += 1
         self.refactors += 1

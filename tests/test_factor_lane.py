@@ -1,0 +1,422 @@
+"""Factor-lane (coalesced cold-start) tests: the ISSUE 5 contracts.
+
+- `stack_trees` / `unstack_tree` round-trip BITWISE (the lane's
+  slice-out primitive — slot i of a stack IS tree i), and `_pad_batch`'s
+  fill='eye' mode pads with identity without touching live slots.
+- Sessions opened by coalesced factor dispatches solve BITWISE
+  identically to `plan.factor` sessions: `plan.factor` rides bucket 1 of
+  the same stacked factor program family, and the vmapped factor body is
+  bucket- and pad-invariant (asserted here directly).
+- Blast-radius isolation: a non-finite A is rejected at admission
+  (`RhsNonFinite`), a post-admission poisoned A fails its OWN future at
+  staging, and an unfactorable (singular) matrix fails alone with
+  structured `SolveUnhealthy` evidence while co-batched matrices get
+  their sessions, bitwise.
+- Prewarming `factor_batches` (and the solve widths) leaves a mixed
+  solve+factor churn trace with ZERO compiles (plan trace counters).
+- close()/deadline semantics cover factor futures: queued requests are
+  answered at close, a wedged close fails them with `EngineClosed`, and
+  expired requests are lazily evicted with `DeadlineExceeded`.
+- Cold-start counters surface through `engine.stats()` and merge into
+  `profiler.serve_stats()['engine']`.
+"""
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from conflux_tpu import batched, profiler, resilience, serve
+from conflux_tpu.batched import stack_trees, unstack_tree
+from conflux_tpu.engine import EngineClosed, ServeEngine
+from conflux_tpu.resilience import (
+    DeadlineExceeded,
+    FaultPlan,
+    FaultSpec,
+    HealthPolicy,
+    RhsNonFinite,
+    SolveUnhealthy,
+)
+
+B, N, V = 4, 32, 16
+
+
+def _systems(b, n=N, seed=0):
+    rng = np.random.default_rng(seed)
+    return (rng.standard_normal((b, n, n)) / np.sqrt(n)
+            + 2.0 * np.eye(n)).astype(np.float32)
+
+
+def _delta(h0, h1):
+    return {k: h1[k] - h0.get(k, 0) for k in h1}
+
+
+# --------------------------------------------------------------------- #
+# the slice-out primitive
+# --------------------------------------------------------------------- #
+
+
+def test_unstack_stack_roundtrip_bitwise():
+    """stack_trees / unstack_tree are exact inverses on real factor
+    pytrees (mixed float factor + int perm leaves) — no arithmetic
+    happens, so the round-trip is bitwise both ways."""
+    serve.clear_plans()
+    A = _systems(3, seed=11)
+    plan = serve.FactorPlan.create((N, N), jnp.float32, v=V)
+    trees = [plan.factor(jnp.asarray(A[i]))._factors for i in range(3)]
+    stacked = stack_trees(trees)
+    back = unstack_tree(stacked, 3)
+    for orig, got in zip(trees, back):
+        for lo, lg in zip(orig, got):
+            np.testing.assert_array_equal(np.asarray(lo), np.asarray(lg))
+    # prefix unstack (the engine leaves pad slots untouched)
+    two = unstack_tree(stacked, 2)
+    assert len(two) == 2
+    # and stack(unstack(stack)) is the original stack, leaf for leaf
+    restacked = stack_trees(back)
+    for ls, lr in zip(stacked, restacked):
+        np.testing.assert_array_equal(np.asarray(ls), np.asarray(lr))
+
+
+def test_pad_batch_eye_fill():
+    A = jnp.asarray(_systems(3, seed=13))
+    (Ap,), Bp = batched._pad_batch((A,), 3, 4, fill="eye")
+    assert Bp == 4 and Ap.shape == (4, N, N)
+    np.testing.assert_array_equal(np.asarray(Ap[:3]), np.asarray(A))
+    np.testing.assert_array_equal(np.asarray(Ap[3]),
+                                  np.eye(N, dtype=np.float32))
+    with pytest.raises(ValueError, match="square"):
+        batched._pad_batch((jnp.zeros((3, N)),), 3, 4, fill="eye")
+
+
+# --------------------------------------------------------------------- #
+# bitwise identity with plan.factor
+# --------------------------------------------------------------------- #
+
+
+def test_stacked_factor_bucket_and_pad_invariance():
+    """The property the whole lane leans on, asserted directly: per-slot
+    factors are bitwise identical across batch buckets and regardless of
+    the (identity) pad contents."""
+    serve.clear_plans()
+    A = _systems(4, seed=17)
+    plan = serve.FactorPlan.create((N, N), jnp.float32, v=V)
+    F1 = plan._stacked_factor_fn(1)(jnp.asarray(A[:1]))
+    F4 = plan._stacked_factor_fn(4)(jnp.asarray(A))
+    for l1, l4 in zip(F1, F4):
+        np.testing.assert_array_equal(np.asarray(l1[0]), np.asarray(l4[0]))
+    Apad = np.stack([A[0], np.eye(N, dtype=np.float32)])
+    F2 = plan._stacked_factor_fn(2)(jnp.asarray(Apad))
+    for l1, l2 in zip(F1, F2):
+        np.testing.assert_array_equal(np.asarray(l1[0]), np.asarray(l2[0]))
+    # bucket contract: non-power-of-two buckets are a routing bug
+    with pytest.raises(AssertionError, match="power-of-two"):
+        plan._stacked_factor_fn(3)
+
+
+@pytest.mark.parametrize("health", [None, HealthPolicy()],
+                         ids=["plain", "checked"])
+def test_factor_lane_bitwise_vs_plan_factor(health):
+    """Sessions opened by one coalesced factor dispatch (single-system
+    AND batched plans, mixed in one window) solve bitwise identically to
+    plan.factor sessions of the same matrices — including through the
+    CHECKED factor program (the fused verdict changes the program, not
+    the factor bits)."""
+    serve.clear_plans()
+    A = _systems(3, seed=19)
+    Ab = _systems(B, seed=23)
+    plan = serve.FactorPlan.create((N, N), jnp.float32, v=V)
+    bplan = serve.FactorPlan.create((B, N, N), jnp.float32, v=V)
+    rng = np.random.default_rng(23)
+    b1 = rng.standard_normal((N, 2)).astype(np.float32)
+    bb = rng.standard_normal((B, N)).astype(np.float32)
+    with ServeEngine(max_batch_delay=0.05, max_factor_batch=4,
+                     health=health) as eng:
+        futs = [eng.submit_factor(plan, A[i]) for i in range(3)]
+        bfut = eng.submit_factor(bplan, Ab)
+        sessions = [f.result(timeout=120) for f in futs]
+        bsession = bfut.result(timeout=120)
+        for i, s in enumerate(sessions):
+            ref = plan.factor(jnp.asarray(A[i]))
+            np.testing.assert_array_equal(np.asarray(s.solve(b1)),
+                                          np.asarray(ref.solve(b1)),
+                                          err_msg=f"session {i}")
+        bref = bplan.factor(jnp.asarray(Ab))
+        np.testing.assert_array_equal(np.asarray(bsession.solve(bb)),
+                                      np.asarray(bref.solve(bb)))
+        stats = eng.stats()
+    # 3 single-system requests coalesced into one 4-bucket dispatch
+    # (1 pad slot), the batched request into its own 1-bucket dispatch
+    assert stats["factor_requests"] == 4
+    assert stats["factor_batches"] == 2
+    assert stats["factor_pad_slots"] == 1
+    if health is not None:
+        # checked sessions open with their probe row already resident
+        assert sessions[0]._probe is not None
+        _x, verdict = sessions[0].solve_checked(b1)
+        healthy, finite, _res = resilience.evaluate(
+            verdict, health.resolved_residual_limit(np.float32, N))
+        assert healthy and finite
+
+
+def test_factor_lane_session_full_downstream_behavior():
+    """A coalesced-factored session is a first-class SolveSession:
+    update/drift, refactor, and the engine's solve lane all behave as on
+    a plan.factor session (same counters, same answers)."""
+    serve.clear_plans()
+    A = _systems(2, seed=29)
+    plan = serve.FactorPlan.create((N, N), jnp.float32, v=V)
+    rng = np.random.default_rng(29)
+    b = rng.standard_normal((N, 2)).astype(np.float32)
+    U = (0.01 * rng.standard_normal((N, 2))).astype(np.float32)
+    Vf = (0.01 * rng.standard_normal((N, 2))).astype(np.float32)
+    with ServeEngine(max_batch_delay=0.02) as eng:
+        s_eng = eng.factor(plan, A[0], timeout=120)
+        s_ref = plan.factor(jnp.asarray(A[0]))
+        for s in (s_eng, s_ref):
+            s.update(jnp.asarray(U), jnp.asarray(Vf))
+        np.testing.assert_array_equal(np.asarray(s_eng.solve(b)),
+                                      np.asarray(s_ref.solve(b)))
+        for s in (s_eng, s_ref):
+            s.refactor()
+        np.testing.assert_array_equal(np.asarray(s_eng.solve(b)),
+                                      np.asarray(s_ref.solve(b)))
+        assert s_eng.factorizations == s_ref.factorizations == 2
+        # and the solve lane serves the churned-in session
+        np.testing.assert_array_equal(
+            np.asarray(eng.solve(s_eng, b, timeout=120)),
+            np.asarray(s_ref.solve(b)))
+
+
+# --------------------------------------------------------------------- #
+# blast-radius isolation
+# --------------------------------------------------------------------- #
+
+
+def test_factor_admission_rejects_nonfinite_A():
+    serve.clear_plans()
+    plan = serve.FactorPlan.create((N, N), jnp.float32, v=V)
+    Abad = _systems(1, seed=31)[0]
+    Abad[0, 0] = np.inf
+    h0 = resilience.health_stats()
+    with ServeEngine(max_batch_delay=0.0, health=HealthPolicy()) as eng:
+        with pytest.raises(RhsNonFinite, match="admission"):
+            eng.submit_factor(plan, Abad)
+        assert eng.stats()["pending"] == 0, "reject consumed a slot"
+    assert _delta(h0, resilience.health_stats())["factor_rejects"] == 1
+
+
+def test_factor_staging_poison_isolated_survivors_bitwise():
+    """A matrix poisoned AFTER admission (injected at the 'factor' nan
+    site) fails its own future at staging; its co-batched neighbours
+    still get sessions whose answers are bitwise plan.factor's."""
+    serve.clear_plans()
+    A = _systems(3, seed=37)
+    plan = serve.FactorPlan.create((N, N), jnp.float32, v=V)
+    rng = np.random.default_rng(37)
+    b = rng.standard_normal((N,)).astype(np.float32)
+    faults = FaultPlan([FaultSpec("factor", "nan", count=1)])
+    h0 = resilience.health_stats()
+    with ServeEngine(max_batch_delay=0.1, max_factor_batch=4,
+                     health=HealthPolicy(), fault_plan=faults) as eng:
+        futs = [eng.submit_factor(plan, A[i]) for i in range(3)]
+        with pytest.raises(RhsNonFinite, match="staging"):
+            futs[0].result(timeout=120)
+        for i in (1, 2):
+            s = futs[i].result(timeout=120)
+            ref = plan.factor(jnp.asarray(A[i]))
+            np.testing.assert_array_equal(np.asarray(s.solve(b)),
+                                          np.asarray(ref.solve(b)),
+                                          err_msg=f"survivor {i}")
+    dh = _delta(h0, resilience.health_stats())
+    assert dh["factor_isolations"] == 1
+    assert faults.injected[("factor", "nan")] == 1
+
+
+def test_singular_matrix_fails_alone_with_evidence():
+    """No fault injection: a genuinely unfactorable matrix trips the
+    fused post-factor verdict, re-dispatches solo, and fails with
+    structured evidence — the finite co-batched matrix is unaffected."""
+    serve.clear_plans()
+    A = _systems(2, seed=41)
+    Asing = np.zeros((N, N), np.float32)  # finite, passes the A guards
+    plan = serve.FactorPlan.create((N, N), jnp.float32, v=V)
+    h0 = resilience.health_stats()
+    with ServeEngine(max_batch_delay=0.1, max_factor_batch=4,
+                     health=HealthPolicy()) as eng:
+        f_good = eng.submit_factor(plan, A[0])
+        f_sick = eng.submit_factor(plan, Asing)
+        s = f_good.result(timeout=120)
+        with pytest.raises(SolveUnhealthy) as ei:
+            f_sick.result(timeout=120)
+        rungs = ei.value.evidence["rungs"]
+        assert rungs and rungs[-1]["rung"] == "factor"
+        assert not rungs[-1]["finite"]
+        b = np.ones(N, np.float32)
+        ref = plan.factor(jnp.asarray(A[0]))
+        np.testing.assert_array_equal(np.asarray(s.solve(b)),
+                                      np.asarray(ref.solve(b)))
+    dh = _delta(h0, resilience.health_stats())
+    # batch verdict + failed solo retry
+    assert dh["factor_unhealthy"] == 2
+
+
+def test_forced_unhealthy_verdict_recovers_via_solo_redispatch():
+    """A transiently-sick batch verdict (forced once at the 'factor'
+    unhealthy site) re-dispatches every flagged slot solo; the solo
+    re-factor comes back healthy and every request still gets its
+    session."""
+    serve.clear_plans()
+    A = _systems(2, seed=43)
+    plan = serve.FactorPlan.create((N, N), jnp.float32, v=V)
+    faults = FaultPlan([FaultSpec("factor", "unhealthy", count=1)])
+    h0 = resilience.health_stats()
+    with ServeEngine(max_batch_delay=0.1, max_factor_batch=2,
+                     health=HealthPolicy(), fault_plan=faults) as eng:
+        futs = [eng.submit_factor(plan, A[i]) for i in range(2)]
+        sessions = [f.result(timeout=120) for f in futs]
+    assert all(s.solves == 0 and s.factorizations == 1 for s in sessions)
+    assert _delta(h0, resilience.health_stats())["factor_unhealthy"] == 2
+
+
+# --------------------------------------------------------------------- #
+# prewarmed zero-compile churn
+# --------------------------------------------------------------------- #
+
+
+def test_prewarmed_churn_trace_zero_compiles():
+    """A mixed solve+factor churn trace against prewarmed buckets
+    compiles NOTHING: factor_batches covers every coalesced bucket
+    (including plan.factor's own bucket 1), widths cover the solve
+    lane."""
+    serve.clear_plans()
+    A = _systems(6, seed=47)
+    plan = serve.FactorPlan.create((N, N), jnp.float32, v=V)
+    rng = np.random.default_rng(47)
+    with ServeEngine(max_batch_delay=0.02, max_factor_batch=4,
+                     max_coalesce_width=4) as eng:
+        seed_session = plan.factor(jnp.asarray(A[0]))
+        eng.prewarm(seed_session, widths=(1, 2, 4),
+                    factor_batches=(1, 2, 4))
+        snapshot = dict(plan.trace_counts)
+        fleet = [seed_session]
+        futs = []
+        for i in range(1, 6):  # churn: open sessions, solve against them
+            futs.append(eng.submit_factor(plan, A[i]))
+            b = rng.standard_normal((N, 1 + i % 2)).astype(np.float32)
+            futs.append(eng.submit(fleet[rng.integers(len(fleet))],
+                                   jnp.asarray(b)))
+            if i % 2 == 0:
+                fleet.append(futs[-2].result(timeout=120))
+        for f in futs:
+            f.result(timeout=120)
+        assert plan.trace_counts == snapshot, \
+            "churn traffic compiled after prewarm"
+        stats = eng.stats()
+    assert stats["factor_batches"] >= 1
+    assert stats["factor_coalesced_mean"] >= 1.0
+    # prewarming a bare plan (no session yet — true cold start) works too
+    with ServeEngine(max_batch_delay=0.0) as eng2:
+        eng2.prewarm(plan, factor_batches=(2,))
+        snapshot = dict(plan.trace_counts)
+        eng2.factor(plan, A[1], timeout=120)
+        assert plan.trace_counts == snapshot
+
+
+# --------------------------------------------------------------------- #
+# close / deadline semantics
+# --------------------------------------------------------------------- #
+
+
+def test_close_answers_queued_factor_requests():
+    serve.clear_plans()
+    A = _systems(2, seed=53)
+    plan = serve.FactorPlan.create((N, N), jnp.float32, v=V)
+    eng = ServeEngine(max_batch_delay=60.0)  # everything queued at close
+    futs = [eng.submit_factor(plan, A[i]) for i in range(2)]
+    eng.close(timeout=120)
+    b = np.ones(N, np.float32)
+    for i, f in enumerate(futs):
+        assert f.done(), "close() dropped a queued factor request"
+        ref = plan.factor(jnp.asarray(A[i]))
+        np.testing.assert_array_equal(np.asarray(f.result().solve(b)),
+                                      np.asarray(ref.solve(b)))
+    with pytest.raises(EngineClosed):
+        eng.submit_factor(plan, A[0])
+
+
+def test_wedged_close_fails_pending_factor_futures():
+    """A wedged worker (injected drain delay) cannot strand factor
+    futures: close(timeout) names the wedged thread and fails the
+    still-pending requests with EngineClosed."""
+    serve.clear_plans()
+    A = _systems(1, seed=59)
+    plan = serve.FactorPlan.create((N, N), jnp.float32, v=V)
+    plan._stacked_factor_fn(1)(jnp.asarray(A[:1]))  # no compile stall below
+    faults = FaultPlan([FaultSpec("drain", "delay", delay_s=8.0)])
+    eng = ServeEngine(max_batch_delay=0.0, fault_plan=faults,
+                      watchdog_interval=0)
+    f = eng.submit_factor(plan, A[0])
+    wedged = eng.close(timeout=0.4)
+    assert wedged, "drain should still be sleeping in the injected delay"
+    with pytest.raises(EngineClosed, match="wedged"):
+        f.result(timeout=10)
+
+
+def test_factor_deadline_lazy_eviction():
+    serve.clear_plans()
+    A = _systems(1, seed=61)
+    plan = serve.FactorPlan.create((N, N), jnp.float32, v=V)
+    eng = ServeEngine(max_batch_delay=60.0)  # parked dispatcher window
+    h0 = resilience.health_stats()
+    f = eng.submit_factor(plan, A[0], deadline=0.01)
+    with pytest.raises(DeadlineExceeded):
+        f.result(timeout=60)
+    # the blocking wrapper carries the same deadline semantics
+    with pytest.raises(DeadlineExceeded):
+        eng.factor(plan, A[0], timeout=60, deadline=0.01)
+    assert _delta(h0, resilience.health_stats())["evictions"] == 2
+    assert eng.stats()["pending"] == 0, "eviction leaked a pending slot"
+    eng.close(timeout=60)
+
+
+def test_factor_lane_rejects_bad_inputs():
+    serve.clear_plans()
+    plan = serve.FactorPlan.create((N, N), jnp.float32, v=V)
+    mplan = serve.FactorPlan.create((8, N, N), jnp.float32, v=V,
+                                    mesh=batched.batch_mesh())
+    session = plan.factor(jnp.asarray(_systems(1, seed=67)[0]))
+    with ServeEngine(max_batch_delay=0.0) as eng:
+        with pytest.raises(ValueError, match="unsharded"):
+            eng.submit_factor(mplan, np.zeros((8, N, N), np.float32))
+        with pytest.raises(ValueError, match="shape"):
+            eng.submit_factor(plan, np.zeros((N, N + 1), np.float32))
+        with pytest.raises(TypeError, match="FactorPlan"):
+            eng.submit_factor(session, np.zeros((N, N), np.float32))
+
+
+# --------------------------------------------------------------------- #
+# observability
+# --------------------------------------------------------------------- #
+
+
+def test_factor_counters_in_serve_stats():
+    serve.clear_plans()
+    A = _systems(3, seed=71)
+    plan = serve.FactorPlan.create((N, N), jnp.float32, v=V)
+    with ServeEngine(max_batch_delay=0.05, max_factor_batch=4) as eng:
+        futs = [eng.submit_factor(plan, A[i]) for i in range(3)]
+        for f in futs:
+            f.result(timeout=120)
+        merged = profiler.serve_stats()["engine"]
+        mine = eng.stats()
+    assert mine["factor_requests"] == 3
+    assert mine["factor_batches"] >= 1
+    assert mine["factor_coalesced_mean"] >= 1.0
+    assert 0.0 <= mine["factor_pad_waste"] < 1.0
+    assert mine["factor_latency_p50_ms"] > 0.0
+    assert mine["factor_latency_p99_ms"] >= mine["factor_latency_p50_ms"]
+    assert merged["factor_requests"] >= mine["factor_requests"]
+    assert merged["factor_batches"] >= mine["factor_batches"]
+    assert merged["factor_latency_p99_ms"] >= \
+        merged["factor_latency_p50_ms"] > 0.0
