@@ -1,0 +1,29 @@
+"""conflint — the serve stack's static analysis layer (DESIGN.md §22).
+
+The engine/serve/resilience/profiler modules are multithreaded and
+their correctness rests on conventions nothing used to check: lock
+guards, donation ownership, no-host-sync hot paths, future-resolution
+ownership, bucket-keyed compilation, and BaseException discipline.
+conflint mechanically re-proves them on every run:
+
+    python -m conflux_tpu.analysis              # scan the repo, exit 1
+    python -m conflux_tpu.analysis --json r.json  # + diffable report
+
+`lockcheck` is the opt-in runtime half (lock-order cycles and
+lock-held-across-dispatch): `scripts/soak.py --serve --lockcheck`.
+
+Rules live in `conflux_tpu.analysis.rules`; this package never imports
+jax, so the analyzer observes the tree without executing it.
+"""
+
+from conflux_tpu.analysis.core import (
+    Finding,
+    Report,
+    RULE_IDS,
+    run_paths,
+    scan_source,
+)
+from conflux_tpu.analysis.rules import ALL_RULES
+
+__all__ = ["Finding", "Report", "RULE_IDS", "ALL_RULES", "run_paths",
+           "scan_source"]

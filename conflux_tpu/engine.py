@@ -300,35 +300,43 @@ class ServeEngine:
         # batch i+1 while the drain thread waits on batch i; a third batch
         # blocks the dispatcher instead of growing in-flight device work.
         self._outq: Queue = Queue(maxsize=2)
+        # the admission lock: every counter and the live set below are
+        # `# guarded-by: _lock` (conflint CFX-LOCK enforces it). This
+        # lock must NEVER be held across a device dispatch — the
+        # lockcheck harness forbids it at runtime.
         self._lock = threading.Lock()
         self._not_full = threading.Condition(self._lock)
-        self._closed = False
-        self._pending = 0
-        self._queue_peak = 0
-        self._requests = 0
-        self._completed = 0
-        self._failed = 0
-        self._sheds = 0
-        self._consec_sheds = 0
-        self._batches = 0
-        self._coalesced_requests = 0
-        self._latencies: deque = deque(maxlen=int(latency_window))
+        self._closed = False            # guarded-by: _lock
+        self._pending = 0               # guarded-by: _lock
+        self._queue_peak = 0            # guarded-by: _lock
+        self._requests = 0              # guarded-by: _lock
+        self._completed = 0             # guarded-by: _lock
+        self._failed = 0                # guarded-by: _lock
+        self._sheds = 0                 # guarded-by: _lock
+        self._consec_sheds = 0          # guarded-by: _lock
+        self._batches = 0               # guarded-by: _lock
+        self._coalesced_requests = 0    # guarded-by: _lock
+        self._latencies: deque = deque(  # guarded-by: _lock
+            maxlen=int(latency_window))
         # factor-lane (cold-start) counters: batches dispatched, requests
         # coalesced into them, total bucket slots vs pad slots (the
         # pad-waste ratio), and the session-open latency window
-        self._factor_requests = 0
-        self._factor_batches = 0
-        self._factor_coalesced = 0
-        self._factor_slots = 0
-        self._factor_pad = 0
-        self._factor_latencies: deque = deque(maxlen=int(latency_window))
+        self._factor_requests = 0       # guarded-by: _lock
+        self._factor_batches = 0        # guarded-by: _lock
+        self._factor_coalesced = 0      # guarded-by: _lock
+        self._factor_slots = 0          # guarded-by: _lock
+        self._factor_pad = 0            # guarded-by: _lock
+        self._factor_latencies: deque = deque(  # guarded-by: _lock
+            maxlen=int(latency_window))
         # every admitted, unanswered request. Resolution OWNERSHIP: a
         # request's future is only ever resolved by the path that removed
         # it from this set under the lock (`_take`), so a wedged worker
         # finishing late after close()/watchdog failed its request can
         # never double-resolve the Future.
-        self._live: set = set()
-        self._dead: tuple | None = None  # (thread name, exc) post-mortem
+        self._live: set = set()         # guarded-by: _lock
+        # (thread name, exc) post-mortem: write-once by the dying worker,
+        # racy reads tolerate staleness by design — not lock-guarded
+        self._dead: tuple | None = None
 
         profiler.register_engine(self)
         self._dispatcher = threading.Thread(
@@ -349,6 +357,7 @@ class ServeEngine:
     # client surface
     # ------------------------------------------------------------------ #
 
+    # hot-path (admission: host work only, no device syncs)
     def submit(self, session, b, *, deadline: float | None = None) -> Future:
         """Enqueue one solve against `session`; returns a Future whose
         result is a HOST (numpy) array with the shape and values
@@ -368,6 +377,7 @@ class ServeEngine:
         :class:`HealthPolicy`, a non-finite RHS raises
         :class:`RhsNonFinite` here and a quarantined session
         :class:`SessionQuarantined`."""
+        # conflint: disable=CFX-LOCK benign racy fast-fail; _admit re-checks locked
         if self._closed:
             raise EngineClosed("submit() on a closed ServeEngine")
         if self._dead is not None:
@@ -428,6 +438,7 @@ class ServeEngine:
         self._inq.put(req)
         return req.future
 
+    # hot-path (admission: host work only, no device syncs)
     def submit_factor(self, plan, A, *, policy=None,
                       deadline: float | None = None) -> Future:
         """Enqueue one factorization against `plan`; returns a Future
@@ -453,6 +464,7 @@ class ServeEngine:
         evidence (:class:`SolveUnhealthy`), its co-batched neighbours
         untouched. Mesh-sharded plans are rejected: their factor program
         is batch-sharded already — call ``plan.factor`` directly."""
+        # conflint: disable=CFX-LOCK benign racy fast-fail; _admit re-checks locked
         if self._closed:
             raise EngineClosed("submit_factor() on a closed ServeEngine")
         if self._dead is not None:
@@ -467,6 +479,7 @@ class ServeEngine:
                 "the factor lane serves unsharded plans only (the stacked "
                 "cold-start program has no mesh variant) — factor "
                 "mesh-sharded plans through plan.factor directly")
+        # conflint: disable=CFX-HOSTSYNC host request ingestion, not a device readback
         A2 = np.asarray(A)
         if tuple(A2.shape) != plan.key.shape:
             raise ValueError(f"A shape {A2.shape} does not match the "
@@ -499,6 +512,7 @@ class ServeEngine:
         """Blocking convenience: ``submit(session, b).result(timeout)``."""
         return self.submit(session, b, deadline=deadline).result(timeout)
 
+    # futures-owner
     def close(self, timeout: float | None = None) -> list:
         """Stop admission, drain every in-flight request, join the
         workers. Queued requests are answered, not dropped; idempotent.
@@ -620,6 +634,7 @@ class ServeEngine:
     # dispatcher: collect a window, coalesce, dispatch async
     # ------------------------------------------------------------------ #
 
+    # futures-owner (post-mortem wrapper: escapes reach _thread_died)
     def _dispatch_loop(self) -> None:
         try:
             self._dispatch_inner()
@@ -653,6 +668,7 @@ class ServeEngine:
                 live.append(r)
         return live
 
+    # hot-path, futures-owner (the dispatcher loop)
     def _dispatch_inner(self) -> None:
         stop = False
         carry: list = []  # small remainder chunks deferred to this round
@@ -718,6 +734,7 @@ class ServeEngine:
             self._dispatch(self._prune_expired(carry), may_defer=False)
         self._outq.put(_STOP)
 
+    # hot-path, futures-owner
     def _dispatch(self, batch, may_defer: bool = False) -> list:
         """Group a window's requests and dispatch each group as one
         device program (async — nothing here blocks on device work).
@@ -761,6 +778,7 @@ class ServeEngine:
                 self._dispatch_stacked(plan, entries)
         return deferred
 
+    # hot-path
     def _dispatch_session(self, session, reqs,
                           may_defer: bool = False) -> list:
         """Per-session coalescing: concatenate RHS columns up to the
@@ -790,6 +808,7 @@ class ServeEngine:
             self._run_chunk(session, c)
         return deferred
 
+    # hot-path
     def _admit_stage(self, reqs) -> list:
         """Pre-staging admission on the dispatch path: lazy deadline
         eviction and the 'staging' fault site (poisons the request's OWN
@@ -800,11 +819,13 @@ class ServeEngine:
             for r in reqs:
                 if resilience.data_fault(self._faults, "staging",
                                          "nan") is not None:
+                    # conflint: disable=CFX-HOSTSYNC fault-injection copy of host-staged numpy
                     poisoned = np.array(r.b2, copy=True)
                     poisoned[..., 0] = np.nan
                     r.b2 = poisoned
         return reqs
 
+    # hot-path, futures-owner
     def _isolate_poisoned(self, reqs) -> list:
         """The SECOND finite guard (staging): one summation over the
         coalesced buffer answers 'is anything poisoned?' per BATCH; only
@@ -823,6 +844,7 @@ class ServeEngine:
                 "staging (co-batched requests unaffected)"))
         return live
 
+    # hot-path (numpy staging IS the point: one h2d per batch)
     def _stage(self, reqs):
         """Host-stage a session chunk: memcpy every request's columns
         into ONE bucket-width buffer (zero-padded — exactly the padding
@@ -844,6 +866,7 @@ class ServeEngine:
             lo += r.width
         return buf, spec
 
+    # hot-path
     def _solve_session(self, session, buf):
         """One dispatch through the session, checked when the policy
         says so. Holds the session lock so a drain-thread escalation
@@ -853,6 +876,7 @@ class ServeEngine:
                 return session.solve_checked(buf)
             return session.solve(buf), None
 
+    # hot-path, futures-owner
     def _run_chunk(self, session, reqs, solo: bool = False) -> None:
         reqs = self._admit_stage(reqs)
         if not reqs:
@@ -882,6 +906,7 @@ class ServeEngine:
             self._coalesced_requests += len(reqs)
         self._outq.put((spec, x, verdict, buf))
 
+    # futures-owner
     def _redispatch_survivors(self, reqs, exc, solo: bool = False) -> None:
         """A batch-attributable failure (dispatch exception, failed d2h
         copy, unhealthy verdict on a multi-request batch) re-dispatches
@@ -901,6 +926,7 @@ class ServeEngine:
     # the factor lane: coalesced cold-start dispatch
     # ------------------------------------------------------------------ #
 
+    # hot-path
     def _dispatch_factors(self, reqs, may_defer: bool = False) -> list:
         """Per-plan coalescing of factor requests: same-plan requests
         stack into chunks of up to `max_factor_batch` matrices, each
@@ -932,6 +958,7 @@ class ServeEngine:
                 self._run_factor_chunk(plan, c)
         return deferred
 
+    # hot-path
     def _admit_stage_factor(self, reqs) -> list:
         """Pre-staging admission for the factor lane: lazy deadline
         eviction plus the 'factor' nan fault site (poisons the request's
@@ -942,11 +969,13 @@ class ServeEngine:
             for r in reqs:
                 if resilience.data_fault(self._faults, "factor",
                                          "nan") is not None:
+                    # conflint: disable=CFX-HOSTSYNC fault-injection copy of host-staged numpy
                     poisoned = np.array(r.A, copy=True)
                     poisoned[..., 0, 0] = np.nan
                     r.A = poisoned
         return reqs
 
+    # hot-path, futures-owner
     def _isolate_poisoned_A(self, reqs) -> list:
         """Factor-lane staging guard: a matrix gone non-finite after
         admission fails its OWN future and is dropped from the staged
@@ -965,6 +994,7 @@ class ServeEngine:
                 "staging (co-batched factorizations unaffected)"))
         return live
 
+    # hot-path (numpy staging: one h2d per factor batch)
     def _stage_factor(self, plan, reqs):
         """Host-stage a factor chunk: memcpy every request's matrix into
         ONE (bucket,)+shape staging buffer — the factor-lane mirror of
@@ -981,11 +1011,13 @@ class ServeEngine:
             buf[len(reqs):] = np.eye(plan.N, dtype=buf.dtype)
         return buf
 
+    # hot-path
     def _run_factor_chunk(self, plan, reqs, solo: bool = False) -> None:
         fb = self._build_factor_batch(plan, reqs, solo)
         if fb is not None:
             self._outq.put(fb)
 
+    # hot-path, futures-owner
     def _build_factor_batch(self, plan, reqs, solo: bool = False):
         """Stage and dispatch one coalesced factor chunk (async —
         nothing blocks on device work here); returns the
@@ -1029,6 +1061,7 @@ class ServeEngine:
             self._factor_pad += buf.shape[0] - len(reqs)
         return _FactorBatch(plan, reqs, F, wA, verdict, Ad, solo)
 
+    # futures-owner
     def _redispatch_factor_survivors(self, reqs, exc,
                                      solo: bool = False) -> None:
         """Batch-attributable factor-dispatch failure: re-dispatch each
@@ -1041,6 +1074,7 @@ class ServeEngine:
         for r in reqs:
             self._run_factor_chunk(r.plan, [r], solo=True)
 
+    # hot-path
     def _dispatch_stacked(self, plan, entries) -> None:
         """Cross-session coalescing for single-system plans: per-session
         RHS concat first (width-capped; overflow falls back to per-session
@@ -1072,6 +1106,7 @@ class ServeEngine:
             else:
                 self._run_stack(plan, part)
 
+    # hot-path, futures-owner
     def _run_stack(self, plan, part) -> None:
         reqs_all = [r for _, reqs, _ in part for r in reqs]
         try:
@@ -1089,8 +1124,13 @@ class ServeEngine:
                     buf[si, :, lo:lo + r.width] = r.b2
                     spec.append((r, si, lo))
                     lo += r.width
-                factors.append(session._factors)
-                As.append(session._A)
+                # read the resident state under the session lock: a
+                # drain-thread escalation must never hand this stack a
+                # half-swapped factor pytree (conflint CFX-LOCK is
+                # self-scoped; cross-object discipline is on us here)
+                with session._lock:
+                    factors.append(session._factors)
+                    As.append(session._A)
             while len(factors) < sb:
                 factors.append(factors[0])
                 As.append(As[0])
@@ -1102,7 +1142,8 @@ class ServeEngine:
             self._redispatch_survivors(reqs_all, e)
             return
         for session, _reqs, _w in part:
-            session.solves += 1
+            with session._lock:  # solves is guarded-by the session lock
+                session.solves += 1
         with self._lock:
             self._batches += 1
             self._coalesced_requests += len(reqs_all)
@@ -1152,12 +1193,14 @@ class ServeEngine:
     # drain: the only thread that blocks on device work
     # ------------------------------------------------------------------ #
 
+    # futures-owner (post-mortem wrapper: escapes reach _thread_died)
     def _drain_loop(self) -> None:
         try:
             self._drain_inner()
         except BaseException as e:  # noqa: BLE001 — post-mortem + watchdog
             self._thread_died(self._drainer.name, e)
 
+    # futures-owner (the drain loop — the one thread that MAY block)
     def _drain_inner(self) -> None:
         while True:
             item = self._outq.get()
@@ -1206,6 +1249,7 @@ class ServeEngine:
     # the factor lane: drain, per-slot health, slice-out
     # ------------------------------------------------------------------ #
 
+    # futures-owner
     def _drain_factor(self, fb: _FactorBatch) -> None:
         """Drain one coalesced factor batch: ONE block on the dispatched
         program (the factors never cross to the host — only the tiny
@@ -1253,6 +1297,7 @@ class ServeEngine:
         if entries:
             self._settle_factor(fb, entries)
 
+    # futures-owner
     def _drain_factor_redispatch(self, reqs, exc) -> None:
         """Drain-side batch-attributable factor failure: re-run each
         request solo, inline (the rare path — the drain thread may
@@ -1264,6 +1309,7 @@ class ServeEngine:
         for r in reqs:
             self._solo_factor_drain(r.plan, r)
 
+    # futures-owner
     def _solo_factor_drain(self, plan, r) -> None:
         """One factor request, re-dispatched and drained inline on the
         drain thread with its own per-slot verdict (solo, so a second
@@ -1272,6 +1318,7 @@ class ServeEngine:
         if fb is not None:
             self._drain_factor(fb)
 
+    # futures-owner
     def _settle_factor(self, fb: _FactorBatch, entries) -> None:
         """Resolve a drained factor batch: slice each live slot's factor
         pytree, base matrix, and (when checked) probe row out of the
@@ -1305,6 +1352,7 @@ class ServeEngine:
                 session._probe = fb.wA[i]
             r.future.set_result(session)
 
+    # futures-owner
     def _drain_redispatch(self, reqs, exc) -> None:
         """Survivor re-dispatch from the drain side: re-solve each
         request solo, synchronously (this is the rare failure path — the
@@ -1316,6 +1364,7 @@ class ServeEngine:
         for r in reqs:
             self._solo_drain(r)
 
+    # futures-owner
     def _solo_drain(self, r) -> None:
         """One request, re-dispatched and drained inline, with its own
         health verdict and (if needed) escalation ladder."""
@@ -1344,6 +1393,7 @@ class ServeEngine:
         except Exception as e:  # noqa: BLE001
             self._fail([r], e)
 
+    # futures-owner
     def _drain_unhealthy(self, session, spec, buf, finite, res) -> None:
         """An unhealthy verdict on a drained batch: multi-request
         batches isolate first (solo re-dispatch finds the sick request —
@@ -1357,6 +1407,7 @@ class ServeEngine:
             return
         self._escalate_settle(session, spec, buf, finite, res)
 
+    # futures-owner
     def _escalate_settle(self, session, spec, buf, finite, res) -> None:
         """Run the ladder for one request's staged buffer; settle on
         recovery, fail with the structured evidence (and count toward
@@ -1389,6 +1440,7 @@ class ServeEngine:
         self._dead = (name, exc)
         self._watchdog_trip([name], exc)
 
+    # futures-owner
     def _watchdog_trip(self, names, exc) -> None:
         resilience.bump("watchdog_trips")
         with self._lock:
@@ -1404,12 +1456,14 @@ class ServeEngine:
         self._inq.put(_STOP)
         try:
             self._outq.put_nowait(_STOP)
+        # conflint: disable=CFX-FUTURE a full outq already wakes the drain; nothing owned here
         except Full:
             pass
 
     def _watchdog_loop(self) -> None:
         while True:
             time.sleep(self.watchdog_interval)
+            # conflint: disable=CFX-LOCK benign racy poll; a stale read only delays one tick
             if self._closed:
                 return
             dead = [t.name for t in (self._dispatcher, self._drainer)

@@ -17,14 +17,25 @@ from __future__ import annotations
 
 import contextlib
 import functools
+import threading
 import time
 from collections import defaultdict
 
 import jax
 
-_times: dict[str, float] = defaultdict(float)
-_counts: dict[str, int] = defaultdict(int)
+# the region tables are written from every serve-engine worker thread
+# (dispatcher, drain, watchdog) plus the caller: the += below is a
+# read-modify-write, so unlocked it silently loses updates (a conflint
+# CFX-LOCK finding; regression test in tests/test_analysis.py)
+_PROF_LOCK = threading.Lock()
+_times: dict[str, float] = defaultdict(float)    # guarded-by: _PROF_LOCK
+_counts: dict[str, int] = defaultdict(int)       # guarded-by: _PROF_LOCK
 _enabled = True
+
+# set by conflux_tpu.analysis.lockcheck while a watch() is active: the
+# hook observes which locks are held when a dispatch region is entered.
+# One attribute read per region when inactive.
+_dispatch_hook = None
 
 
 def enable(on: bool = True) -> None:
@@ -36,6 +47,9 @@ def enable(on: bool = True) -> None:
 @contextlib.contextmanager
 def region(name: str):
     """Profiled named scope: `with profiler.region('step1_pivoting'): ...`"""
+    hook = _dispatch_hook
+    if hook is not None:
+        hook(name)
     if not _enabled:
         with jax.named_scope(name):
             yield
@@ -43,8 +57,10 @@ def region(name: str):
     t0 = time.perf_counter()
     with jax.named_scope(name):
         yield
-    _times[name] += time.perf_counter() - t0
-    _counts[name] += 1
+    dt = time.perf_counter() - t0
+    with _PROF_LOCK:
+        _times[name] += dt
+        _counts[name] += 1
 
 
 def profiled(name: str):
@@ -61,13 +77,21 @@ def profiled(name: str):
     return deco
 
 
+def _snapshot() -> tuple[dict, dict]:
+    """Consistent copy of the region tables (readers never iterate the
+    live dicts while a worker thread is inserting)."""
+    with _PROF_LOCK:
+        return dict(_times), dict(_counts)
+
+
 def report() -> str:
     """semiprof-style table (reference README.md:120-165 output shape)."""
+    times, counts = _snapshot()
     lines = [f"{'REGION':<32}{'CALLS':>8}{'THREAD':>12}{'WALL':>12}{'%':>8}"]
-    total = sum(_times.values()) or 1.0
-    for name, t in sorted(_times.items(), key=lambda kv: -kv[1]):
+    total = sum(times.values()) or 1.0
+    for name, t in sorted(times.items(), key=lambda kv: -kv[1]):
         lines.append(
-            f"{name:<32}{_counts[name]:>8}{t:>12.3f}{t:>12.3f}{100 * t / total:>8.1f}"
+            f"{name:<32}{counts[name]:>8}{t:>12.3f}{t:>12.3f}{100 * t / total:>8.1f}"
         )
     out = "\n".join(lines)
     print(out)
@@ -75,8 +99,9 @@ def report() -> str:
 
 
 def clear() -> None:
-    _times.clear()
-    _counts.clear()
+    with _PROF_LOCK:
+        _times.clear()
+        _counts.clear()
     # the resilience outcome counters are global like the region tables,
     # so they reset together (engine counters live on the engines and
     # survive — see serve_stats)
@@ -86,7 +111,8 @@ def clear() -> None:
 
 
 def timings() -> dict[str, tuple[int, float]]:
-    return {k: (_counts[k], _times[k]) for k in _times}
+    times, counts = _snapshot()
+    return {k: (counts[k], times[k]) for k in times}
 
 
 def trace(logdir: str):
@@ -105,25 +131,34 @@ SERVE_PHASES = ("factor", "solve", "update", "refactor")
 
 # live ServeEngines (conflux_tpu/engine.py) register here (weakly — an
 # engine dies with its owner) so serve_stats() can fold queue/coalescing/
-# latency counters in next to the per-phase wall times
-_ENGINE_REFS: list = []
+# latency counters in next to the per-phase wall times. Unlocked, two
+# concurrent _live_engines() calls could both .remove() the same dead
+# ref (ValueError) — another conflint CFX-LOCK find.
+_ENGINE_REFS: list = []  # guarded-by: _PROF_LOCK
 
 
 def register_engine(engine) -> None:
     """Called by ServeEngine.__init__; weak so engines are collectable."""
     import weakref
 
-    _ENGINE_REFS.append(weakref.ref(engine))
+    ref = weakref.ref(engine)
+    with _PROF_LOCK:
+        _ENGINE_REFS.append(ref)
 
 
 def _live_engines() -> list:
-    alive, dead = [], []
-    for ref in _ENGINE_REFS:
-        e = ref()
-        (alive if e is not None else dead).append(e if e is not None
-                                                  else ref)
-    for ref in dead:
-        _ENGINE_REFS.remove(ref)
+    """Snapshot the live engines, pruning dead refs. Only the registry
+    walk holds the lock — callers talk to the engines (their own locks)
+    outside it, so profiler-lock -> engine-lock never nests."""
+    alive = []
+    with _PROF_LOCK:
+        dead = []
+        for ref in _ENGINE_REFS:
+            e = ref()
+            (alive if e is not None else dead).append(e if e is not None
+                                                      else ref)
+        for ref in dead:
+            _ENGINE_REFS.remove(ref)
     return alive
 
 
@@ -200,11 +235,12 @@ def serve_stats() -> dict:
     injected faults) — global like the region tables, so `clear()`
     resets them too. Reliability and throughput read off ONE surface.
     """
+    times, counts = _snapshot()
     out: dict = {}
     for ph in SERVE_PHASES:
         key = f"serve.{ph}"
-        out[ph] = {"count": _counts.get(key, 0),
-                   "wall_s": _times.get(key, 0.0)}
+        out[ph] = {"count": counts.get(key, 0),
+                   "wall_s": times.get(key, 0.0)}
     factors = out["factor"]["count"] + out["refactor"]["count"]
     out["solves_per_factor"] = (out["solve"]["count"] / factors
                                 if factors else 0.0)

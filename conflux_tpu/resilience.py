@@ -129,7 +129,7 @@ _HEALTH_KEYS = (
 )
 
 _HEALTH_LOCK = threading.Lock()
-_HEALTH: dict[str, int] = {k: 0 for k in _HEALTH_KEYS}
+_HEALTH: dict[str, int] = {k: 0 for k in _HEALTH_KEYS}  # guarded-by: _HEALTH_LOCK
 
 
 def bump(key: str, n: int = 1) -> None:
@@ -268,10 +268,11 @@ class CircuitBreaker:
         self.cooldown = float(cooldown)
         self._clock = clock
         self._lock = threading.Lock()
-        self._failures = 0
-        self._state = "closed"
-        self._opened_at = 0.0
-        self._probe_at = None  # clock() of the outstanding half-open probe
+        self._failures = 0     # guarded-by: _lock
+        self._state = "closed"  # guarded-by: _lock
+        self._opened_at = 0.0  # guarded-by: _lock
+        # clock() of the outstanding half-open probe
+        self._probe_at = None  # guarded-by: _lock
 
     @property
     def state(self) -> str:
@@ -384,7 +385,7 @@ class FaultPlan:
                       for s in specs]
         self._rng = np.random.default_rng(seed)
         self._lock = threading.Lock()
-        self.injected: dict[tuple[str, str], int] = {}
+        self.injected: dict[tuple[str, str], int] = {}  # guarded-by: _lock
 
     def fire(self, site: str, kinds=None) -> FaultSpec | None:
         with self._lock:

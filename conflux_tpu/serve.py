@@ -774,44 +774,52 @@ class SolveSession:
     def __init__(self, plan: FactorPlan, factors, A, A_base=None,
                  policy: DriftPolicy | None = None):
         self.plan = plan
-        self._factors = factors
-        self._A = A
-        self._A0 = A if A_base is None else A_base
+        # resilience + concurrency state: every factor/drift mutation
+        # and every read of the resident state happens under this
+        # re-entrant lock (conflint CFX-LOCK enforces the guarded-by
+        # annotations below) — a drain-thread escalation's factor swap
+        # (`self._factors = None` then the fresh dispatch) is atomic
+        # against any dispatcher or direct-caller solve. The RLock
+        # makes the engine's outer hold (`_solve_session`) and the
+        # escalation ladder's (`resilience.escalate`) re-enter cleanly.
+        self._lock = threading.RLock()
+        self._factors = factors    # guarded-by: _lock
+        self._A = A                # guarded-by: _lock
+        self._A0 = A if A_base is None else A_base  # guarded-by: _lock
         self.policy = DriftPolicy() if policy is None else policy
-        self._upd = None  # dict(k, kb, Up, Vp, Y, Cinv) when drifted
+        self._upd = None  # guarded-by: _lock — dict(k, kb, Up, Vp, Y, Cinv)
         # the base matrix is the CALLER's array until the first refactor
         # replaces it with an engine-built one; only owned bases may be
         # donated to the refresh program (see FactorPlan._refresh_fn)
-        self._owns_base = False
-        # resilience state: the escalation ladder swaps factors under
-        # this lock (the engine's dispatch path takes it too, so a
-        # drain-thread refactor never races a dispatcher solve); the
-        # breaker is attached lazily by resilience.breaker_for; last_cond
-        # is the latest capacitance condition estimate — SolveUnhealthy
+        self._owns_base = False    # guarded-by: _lock
+        # the breaker is attached lazily by resilience.breaker_for
+        # (write-once under its own attach lock); last_cond is the
+        # latest capacitance condition estimate — SolveUnhealthy
         # evidence
-        self._lock = threading.RLock()
         self._breaker = None
-        self.last_cond = None
+        self.last_cond = None      # guarded-by: _lock
         # wA = w^T A0, the once-per-base half of the projected-residual
         # check — computed lazily on the first checked solve, dropped
         # whenever a refactor replaces the base
-        self._probe = None
-        self.factorizations = 1
-        self.solves = 0
-        self.updates = 0
-        self.refactors = 0
+        self._probe = None         # guarded-by: _lock
+        self.factorizations = 1    # guarded-by: _lock
+        self.solves = 0            # guarded-by: _lock
+        self.updates = 0           # guarded-by: _lock
+        self.refactors = 0         # guarded-by: _lock
 
     @property
     def factors(self):
         """The device-resident factor pytree: (LU, perm) / (L,) for
         'trsm' plans, (Li, Ui, perm) / (Li,) triangular inverses for
         'inv' plans."""
-        return self._factors
+        with self._lock:
+            return self._factors
 
     @property
     def update_rank(self) -> int:
         """Accumulated drift rank since the last (re)factorization."""
-        return 0 if self._upd is None else self._upd["k"]
+        with self._lock:
+            return 0 if self._upd is None else self._upd["k"]
 
     def _rhs(self, b):
         plan = self.plan
@@ -835,13 +843,16 @@ class SolveSession:
             raise ValueError(f"rhs {b.shape}, session needs ({plan.N}, k)")
         return b, False
 
-    def solve(self, b):
+    def solve(self, b):  # hot-path
         """Solve against the resident factors: O(N^2) substitution plus
         the plan's `refine` sweeps (plus the Woodbury correction when the
         session carries an un-refactored drift). b is (N,)/(N, k) for
         single plans, (B, N)/(B, N, k) for batched ones; x comes back in
         b's shape. RHS widths are padded up to power-of-two buckets and
-        sliced back, so a width mix compiles O(log) programs."""
+        sliced back, so a width mix compiles O(log) programs. The
+        dispatch rides the session lock (uncontended RLock, ~100ns) so
+        a concurrent drift update or escalation refactor can never show
+        this solve half-swapped factors."""
         plan = self.plan
         b2, squeeze = self._rhs(b)
         nrhs = b2.shape[-1]
@@ -851,16 +862,17 @@ class SolveSession:
             b2 = jnp.pad(b2, pad)
         if plan.mesh is not None:
             (b2,) = _shard_batch((b2,), plan.mesh)
-        with profiler.region("serve.solve"):
-            if self._upd is None:
-                x = plan._solve_fn(nb)(self._factors, self._A, b2)
-            else:
-                u = self._upd
-                sweeps = plan.key.refine + self.policy.refine
-                x = plan._update_solve_fn(u["kb"], nb, sweeps)(
-                    self._factors, self._A0, u["Up"], u["Vp"],
-                    u["Y"], u["Cinv"], b2)
-        self.solves += 1
+        with self._lock:
+            with profiler.region("serve.solve"):
+                if self._upd is None:
+                    x = plan._solve_fn(nb)(self._factors, self._A, b2)
+                else:
+                    u = self._upd
+                    sweeps = plan.key.refine + self.policy.refine
+                    x = plan._update_solve_fn(u["kb"], nb, sweeps)(
+                        self._factors, self._A0, u["Up"], u["Vp"],
+                        u["Y"], u["Cinv"], b2)
+            self.solves += 1
         if nb != nrhs:
             x = x[..., :nrhs]
         if squeeze:
@@ -887,11 +899,12 @@ class SolveSession:
         """The session's cached probe row wA = w^T A0 (device-resident,
         like the factors; O(N^2) once per base, invalidated by
         refactors)."""
-        if self._probe is None:
-            self._probe = self.plan._probe_fn()(self._A0)
-        return self._probe
+        with self._lock:
+            if self._probe is None:
+                self._probe = self.plan._probe_fn()(self._A0)
+            return self._probe
 
-    def solve_checked(self, b):
+    def solve_checked(self, b):  # hot-path
         """`solve` plus the fused finite/projected-residual health
         verdict, in the SAME dispatched program. Returns (x, verdict)
         with verdict a (2,) float32 device array
@@ -901,19 +914,20 @@ class SolveSession:
         pad + slice, squeeze)."""
         plan = self.plan
         b2, nb, nrhs, squeeze = self._rhs_bucketed(b)
-        wA = self._probe_row()
-        with profiler.region("serve.solve"):
-            if self._upd is None:
-                x, verdict = plan._solve_health_fn(nb)(
-                    self._factors, self._A0, wA, b2)
-            else:
-                u = self._upd
-                sweeps = plan.key.refine + self.policy.refine
-                x, verdict = plan._update_solve_health_fn(
-                    u["kb"], nb, sweeps)(
-                    self._factors, self._A0, u["Up"], u["Vp"],
-                    u["Y"], u["Cinv"], wA, b2)
-        self.solves += 1
+        with self._lock:
+            wA = self._probe_row()
+            with profiler.region("serve.solve"):
+                if self._upd is None:
+                    x, verdict = plan._solve_health_fn(nb)(
+                        self._factors, self._A0, wA, b2)
+                else:
+                    u = self._upd
+                    sweeps = plan.key.refine + self.policy.refine
+                    x, verdict = plan._update_solve_health_fn(
+                        u["kb"], nb, sweeps)(
+                        self._factors, self._A0, u["Up"], u["Vp"],
+                        u["Y"], u["Cinv"], wA, b2)
+            self.solves += 1
         if nb != nrhs:
             x = x[..., :nrhs]
         if squeeze:
@@ -926,10 +940,6 @@ class SolveSession:
         (`resilience.escalate`). `b` and `x` carry the same (bucketed)
         solve shapes; sessions with un-refactored drift must refactor
         first (rung 1 always precedes this one)."""
-        if self._upd is not None:
-            raise AssertionError(
-                "refine_checked rides the base factors — refactor() the "
-                "drifted session first (escalation rung order)")
         plan = self.plan
         b2, nb, nrhs, squeeze = self._rhs_bucketed(b)
         x2 = jnp.asarray(x)
@@ -940,9 +950,14 @@ class SolveSession:
             x2 = jnp.pad(x2, pad)
         if plan.mesh is not None:
             (x2,) = _shard_batch((x2,), plan.mesh)
-        with profiler.region("serve.solve"):
-            x2, verdict = plan._refine_fn(nb)(
-                self._factors, self._A0, self._probe_row(), x2, b2)
+        with self._lock:
+            if self._upd is not None:
+                raise AssertionError(
+                    "refine_checked rides the base factors — refactor() "
+                    "the drifted session first (escalation rung order)")
+            with profiler.region("serve.solve"):
+                x2, verdict = plan._refine_fn(nb)(
+                    self._factors, self._A0, self._probe_row(), x2, b2)
         if nb != nrhs:
             x2 = x2[..., :nrhs]
         if squeeze:
@@ -955,20 +970,21 @@ class SolveSession:
         drift into a fresh base (the `_refactor` path, donation and
         all); an un-drifted session re-runs the factor program on its
         resident base, replacing possibly-corrupt factors. Chainable."""
-        if self._upd is not None:
-            u = self._upd
-            k = u["k"]
-            self._refactor(u["Up"][..., :k], u["Vp"][..., :k])
-            return self
-        with profiler.region("serve.refactor"):
-            from conflux_tpu import resilience
+        with self._lock:
+            if self._upd is not None:
+                u = self._upd
+                k = u["k"]
+                self._refactor(u["Up"][..., :k], u["Vp"][..., :k])
+                return self
+            with profiler.region("serve.refactor"):
+                from conflux_tpu import resilience
 
-            resilience.maybe_fault(None, "refresh")
-            self._factors = None  # release before the factor dispatch
-            self._factors = self.plan._factor_once(self._A0)
-        self.factorizations += 1
-        self.refactors += 1
-        return self
+                resilience.maybe_fault(None, "refresh")
+                self._factors = None  # release before the factor dispatch
+                self._factors = self.plan._factor_once(self._A0)
+            self.factorizations += 1
+            self.refactors += 1
+            return self
 
     # ------------------------------------------------------------------ #
     # incremental drift
@@ -1006,7 +1022,7 @@ class SolveSession:
         U = jnp.asarray(U, dtype)
         V = jnp.asarray(V, dtype)
         self._check_uv(U, V)
-        with profiler.region("serve.update"):
+        with self._lock, profiler.region("serve.update"):
             if self._upd is not None:
                 if replace:
                     # the superseded Woodbury state (Up/Vp/Y/Cinv) is dead
@@ -1032,6 +1048,9 @@ class SolveSession:
             if plan.mesh is not None:
                 U, V = _shard_batch((U, V), plan.mesh)
             Y, Cinv, cond1 = plan._update_fn(kb)(self._factors, U, V)
+            # the scalar readback is deliberate (and why update() is
+            # not a hot-path function): the drift policy's refactor
+            # decision is host control flow
             cond = float(jnp.max(cond1))
             self.last_cond = cond
             if not (cond <= self.policy.cond_limit):  # catches NaN/inf too
@@ -1042,15 +1061,17 @@ class SolveSession:
                 return self
             self._upd = {"k": k, "kb": kb, "Up": U, "Vp": V,
                          "Y": Y, "Cinv": Cinv}
-        self.updates += 1
+            self.updates += 1
         return self
 
     def _refactor(self, Up, Vp):
         """Drift-policy trigger: materialize A0 + U V^H and pay one true
         refactorization through the plan's cached factor program; the
-        session's base then absorbs the drift and the correction resets."""
+        session's base then absorbs the drift and the correction resets.
+        Callers (`update`, `refactor`) already hold the session lock;
+        the re-entrant acquire here keeps the swap atomic regardless."""
         plan = self.plan
-        with profiler.region("serve.refactor"):
+        with self._lock, profiler.region("serve.refactor"):
             from conflux_tpu import resilience
 
             resilience.maybe_fault(None, "refresh")
@@ -1075,5 +1096,5 @@ class SolveSession:
                 self._A = A_new
             self._factors = None  # release before the factor dispatch
             self._factors = plan._factor_once(A_new)
-        self.factorizations += 1
-        self.refactors += 1
+            self.factorizations += 1
+            self.refactors += 1

@@ -392,30 +392,61 @@ def main(argv=None) -> int:
     ap.add_argument("--serve", action="store_true",
                     help="chaos-soak the serving stack (engine + "
                     "resilience layer) instead of the factor cores")
+    ap.add_argument("--lockcheck", action="store_true",
+                    help="run trials under the conflint runtime "
+                    "lock-order harness (conflux_tpu.analysis."
+                    "lockcheck): every engine/session/plan lock the "
+                    "trials create is instrumented; any lock-order "
+                    "cycle or lock-held-across-dispatch fails the soak")
     args = ap.parse_args(argv)
 
     trial = run_serve_trial if args.serve else run_trial
 
-    if args.replay is not None:
-        ok, msg = trial(args.replay)
-        print(msg, flush=True)
-        return 0 if ok else 1
+    import contextlib
 
-    t0 = time.time()
-    fails = 0
-    for i in range(args.trials):
-        if args.time_budget and time.time() - t0 > args.time_budget:
-            print(f"time budget reached after {i} trials", flush=True)
-            break
-        ok, msg = trial(args.seed + i)
-        print(("PASS " if ok else "FAIL ") + msg, flush=True)
-        if not ok:
-            fails += 1
-            if not args.keep_going:
-                return 1
-    print(f"soak: {fails} failures / {i + 1} trials "
-          f"in {time.time() - t0:.0f}s", flush=True)
-    return 1 if fails else 0
+    cm = contextlib.nullcontext(None)
+    if args.lockcheck:
+        from conflux_tpu.analysis import lockcheck
+
+        cm = lockcheck.watch()
+
+    rc = 0
+    with cm as lc:
+        if args.replay is not None:
+            ok, msg = trial(args.replay)
+            print(msg, flush=True)
+            rc = 0 if ok else 1
+        else:
+            t0 = time.time()
+            fails = 0
+            i = -1
+            for i in range(args.trials):
+                if args.time_budget and time.time() - t0 > args.time_budget:
+                    print(f"time budget reached after {i} trials",
+                          flush=True)
+                    break
+                ok, msg = trial(args.seed + i)
+                print(("PASS " if ok else "FAIL ") + msg, flush=True)
+                if not ok:
+                    fails += 1
+                    if not args.keep_going:
+                        rc = 1
+                        break
+            if rc == 0:
+                print(f"soak: {fails} failures / {i + 1} trials "
+                      f"in {time.time() - t0:.0f}s", flush=True)
+                rc = 1 if fails else 0
+    if lc is not None:
+        rep = lc.report()
+        print(f"lockcheck: {rep['locks']} locks, "
+              f"{rep['acquisitions']} acquisitions, "
+              f"{rep['order_edges']} order edges, "
+              f"{len(rep['violations'])} violation(s)", flush=True)
+        for v in rep["violations"]:
+            print("LOCKCHECK " + v, flush=True)
+        if rep["violations"]:
+            rc = 1
+    return rc
 
 
 if __name__ == "__main__":

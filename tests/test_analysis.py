@@ -1,0 +1,685 @@
+"""conflint (conflux_tpu.analysis): fixture coverage for every rule
+(positive hit, negative non-hit, suppression honored), the repo
+self-run, the runtime lock-order harness, and regression tests for the
+real findings conflint surfaced in this tree (unlocked profiler
+tables, unlocked SolveSession state, the _ENGINE_REFS prune race)."""
+
+import os
+import textwrap
+import threading
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from conflux_tpu import analysis, profiler, serve
+from conflux_tpu.analysis import lockcheck
+from conflux_tpu.engine import ServeEngine
+from conflux_tpu.resilience import HealthPolicy
+
+
+def hits(src: str, rule: str, suppressed: bool = False):
+    return [f for f in analysis.scan_source(textwrap.dedent(src))
+            if f.rule == rule and f.suppressed == suppressed]
+
+
+# --------------------------------------------------------------------- #
+# CFX-LOCK
+# --------------------------------------------------------------------- #
+
+
+LOCK_FIXTURE = """
+    import threading
+
+    class Eng:
+        def __init__(self):
+            self._lock = threading.Lock()
+            self._pending = 0  # guarded-by: _lock
+
+        def bad(self):
+            return self._pending
+
+        def good(self):
+            with self._lock:
+                return self._pending
+
+        # requires-lock: _lock
+        def helper_called_under_lock(self):
+            self._pending += 1
+"""
+
+
+def test_lock_rule_positive_negative():
+    # the bad access is the only hit: good() and the requires-lock
+    # helper are clean, __init__ is exempt
+    found = hits(LOCK_FIXTURE, "CFX-LOCK")
+    assert len(found) == 1
+    assert "self._pending" in found[0].message
+
+
+def test_lock_rule_module_globals():
+    src = """
+        import threading
+        _L = threading.Lock()
+        _TABLE = {}  # guarded-by: _L
+
+        def bad():
+            _TABLE["x"] = 1
+
+        def good():
+            with _L:
+                _TABLE["x"] = 1
+    """
+    found = hits(src, "CFX-LOCK")
+    assert len(found) == 1
+    assert "_TABLE" in found[0].message
+
+
+def test_lock_rule_suppression_counted():
+    src = """
+        import threading
+
+        class Eng:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._n = 0  # guarded-by: _lock
+
+            def racy(self):
+                # conflint: disable=CFX-LOCK fixture reason
+                return self._n
+    """
+    assert hits(src, "CFX-LOCK") == []
+    sup = hits(src, "CFX-LOCK", suppressed=True)
+    assert len(sup) == 1 and sup[0].reason == "fixture reason"
+
+
+def test_lock_rule_closure_is_conservative():
+    # a closure may run on another thread: the enclosing with does not
+    # bless its accesses
+    src = """
+        import threading
+
+        class Eng:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._n = 0  # guarded-by: _lock
+
+            def spawn(self):
+                with self._lock:
+                    def worker():
+                        return self._n
+                    return worker
+    """
+    assert len(hits(src, "CFX-LOCK")) == 1
+
+
+# --------------------------------------------------------------------- #
+# CFX-DONATE
+# --------------------------------------------------------------------- #
+
+
+def test_donate_rule_use_after_donate():
+    src = """
+        import jax
+
+        def f(g, x, y):
+            fn = jax.jit(g, donate_argnums=(0,))
+            out = fn(x, y)
+            return x.sum() + out
+    """
+    found = hits(src, "CFX-DONATE")
+    assert len(found) == 1 and "'x'" in found[0].message
+
+
+def test_donate_rule_reassignment_clears():
+    src = """
+        import jax
+
+        def f(g, x, y):
+            fn = jax.jit(g, donate_argnums=(0,))
+            out = fn(x, y)
+            x = out
+            return x.sum()
+    """
+    assert hits(src, "CFX-DONATE") == []
+
+
+def test_donate_rule_refresh_convention():
+    # the serve-stack convention: _refresh_fn(kb, donate)(A0, ...)
+    # donates arg 0 — reading the old base afterwards is the bug
+    src = """
+        def refactor(self, plan, kb, Up, Vp):
+            A_new = plan._refresh_fn(kb, donate=True)(self._A0, Up, Vp)
+            leak = self._A0 + 1
+            self._A0 = A_new
+            return leak
+    """
+    found = hits(src, "CFX-DONATE")
+    assert len(found) == 1 and "self._A0" in found[0].message
+    # store-before-read (what serve.py actually does) is clean
+    clean = """
+        def refactor(self, plan, kb, Up, Vp):
+            A_new = plan._refresh_fn(kb, donate=True)(self._A0, Up, Vp)
+            self._A0 = A_new
+            return self._A0
+    """
+    assert hits(clean, "CFX-DONATE") == []
+
+
+def test_donate_rule_suppression():
+    src = """
+        import jax
+
+        def f(g, x):
+            fn = jax.jit(g, donate_argnums=(0,))
+            out = fn(x)
+            # conflint: disable=CFX-DONATE fixture knows better
+            return x.sum() + out
+    """
+    assert hits(src, "CFX-DONATE") == []
+    assert len(hits(src, "CFX-DONATE", suppressed=True)) == 1
+
+
+# --------------------------------------------------------------------- #
+# CFX-HOSTSYNC
+# --------------------------------------------------------------------- #
+
+
+def test_hostsync_rule_positive():
+    src = """
+        import numpy as np
+
+        # hot-path
+        def stage(x, v):
+            a = np.asarray(x)
+            x.block_until_ready()
+            s = float(v.sum())
+            return a, s, x.item()
+    """
+    found = hits(src, "CFX-HOSTSYNC")
+    kinds = " ".join(f.message for f in found)
+    assert len(found) == 4
+    assert "np.asarray" in kinds and "block_until_ready" in kinds \
+        and "float(<call>)" in kinds and ".item()" in kinds
+
+
+def test_hostsync_rule_unmarked_function_is_free():
+    src = """
+        import numpy as np
+
+        def drain(x):
+            return np.asarray(x)
+    """
+    assert hits(src, "CFX-HOSTSYNC") == []
+
+
+def test_hostsync_rule_suppression():
+    src = """
+        import numpy as np
+
+        # hot-path
+        def stage(x):
+            # conflint: disable=CFX-HOSTSYNC host numpy, not device
+            return np.asarray(x)
+    """
+    assert hits(src, "CFX-HOSTSYNC") == []
+    assert len(hits(src, "CFX-HOSTSYNC", suppressed=True)) == 1
+
+
+# --------------------------------------------------------------------- #
+# CFX-FUTURE
+# --------------------------------------------------------------------- #
+
+
+def test_future_rule_broad_swallow():
+    src = """
+        # futures-owner
+        def worker(self, reqs):
+            try:
+                dispatch(reqs)
+            except Exception:
+                pass
+    """
+    assert len(hits(src, "CFX-FUTURE")) == 1
+
+
+def test_future_rule_resolver_and_reraise_pass():
+    src = """
+        # futures-owner
+        def worker(self, reqs):
+            try:
+                dispatch(reqs)
+            except Exception as e:
+                self._fail(reqs, e)
+            try:
+                drain(reqs)
+            except Exception:
+                raise
+    """
+    assert hits(src, "CFX-FUTURE") == []
+
+
+def test_future_rule_narrow_handlers():
+    src = """
+        # futures-owner
+        def worker(self, reqs):
+            try:
+                dispatch(reqs)
+            except KeyError:
+                pass
+            try:
+                stage(reqs)
+            except KeyError:
+                reqs = recover(reqs)
+    """
+    found = hits(src, "CFX-FUTURE")
+    # pass-only narrow handler flagged; narrow handler with real
+    # recovery logic trusted
+    assert len(found) == 1 and "KeyError" in found[0].message
+
+
+def test_future_rule_unmarked_function_is_free():
+    src = """
+        def not_a_worker(reqs):
+            try:
+                dispatch(reqs)
+            except Exception:
+                pass
+    """
+    assert hits(src, "CFX-FUTURE") == []
+
+
+def test_future_rule_suppression():
+    src = """
+        # futures-owner
+        def worker(self, reqs):
+            try:
+                dispatch(reqs)
+            # conflint: disable=CFX-FUTURE nothing owned here
+            except Exception:
+                pass
+    """
+    assert hits(src, "CFX-FUTURE") == []
+    assert len(hits(src, "CFX-FUTURE", suppressed=True)) == 1
+
+
+# --------------------------------------------------------------------- #
+# CFX-RECOMPILE
+# --------------------------------------------------------------------- #
+
+
+def test_recompile_rule_jit_in_loop_and_immediate():
+    src = """
+        import jax
+
+        def f(xs):
+            for x in xs:
+                fn = jax.jit(lambda a: a + 1)
+                fn(x)
+            return jax.jit(lambda a: a)(xs)
+    """
+    found = hits(src, "CFX-RECOMPILE")
+    msgs = " ".join(f.message for f in found)
+    assert "inside a loop" in msgs and "retraces on every call" in msgs
+
+
+def test_recompile_rule_bucket_literals():
+    src = """
+        def f(plan, b):
+            plan._solve_fn(3)(b)
+            w = 5
+            plan._solve_fn(w)(b)
+    """
+    assert len(hits(src, "CFX-RECOMPILE")) == 2
+
+
+def test_recompile_rule_bucketed_keys_pass():
+    src = """
+        from conflux_tpu.update import rank_bucket
+
+        def f(plan, b, nrhs, wb):
+            plan._solve_fn(rank_bucket(nrhs))(b)
+            nb = rank_bucket(nrhs)
+            plan._solve_fn(nb)(b)
+            plan._solve_fn(4)(b)
+            plan._solve_fn(wb)(b)  # parameter: runtime asserts pow2
+            plan._factor_health_fn(b.shape[0])(b)
+    """
+    assert hits(src, "CFX-RECOMPILE") == []
+
+
+def test_recompile_rule_suppression():
+    src = """
+        def f(plan, b):
+            # conflint: disable=CFX-RECOMPILE asserting the contract
+            plan._solve_fn(3)(b)
+    """
+    assert hits(src, "CFX-RECOMPILE") == []
+    assert len(hits(src, "CFX-RECOMPILE", suppressed=True)) == 1
+
+
+# --------------------------------------------------------------------- #
+# CFX-EXCEPT
+# --------------------------------------------------------------------- #
+
+
+def test_except_rule_bare_and_base():
+    src = """
+        def worker():
+            try:
+                run()
+            except:
+                pass
+
+        def worker2():
+            try:
+                run()
+            except (ValueError, BaseException):
+                pass
+    """
+    assert len(hits(src, "CFX-EXCEPT")) == 2
+
+
+def test_except_rule_sanctioned_forms_pass():
+    src = """
+        def loop(self):
+            try:
+                run()
+            except BaseException as e:
+                self._thread_died("drain", e)
+
+        def passthrough():
+            try:
+                run()
+            except BaseException:
+                raise
+
+        def normal():
+            try:
+                run()
+            except Exception:
+                pass
+    """
+    assert hits(src, "CFX-EXCEPT") == []
+
+
+def test_except_rule_injected_kill():
+    src = """
+        def worker():
+            try:
+                run()
+            except InjectedKill:
+                pass
+    """
+    found = hits(src, "CFX-EXCEPT")
+    assert len(found) == 1 and "InjectedKill" in found[0].message
+
+
+def test_except_rule_suppression():
+    src = """
+        def worker():
+            try:
+                run()
+            # conflint: disable=CFX-EXCEPT fixture
+            except BaseException:
+                pass
+    """
+    assert hits(src, "CFX-EXCEPT") == []
+    assert len(hits(src, "CFX-EXCEPT", suppressed=True)) == 1
+
+
+# --------------------------------------------------------------------- #
+# the self-run: this repo is conflint-clean
+# --------------------------------------------------------------------- #
+
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture(scope="module")
+def repo_report():
+    return analysis.run_paths([REPO])
+
+
+def test_repo_is_conflint_clean(repo_report):
+    assert repo_report.errors == [], repo_report.errors
+    assert repo_report.findings == [], "\n".join(
+        str(f) for f in repo_report.findings)
+
+
+def test_repo_report_shape(repo_report, tmp_path):
+    s = repo_report.summary()
+    assert s["rules_run"] == len(analysis.RULE_IDS) == 6
+    assert s["files_scanned"] > 50
+    assert s["findings"] == 0
+    # the annotated tree carries REAL, reasoned suppressions — they are
+    # counted, not hidden (the diffable-trend surface of ISSUE 6)
+    assert s["suppressions"] >= 5
+    assert set(s["by_rule"]) >= set(analysis.RULE_IDS)
+    for f in repo_report.suppressions:
+        assert f.reason, f"suppression without a reason: {f}"
+    out = tmp_path / "report.json"
+    repo_report.to_json(str(out))
+    import json
+
+    data = json.loads(out.read_text())
+    assert data["tool"] == "conflint" and data["summary"] == s
+
+
+def test_annotations_present_in_serve_stack():
+    """The contract surface is actually annotated (a future refactor
+    that drops the comments would silently disable the rules)."""
+    eng = open(os.path.join(REPO, "conflux_tpu", "engine.py")).read()
+    srv = open(os.path.join(REPO, "conflux_tpu", "serve.py")).read()
+    prof = open(os.path.join(REPO, "conflux_tpu", "profiler.py")).read()
+    res = open(os.path.join(REPO, "conflux_tpu", "resilience.py")).read()
+    assert eng.count("guarded-by: _lock") >= 15
+    assert eng.count("# hot-path") >= 10
+    assert eng.count("# futures-owner") + eng.count(", futures-owner") >= 10
+    assert srv.count("guarded-by: _lock") >= 8
+    assert prof.count("guarded-by: _PROF_LOCK") >= 2
+    assert res.count("guarded-by:") >= 3
+
+
+# --------------------------------------------------------------------- #
+# lockcheck: the runtime lock-order / dispatch harness
+# --------------------------------------------------------------------- #
+
+
+def test_lockcheck_detects_order_cycle():
+    with lockcheck.watch() as lc:
+        a = threading.Lock()
+        b = threading.Lock()
+
+        def ab():
+            with a:
+                with b:
+                    pass
+
+        t = threading.Thread(target=ab)
+        t.start()
+        t.join()
+        with b:
+            with a:
+                pass
+    assert any("cycle" in v for v in lc.violations), lc.report()
+
+
+def test_lockcheck_consistent_order_is_green():
+    with lockcheck.watch() as lc:
+        a = threading.Lock()
+        b = threading.Lock()
+        for _ in range(3):
+            with a:
+                with b:
+                    pass
+    assert lc.violations == []
+    assert lc.report()["order_edges"] >= 1
+
+
+def test_lockcheck_flags_lock_held_across_dispatch():
+    with lockcheck.watch() as lc:
+        lk = threading.Lock()
+        lc.mark_no_dispatch(lk)
+        with profiler.region("serve.solve"):
+            pass  # not held: clean
+        assert lc.violations == []
+        with lk:
+            with profiler.region("serve.solve"):
+                pass
+    assert any("held across dispatch" in v for v in lc.violations)
+
+
+def test_lockcheck_condition_protocol():
+    # Condition built on a wrapped RLock must wait/notify correctly
+    # (the engine's Condition sits on a wrapped Lock the same way)
+    with lockcheck.watch():
+        cond = threading.Condition(threading.RLock())
+        box = []
+
+        def producer():
+            with cond:
+                box.append(1)
+                cond.notify()
+
+        t = threading.Thread(target=producer)
+        with cond:
+            t.start()
+            assert cond.wait_for(lambda: box, timeout=10)
+        t.join()
+
+
+def test_lockcheck_engine_workload_green():
+    """The serve engine under real traffic holds no lock across a
+    dispatch and keeps one global lock order — the harness proves the
+    property the static rules cannot see."""
+    serve.clear_plans()
+    with lockcheck.watch() as lc:
+        plan = serve.FactorPlan.create((16, 16), jnp.float32, v=8,
+                                       persistent_cache=False)
+        rng = np.random.default_rng(0)
+        A = (rng.standard_normal((16, 16)) / 4
+             + 2.0 * np.eye(16)).astype(np.float32)
+        eng = ServeEngine(max_batch_delay=0.0, health=HealthPolicy(),
+                          watchdog_interval=0.05,
+                          persistent_cache=False)
+        try:
+            sess = eng.factor(plan, A, timeout=60)
+            futs = [eng.submit(
+                sess, rng.standard_normal((16, 2)).astype(np.float32))
+                for _ in range(6)]
+            for f in futs:
+                f.result(60)
+        finally:
+            eng.close(timeout=60)
+    assert lc.violations == [], lc.report()
+    assert lc.report()["acquisitions"] > 0
+
+
+# --------------------------------------------------------------------- #
+# regression tests for the findings conflint surfaced in this tree
+# --------------------------------------------------------------------- #
+
+
+def test_profiler_region_counters_thread_safe():
+    """conflint find: `_times[name] += dt` ran unlocked on every worker
+    thread — a read-modify-write that loses updates. Exact counts must
+    survive a thread hammer now."""
+    profiler.clear()
+    n_threads, n_iter = 8, 200
+
+    def worker():
+        for _ in range(n_iter):
+            with profiler.region("test.hammer"):
+                pass
+
+    threads = [threading.Thread(target=worker) for _ in range(n_threads)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    count = profiler.timings()["test.hammer"][0]
+    assert count == n_threads * n_iter
+    profiler.clear()
+
+
+def test_engine_registry_prune_thread_safe():
+    """conflint find: concurrent engine_stats() calls could both prune
+    the same dead weakref from _ENGINE_REFS (ValueError from
+    list.remove). Hammer registrations + stats concurrently."""
+
+    class Dummy:
+        def stats(self):
+            return {"requests": 1, "completed": 1, "shed": 0,
+                    "batches": 1, "queue_peak": 1,
+                    "coalesced_requests": 1, "factor_requests": 0,
+                    "factor_batches": 0, "factor_coalesced_requests": 0,
+                    "factor_slots": 0, "factor_pad_slots": 0}
+
+        def latency_samples(self):
+            return [0.001]
+
+        def factor_latency_samples(self):
+            return []
+
+    errors = []
+
+    def churn():
+        try:
+            for _ in range(100):
+                profiler.register_engine(Dummy())  # dies immediately
+                profiler.engine_stats()
+        except Exception as e:  # noqa: BLE001 — the race under test
+            errors.append(e)
+
+    threads = [threading.Thread(target=churn) for _ in range(6)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert errors == []
+
+
+def test_session_state_swap_atomic_against_solve():
+    """conflint find: SolveSession.solve read `_factors`/`_upd` with no
+    lock while refactor()/update() swapped them (`_factors = None`
+    mid-swap) — a concurrent direct solve could dispatch on None.
+    Hammer solve against refactor; every answer must match the oracle
+    and the guarded counters must be exact."""
+    serve.clear_plans()
+    plan = serve.FactorPlan.create((16, 16), jnp.float32, v=8,
+                                   persistent_cache=False)
+    rng = np.random.default_rng(1)
+    A = (rng.standard_normal((16, 16)) / 4
+         + 2.0 * np.eye(16)).astype(np.float32)
+    session = plan.factor(jnp.asarray(A))
+    b = rng.standard_normal((16, 2)).astype(np.float32)
+    want = np.linalg.solve(A.astype(np.float64), b.astype(np.float64))
+    n_iter, errors = 30, []
+
+    def solver():
+        try:
+            for _ in range(n_iter):
+                x = np.asarray(session.solve(jnp.asarray(b)))
+                err = np.linalg.norm(x - want) / np.linalg.norm(want)
+                assert err < 1e-4, err
+        except Exception as e:  # noqa: BLE001 — the race under test
+            errors.append(e)
+
+    def refactorer():
+        try:
+            for _ in range(n_iter):
+                session.refactor()
+        except Exception as e:  # noqa: BLE001
+            errors.append(e)
+
+    ts = [threading.Thread(target=solver),
+          threading.Thread(target=refactorer)]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join()
+    assert errors == []
+    assert session.solves == n_iter
+    assert session.refactors == n_iter

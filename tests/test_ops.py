@@ -155,6 +155,7 @@ def test_butterfly_zero_fill_contract_real_reducers(Px):
                 jnp.concatenate([top[0], bot[0]], axis=0), 2 * v),))
         return nom[None], nid[None], lu00[None], r[None]
 
+    # conflint: disable=CFX-RECOMPILE one-shot test trace; nothing to reuse
     nom, nid, lu00, r = jax.jit(shard_map(
         fn, mesh=mesh, in_specs=(P("x", None, None), P("x", None)),
         out_specs=(P("x", None, None), P("x", None),
@@ -196,6 +197,7 @@ def test_butterfly_allreduce_any_px(Px):
             (blk[0],), Px, "x", lambda top, bot: (top[0],))
         return s[None], w[None]
 
+    # conflint: disable=CFX-RECOMPILE one-shot test trace; nothing to reuse
     ssum, wtop = jax.jit(shard_map(
         fn, mesh=mesh, in_specs=P("x", None),
         out_specs=(P("x", None), P("x", None))))(data)
