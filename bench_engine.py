@@ -106,6 +106,22 @@ def parse_args():
     ap.add_argument("--factor-gate", type=float, default=2.0,
                     help="min sessions/s speedup vs the sequential "
                     "plan.factor loop (--factor, full shape)")
+    ap.add_argument("--tier", action="store_true",
+                    help="measure the ISSUE 7 tiered-residency win "
+                    "instead: Zipf-distributed session popularity over "
+                    "a fleet >= 8x the device-resident capacity, "
+                    "spill/revive through a ResidentSet vs the naive "
+                    "always-refactor LRU baseline, gate >= "
+                    "--tier-gate, write BENCH_WORKINGSET.json")
+    ap.add_argument("--fleet", type=int, default=32,
+                    help="sessions in the over-capacity fleet (--tier)")
+    ap.add_argument("--capacity", type=int, default=4,
+                    help="device-resident session cap (--tier)")
+    ap.add_argument("--zipf", type=float, default=1.1,
+                    help="Zipf popularity exponent (--tier)")
+    ap.add_argument("--tier-gate", type=float, default=2.0,
+                    help="min solves/s speedup vs the always-refactor "
+                    "baseline (--tier, full shape)")
     ap.add_argument("--resilience", action="store_true",
                     help="measure the HealthPolicy guard overhead on the "
                     "clean path instead: interleaved guarded vs unguarded "
@@ -146,7 +162,168 @@ def main():
     if args.out is None:
         args.out = ("BENCH_RESILIENCE.json" if args.resilience
                     else "BENCH_COLDSTART.json" if args.factor
+                    else "BENCH_WORKINGSET.json" if args.tier
                     else "BENCH_ENGINE.json")
+
+    # ---------------- tier mode: working-set residency gate -------------- #
+    # the ISSUE 7 acceptance number: Zipf-popular traffic over a fleet
+    # >= 8x the device-resident capacity, served through a ResidentSet
+    # (idle sessions spill to host, touches fault them back in with one
+    # h2d implant) must beat the naive always-refactor baseline (at
+    # most `capacity` live sessions; a miss re-runs plan.factor from
+    # the kept matrix — the only strategy the pre-tier stack had) by
+    # >= --tier-gate solves/s, with the device-byte high-water bounded
+    # at the configured cap THROUGHOUT. Both legs run the identical
+    # deterministic trace and every answer is checked BITWISE against
+    # the untiered oracle session (h2d revival restores the exact
+    # bits; a refactor re-runs the exact program).
+    if args.tier:
+        from conflux_tpu import tier
+        from conflux_tpu.tier import ResidentSet
+
+        if args.smoke:
+            args.N, args.v = 128, 64
+            args.fleet, args.capacity = 16, 2
+            args.requests, args.reps = 100, 3
+        N, v, F, C = args.N, args.v, args.fleet, args.capacity
+        R = max(args.requests, 2 * F)
+        if F < 8 * C:
+            raise SystemExit(f"--fleet {F} must be >= 8x --capacity {C} "
+                             "(the over-capacity working-set shape)")
+        plan = serve.FactorPlan.create((N, N), jnp.float32, v=v)
+        rng = np.random.default_rng(0)
+        Amats = [(rng.standard_normal((N, N)) / np.sqrt(N)
+                  + 2.0 * np.eye(N)).astype(np.float32)
+                 for _ in range(F)]
+        # Zipf popularity over the fleet; deterministic request trace
+        pmf = 1.0 / np.arange(1, F + 1) ** args.zipf
+        pmf /= pmf.sum()
+        order = rng.permutation(F)  # popularity decoupled from id
+        picks = order[rng.choice(F, size=R, p=pmf)]
+        b = rng.standard_normal((N, 1)).astype(np.float32)
+
+        # the bitwise oracle: one untiered session per matrix
+        oracle = [plan.factor(jnp.asarray(A)) for A in Amats]
+        x_want = [np.asarray(s.solve(b)) for s in oracle]
+        per_nbytes = oracle[0].nbytes
+        cap_bytes = C * per_nbytes
+        del oracle
+
+        def leg_baseline():
+            """Naive always-refactor: keep at most C live sessions; a
+            miss pays a full plan.factor of the kept host matrix."""
+            live: dict[int, object] = {}
+            lru: list[int] = []
+            misses = 0
+            t0 = time.perf_counter()
+            for sid in picks:
+                sid = int(sid)
+                s = live.get(sid)
+                if s is None:
+                    misses += 1
+                    if len(live) >= C:
+                        live.pop(lru.pop(0))
+                    s = plan.factor(jnp.asarray(Amats[sid]))
+                    live[sid] = s
+                else:
+                    lru.remove(sid)
+                lru.append(sid)
+                x = s.solve(b)
+            jax.block_until_ready(x)
+            return time.perf_counter() - t0, misses
+
+        fleet = [plan.factor(jnp.asarray(A)) for A in Amats]
+        rs = ResidentSet(max_sessions=C, max_bytes=cap_bytes,
+                         evict_batch=max(1, C // 2))
+        for s in fleet:
+            rs.adopt(s)
+
+        def leg_tiered():
+            t0 = time.perf_counter()
+            for sid in picks:
+                x = fleet[int(sid)].solve(b)
+            jax.block_until_ready(x)
+            return time.perf_counter() - t0
+
+        # warm both legs (programs, thread-free numpy paths)
+        leg_baseline()
+        leg_tiered()
+        traces0 = dict(plan.trace_counts)
+        h0 = tier.tier_stats()
+        t_base_reps, t_tier_reps, ratios = [], [], []
+        misses = 0
+        for rep in range(args.reps):  # interleaved + alternating order
+            if rep % 2 == 0:
+                tb, misses = leg_baseline()
+                tt = leg_tiered()
+            else:
+                tt = leg_tiered()
+                tb, misses = leg_baseline()
+            t_base_reps.append(tb)
+            t_tier_reps.append(tt)
+            ratios.append(tb / tt)
+
+        def median(xs):
+            xs = sorted(xs)
+            return xs[len(xs) // 2]
+
+        t_base, t_tier = median(t_base_reps), median(t_tier_reps)
+        speedup = median(ratios)
+        assert plan.trace_counts == traces0, \
+            "tiered traffic compiled after warmup — a bucket leaked"
+        # answers must be BITWISE the untiered oracle's (both legs ride
+        # the same compiled programs on the same bits)
+        n_bad = sum(
+            not np.array_equal(np.asarray(fleet[i].solve(b)), x_want[i])
+            for i in range(F))
+        if n_bad:
+            raise SystemExit(f"{n_bad}/{F} tiered sessions diverged "
+                             "from the untiered oracle (bitwise)")
+        st = rs.stats()
+        h1 = tier.tier_stats()
+        if st["device_bytes_high_water"] > cap_bytes:
+            raise SystemExit(
+                f"device-byte high-water {st['device_bytes_high_water']}"
+                f" exceeded the cap {cap_bytes} — the tier bound leaked")
+        gate = 1.0 if args.smoke else args.tier_gate
+        out = {
+            "metric": (f"tiered working-set solves/s N={N} v={v} "
+                       f"fleet={F} capacity={C} zipf={args.zipf} "
+                       f"R={R} f32 ({jax.device_count()} "
+                       f"{jax.devices()[0].platform} devices"
+                       + (", smoke" if args.smoke else "") + ")"),
+            "value": round(R / t_tier, 2),
+            "unit": "solves/s",
+            "always_refactor_solves_per_s": round(R / t_base, 2),
+            "speedup_vs_always_refactor": round(speedup, 2),
+            "speedup_gate_x": gate,
+            "reps": args.reps,
+            "baseline_miss_rate": round(misses / R, 3),
+            "spills_host": h1["spills_host"] - h0["spills_host"],
+            "revives_h2d": h1["revives_h2d"] - h0["revives_h2d"],
+            "revives_refactor": (h1["revives_refactor"]
+                                 - h0["revives_refactor"]),
+            "fault_in_p50_ms": round(h1["fault_in_p50_ms"], 3),
+            "fault_in_p95_ms": round(h1["fault_in_p95_ms"], 3),
+            "fault_in_p99_ms": round(h1["fault_in_p99_ms"], 3),
+            "session_nbytes": per_nbytes,
+            "device_bytes_cap": cap_bytes,
+            "device_bytes_high_water": st["device_bytes_high_water"],
+            "bitwise_vs_untiered": f"{F - n_bad}/{F}",
+            "compiles_after_warmup": 0,  # asserted above
+            "baseline": ("always-refactor LRU loop (<= capacity live "
+                         "sessions, plan.factor per miss)"),
+            "persistent_cache": cache.cache_dir(),
+        }
+        with open(args.out, "w") as f:
+            json.dump(out, f, indent=2)
+            f.write("\n")
+        print(json.dumps(out))
+        if speedup < gate:
+            raise SystemExit(
+                f"gate: tiered speedup {speedup:.2f}x < {gate}x over "
+                "the always-refactor baseline")
+        return
 
     # ---------------- factor mode: coalesced cold-start gate ------------ #
     # the ISSUE 5 acceptance number: session churn through the engine's

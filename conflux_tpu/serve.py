@@ -256,6 +256,23 @@ class FactorPlan:
                 _PLANS[key] = plan
         return plan
 
+    @classmethod
+    def from_key(cls, key: PlanKey) -> "FactorPlan":
+        """Get-or-build the plan for an EXACT :class:`PlanKey` — the
+        checkpoint-restore path (`conflux_tpu.tier.load_fleet`), which
+        must reconstruct the key verbatim (trace-time knobs included)
+        rather than re-derive them from process globals: same key, same
+        compiled program family, bitwise the same restored solves."""
+        if not isinstance(key, PlanKey):
+            raise TypeError(f"from_key takes a PlanKey, got "
+                            f"{type(key).__name__}")
+        with _PLANS_LOCK:
+            plan = _PLANS.get(key)
+            if plan is None:
+                plan = cls(key)
+                _PLANS[key] = plan
+        return plan
+
     # ------------------------------------------------------------------ #
     # program builders
     # ------------------------------------------------------------------ #
@@ -806,6 +823,17 @@ class SolveSession:
         self.solves = 0            # guarded-by: _lock
         self.updates = 0           # guarded-by: _lock
         self.refactors = 0         # guarded-by: _lock
+        # tiered residency (conflux_tpu.tier): `_residency` is the
+        # managing ResidentSet (write-once at adopt; None = untiered,
+        # zero behavioral change), `_spill` holds the spill record
+        # while the session's state lives off-device — every
+        # state-touching method faults it back in first
+        # (`_ensure_resident`, under this same lock). `_tier_stamp` is
+        # the LRU clock: a single int write per touch, read only by the
+        # manager's eviction scan — benign staleness by design
+        self._residency = None
+        self._spill = None         # guarded-by: _lock
+        self._tier_stamp = 0
 
     @property
     def factors(self):
@@ -819,7 +847,65 @@ class SolveSession:
     def update_rank(self) -> int:
         """Accumulated drift rank since the last (re)factorization."""
         with self._lock:
+            if self._spill is not None and self._spill.meta:
+                # spilled: report the record's drift rank without the
+                # cost of faulting the session back in
+                u = self._spill.meta.get("upd")
+                return 0 if u is None else u["k"]
             return 0 if self._upd is None else self._upd["k"]
+
+    # ------------------------------------------------------------------ #
+    # tiered residency (conflux_tpu.tier)
+    # ------------------------------------------------------------------ #
+
+    # requires-lock: _lock
+    def _ensure_resident(self) -> None:
+        """Fault a spilled session back in and stamp the LRU clock —
+        the transparent-revival hook every state-touching method runs
+        first, under the session RLock (so a request never observes a
+        half-restored factor pytree). Untiered sessions pay two
+        attribute reads."""
+        if self._spill is not None:
+            if self._residency is None:
+                from conflux_tpu.resilience import SessionSpilled
+
+                raise SessionSpilled(
+                    "session is spilled but no ResidentSet manages it "
+                    "(the manager detached or the record was grafted) — "
+                    "revive through ResidentSet.fault_in")
+            self._residency.fault_in(self)
+        rs = self._residency
+        if rs is not None:
+            self._tier_stamp = rs._tick()
+
+    @property
+    def tier(self) -> str:
+        """'device' (resident), 'host' or 'disk' (spilled), or
+        'corrupt' (a spill record that failed its integrity check —
+        permanently failed, see `resilience.RestoreCorrupt`)."""
+        with self._lock:
+            return "device" if self._spill is None else self._spill.tier
+
+    @property
+    def nbytes(self) -> int:
+        """Device-resident footprint in bytes: factors + base matrix +
+        Woodbury correction state + the cached probe row, deduplicated
+        by buffer identity (`_A` aliases `_A0` whenever the plan keeps
+        it). 0 while spilled — the spill record accounts its own
+        host/disk bytes. The byte-bounded tier policy
+        (`tier.ResidentSet(max_bytes=...)`) and `engine.stats()` read
+        this."""
+        with self._lock:
+            seen: dict[int, int] = {}
+            leaves = list(self._factors or ())
+            leaves += [self._A, self._A0, self._probe]
+            if self._upd is not None:
+                leaves += [self._upd[k] for k in
+                           ("Up", "Vp", "Y", "Cinv")]
+            for leaf in leaves:
+                if leaf is not None:
+                    seen[id(leaf)] = int(leaf.nbytes)
+            return sum(seen.values())
 
     def _rhs(self, b):
         plan = self.plan
@@ -863,6 +949,7 @@ class SolveSession:
         if plan.mesh is not None:
             (b2,) = _shard_batch((b2,), plan.mesh)
         with self._lock:
+            self._ensure_resident()
             with profiler.region("serve.solve"):
                 if self._upd is None:
                     x = plan._solve_fn(nb)(self._factors, self._A, b2)
@@ -900,6 +987,7 @@ class SolveSession:
         like the factors; O(N^2) once per base, invalidated by
         refactors)."""
         with self._lock:
+            self._ensure_resident()
             if self._probe is None:
                 self._probe = self.plan._probe_fn()(self._A0)
             return self._probe
@@ -915,6 +1003,7 @@ class SolveSession:
         plan = self.plan
         b2, nb, nrhs, squeeze = self._rhs_bucketed(b)
         with self._lock:
+            self._ensure_resident()
             wA = self._probe_row()
             with profiler.region("serve.solve"):
                 if self._upd is None:
@@ -951,6 +1040,7 @@ class SolveSession:
         if plan.mesh is not None:
             (x2,) = _shard_batch((x2,), plan.mesh)
         with self._lock:
+            self._ensure_resident()
             if self._upd is not None:
                 raise AssertionError(
                     "refine_checked rides the base factors — refactor() "
@@ -971,6 +1061,7 @@ class SolveSession:
         all); an un-drifted session re-runs the factor program on its
         resident base, replacing possibly-corrupt factors. Chainable."""
         with self._lock:
+            self._ensure_resident()
             if self._upd is not None:
                 u = self._upd
                 k = u["k"]
@@ -1023,6 +1114,7 @@ class SolveSession:
         V = jnp.asarray(V, dtype)
         self._check_uv(U, V)
         with self._lock, profiler.region("serve.update"):
+            self._ensure_resident()
             if self._upd is not None:
                 if replace:
                     # the superseded Woodbury state (Up/Vp/Y/Cinv) is dead
@@ -1062,6 +1154,12 @@ class SolveSession:
             self._upd = {"k": k, "kb": kb, "Up": U, "Vp": V,
                          "Y": Y, "Cinv": Cinv}
             self.updates += 1
+            if self._residency is not None:
+                # footprint grew by the Woodbury state: refresh the
+                # manager's byte gauge (nbytes under this held lock,
+                # the gauge store under the manager's — the tier
+                # layer's session->manager lock order)
+                self._residency._note_bytes(self)
         return self
 
     def _refactor(self, Up, Vp):
@@ -1098,3 +1196,5 @@ class SolveSession:
             self._factors = plan._factor_once(A_new)
             self.factorizations += 1
             self.refactors += 1
+            if self._residency is not None:
+                self._residency._note_bytes(self)

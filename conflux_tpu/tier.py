@@ -1,0 +1,1327 @@
+"""Tiered session residency: device / host / disk, spill and revival.
+
+A device holds a few thousand resident factor sets at N=256; the north
+star ("millions of users") does not fit, and before this layer the
+fleet's only behavior under memory pressure was an allocator OOM that
+killed every session at once. :class:`ResidentSet` bounds the
+device-resident fleet by session count and bytes and moves the overflow
+down a three-tier ladder:
+
+- **device** — a normal :class:`~conflux_tpu.serve.SolveSession`:
+  factors, base matrix, Woodbury state and probe row resident, solves
+  are substitution-only.
+- **host** — the session's FULL state (factor pytree, A0, the Woodbury
+  ``(Up, Vp, Y, Cinv)`` correction, the cached probe row ``wA``, and
+  the drift bookkeeping) swapped out as numpy arrays. Eviction is
+  batch-amortized: a spill batch stashes every victim's device arrays
+  under its own session lock (cheap pointer swaps), then ONE
+  ``jax.device_get`` moves the whole batch's pytrees across — one
+  blocking sync per eviction wave, not one per session, and never more
+  than one session lock held at a time.
+- **disk** — cold host records demoted to the §11 checkpoint
+  serialization (`conflux_tpu.io`'s headered binary format, one file
+  per pytree leaf plus a JSON manifest with shapes/dtypes/CRCs). The
+  same records back :func:`save_fleet`/:func:`load_fleet` — the engine
+  checkpoint/restore surface — so a crashed or upgraded server restarts
+  with its fleet intact instead of cold-start-storming the factor lane.
+
+Revival is transparent: ``solve``/``update``/``refactor`` on a spilled
+session fault it back in under the session RLock
+(`SolveSession._ensure_resident` -> :meth:`ResidentSet.fault_in`),
+choosing between
+
+- **h2d restore** — implant the record's arrays back on device
+  (bitwise: a d2h/h2d round trip and the io.py codec never touch
+  payload bits, asserted in tests/test_tier.py). Batched restores
+  (:meth:`revive_many`, the checkpoint warm-up) ride
+  ``batched.stack_host_trees``: one transfer per leaf POSITION for a
+  whole same-plan group instead of one per (session, leaf).
+- **re-factorization** — when the spilled drift is past
+  ``revive_refactor_rank`` the factors are stale anyway, so the drifted
+  base ``A0 + U V^H`` is materialized host-side and refactored through
+  PR 5's coalesced factor lane (``engine.submit_factor``): a
+  thundering-herd revival coalesces into a few vmapped factor
+  dispatches instead of serializing narrow ones. Engine worker threads
+  (which must not block on their own lane) and engineless managers take
+  the direct ``plan._factor_once`` path — the same program family,
+  bitwise the same factors.
+
+Robustness rails (DESIGN §20/§23): a revive-lane semaphore bounds
+concurrent fault-ins, so a revival storm degrades to bounded latency
+instead of device OOM (a timed-out acquisition raises structured
+:class:`~conflux_tpu.resilience.SessionSpilled`, the record intact);
+every disk record carries per-leaf CRCs and a corrupt one fails ONLY
+its owning session with :class:`~conflux_tpu.resilience.RestoreCorrupt`
+evidence; `FaultPlan` sites ``spill``/``revive``/``disk_write``/
+``disk_read`` inject crashes, delays and byte corruption
+deterministically (a spill crash leaves the session resident, a revive
+crash leaves it fully spilled — fail-safe in both directions). Every
+outcome lands in ``profiler.serve_stats()['tier']``.
+"""
+
+from __future__ import annotations
+
+import itertools
+import json
+import os
+import shutil
+import threading
+import time
+import zlib
+from collections import deque
+from typing import Any
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from conflux_tpu import io as cfio
+from conflux_tpu import profiler, resilience
+from conflux_tpu.resilience import (
+    InjectedFault,
+    RestoreCorrupt,
+    SessionSpilled,
+)
+
+# --------------------------------------------------------------------------- #
+# tier counters (merged into profiler.serve_stats()['tier'])
+# --------------------------------------------------------------------------- #
+
+_TIER_KEYS = (
+    "spills_host",        # sessions spilled device -> host
+    "spills_disk",        # host records demoted to the disk tier
+    "revives_h2d",        # fault-ins restored host -> device
+    "revives_disk",       # fault-ins that read the disk tier first
+    "revives_refactor",   # fault-ins that re-factored (stale drift)
+    "revive_rejects",     # revive-lane admission timeouts (backpressure)
+    "spill_faults",       # injected/real spill failures (session stayed
+                          # resident — fail-safe)
+    "disk_write_faults",  # demotion failures (record stayed host-tier)
+    "restore_corrupt",    # records that failed their CRC on read
+    "disk_bytes_written",
+    "disk_bytes_read",
+    "checkpoints",        # save_fleet calls
+    "restores",           # load_fleet calls
+)
+
+_TIER_LOCK = threading.Lock()
+_TIER: dict[str, int] = {k: 0 for k in _TIER_KEYS}  # guarded-by: _TIER_LOCK
+# fault-in wall-clock window (seconds) — serve_stats reports p50/p95/p99
+_FAULT_LAT: deque = deque(maxlen=8192)  # guarded-by: _TIER_LOCK
+# live ResidentSets (weak — a manager dies with its owner) for the gauge
+# half of tier_stats(): resident/host/disk population, byte high-waters
+_SET_REFS: list = []  # guarded-by: _TIER_LOCK
+
+
+def bump(key: str, n: int = 1) -> None:
+    """Count one tier outcome (unknown keys appear lazily)."""
+    with _TIER_LOCK:
+        _TIER[key] = _TIER.get(key, 0) + n
+
+
+def _note_latency(dt: float) -> None:
+    with _TIER_LOCK:
+        _FAULT_LAT.append(dt)
+
+
+def clear_tier() -> None:
+    """Reset the global tier counters + latency window (gauges live on
+    the ResidentSets and survive, like engine counters)."""
+    with _TIER_LOCK:
+        for k in list(_TIER):
+            _TIER[k] = 0
+        _FAULT_LAT.clear()
+
+
+def tier_stats() -> dict:
+    """Counters + fault-in latency percentiles + gauges merged across
+    live ResidentSets — the 'tier' sub-dict of
+    `profiler.serve_stats()`."""
+    from conflux_tpu.engine import _percentile
+
+    with _TIER_LOCK:
+        out: dict[str, Any] = dict(_TIER)
+        lats = sorted(_FAULT_LAT)
+        alive, dead = [], []
+        for ref in _SET_REFS:
+            rs = ref()
+            (alive if rs is not None else dead).append(
+                rs if rs is not None else ref)
+        for ref in dead:
+            _SET_REFS.remove(ref)
+    for pct in (50, 95, 99):
+        out[f"fault_in_p{pct}_ms"] = 1e3 * _percentile(lats, pct)
+    gauges = {"managed_sessions": 0, "resident_sessions": 0,
+              "host_sessions": 0, "disk_sessions": 0,
+              "corrupt_sessions": 0, "device_bytes": 0,
+              "device_bytes_high_water": 0, "resident_high_water": 0,
+              "host_bytes": 0, "disk_bytes": 0}
+    for rs in alive:  # each stats() takes only that manager's lock
+        s = rs.stats()
+        for k in gauges:
+            if k in ("device_bytes_high_water", "resident_high_water"):
+                gauges[k] = max(gauges[k], s[k])
+            else:
+                gauges[k] += s[k]
+    out.update(gauges)
+    return out
+
+
+def _register_set(rs) -> None:
+    import weakref
+
+    ref = weakref.ref(rs)
+    with _TIER_LOCK:
+        _SET_REFS.append(ref)
+
+
+# --------------------------------------------------------------------------- #
+# leaf codec: any session pytree leaf <-> the io.py headered format
+# --------------------------------------------------------------------------- #
+
+# io.py stores float32/float64/int32 (§11's checkpoint dtypes). Every
+# other leaf dtype the serve stack produces maps onto them losslessly:
+# complex views as real pairs, int64/uint64/uint32 view as int32 words
+# (bit-preserving), and the sub-32-bit floats widen exactly (bf16/f16 ->
+# f32 is injective). 'enc' in the leaf meta names the inverse.
+_IO_NATIVE = ("float32", "float64", "int32")
+_VIEW_AS = {"complex64": "float32", "complex128": "float64",
+            "int64": "int32", "uint64": "int32", "uint32": "int32"}
+_CAST_AS = {"bfloat16": "float32", "float16": "float32", "bool": "int32"}
+
+
+def _encode_leaf(a: np.ndarray) -> tuple[np.ndarray, dict]:
+    """One host leaf -> (2D io.py-storable array, leaf meta). The
+    encoding is bit-lossless: 'raw' stores as-is, 'view' reinterprets
+    the bytes, 'cast' widens through an injective dtype map."""
+    a = np.ascontiguousarray(a)
+    name = a.dtype.name
+    meta = {"shape": list(a.shape), "dtype": name}
+    if name in _IO_NATIVE:
+        enc, how = a, "raw"
+    elif name in _VIEW_AS:
+        enc, how = a.view(np.dtype(_VIEW_AS[name])), "view"
+    elif name in _CAST_AS:
+        enc, how = a.astype(np.dtype(_CAST_AS[name])), "cast"
+    else:
+        raise ValueError(
+            f"tier codec cannot store dtype {name} (extend _VIEW_AS/"
+            "_CAST_AS with a lossless mapping)")
+    meta["enc"] = how
+    return enc.reshape(1, enc.size), meta
+
+
+def _decode_leaf(flat: np.ndarray, meta: dict) -> np.ndarray:
+    """Inverse of :func:`_encode_leaf` — bitwise."""
+    dt = jnp.dtype(meta["dtype"])  # resolves bfloat16 via jax/ml_dtypes
+    how = meta["enc"]
+    flat = flat.reshape(-1)
+    if how == "view":
+        flat = flat.view(dt)
+    elif how == "cast":
+        flat = flat.astype(dt)
+    return flat.reshape(tuple(meta["shape"]))
+
+
+# --------------------------------------------------------------------------- #
+# session state <-> leaves dict (+ structural meta)
+# --------------------------------------------------------------------------- #
+
+
+def _extract_state(session) -> tuple[dict, dict]:
+    """Read-only snapshot of a resident session's device state as
+    ({leaf name: device array}, structural meta). Caller holds the
+    session lock (`# requires-lock` discipline — tier code only calls
+    this under `with session._lock`)."""
+    leaves: dict[str, Any] = {}
+    for i, f in enumerate(session._factors):
+        leaves[f"f{i}"] = f
+    leaves["A0"] = session._A0
+    if session._probe is not None:
+        leaves["probe"] = session._probe
+    upd = session._upd
+    if upd is not None:
+        for k in ("Up", "Vp", "Y", "Cinv"):
+            leaves[k] = upd[k]
+    meta = {
+        "n_factors": len(session._factors),
+        "keep_A": session._A is not None,
+        "has_probe": session._probe is not None,
+        "upd": (None if upd is None
+                else {"k": int(upd["k"]), "kb": int(upd["kb"])}),
+        "owns_base": bool(session._owns_base),
+        "last_cond": session.last_cond,
+        "counters": {"factorizations": session.factorizations,
+                     "solves": session.solves,
+                     "updates": session.updates,
+                     "refactors": session.refactors},
+    }
+    return leaves, meta
+
+
+def _implant(session, leaves: dict, meta: dict,
+             counters: bool = False) -> None:
+    """Install a state snapshot (device arrays) into `session` — the
+    inverse of :func:`_extract_state`; caller holds the session lock.
+    `counters=True` additionally restores the bookkeeping counters (the
+    checkpoint-restore path; a same-process fault-in keeps the live
+    ones — they were never cleared)."""
+    session._factors = tuple(leaves[f"f{i}"]
+                             for i in range(meta["n_factors"]))
+    session._A0 = leaves["A0"]
+    session._A = session._A0 if meta["keep_A"] else None
+    session._probe = leaves.get("probe")
+    u = meta["upd"]
+    session._upd = (None if u is None else
+                    {"k": u["k"], "kb": u["kb"],
+                     "Up": leaves["Up"], "Vp": leaves["Vp"],
+                     "Y": leaves["Y"], "Cinv": leaves["Cinv"]})
+    session._owns_base = meta["owns_base"]
+    if counters:
+        c = meta["counters"]
+        session.factorizations = c["factorizations"]
+        session.solves = c["solves"]
+        session.updates = c["updates"]
+        session.refactors = c["refactors"]
+        session.last_cond = meta["last_cond"]
+
+
+# --------------------------------------------------------------------------- #
+# disk records: one io.py file per leaf + a JSON manifest with CRCs
+# --------------------------------------------------------------------------- #
+
+
+def _write_record(dirpath: str, leaves: dict, meta: dict,
+                  faults=None) -> int:
+    """Serialize a host-tier state snapshot to `dirpath` (one
+    `conflux_tpu.io` binary per leaf + manifest.json naming shapes,
+    dtypes, encodings and CRC32s). Returns the bytes written. The
+    'disk_write' fault site injects delay/crash before any byte lands
+    and, with kind 'nan', corrupts the written record afterwards (the
+    next read fails its CRC with :class:`RestoreCorrupt`)."""
+    resilience.maybe_fault(faults, "disk_write")
+    os.makedirs(dirpath, exist_ok=True)
+    manifest: dict[str, Any] = {"format": 1, "meta": meta, "leaves": {}}
+    total = 0
+    for name, a in leaves.items():
+        enc, lmeta = _encode_leaf(np.asarray(a))
+        fname = f"{name}.bin"
+        cfio.save_matrix(os.path.join(dirpath, fname), enc)
+        lmeta["file"] = fname
+        lmeta["crc"] = zlib.crc32(enc.tobytes()) & 0xFFFFFFFF
+        manifest["leaves"][name] = lmeta
+        total += enc.nbytes
+    with open(os.path.join(dirpath, "manifest.json"), "w") as f:
+        json.dump(manifest, f)
+    if resilience.data_fault(faults, "disk_write", "nan") is not None:
+        # corrupt the first leaf's payload IN the written file — the
+        # deterministic stand-in for bit rot / a torn write; detection
+        # happens at read time through the CRC
+        first = sorted(manifest["leaves"])[0]
+        fpath = os.path.join(dirpath, manifest["leaves"][first]["file"])
+        with open(fpath, "r+b") as f:
+            f.seek(24)  # just past the io.py header
+            f.write(b"\xde\xad\xbe\xef")
+    return total
+
+
+def _read_record(dirpath: str, faults=None) -> tuple[dict, dict]:
+    """Deserialize a disk record: (host leaves, meta). Integrity
+    failures (missing/truncated files, CRC mismatch, undecodable
+    manifest) raise :class:`RestoreCorrupt` with evidence — the caller
+    fails ONLY the owning session."""
+    resilience.maybe_fault(faults, "disk_read")
+    mpath = os.path.join(dirpath, "manifest.json")
+    try:
+        with open(mpath) as f:
+            manifest = json.load(f)
+    except (OSError, ValueError) as e:
+        raise RestoreCorrupt(
+            f"spill record manifest unreadable: {mpath!r} ({e})",
+            {"path": dirpath}) from e
+    leaves: dict[str, Any] = {}
+    total = 0
+    for name, lmeta in manifest["leaves"].items():
+        fpath = os.path.join(dirpath, lmeta["file"])
+        try:
+            enc = cfio.load_matrix(fpath)
+        except (OSError, ValueError) as e:
+            raise RestoreCorrupt(
+                f"spill record leaf unreadable: {fpath!r} ({e})",
+                {"path": dirpath, "leaf": name}) from e
+        crc = zlib.crc32(enc.tobytes()) & 0xFFFFFFFF
+        if crc != lmeta["crc"]:
+            raise RestoreCorrupt(
+                f"spill record leaf {name!r} failed its integrity "
+                f"check (crc {crc:#010x} != recorded "
+                f"{lmeta['crc']:#010x}) — the record is corrupt and "
+                "only this session fails",
+                {"path": dirpath, "leaf": name,
+                 "expected_crc": lmeta["crc"], "got_crc": crc})
+        leaves[name] = _decode_leaf(enc, lmeta)
+        total += enc.nbytes
+    bump("disk_bytes_read", total)
+    return leaves, manifest["meta"]
+
+
+# --------------------------------------------------------------------------- #
+# the spill record
+# --------------------------------------------------------------------------- #
+
+
+class _SpillRecord:
+    """Where a non-resident session's state lives. `tier` walks
+    'transit' (device arrays stashed, d2h pending — a racing fault-in
+    reclaims them instantly) -> 'host' (numpy) -> 'disk' (path only).
+    'corrupt' pins the RestoreCorrupt a failed read produced, so every
+    later touch of this session re-raises the same structured error."""
+
+    __slots__ = ("tier", "leaves", "meta", "path", "nbytes", "error")
+
+    def __init__(self, tier, leaves, meta, path=None, nbytes=0,
+                 error=None):
+        self.tier = tier
+        self.leaves = leaves
+        self.meta = meta
+        self.path = path
+        self.nbytes = nbytes
+        self.error = error
+
+
+def _host_nbytes(leaves: dict) -> int:
+    return sum(int(np.asarray(a).nbytes) for a in leaves.values())
+
+
+# --------------------------------------------------------------------------- #
+# ResidentSet — the tier manager
+# --------------------------------------------------------------------------- #
+
+
+class ResidentSet:
+    """Bounds device-resident sessions by count/bytes; spills overflow
+    to host, demotes cold host records to disk, and revives on touch.
+
+    Knobs:
+
+    max_sessions / max_bytes: the device-tier caps. Eviction makes room
+        BEFORE a fault-in implants, so the byte gauge's high-water never
+        exceeds the cap (the working-set bench asserts it). None = that
+        dimension unbounded.
+    host_max_sessions / host_max_bytes: host-tier caps; overflow demotes
+        the coldest records to `disk_dir` (demotion is skipped — host
+        grows — when no disk_dir is configured).
+    evict_batch: sessions spilled per eviction wave. Larger batches
+        amortize the d2h better (ONE `jax.device_get` per wave) at the
+        cost of briefly undershooting the resident set.
+    max_concurrent_revives: the revive-lane admission bound — at most
+        this many fault-ins materialize device state concurrently, so a
+        thundering-herd revival degrades to bounded latency instead of
+        transient device OOM. A fault-in that cannot acquire a slot
+        within its caller's deadline fails with structured
+        :class:`SessionSpilled` (record intact). Engine worker threads
+        always pass a bounded wait (the requests' soonest deadline,
+        else the engine's `revive_wait`), so a saturated lane degrades
+        to structured failures and can never wedge the dispatcher
+        behind a client-held slot. 0/None disables.
+    revive_refactor_rank: spilled drift rank at which revival
+        re-factorizes (through the engine's coalesced factor lane when
+        one is attached) instead of restoring stale factors + a fat
+        Woodbury correction. None (default) resolves past
+        `DriftPolicy.resolved_max_rank` — i.e. never, since `update()`
+        refactors beyond that rank anyway — keeping default revivals
+        BITWISE; set it lower to trade bitwise restoration for cheaper
+        revived solves on heavily drifted sessions.
+    engine: the ServeEngine whose factor lane coalesces refactor-
+        revivals (attached automatically by ``ServeEngine(residency=)``).
+        Engine worker threads never block on their own lane — they take
+        the direct factor path (same program family, same bits).
+    fault_plan: consulted at the 'spill'/'revive'/'disk_write'/
+        'disk_read' sites (falls back to the installed global plan).
+
+    Lock order (enforced at runtime by `scripts/soak.py --lockcheck`):
+    session RLock -> manager lock, never the reverse — the manager lock
+    guards only registry/gauge state and is never held across a device
+    dispatch or another session's lock.
+    """
+
+    def __init__(self, *, max_sessions: int | None = None,
+                 max_bytes: int | None = None,
+                 host_max_sessions: int | None = None,
+                 host_max_bytes: int | None = None,
+                 disk_dir: str | None = None,
+                 evict_batch: int = 4,
+                 max_concurrent_revives: int | None = 4,
+                 revive_refactor_rank: int | None = None,
+                 engine=None, fault_plan=None):
+        if max_sessions is not None and max_sessions < 1:
+            raise ValueError("max_sessions must be >= 1 (a zero-session "
+                             "device tier cannot serve)")
+        if evict_batch < 1:
+            raise ValueError("evict_batch must be >= 1")
+        self.max_sessions = max_sessions
+        self.max_bytes = max_bytes
+        self.host_max_sessions = host_max_sessions
+        self.host_max_bytes = host_max_bytes
+        self.disk_dir = disk_dir
+        self.evict_batch = int(evict_batch)
+        self.revive_refactor_rank = revive_refactor_rank
+        self.engine = engine
+        self._faults = fault_plan
+        slots = max_concurrent_revives
+        if slots and max_sessions is not None:
+            # more in-flight revivals than resident slots could land
+            # together and overshoot the cap even with eviction making
+            # room first — the lane never needs to outnumber the tier
+            slots = min(int(slots), int(max_sessions))
+        self._revive_sem = (threading.BoundedSemaphore(int(slots))
+                            if slots else None)
+        self._lock = threading.Lock()
+        self._sessions: dict[int, Any] = {}  # guarded-by: _lock
+        # id -> resident|spilling|reviving|host|disk|corrupt. A session
+        # mid-fault-in is 'reviving' and NEVER an eviction victim, so
+        # two concurrent fault-ins can't pick each other (no
+        # session-lock cycle); 'spilling' claims a victim so concurrent
+        # enforcers don't double-spill it.
+        self._state: dict[int, str] = {}     # guarded-by: _lock
+        self._bytes: dict[int, int] = {}     # guarded-by: _lock
+        # in-flight capacity claims {token: (bytes, sessions)}: a
+        # fault-in/adopt registers its incoming footprint here BEFORE
+        # making room, so two concurrent revivals each see the other's
+        # reservation and the victim math never lets them land past
+        # the caps together (the capacity race the tier chaos soak
+        # caught: both sized their eviction against the same snapshot)
+        self._claims: dict[int, tuple[int, int]] = {}  # guarded-by: _lock
+        self._claim_seq = itertools.count()
+        self._device_bytes = 0               # guarded-by: _lock
+        self._device_hw = 0                  # guarded-by: _lock
+        self._resident_hw = 0                # guarded-by: _lock
+        self._host_bytes = 0                 # guarded-by: _lock
+        self._disk_bytes = 0                 # guarded-by: _lock
+        self._clock = itertools.count(1)
+        self._disk_seq = itertools.count()
+        _register_set(self)
+
+    # -------------------------------------------------------------- #
+    # registration + the LRU clock
+    # -------------------------------------------------------------- #
+
+    def _tick(self) -> int:
+        return next(self._clock)
+
+    def adopt(self, *sessions) -> "ResidentSet":
+        """Bring sessions under management (resident ones count against
+        the caps immediately and may be evicted; already-spilled ones —
+        the lazy checkpoint-restore path — register in their current
+        tier). Mesh-sharded plans are rejected: their state is sharded
+        device buffers the host tiers cannot round-trip. Chainable."""
+        for s in sessions:
+            if s.plan.mesh is not None:
+                raise ValueError(
+                    "ResidentSet manages unsharded plans only — a "
+                    "mesh-sharded session's state lives across devices")
+            if s._residency is not None and s._residency is not self:
+                raise ValueError("session is already managed by a "
+                                 "different ResidentSet")
+            with s._lock:
+                s._residency = self
+                s._tier_stamp = self._tick()
+                rec = s._spill
+                nb = s.nbytes
+                sid = id(s)
+                token = None
+                if rec is None:
+                    # claim + make room BEFORE the incoming session
+                    # counts against the gauges, so the device-tier
+                    # high-water never exceeds the caps even while a
+                    # whole fleet adopts concurrently
+                    token = self._claim(nb, 1)
+                    try:
+                        self._make_room(0, 0)
+                    except BaseException:
+                        self._unclaim(token)
+                        raise
+                with self._lock:
+                    fresh = sid not in self._sessions
+                    self._sessions[sid] = s
+                    if rec is None:
+                        # atomic claim -> gauge transfer (see
+                        # _fault_in_admitted)
+                        self._claims.pop(token, None)
+                        self._state[sid] = "resident"
+                        self._bytes[sid] = nb
+                        if fresh:
+                            self._device_bytes += nb
+                            self._device_hw = max(self._device_hw,
+                                                  self._device_bytes)
+                            self._resident_hw = max(
+                                self._resident_hw,
+                                self._resident_now())
+                    else:
+                        self._state[sid] = rec.tier \
+                            if rec.tier in ("host", "disk", "corrupt") \
+                            else "host"
+                        self._bytes[sid] = rec.nbytes
+                        if fresh and rec.tier == "host":
+                            self._host_bytes += rec.nbytes
+                        elif fresh and rec.tier == "disk":
+                            self._disk_bytes += rec.nbytes
+                if token is not None:
+                    self._unclaim(token)
+        self._enforce()
+        return self
+
+    def sessions(self) -> list:
+        """Every managed session, in adoption order."""
+        with self._lock:
+            return list(self._sessions.values())
+
+    def _note_bytes(self, session) -> None:
+        """Refresh one resident session's byte gauge (called by the
+        serve layer after updates/refactors change the footprint;
+        caller holds the session lock, `nbytes` was computed under it)."""
+        nb = session.nbytes
+        sid = id(session)
+        with self._lock:
+            if self._state.get(sid) == "resident":
+                self._device_bytes += nb - self._bytes.get(sid, 0)
+                self._bytes[sid] = nb
+                self._device_hw = max(self._device_hw,
+                                      self._device_bytes)
+
+    # -------------------------------------------------------------- #
+    # spill: device -> host (batch-amortized d2h), host -> disk
+    # -------------------------------------------------------------- #
+
+    def spill(self, *sessions) -> int:
+        """Explicitly spill sessions to the host tier (idle-set
+        trimming; capacity eviction calls the same machinery). Returns
+        how many actually moved."""
+        victims = []
+        with self._lock:
+            for s in sessions:
+                sid = id(s)
+                if self._state.get(sid) == "resident":
+                    self._state[sid] = "spilling"
+                    victims.append(s)
+        return self._spill_batch(victims)
+
+    def spill_lru(self, n: int) -> int:
+        """Spill the n least-recently-used resident sessions."""
+        with self._lock:
+            resident = [s for sid, s in self._sessions.items()
+                        if self._state.get(sid) == "resident"]
+            resident.sort(key=lambda s: s._tier_stamp)
+            victims = resident[:n]
+            for s in victims:
+                self._state[id(s)] = "spilling"
+        return self._spill_batch(victims)
+
+    def _spill_batch(self, victims: list) -> int:
+        """The two-phase batch spill. Phase 1, per victim under its own
+        session lock: stash the device-array state in a 'transit'
+        record and null the session's fields (pointer swaps, no device
+        work). Phase 2, no session locks held: ONE `jax.device_get`
+        moves every stashed pytree host-side, then each record flips to
+        'host' under a brief re-acquire (skipping any a fault-in
+        reclaimed mid-flight). One session lock at a time, one blocking
+        sync per batch."""
+        recs = []
+        for s in victims:
+            sid = id(s)
+            with s._lock:
+                if s._spill is not None:  # raced: already off-device
+                    t = s._spill.tier
+                    with self._lock:
+                        if self._state.get(sid) == "spilling":
+                            # a 'transit' record registers as host-tier
+                            # (phase 2 pending elsewhere)
+                            self._state[sid] = t if t in (
+                                "host", "disk", "corrupt") else "host"
+                    continue
+                try:
+                    resilience.maybe_fault(self._faults, "spill")
+                except InjectedFault:
+                    bump("spill_faults")
+                    with self._lock:  # fail-safe: stays resident
+                        self._state[sid] = "resident"
+                    continue
+                leaves, meta = _extract_state(s)
+                rec = _SpillRecord("transit", leaves, meta)
+                s._spill = rec
+                s._factors = None
+                s._A = None
+                s._A0 = None
+                s._probe = None
+                s._upd = None
+            with self._lock:
+                self._state[sid] = "host"
+                self._device_bytes -= self._bytes.get(sid, 0)
+            recs.append((s, rec))
+        if not recs:
+            return 0
+        with profiler.region("serve.spill"):
+            host = jax.device_get([rec.leaves for _s, rec in recs])
+        moved = 0
+        for (s, rec), hl in zip(recs, host):
+            # try-acquire, never block: the lock holder is mid-touch,
+            # and every touch path reclaims the transit record itself
+            # (`_fault_in_admitted`'s transit branch), so skipping the
+            # flip loses nothing — blocking here while holding a
+            # revive-lane slot deadlocked against a client waiting on
+            # that slot with this session's lock held (soak-caught)
+            if not s._lock.acquire(timeout=0.05):
+                continue
+            try:
+                if s._spill is not rec or rec.tier != "transit":
+                    continue  # a fault-in reclaimed the transit record
+                rec.leaves = hl
+                rec.tier = "host"
+                rec.nbytes = _host_nbytes(hl)
+            finally:
+                s._lock.release()
+            with self._lock:
+                self._bytes[id(s)] = rec.nbytes
+                self._host_bytes += rec.nbytes
+            bump("spills_host")
+            moved += 1
+        self._demote_overflow()
+        return moved
+
+    def demote(self, *sessions) -> int:
+        """Explicitly demote host-tier sessions to the disk tier."""
+        return sum(self._demote_one(s) for s in sessions)
+
+    def _demote_one(self, s) -> int:
+        if self.disk_dir is None:
+            raise ValueError("demotion needs a disk_dir")
+        sid = id(s)
+        # try-acquire, never block: demotion is best-effort
+        # housekeeping, and a host-tier session's lock can be held by
+        # a client waiting on the revive lane — blocking here from a
+        # fault-in's _spill_batch (which holds its session lock AND a
+        # lane slot) closed a cycle lockcheck caught. On contention the
+        # host tier runs transiently over its cap until the next
+        # enforce — the safe direction.
+        if not s._lock.acquire(timeout=0.05):
+            return 0
+        try:
+            rec = s._spill
+            if rec is None or rec.tier != "host":
+                return 0
+            d = os.path.join(self.disk_dir,
+                             f"sess-{sid:x}-{next(self._disk_seq)}")
+            try:
+                nbytes = _write_record(d, rec.leaves, rec.meta,
+                                       self._faults)
+            except InjectedFault:
+                bump("disk_write_faults")
+                shutil.rmtree(d, ignore_errors=True)
+                return 0  # fail-safe: the record stays host-tier
+            host_nb = rec.nbytes
+            rec.tier = "disk"
+            rec.path = d
+            rec.leaves = None
+            rec.nbytes = nbytes
+        finally:
+            s._lock.release()
+        with self._lock:
+            self._state[sid] = "disk"
+            self._host_bytes -= host_nb
+            self._disk_bytes += nbytes
+            self._bytes[sid] = nbytes
+        bump("spills_disk")
+        bump("disk_bytes_written", nbytes)
+        return 1
+
+    def _demote_overflow(self) -> None:
+        if self.disk_dir is None:
+            return
+        while True:
+            with self._lock:
+                hosts = [s for sid, s in self._sessions.items()
+                         if self._state.get(sid) == "host"]
+                over = 0
+                if self.host_max_sessions is not None:
+                    over = max(over, len(hosts) - self.host_max_sessions)
+                if self.host_max_bytes is not None \
+                        and self._host_bytes > self.host_max_bytes:
+                    over = max(over, 1)
+                if over <= 0:
+                    return
+                hosts.sort(key=lambda s: s._tier_stamp)
+                victims = hosts[:over]
+            if not victims:
+                return
+            if sum(self._demote_one(s) for s in victims) == 0:
+                return  # nothing demotable (faults): stop, don't spin
+
+    # -------------------------------------------------------------- #
+    # capacity enforcement
+    # -------------------------------------------------------------- #
+
+    # requires-lock: _lock
+    def _resident_now(self) -> int:
+        """Device-tier occupancy for the high-water gauge: 'resident'
+        sessions plus every in-flight capacity claim. A 'reviving'
+        session is represented by its claim alone (it holds no device
+        state until it lands, and landing retires the claim
+        atomically), and a 'spilling' victim is NOT counted — the
+        claim that evicted it already owns its slot, so counting both
+        would double-count one slot for the duration of the handoff
+        (the accounted-byte gauge retires victims at stash time for
+        the same reason)."""
+        res = sum(1 for x in self._state.values() if x == "resident")
+        return res + sum(cn for _cb, cn in self._claims.values())
+
+    def _claim(self, nbytes: int, count: int) -> int:
+        """Reserve incoming device capacity ahead of a fault-in/adopt.
+        The reservation participates in every concurrent caller's
+        victim math (`_pick_victims`) until released, so simultaneous
+        revivals cannot each size their eviction against a snapshot
+        blind to the other and land past the caps together. Returns
+        the release token for :meth:`_unclaim`."""
+        token = next(self._claim_seq)
+        with self._lock:
+            self._claims[token] = (int(nbytes), int(count))
+        return token
+
+    def _unclaim(self, token: int) -> None:
+        """Release a capacity claim — called AFTER the landing bytes
+        are registered in the gauges (a moment of double-count is
+        harmless; a window counted by neither would re-open the race)
+        or when the fault-in fails and nothing lands."""
+        with self._lock:
+            self._claims.pop(token, None)
+
+    def _pick_victims(self, incoming_bytes: int,
+                      incoming_count: int) -> list:
+        """Under the manager lock, claim the LRU resident sessions that
+        must spill to fit `incoming_count` sessions of `incoming_bytes`
+        plus every in-flight capacity claim under the caps. A session
+        mid-fault-in is 'reviving' (never 'resident'), so it is never
+        picked — which is what keeps two concurrent fault-ins from
+        deadlocking on each other's session locks."""
+        with self._lock:
+            resident = [(sid, s) for sid, s in self._sessions.items()
+                        if self._state.get(sid) == "resident"]
+            resident.sort(key=lambda e: e[1]._tier_stamp)
+            claimed_b = claimed_n = 0
+            for cb, cn in self._claims.values():
+                claimed_b += cb
+                claimed_n += cn
+            need_n = 0
+            if self.max_sessions is not None:
+                need_n = (len(resident) + claimed_n + incoming_count
+                          - self.max_sessions)
+            need_b = 0
+            if self.max_bytes is not None:
+                need_b = (self._device_bytes + claimed_b
+                          + incoming_bytes - self.max_bytes)
+            victims = []
+            freed = 0
+            for sid, s in resident:
+                if len(victims) >= need_n and freed >= need_b:
+                    break
+                victims.append(s)
+                freed += self._bytes.get(sid, 0)
+            # round small count-pressure waves up to the amortization
+            # batch (never byte-pressure ones: bytes freed beyond the
+            # need would thrash)
+            if victims and need_n > 0 and need_b <= 0:
+                for sid, s in resident[len(victims):]:
+                    if len(victims) >= self.evict_batch:
+                        break
+                    victims.append(s)
+            for s in victims:
+                self._state[id(s)] = "spilling"
+        return victims
+
+    def _make_room(self, incoming_bytes: int,
+                   incoming_count: int) -> None:
+        victims = self._pick_victims(incoming_bytes, incoming_count)
+        if victims:
+            self._spill_batch(victims)
+
+    def _enforce(self) -> None:
+        self._make_room(0, 0)
+        self._demote_overflow()
+
+    # -------------------------------------------------------------- #
+    # fault-in (revival)
+    # -------------------------------------------------------------- #
+
+    def _refactor_rank(self, session) -> int:
+        if self.revive_refactor_rank is not None:
+            return int(self.revive_refactor_rank)
+        # "stale" by default means past the DriftPolicy refactor
+        # trigger — update() refactors beyond resolved_max_rank, so a
+        # spilled session can never carry more: default revivals are
+        # always h2d (bitwise)
+        return session.policy.resolved_max_rank(session.plan.N) + 1
+
+    def fault_in(self, session, timeout: float | None = None) -> None:
+        """Revive a spilled session in place, under its RLock (the
+        transparent-revival entry — `SolveSession._ensure_resident` and
+        the engine's pre-dispatch hook land here). Atomic: the session
+        is either fully revived or fully spilled with its record intact
+        — never half-resident. `timeout` bounds BOTH waits a fault-in
+        can block on — the session-lock acquire and the revive-lane
+        admission slot (the engine passes the requests' soonest
+        deadline); expiry raises :class:`SessionSpilled` and releases
+        nothing but the caller's time.
+
+        The lock acquire MUST honor the timeout for deadlock freedom,
+        not just latency: a client-thread refactor-revival legitimately
+        holds its session's RLock AND a revive-lane slot while blocking
+        on the engine's factor lane, so a dispatcher that blocked
+        unbounded here — on that SAME session's lock, or on the lane
+        slot the client holds — would close the cycle (client waits on
+        dispatcher, dispatcher waits on the client's lock/slot). Engine
+        worker threads therefore NEVER wait unbounded: a `timeout=None`
+        call from one is bounded by the engine's `revive_wait`, no
+        matter which entry path led here (`_revive_for`'s pre-dispatch
+        hook, or a session's own `_ensure_resident` after a concurrent
+        eviction spilled it mid-dispatch). The bounded waits fail the
+        request structurally and keep the dispatcher live to serve the
+        factor batch that un-wedges the client."""
+        t0 = time.perf_counter()
+        if timeout is None:
+            eng = self.engine
+            if eng is not None and eng._is_worker_thread():
+                timeout = eng.revive_wait
+        if timeout is None:
+            session._lock.acquire()
+        elif not session._lock.acquire(timeout=max(0.0, timeout)):
+            bump("revive_rejects")
+            raise SessionSpilled(
+                f"session busy: another thread held its lock past the "
+                f"{timeout:.3f}s revive budget (likely a revival in "
+                "flight) — the record is intact; retry shortly",
+                retry_after=timeout)
+        try:
+            rec = session._spill
+            if rec is None:
+                return
+            if rec.tier == "corrupt":
+                raise rec.error
+            sid = id(session)
+            if self._revive_sem is not None:
+                ok = (self._revive_sem.acquire() if timeout is None
+                      else self._revive_sem.acquire(timeout=timeout))
+                if not ok:
+                    bump("revive_rejects")
+                    raise SessionSpilled(
+                        f"revive lane saturated: no admission slot "
+                        f"within {timeout:.3f}s — the session stays "
+                        "spilled (record intact); retry after an "
+                        "in-flight revival completes")
+            try:
+                with self._lock:
+                    self._state[sid] = "reviving"
+                self._fault_in_admitted(session, rec, sid)
+            except RestoreCorrupt as e:
+                bump("restore_corrupt")
+                rec.tier = "corrupt"
+                rec.error = e
+                with self._lock:
+                    self._state[sid] = "corrupt"
+                raise
+            except BaseException:
+                # injected/real revive failure: fully spilled, record
+                # intact — the next touch retries
+                with self._lock:
+                    if self._state.get(sid) == "reviving":
+                        self._state[sid] = rec.tier \
+                            if rec.tier in ("host", "disk") else "host"
+                raise
+            finally:
+                if self._revive_sem is not None:
+                    self._revive_sem.release()
+            session._tier_stamp = self._tick()
+        finally:
+            session._lock.release()
+        _note_latency(time.perf_counter() - t0)
+
+    # requires-lock: session lock (held by fault_in)
+    def _fault_in_admitted(self, session, rec, sid) -> None:
+        resilience.maybe_fault(self._faults, "revive")
+        with profiler.region("serve.revive"):
+            if rec.tier == "transit":
+                leaves, meta = rec.leaves, rec.meta
+                from_disk = False
+            elif rec.tier == "host":
+                leaves, meta = rec.leaves, rec.meta
+                from_disk = False
+            else:  # disk
+                leaves, meta = _read_record(rec.path, self._faults)
+                from_disk = True
+            u = meta["upd"]
+            stale = (u is not None
+                     and u["k"] >= self._refactor_rank(session))
+            # reserve the incoming footprint BEFORE sizing eviction —
+            # a concurrent fault-in's victim math must see it, or two
+            # revivals each sized against the same snapshot could land
+            # past the caps together
+            incoming = (0 if rec.tier == "transit"
+                        else _host_nbytes(leaves))
+            token = self._claim(incoming, 1)
+            try:
+                self._make_room(0, 0)
+                if stale and rec.tier != "transit":
+                    self._revive_refactor(session, leaves, meta)
+                    bump("revives_refactor")
+                elif rec.tier == "transit":
+                    _implant(session, leaves, meta)
+                    bump("revives_h2d")
+                else:
+                    dev = {k: jnp.asarray(v)
+                           for k, v in leaves.items()}
+                    _implant(session, dev, meta)
+                    bump("revives_h2d")
+                if from_disk:
+                    bump("revives_disk")
+                    if rec.path is not None:
+                        shutil.rmtree(rec.path, ignore_errors=True)
+                session._spill = None
+                nb = session.nbytes
+                with self._lock:
+                    # atomic claim -> gauge transfer: the reservation
+                    # retires in the same lock acquisition that counts
+                    # the landed session, so no concurrent reader ever
+                    # sees it twice (or not at all)
+                    self._claims.pop(token, None)
+                    self._state[sid] = "resident"
+                    if rec.tier == "host":
+                        self._host_bytes -= rec.nbytes
+                    elif rec.tier == "disk":
+                        self._disk_bytes -= rec.nbytes
+                    self._bytes[sid] = nb
+                    self._device_bytes += nb
+                    self._device_hw = max(self._device_hw,
+                                          self._device_bytes)
+                    self._resident_hw = max(self._resident_hw,
+                                            self._resident_now())
+            finally:
+                self._unclaim(token)
+
+    # requires-lock: session lock (held by fault_in)
+    def _revive_refactor(self, session, leaves, meta) -> None:
+        """The stale-drift revival path: materialize A1 = A0 + U V^H
+        host-side and re-factor it — through the engine's coalesced
+        factor lane when one is attached and the caller is not an
+        engine worker (a worker blocking on its own lane would
+        deadlock), else through the plan's cached bucket-1 factor
+        program. The revived session absorbs the drift exactly like a
+        DriftPolicy refactor: fresh base, no Woodbury state, counters
+        bumped."""
+        plan = session.plan
+        A0 = np.asarray(leaves["A0"])
+        u = meta["upd"]
+        if u is not None:
+            k = u["k"]
+            Up = np.asarray(leaves["Up"])[..., :k]
+            Vp = np.asarray(leaves["Vp"])[..., :k]
+            Vh = np.conj(np.swapaxes(Vp, -1, -2))
+            A1 = (A0 + Up @ Vh).astype(A0.dtype)
+        else:
+            A1 = A0
+        eng = self.engine
+        fresh = None
+        if eng is not None and not eng._is_worker_thread():
+            from conflux_tpu.engine import EngineClosed, EngineSaturated
+
+            try:
+                fresh = eng.factor(plan, A1, policy=session.policy)
+            except (EngineClosed, EngineSaturated):
+                fresh = None  # lane unavailable: direct path below
+        if fresh is not None:
+            session._factors = fresh._factors
+            session._A0 = fresh._A0
+            session._probe = fresh._probe
+        else:
+            Ad = jnp.asarray(A1)
+            with profiler.region("serve.refactor"):
+                session._factors = plan._factor_once(Ad)
+            session._A0 = Ad
+            session._probe = None
+        session._A = session._A0 if meta["keep_A"] else None
+        session._upd = None
+        session._owns_base = True
+        session.factorizations += 1
+        session.refactors += 1
+
+    def revive_many(self, sessions, timeout: float | None = None) -> int:
+        """Coalesced revival of a set of spilled sessions — the
+        checkpoint warm-up / prefetch path. Same-plan, undrifted
+        host-tier records restore through `batched.stack_host_trees`:
+        their leaves numpy-stack (memcpy) and cross in ONE h2d per leaf
+        position, then device-side slices implant per session (bitwise
+        what per-session `fault_in` restores). Drifted, disk-tier or
+        mismatched sessions fall back to `fault_in` individually.
+        Returns how many sessions were revived."""
+        from conflux_tpu.batched import stack_host_trees, unstack_tree
+
+        groups: dict[tuple, list] = {}
+        rest = []
+        for s in sessions:
+            with s._lock:
+                rec = s._spill
+                if rec is None:
+                    continue
+                if rec.tier != "host" or rec.meta["upd"] is not None:
+                    rest.append(s)
+                    continue
+                key = (id(s.plan), rec.meta["n_factors"],
+                       rec.meta["has_probe"], rec.meta["keep_A"])
+                groups.setdefault(key, []).append(s)
+        n = 0
+        for group in groups.values():
+            if len(group) == 1:
+                rest.append(group[0])
+                continue
+            t0 = time.perf_counter()
+            if self._revive_sem is not None:
+                ok = (self._revive_sem.acquire() if timeout is None
+                      else self._revive_sem.acquire(timeout=timeout))
+                if not ok:
+                    bump("revive_rejects")
+                    raise SessionSpilled(
+                        "revive lane saturated during coalesced "
+                        "revival — the remaining sessions stay spilled")
+            try:
+                recs = []
+                for s in group:
+                    with s._lock:
+                        rec = s._spill
+                        if rec is not None and rec.tier == "host":
+                            recs.append((s, rec))
+                if not recs:
+                    continue
+                # one claim covers the whole group until every member
+                # lands (a moment of claim+gauge double-count as slots
+                # settle is harmless — the safe direction)
+                token = self._claim(
+                    sum(rec.nbytes for _s, rec in recs), len(recs))
+                try:
+                    with profiler.region("serve.revive"):
+                        self._make_room(0, 0)
+                        stacked = stack_host_trees(
+                            [rec.leaves for _s, rec in recs])
+                        slots = unstack_tree(stacked, len(recs))
+                    for (s, rec), dev in zip(recs, slots):
+                        with s._lock:
+                            if s._spill is not rec:
+                                continue  # raced with a direct fault_in
+                            _implant(s, dev, rec.meta)
+                            s._spill = None
+                            s._tier_stamp = self._tick()
+                            nb = s.nbytes
+                        sid = id(s)
+                        with self._lock:
+                            # retire this slot's share of the group
+                            # claim in the same lock acquisition that
+                            # counts it landed
+                            cb, cn = self._claims.get(token, (0, 0))
+                            if cn > 1:
+                                self._claims[token] = (
+                                    max(0, cb - rec.nbytes), cn - 1)
+                            else:
+                                self._claims.pop(token, None)
+                            self._state[sid] = "resident"
+                            self._host_bytes -= rec.nbytes
+                            self._bytes[sid] = nb
+                            self._device_bytes += nb
+                            self._device_hw = max(self._device_hw,
+                                                  self._device_bytes)
+                            self._resident_hw = max(
+                                self._resident_hw,
+                                self._resident_now())
+                        bump("revives_h2d")
+                        _note_latency(time.perf_counter() - t0)
+                        n += 1
+                finally:
+                    self._unclaim(token)
+            finally:
+                if self._revive_sem is not None:
+                    self._revive_sem.release()
+        for s in rest:
+            self.fault_in(s, timeout=timeout)
+            n += 1
+        return n
+
+    # -------------------------------------------------------------- #
+    # observability
+    # -------------------------------------------------------------- #
+
+    def stats(self) -> dict:
+        """Gauges: population per tier, byte totals, and the
+        device-tier high-water marks the capacity bound is judged by
+        (merged fleet-wide into `profiler.serve_stats()['tier']`)."""
+        with self._lock:
+            st = list(self._state.values())
+            resident = sum(1 for x in st
+                           if x in ("resident", "spilling", "reviving"))
+            return {
+                "managed_sessions": len(self._sessions),
+                "resident_sessions": resident,
+                "host_sessions": st.count("host"),
+                "disk_sessions": st.count("disk"),
+                "corrupt_sessions": st.count("corrupt"),
+                "device_bytes": self._device_bytes,
+                "device_bytes_high_water": self._device_hw,
+                "resident_high_water": self._resident_hw,
+                "host_bytes": self._host_bytes,
+                "disk_bytes": self._disk_bytes,
+                "max_sessions": self.max_sessions,
+                "max_bytes": self.max_bytes,
+            }
+
+
+# --------------------------------------------------------------------------- #
+# fleet checkpoint / restore (ServeEngine.checkpoint / .restore)
+# --------------------------------------------------------------------------- #
+
+
+def _encode_precision(p):
+    if isinstance(p, lax.Precision):
+        return ["precision", p.name]
+    return p
+
+
+def _decode_precision(p):
+    if isinstance(p, list) and len(p) == 2 and p[0] == "precision":
+        return lax.Precision[p[1]]
+    return p
+
+
+def _plan_fields(plan) -> dict:
+    k = plan.key
+    if k.mesh_key is not None:
+        raise ValueError(
+            "checkpointing covers unsharded plans only (a mesh-sharded "
+            "session's state lives across devices)")
+    return {"shape": list(k.shape), "dtype": k.dtype,
+            "factor_dtype": k.factor_dtype, "v": k.v,
+            "refine": k.refine, "spd": k.spd,
+            "substitution": k.substitution,
+            "precision": _encode_precision(k.precision),
+            "backend": k.backend, "panel_algo": k.panel_algo}
+
+
+def _plan_from_fields(d: dict):
+    """Reconstruct the EXACT PlanKey (trace-time knobs included, not
+    re-derived from process globals) and get-or-build its plan — the
+    restore path's half of the bitwise contract: same key, same
+    compiled program family, same bits."""
+    from conflux_tpu.serve import FactorPlan, PlanKey
+
+    key = PlanKey(
+        shape=tuple(int(s) for s in d["shape"]), dtype=d["dtype"],
+        factor_dtype=d["factor_dtype"], v=int(d["v"]),
+        refine=int(d["refine"]), spd=bool(d["spd"]),
+        substitution=d["substitution"],
+        precision=_decode_precision(d["precision"]),
+        backend=d["backend"], panel_algo=d["panel_algo"],
+        mesh_key=None)
+    return FactorPlan.from_key(key)
+
+
+def _policy_fields(policy) -> dict:
+    return {"max_rank": policy.max_rank,
+            "cond_limit": policy.cond_limit,
+            "refine": policy.refine}
+
+
+def save_fleet(path: str, sessions, names=None) -> dict:
+    """Serialize a fleet snapshot to `path`: one disk record per
+    session (the spill serialization, CRCs and all) + fleet.json naming
+    each session's record dir, plan key and drift policy. Works across
+    tiers WITHOUT moving anything: resident sessions d2h their state,
+    host records serialize directly, disk records re-read (the engine's
+    `checkpoint()` provides the drain barrier that makes the snapshot
+    consistent). Returns {name: record dir}."""
+    os.makedirs(path, exist_ok=True)
+    entries = []
+    for i, s in enumerate(sessions):
+        name = names[i] if names is not None else f"s{i:04d}"
+        with s._lock:
+            rec = s._spill
+            if rec is None:
+                leaves, meta = _extract_state(s)
+                leaves = jax.device_get(leaves)
+            elif rec.tier == "transit":
+                leaves, meta = jax.device_get(rec.leaves), rec.meta
+            elif rec.tier == "host":
+                leaves, meta = rec.leaves, rec.meta
+            elif rec.tier == "disk":
+                leaves, meta = _read_record(rec.path)
+            else:
+                raise rec.error  # corrupt: this session has no state
+            meta = dict(meta)
+            meta["policy"] = _policy_fields(s.policy)
+            nbytes = _write_record(os.path.join(path, name), leaves,
+                                   meta)
+        entries.append({"name": name, "dir": name,
+                        "plan": _plan_fields(s.plan), "nbytes": nbytes})
+    with open(os.path.join(path, "fleet.json"), "w") as f:
+        json.dump({"format": 1, "sessions": entries}, f, indent=1)
+    bump("checkpoints")
+    return {e["name"]: e["dir"] for e in entries}
+
+
+def load_fleet(path: str, *, residency: ResidentSet | None = None):
+    """Rebuild a fleet from a :func:`save_fleet` snapshot. Plans are
+    reconstructed from their exact keys; each session comes back with
+    its counters, drift policy, Woodbury state and probe row, and
+    solves BITWISE identically to its pre-checkpoint self (plain and
+    checked paths — asserted in tests/test_tier.py and the CI
+    round-trip job).
+
+    With `residency=None` every session is restored device-resident
+    (eager h2d — small fleets, tests). With a ResidentSet the sessions
+    register in the HOST tier instead and fault in lazily on first
+    touch — the scalable warm restart: restore cost is file reads, and
+    traffic pulls in exactly the working set (capacity-bounded, revival
+    storms coalescing through the usual lanes). Returns the sessions in
+    checkpoint order. A corrupt record raises :class:`RestoreCorrupt`
+    naming the session; pass over it by deleting its entry from
+    fleet.json if partial restore is wanted."""
+    from conflux_tpu.serve import SolveSession
+    from conflux_tpu.update import DriftPolicy
+
+    with open(os.path.join(path, "fleet.json")) as f:
+        fleet = json.load(f)
+    sessions = []
+    for e in fleet["sessions"]:
+        plan = _plan_from_fields(e["plan"])
+        leaves, meta = _read_record(os.path.join(path, e["dir"]))
+        pol = (DriftPolicy(**meta["policy"])
+               if meta.get("policy") is not None else None)
+        s = SolveSession(plan, None, None, None, pol)
+        rec = _SpillRecord("host", leaves, meta,
+                           nbytes=_host_nbytes(leaves))
+        with s._lock:
+            c = meta["counters"]
+            s.factorizations = c["factorizations"]
+            s.solves = c["solves"]
+            s.updates = c["updates"]
+            s.refactors = c["refactors"]
+            s.last_cond = meta["last_cond"]
+            s._owns_base = meta["owns_base"]
+            s._factors = None
+            s._spill = rec
+        sessions.append(s)
+    if residency is not None:
+        residency.adopt(*sessions)
+    else:
+        for s in sessions:
+            with s._lock:
+                rec = s._spill
+                dev = {k: jnp.asarray(v) for k, v in rec.leaves.items()}
+                _implant(s, dev, rec.meta)
+                s._spill = None
+            bump("revives_h2d")
+    bump("restores")
+    return sessions

@@ -1,0 +1,147 @@
+"""Checkpoint -> kill -> restore round-trip (ISSUE 7 / DESIGN §23).
+
+Two phases in two separate PROCESSES — a real process death, not a
+simulated one — driven by CI (and runnable locally):
+
+    python scripts/ckpt_roundtrip.py --save    DIR
+    python scripts/ckpt_roundtrip.py --restore DIR
+
+`--save` builds a mixed fleet (plain, drifted, refined-plan sessions)
+behind a ServeEngine + ResidentSet with some members already spilled,
+records every session's plain AND checked solve, and checkpoints at
+the engine's drain barrier. `--restore`, in a fresh process with cold
+plan/program caches, rebuilds the fleet through `engine.restore` and
+asserts every session solves BITWISE identically to its
+pre-checkpoint self (answers, health verdicts, counters, drift rank).
+Exit status is the gate.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+import numpy as np
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+import jax.numpy as jnp
+
+from conflux_tpu import serve
+from conflux_tpu.engine import ServeEngine
+from conflux_tpu.tier import ResidentSet
+
+N, V = 48, 16
+FLEET = 6  # 2 plain + 2 drifted + 2 on a refine=1 plan
+
+
+def _fleet(rng):
+    plans = [serve.FactorPlan.create((N, N), jnp.float32, v=V),
+             serve.FactorPlan.create((N, N), jnp.float32, v=V,
+                                     refine=1)]
+    sessions = []
+    for i in range(FLEET):
+        A = (rng.standard_normal((N, N)) / np.sqrt(N)
+             + 2.0 * np.eye(N)).astype(np.float32)
+        s = plans[i % 2].factor(jnp.asarray(A))
+        if i in (2, 3):  # drifted members: Woodbury state must survive
+            k = 1 + i % 2
+            U = (0.01 * rng.standard_normal((N, k))).astype(np.float32)
+            Vm = (0.01 * rng.standard_normal((N, k))).astype(np.float32)
+            s.update(U, Vm)
+        sessions.append(s)
+    return sessions
+
+
+def save(path: str) -> int:
+    rng = np.random.default_rng(0)
+    sessions = _fleet(rng)
+    b = rng.standard_normal((N, 2)).astype(np.float32)
+    rs = ResidentSet(max_sessions=FLEET)
+    eng = ServeEngine(max_batch_delay=0.0, residency=rs)
+    try:
+        rs.adopt(*sessions)
+        rs.spill(sessions[1], sessions[3])  # snapshot spans tiers
+        want = {
+            "b": b.tolist(),
+            "plain": [np.asarray(s.solve(b)).tolist()
+                      for s in sessions],
+            "checked": [[np.asarray(a).tolist()
+                         for a in s.solve_checked(b)]
+                        for s in sessions],
+            "counters": [[s.factorizations, s.solves, s.updates,
+                          s.refactors] for s in sessions],
+            "ranks": [s.update_rank for s in sessions],
+        }
+        eng.checkpoint(path, sessions)
+    finally:
+        eng.close()
+    with open(os.path.join(path, "expected.json"), "w") as f:
+        json.dump(want, f)
+    print(f"ckpt_roundtrip: saved {FLEET} sessions to {path}")
+    return 0
+
+
+def restore(path: str) -> int:
+    with open(os.path.join(path, "expected.json")) as f:
+        want = json.load(f)
+    b = np.asarray(want["b"], dtype=np.float32)
+    rs = ResidentSet(max_sessions=FLEET)
+    eng = ServeEngine(max_batch_delay=0.0, residency=rs)
+    bad = 0
+    try:
+        sessions = eng.restore(path)
+        assert len(sessions) == FLEET, len(sessions)
+        assert all(s.tier == "host" for s in sessions), \
+            "residency-attached restore must come back host-tier (lazy)"
+        for i, s in enumerate(sessions):
+            got_c = [s.factorizations, s.solves, s.updates, s.refactors]
+            if got_c != want["counters"][i]:
+                print(f"  session {i}: counters {got_c} != "
+                      f"{want['counters'][i]}")
+                bad += 1
+            if s.update_rank != want["ranks"][i]:
+                print(f"  session {i}: drift rank {s.update_rank} != "
+                      f"{want['ranks'][i]}")
+                bad += 1
+            x = np.asarray(s.solve(b))
+            if not np.array_equal(
+                    x, np.asarray(want["plain"][i], dtype=x.dtype)):
+                print(f"  session {i}: plain solve NOT bitwise")
+                bad += 1
+            xc, v = s.solve_checked(b)
+            wc, wv = want["checked"][i]
+            if not np.array_equal(
+                    np.asarray(xc),
+                    np.asarray(wc, dtype=np.asarray(xc).dtype)):
+                print(f"  session {i}: checked solve NOT bitwise")
+                bad += 1
+            if not np.array_equal(
+                    np.asarray(v),
+                    np.asarray(wv, dtype=np.asarray(v).dtype)):
+                print(f"  session {i}: health verdict NOT bitwise")
+                bad += 1
+    finally:
+        eng.close()
+    if bad:
+        print(f"ckpt_roundtrip: FAIL ({bad} divergences)")
+        return 1
+    print(f"ckpt_roundtrip: {FLEET}/{FLEET} sessions restored bitwise "
+          "(plain + checked), counters and drift state intact")
+    return 0
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser()
+    g = ap.add_mutually_exclusive_group(required=True)
+    g.add_argument("--save", action="store_true")
+    g.add_argument("--restore", action="store_true")
+    ap.add_argument("dir")
+    args = ap.parse_args(argv)
+    return save(args.dir) if args.save else restore(args.dir)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -379,6 +379,163 @@ def run_serve_trial(seed: int) -> tuple[bool, str]:
                   f"evictions={h['evictions']}")
 
 
+def run_tier_trial(seed: int) -> tuple[bool, str]:
+    """One chaos trial of the tiered-residency layer (ISSUE 7).
+
+    A Zipf-popular request stream drives a fleet far larger than the
+    device-resident capacity through a ResidentSet-managed engine while
+    all four tier fault sites (spill/revive/disk_write/disk_read)
+    inject crashes, delays and record corruption. Invariants: every
+    future resolves with an answer or a STRUCTURED error; clean answers
+    match each session's own f64 oracle (zero cross-session
+    corruption — a spill/revive bug that leaked state between sessions
+    would miss the oracle); the managed session count is conserved
+    across tiers; the resident high-water respects the capacity unless
+    a spill crash was injected (spill failures keep sessions resident
+    by design); the engine closes un-wedged with zero pending."""
+    import tempfile
+
+    import jax.numpy as jnp
+
+    from conflux_tpu import serve, tier
+    from conflux_tpu.engine import EngineSaturated, ServeEngine
+    from conflux_tpu.resilience import (
+        DeadlineExceeded,
+        FaultPlan,
+        FaultSpec,
+        HealthPolicy,
+        InjectedFault,
+        RestoreCorrupt,
+        RhsNonFinite,
+        SessionQuarantined,
+        SessionSpilled,
+        SolveUnhealthy,
+    )
+
+    rng = np.random.default_rng(seed)
+    serve.clear_plans()
+    N = int(rng.choice([24, 32]))
+    F = int(rng.integers(6, 10))
+    C = int(rng.integers(1, 3))
+    plan = serve.FactorPlan.create((N, N), jnp.float32, v=8)
+    As, fleet = [], []
+    for _ in range(F):
+        A = (rng.standard_normal((N, N)) / np.sqrt(N)
+             + 2.0 * np.eye(N)).astype(np.float32)
+        sess = plan.factor(jnp.asarray(A))
+        A64 = A.astype(np.float64)
+        if rng.integers(2):  # pre-traffic SMW drift on this session
+            k = int(rng.integers(1, 3))
+            U = (0.01 * rng.standard_normal((N, k))).astype(np.float32)
+            Vm = (0.01 * rng.standard_normal((N, k))).astype(np.float32)
+            sess.update(U, Vm)
+            A64 = A64 + U.astype(np.float64) @ Vm.astype(np.float64).T
+        As.append(A64)
+        fleet.append(sess)
+    menu = [
+        FaultSpec("spill", "crash", prob=0.3, count=2),
+        FaultSpec("spill", "delay", prob=0.3, delay_s=0.001, count=3),
+        FaultSpec("revive", "crash", prob=0.3, count=2),
+        FaultSpec("revive", "delay", prob=0.3, delay_s=0.001, count=3),
+        FaultSpec("disk_write", "nan", prob=0.3, count=1),
+        FaultSpec("disk_write", "crash", prob=0.3, count=1),
+        FaultSpec("disk_read", "crash", prob=0.4, count=1),
+    ]
+    picks = [m for m in menu if rng.integers(2)]
+    faults = FaultPlan(picks, seed=seed)
+    label = (f"seed={seed} tier N={N} F={F} C={C} "
+             f"faults={[(f.site, f.kind) for f in picks]}")
+    pmf = 1.0 / np.arange(1, F + 1) ** 1.1
+    pmf /= pmf.sum()
+    ok_exc = (RhsNonFinite, DeadlineExceeded, SolveUnhealthy,
+              SessionQuarantined, InjectedFault, SessionSpilled,
+              RestoreCorrupt)
+    with tempfile.TemporaryDirectory() as tmp:
+        rs = tier.ResidentSet(
+            max_sessions=C, host_max_sessions=max(2, F // 2),
+            disk_dir=tmp, evict_batch=max(1, C),
+            max_concurrent_revives=2,
+            revive_refactor_rank=(1 if rng.integers(2) else None),
+            fault_plan=faults)
+        eng = ServeEngine(
+            max_batch_delay=float(rng.choice([0.0, 0.002])),
+            max_pending=64, max_coalesce_width=8,
+            health=HealthPolicy(quarantine_after=3,
+                                quarantine_cooldown=0.05),
+            residency=rs, revive_wait=5.0, watchdog_interval=0.05)
+        rs.adopt(*fleet)
+        reqs = []
+        try:
+            for i in range(28):
+                si = int(rng.choice(F, p=pmf))
+                w = int(rng.choice([1, 1, 2]))
+                b = rng.standard_normal((N, w)).astype(np.float32)
+                deadline = 0.0 if rng.integers(8) == 0 else None
+                if rng.integers(4) == 0:
+                    # direct client-thread touch: the transparent
+                    # session-level revival path (engine-free)
+                    try:
+                        x = np.asarray(fleet[si].solve(b))
+                        reqs.append((si, b, None, x))
+                    except ok_exc:
+                        continue
+                    continue
+                try:
+                    fut = eng.submit(fleet[si], b, deadline=deadline)
+                except (RhsNonFinite, SessionQuarantined,
+                        EngineSaturated, SessionSpilled,
+                        RestoreCorrupt):
+                    continue
+                reqs.append((si, b, fut, None))
+            wedged = eng.close(timeout=120)
+            if wedged:
+                return False, f"{label}: close() wedged {wedged}"
+        finally:
+            eng.close(timeout=10)
+        answered = 0
+        for si, b, fut, x in reqs:
+            if fut is not None:
+                if not fut.done():
+                    return False, (f"{label}: close() left a future "
+                                   "unresolved")
+                try:
+                    x = np.asarray(fut.result(0))
+                except ok_exc:
+                    continue
+                except Exception as e:  # noqa: BLE001 — a leak is a bug
+                    return False, (f"{label}: UNSTRUCTURED "
+                                   f"{type(e).__name__}: {e}")
+            want = np.linalg.solve(As[si], b.astype(np.float64))
+            err = (np.linalg.norm(x - want)
+                   / max(np.linalg.norm(want), 1e-30))
+            if not (err < 1e-3):
+                return False, (f"{label}: answer off its own oracle "
+                               f"({err:.2e}) — cross-session "
+                               "corruption or a torn revive")
+            answered += 1
+        stats = eng.stats()
+        if stats["pending"] != 0:
+            return False, f"{label}: {stats['pending']} slots leaked"
+        st = rs.stats()
+        conserved = (st["resident_sessions"] + st["host_sessions"]
+                     + st["disk_sessions"] + st["corrupt_sessions"])
+        if conserved != F or st["managed_sessions"] != F:
+            return False, (f"{label}: session count not conserved "
+                           f"({conserved}/{F}: {st})")
+        if (st["resident_high_water"] > C
+                and ("spill", "crash") not in faults.injected):
+            return False, (f"{label}: resident high-water "
+                           f"{st['resident_high_water']} > cap {C} "
+                           "with no spill fault injected")
+        h = tier.tier_stats()
+        return True, (f"{label}: ok {answered}/{len(reqs)} answered, "
+                      f"injected={sum(faults.injected.values())}, "
+                      f"spills={h['spills_host']}+{h['spills_disk']}d, "
+                      f"revives={h['revives_h2d']}h/"
+                      f"{h['revives_refactor']}rf, "
+                      f"corrupt={st['corrupt_sessions']}")
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser()
     ap.add_argument("--trials", type=int, default=200)
@@ -392,6 +549,13 @@ def main(argv=None) -> int:
     ap.add_argument("--serve", action="store_true",
                     help="chaos-soak the serving stack (engine + "
                     "resilience layer) instead of the factor cores")
+    ap.add_argument("--tier", action="store_true",
+                    help="chaos-soak the tiered-residency layer: Zipf "
+                    "traffic over a fleet >> device capacity with the "
+                    "spill/revive/disk_write/disk_read fault sites "
+                    "enabled; asserts structured failures only, "
+                    "per-session oracle answers (zero cross-session "
+                    "corruption) and a conserved session count")
     ap.add_argument("--lockcheck", action="store_true",
                     help="run trials under the conflint runtime "
                     "lock-order harness (conflux_tpu.analysis."
@@ -400,7 +564,8 @@ def main(argv=None) -> int:
                     "cycle or lock-held-across-dispatch fails the soak")
     args = ap.parse_args(argv)
 
-    trial = run_serve_trial if args.serve else run_trial
+    trial = (run_tier_trial if args.tier
+             else run_serve_trial if args.serve else run_trial)
 
     import contextlib
 
@@ -441,6 +606,7 @@ def main(argv=None) -> int:
         print(f"lockcheck: {rep['locks']} locks, "
               f"{rep['acquisitions']} acquisitions, "
               f"{rep['order_edges']} order edges, "
+              f"{rep['stash_edges']} victim-stash edges, "
               f"{len(rep['violations'])} violation(s)", flush=True)
         for v in rep["violations"]:
             print("LOCKCHECK " + v, flush=True)
