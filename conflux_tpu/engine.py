@@ -330,6 +330,11 @@ class ServeEngine:
         # checkpoint drain barrier: admission holds while True so the
         # snapshot observes a quiesced fleet (pending == 0)
         self._draining = False          # guarded-by: _lock
+        # serializes whole checkpoint() calls: two overlapping drains
+        # would share the single _draining flag, and whichever snapshot
+        # finished first would reopen admission under the other —
+        # silently voiding its pending==0 consistent cut
+        self._ckpt_lock = threading.Lock()
         self._pending = 0               # guarded-by: _lock
         self._queue_peak = 0            # guarded-by: _lock
         self._requests = 0              # guarded-by: _lock
@@ -435,6 +440,22 @@ class ServeEngine:
             if self._closed:
                 raise EngineClosed("submit() on a closed ServeEngine")
             while self._draining and not self._closed:
+                if isinstance(req, _FactorRequest):
+                    # A factor submission must SHED at the drain
+                    # barrier, never wait: a client-thread stale-drift
+                    # revival (tier._revive_refactor) legitimately
+                    # holds its session RLock while submitting here,
+                    # and checkpoint()'s save_fleet needs that same
+                    # lock — and _draining only clears after save_fleet
+                    # returns, so waiting would close the cycle and
+                    # wedge the engine forever. EngineSaturated routes
+                    # the revival onto its direct plan._factor_once
+                    # fallback (same program family, same bits).
+                    raise EngineSaturated(
+                        "factor lane paused at the checkpoint drain "
+                        "barrier (snapshot serializing) — retry "
+                        "shortly, or fall back to plan.factor",
+                        retry_after=0.05)
                 # checkpoint drain barrier: hold admission (both
                 # policies) until the snapshot completes — brief by
                 # construction, the snapshot is host-side serialization
@@ -591,24 +612,31 @@ class ServeEngine:
         save_fleet`). `sessions` defaults to the attached residency's
         fleet. Restored sessions (`restore`) solve BITWISE identically
         to their pre-checkpoint selves. Returns {name: record dir}."""
-        if sessions is None:
-            if self.residency is None:
-                raise ValueError(
-                    "checkpoint() needs sessions= when the engine has "
-                    "no residency-managed fleet")
-            sessions = self.residency.sessions()
+        if sessions is None and self.residency is None:
+            raise ValueError(
+                "checkpoint() needs sessions= when the engine has "
+                "no residency-managed fleet")
         from conflux_tpu import tier
 
-        with self._lock:
-            self._draining = True
-            while self._pending and not self._closed:
-                self._not_full.wait()
-        try:
-            return tier.save_fleet(path, sessions, names)
-        finally:
+        # one checkpoint at a time: concurrent calls each queue behind
+        # the mutex and take their own complete drain barrier, so
+        # _draining never clears while another snapshot is serializing
+        with self._ckpt_lock:
             with self._lock:
-                self._draining = False
-                self._not_full.notify_all()
+                self._draining = True
+                while self._pending and not self._closed:
+                    self._not_full.wait()
+            try:
+                if sessions is None:
+                    # resolve the fleet AT the barrier, so sessions
+                    # adopted while we queued behind an earlier
+                    # checkpoint still make this snapshot
+                    sessions = self.residency.sessions()
+                return tier.save_fleet(path, sessions, names)
+            finally:
+                with self._lock:
+                    self._draining = False
+                    self._not_full.notify_all()
 
     def restore(self, path: str) -> list:
         """Rebuild a `checkpoint()` fleet: plans from their exact keys,

@@ -524,40 +524,46 @@ class ResidentSet:
             if s._residency is not None and s._residency is not self:
                 raise ValueError("session is already managed by a "
                                  "different ResidentSet")
+            sid = id(s)
+            token = None
             with s._lock:
                 s._residency = self
                 s._tier_stamp = self._tick()
                 rec = s._spill
                 nb = s.nbytes
-                sid = id(s)
-                token = None
-                if rec is None:
-                    # claim + make room BEFORE the incoming session
-                    # counts against the gauges, so the device-tier
-                    # high-water never exceeds the caps even while a
-                    # whole fleet adopts concurrently
-                    token = self._claim(nb, 1)
-                    try:
-                        self._make_room(0, 0)
-                    except BaseException:
-                        self._unclaim(token)
-                        raise
                 with self._lock:
                     fresh = sid not in self._sessions
                     self._sessions[sid] = s
                     if rec is None:
-                        # atomic claim -> gauge transfer (see
-                        # _fault_in_admitted)
-                        self._claims.pop(token, None)
-                        self._state[sid] = "resident"
-                        self._bytes[sid] = nb
-                        if fresh:
-                            self._device_bytes += nb
+                        state = self._state.get(sid)
+                        if fresh or state is None:
+                            # register as 'reviving' + a capacity claim
+                            # (exactly a landing fault-in's shape):
+                            # concurrent victim math sees the incoming
+                            # footprint but can never PICK the adoptee.
+                            # The eviction wave itself runs only after
+                            # this session lock is released — a
+                            # blocking _spill_batch under the adoptee's
+                            # lock let two concurrent adopts pick each
+                            # other as victims and deadlock A-holds-sX-
+                            # waits-sY / B-holds-sY-waits-sX, and let a
+                            # re-adoption spill its own adoptee through
+                            # the reentrant RLock (review-caught)
+                            token = next(self._claim_seq)
+                            self._claims[token] = (nb, 1)
+                            self._state[sid] = "reviving"
+                        elif state == "resident":
+                            # re-adoption of a managed resident
+                            # session: already counted — refresh the
+                            # byte gauge; _enforce below re-applies
+                            # the caps without holding this lock
+                            self._device_bytes += \
+                                nb - self._bytes.get(sid, 0)
+                            self._bytes[sid] = nb
                             self._device_hw = max(self._device_hw,
                                                   self._device_bytes)
-                            self._resident_hw = max(
-                                self._resident_hw,
-                                self._resident_now())
+                        # 'spilling'/'reviving' in flight: the owning
+                        # enforcer/fault-in lands the gauges
                     else:
                         self._state[sid] = rec.tier \
                             if rec.tier in ("host", "disk", "corrupt") \
@@ -567,8 +573,28 @@ class ResidentSet:
                             self._host_bytes += rec.nbytes
                         elif fresh and rec.tier == "disk":
                             self._disk_bytes += rec.nbytes
-                if token is not None:
-                    self._unclaim(token)
+            if token is not None:
+                # session lock released: make room for the claim, then
+                # land it — no session lock held across the spill wave
+                try:
+                    self._make_room(0, 0)
+                finally:
+                    with self._lock:
+                        # atomic claim -> gauge transfer (see
+                        # _fault_in_admitted). Even a failed eviction
+                        # wave lands the gauges: the session IS
+                        # device-resident, and _enforce below retries
+                        # the caps
+                        self._claims.pop(token, None)
+                        if self._state.get(sid) == "reviving":
+                            self._state[sid] = "resident"
+                            self._bytes[sid] = nb
+                            self._device_bytes += nb
+                            self._device_hw = max(self._device_hw,
+                                                  self._device_bytes)
+                            self._resident_hw = max(
+                                self._resident_hw,
+                                self._resident_now())
         self._enforce()
         return self
 
@@ -861,16 +887,20 @@ class ResidentSet:
         # always h2d (bitwise)
         return session.policy.resolved_max_rank(session.plan.N) + 1
 
-    def fault_in(self, session, timeout: float | None = None) -> None:
+    def fault_in(self, session, timeout: float | None = None) -> bool:
         """Revive a spilled session in place, under its RLock (the
         transparent-revival entry — `SolveSession._ensure_resident` and
-        the engine's pre-dispatch hook land here). Atomic: the session
-        is either fully revived or fully spilled with its record intact
-        — never half-resident. `timeout` bounds BOTH waits a fault-in
-        can block on — the session-lock acquire and the revive-lane
-        admission slot (the engine passes the requests' soonest
-        deadline); expiry raises :class:`SessionSpilled` and releases
-        nothing but the caller's time.
+        the engine's pre-dispatch hook land here). Returns True when a
+        spill record was actually revived, False when the session was
+        already resident (a no-op — e.g. a racing touch got there
+        first), so batch callers (`revive_many`) count real work only.
+        Atomic: the session is either fully revived or fully spilled
+        with its record intact — never half-resident. `timeout` bounds
+        BOTH waits a fault-in can block on — the session-lock acquire
+        and the revive-lane admission slot (the engine passes the
+        requests' soonest deadline); expiry raises
+        :class:`SessionSpilled` and releases nothing but the caller's
+        time.
 
         The lock acquire MUST honor the timeout for deadlock freedom,
         not just latency: a client-thread refactor-revival legitimately
@@ -903,9 +933,16 @@ class ResidentSet:
         try:
             rec = session._spill
             if rec is None:
-                return
+                return False
             if rec.tier == "corrupt":
-                raise rec.error
+                # re-raise a FRESH copy of the pinned error: the
+                # instance is shared across every thread that touches
+                # this session, and a raise mutates the exception's
+                # traceback — concurrent raises of one object would
+                # scribble on each other
+                err = rec.error
+                raise RestoreCorrupt(str(err),
+                                     dict(err.evidence)) from err
             sid = id(session)
             if self._revive_sem is not None:
                 ok = (self._revive_sem.acquire() if timeout is None
@@ -923,10 +960,27 @@ class ResidentSet:
                 self._fault_in_admitted(session, rec, sid)
             except RestoreCorrupt as e:
                 bump("restore_corrupt")
+                tier0, nb0, path0 = rec.tier, rec.nbytes, rec.path
                 rec.tier = "corrupt"
                 rec.error = e
+                rec.leaves = None
+                rec.path = None
+                rec.nbytes = 0
+                if path0 is not None:
+                    # a CRC failure is permanent — the record can
+                    # never restore, so reclaim its disk space (the
+                    # pinned error keeps the path as evidence)
+                    shutil.rmtree(path0, ignore_errors=True)
                 with self._lock:
                     self._state[sid] = "corrupt"
+                    # retire the dead record from the tier gauges:
+                    # without this, _disk_bytes counted the removed
+                    # record forever
+                    if tier0 == "disk":
+                        self._disk_bytes -= nb0
+                    elif tier0 == "host":
+                        self._host_bytes -= nb0
+                    self._bytes[sid] = 0
                 raise
             except BaseException:
                 # injected/real revive failure: fully spilled, record
@@ -943,6 +997,7 @@ class ResidentSet:
         finally:
             session._lock.release()
         _note_latency(time.perf_counter() - t0)
+        return True
 
     # requires-lock: session lock (held by fault_in)
     def _fault_in_admitted(self, session, rec, sid) -> None:
@@ -1052,15 +1107,54 @@ class ResidentSet:
         session.factorizations += 1
         session.refactors += 1
 
+    def _group_chunks(self, recs: list) -> list:
+        """Split a coalesced-revival group into chunks the device caps
+        can hold: a whole chunk lands in ONE stacked h2d, so an
+        unbounded group would overshoot `max_sessions`/`max_bytes` no
+        matter how many victims spilled first (past one cap's worth
+        there is nothing left to evict — the e2e drive caught a
+        6-session group landing at cap 3). Reviving more than capacity
+        is still allowed: later chunks evict earlier ones (LRU), the
+        tail ends up resident. Oversized singletons land anyway — the
+        `fault_in` semantics: eviction did its best, cap softly
+        exceeded."""
+        out: list = []
+        cur: list = []
+        cb = 0
+        for s, rec in recs:
+            over_n = (self.max_sessions is not None
+                      and len(cur) >= self.max_sessions)
+            over_b = (self.max_bytes is not None and cur
+                      and cb + rec.nbytes > self.max_bytes)
+            if cur and (over_n or over_b):
+                out.append(cur)
+                cur, cb = [], 0
+            cur.append((s, rec))
+            cb += rec.nbytes
+        if cur:
+            out.append(cur)
+        return out
+
     def revive_many(self, sessions, timeout: float | None = None) -> int:
         """Coalesced revival of a set of spilled sessions — the
         checkpoint warm-up / prefetch path. Same-plan, undrifted
         host-tier records restore through `batched.stack_host_trees`:
         their leaves numpy-stack (memcpy) and cross in ONE h2d per leaf
         position, then device-side slices implant per session (bitwise
-        what per-session `fault_in` restores). Drifted, disk-tier or
+        what per-session `fault_in` restores). Groups are chunked to
+        the device caps first — a whole chunk lands at once, so an
+        uncapped group would overshoot `max_sessions`/`max_bytes` with
+        nothing left to evict; reviving more than capacity is allowed,
+        later chunks LRU-evict earlier ones and the tail stays
+        resident. Drifted, disk-tier or
         mismatched sessions fall back to `fault_in` individually.
-        Returns how many sessions were revived."""
+        Returns how many sessions were ACTUALLY revived: no-ops (a
+        record reclaimed by a racing direct revival) don't count, and
+        revive-lane backpressure on one session/group skips it —
+        record intact, `revive_rejects` bumped — instead of abandoning
+        the rest, so a partially-saturated lane still makes progress
+        (the corrupt-record path keeps raising: that session can never
+        revive and the caller should hear it)."""
         from conflux_tpu.batched import stack_host_trees, unstack_tree
 
         groups: dict[tuple, list] = {}
@@ -1086,10 +1180,12 @@ class ResidentSet:
                 ok = (self._revive_sem.acquire() if timeout is None
                       else self._revive_sem.acquire(timeout=timeout))
                 if not ok:
+                    # lane saturated for THIS group: its sessions stay
+                    # spilled (records intact) and the remaining
+                    # groups/rest still get their attempt — partial
+                    # progress, reported through the return count
                     bump("revive_rejects")
-                    raise SessionSpilled(
-                        "revive lane saturated during coalesced "
-                        "revival — the remaining sessions stay spilled")
+                    continue
             try:
                 recs = []
                 for s in group:
@@ -1099,56 +1195,65 @@ class ResidentSet:
                             recs.append((s, rec))
                 if not recs:
                     continue
-                # one claim covers the whole group until every member
-                # lands (a moment of claim+gauge double-count as slots
-                # settle is harmless — the safe direction)
-                token = self._claim(
-                    sum(rec.nbytes for _s, rec in recs), len(recs))
-                try:
-                    with profiler.region("serve.revive"):
-                        self._make_room(0, 0)
-                        stacked = stack_host_trees(
-                            [rec.leaves for _s, rec in recs])
-                        slots = unstack_tree(stacked, len(recs))
-                    for (s, rec), dev in zip(recs, slots):
-                        with s._lock:
-                            if s._spill is not rec:
-                                continue  # raced with a direct fault_in
-                            _implant(s, dev, rec.meta)
-                            s._spill = None
-                            s._tier_stamp = self._tick()
-                            nb = s.nbytes
-                        sid = id(s)
-                        with self._lock:
-                            # retire this slot's share of the group
-                            # claim in the same lock acquisition that
-                            # counts it landed
-                            cb, cn = self._claims.get(token, (0, 0))
-                            if cn > 1:
-                                self._claims[token] = (
-                                    max(0, cb - rec.nbytes), cn - 1)
-                            else:
-                                self._claims.pop(token, None)
-                            self._state[sid] = "resident"
-                            self._host_bytes -= rec.nbytes
-                            self._bytes[sid] = nb
-                            self._device_bytes += nb
-                            self._device_hw = max(self._device_hw,
-                                                  self._device_bytes)
-                            self._resident_hw = max(
-                                self._resident_hw,
-                                self._resident_now())
-                        bump("revives_h2d")
-                        _note_latency(time.perf_counter() - t0)
-                        n += 1
-                finally:
-                    self._unclaim(token)
+                # chunked to the device caps (`_group_chunks`): one
+                # claim covers each chunk until every member lands (a
+                # moment of claim+gauge double-count as slots settle
+                # is harmless — the safe direction)
+                for chunk in self._group_chunks(recs):
+                    token = self._claim(
+                        sum(rec.nbytes for _s, rec in chunk),
+                        len(chunk))
+                    try:
+                        with profiler.region("serve.revive"):
+                            self._make_room(0, 0)
+                            stacked = stack_host_trees(
+                                [rec.leaves for _s, rec in chunk])
+                            slots = unstack_tree(stacked, len(chunk))
+                        for (s, rec), dev in zip(chunk, slots):
+                            with s._lock:
+                                if s._spill is not rec:
+                                    continue  # raced a direct fault_in
+                                _implant(s, dev, rec.meta)
+                                s._spill = None
+                                s._tier_stamp = self._tick()
+                                nb = s.nbytes
+                            sid = id(s)
+                            with self._lock:
+                                # retire this slot's share of the
+                                # chunk claim in the same lock
+                                # acquisition that counts it landed
+                                cb, cn = self._claims.get(token, (0, 0))
+                                if cn > 1:
+                                    self._claims[token] = (
+                                        max(0, cb - rec.nbytes), cn - 1)
+                                else:
+                                    self._claims.pop(token, None)
+                                self._state[sid] = "resident"
+                                self._host_bytes -= rec.nbytes
+                                self._bytes[sid] = nb
+                                self._device_bytes += nb
+                                self._device_hw = max(self._device_hw,
+                                                      self._device_bytes)
+                                self._resident_hw = max(
+                                    self._resident_hw,
+                                    self._resident_now())
+                            bump("revives_h2d")
+                            _note_latency(time.perf_counter() - t0)
+                            n += 1
+                    finally:
+                        self._unclaim(token)
             finally:
                 if self._revive_sem is not None:
                     self._revive_sem.release()
         for s in rest:
-            self.fault_in(s, timeout=timeout)
-            n += 1
+            try:
+                if self.fault_in(s, timeout=timeout):
+                    n += 1
+            except SessionSpilled:
+                # per-session backpressure (lane slot or session lock
+                # busy past the budget): this session stays spilled,
+                # the rest still get their revival attempt
+                continue
         return n
 
     # -------------------------------------------------------------- #
@@ -1258,7 +1363,12 @@ def save_fleet(path: str, sessions, names=None) -> dict:
             elif rec.tier == "disk":
                 leaves, meta = _read_record(rec.path)
             else:
-                raise rec.error  # corrupt: this session has no state
+                # corrupt: this session has no state. Fresh copy — the
+                # pinned instance is shared across threads (see
+                # fault_in's corrupt branch)
+                raise RestoreCorrupt(
+                    str(rec.error),
+                    dict(rec.error.evidence)) from rec.error
             meta = dict(meta)
             meta["policy"] = _policy_fields(s.policy)
             nbytes = _write_record(os.path.join(path, name), leaves,

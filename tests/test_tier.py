@@ -26,6 +26,7 @@
   `engine.stats()['tier']`.
 """
 
+import os
 import threading
 import time
 
@@ -36,7 +37,7 @@ import jax
 import jax.numpy as jnp
 
 from conflux_tpu import profiler, serve, tier
-from conflux_tpu.engine import ServeEngine
+from conflux_tpu.engine import EngineSaturated, ServeEngine
 from conflux_tpu.resilience import (
     DeadlineExceeded,
     FaultPlan,
@@ -619,6 +620,295 @@ def test_direct_fault_in_timeout_structured():
         rs._revive_sem.release()
     rs.fault_in(s)
     assert s.tier == "device"
+
+
+# --------------------------------------------------------------------- #
+# review regressions: barrier x revival, concurrent checkpoints/adopts,
+# corrupt-record accounting, revive_many partial progress
+# --------------------------------------------------------------------- #
+
+
+def test_factor_lane_sheds_at_drain_barrier():
+    """A factor submission during a checkpoint drain SHEDS instead of
+    waiting: a stale-drift revival holds its session RLock while
+    submitting, and save_fleet needs that lock — waiting would wedge
+    the engine forever (review-caught deadlock)."""
+    plan = _plan()
+    rng = np.random.default_rng(32)
+    A = _mk(rng)
+    eng = ServeEngine(max_batch_delay=0.0)
+    try:
+        with eng._lock:
+            eng._draining = True
+        try:
+            t0 = time.perf_counter()
+            with pytest.raises(EngineSaturated):
+                eng.submit_factor(plan, A)
+            assert time.perf_counter() - t0 < 5.0  # shed, not waited
+        finally:
+            with eng._lock:
+                eng._draining = False
+                eng._not_full.notify_all()
+        # the barrier cleared: the factor lane flows again
+        s = eng.factor(plan, A, timeout=60)
+        assert np.asarray(
+            s.solve(np.zeros(N, np.float32))).shape == (N,)
+    finally:
+        eng.close()
+
+
+def test_checkpoint_vs_stale_revival_no_deadlock(tmp_path, monkeypatch):
+    """checkpoint() racing a client-thread stale-drift revival: the
+    client holds the session RLock and submits to the factor lane while
+    the drain barrier is up; the submission sheds, the revival falls
+    back to the direct factor path, and save_fleet then gets the lock —
+    both sides complete."""
+    plan = _plan()
+    fleet = _fleet(plan, 2, seed=33, drift_rank=1)
+    rs = ResidentSet(revive_refactor_rank=1)
+    eng = ServeEngine(max_batch_delay=0.0, residency=rs)
+    rng = np.random.default_rng(33)
+    b = rng.standard_normal((N, 1)).astype(np.float32)
+    in_barrier = threading.Event()
+    client_done = threading.Event()
+    real_save = tier.save_fleet
+
+    def slow_save(path, sessions, names=None):
+        in_barrier.set()
+        client_done.wait(30)  # hold the barrier across the revival
+        return real_save(path, sessions, names)
+
+    monkeypatch.setattr(tier, "save_fleet", slow_save)
+    try:
+        rs.adopt(*[s for s, _ in fleet])
+        rs.spill(*[s for s, _ in fleet])
+        errs, xs = [], []
+
+        def ckpt():
+            try:
+                eng.checkpoint(str(tmp_path / "ck"))
+            except Exception as e:  # noqa: BLE001 — recorded, asserted
+                errs.append(e)
+
+        def touch():
+            try:
+                xs.append(np.asarray(fleet[0][0].solve(b)))
+            except Exception as e:  # noqa: BLE001 — recorded, asserted
+                errs.append(e)
+
+        ct = threading.Thread(target=ckpt, daemon=True)
+        ct.start()
+        assert in_barrier.wait(30)
+        tt = threading.Thread(target=touch, daemon=True)
+        tt.start()
+        tt.join(30)
+        revived = not tt.is_alive()
+        client_done.set()
+        ct.join(60)
+        assert revived, "revival deadlocked against the drain barrier"
+        assert not ct.is_alive(), "checkpoint deadlocked"
+        assert not errs, errs
+        want = np.linalg.solve(fleet[0][1], b.astype(np.float64))
+        assert (np.linalg.norm(xs[0] - want)
+                / np.linalg.norm(want)) < 1e-4
+        assert fleet[0][0].refactors == 1  # the direct fallback ran
+    finally:
+        client_done.set()
+        eng.close()
+
+
+def test_concurrent_checkpoints_serialize(tmp_path, monkeypatch):
+    """Two concurrent checkpoint() calls take their own complete drain
+    barriers (the snapshots never overlap), both land restorable
+    records, and admission reopens afterwards."""
+    plan = _plan()
+    fleet = _fleet(plan, 2, seed=34)
+    rs = ResidentSet()
+    eng = ServeEngine(max_batch_delay=0.0, residency=rs)
+    rng = np.random.default_rng(34)
+    b = rng.standard_normal((N, 1)).astype(np.float32)
+    real_save = tier.save_fleet
+    alock = threading.Lock()
+    active, peak = [0], [0]
+
+    def counted_save(path, sessions, names=None):
+        with alock:
+            active[0] += 1
+            peak[0] = max(peak[0], active[0])
+        try:
+            time.sleep(0.05)
+            return real_save(path, sessions, names)
+        finally:
+            with alock:
+                active[0] -= 1
+
+    monkeypatch.setattr(tier, "save_fleet", counted_save)
+    try:
+        rs.adopt(*[s for s, _ in fleet])
+        want = [np.asarray(s.solve(b)) for s, _ in fleet]
+        errs = []
+
+        def ck(d):
+            try:
+                eng.checkpoint(str(d))
+            except Exception as e:  # noqa: BLE001 — recorded, asserted
+                errs.append(e)
+
+        ts = [threading.Thread(target=ck, args=(tmp_path / f"ck{i}",),
+                               daemon=True) for i in range(2)]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join(60)
+        assert not any(t.is_alive() for t in ts)
+        assert not errs, errs
+        assert peak[0] == 1, "snapshots overlapped under one barrier"
+        with eng._lock:
+            assert not eng._draining  # the barrier fully cleared
+        assert np.array_equal(want[0],
+                              eng.solve(fleet[0][0], b, timeout=60))
+        for i in range(2):
+            restored = tier.load_fleet(str(tmp_path / f"ck{i}"))
+            for j, r in enumerate(restored):
+                assert np.array_equal(want[j], np.asarray(r.solve(b)))
+    finally:
+        eng.close()
+
+
+def test_concurrent_adopt_touch_churn_consistent():
+    """Concurrent re-adopts and touches under count pressure: adopt()
+    used to size its eviction wave while HOLDING the adoptee's session
+    lock, letting two adopts pick each other's adoptee as a victim
+    (lock cycle) or a re-adoption spill its own adoptee mid-adopt.
+    The hammer asserts liveness and resident<->record consistency."""
+    plan = _plan()
+    fleet = _fleet(plan, 3, seed=35)
+    rs = ResidentSet(max_sessions=1, evict_batch=1)
+    rs.adopt(*[s for s, _ in fleet])
+    rng = np.random.default_rng(35)
+    b = rng.standard_normal((N,)).astype(np.float32)
+    stop = time.perf_counter() + 2.0
+    errs = []
+
+    def churn(s):
+        try:
+            while time.perf_counter() < stop:
+                rs.adopt(s)
+                s.solve(b)
+        except Exception as e:  # noqa: BLE001 — recorded, asserted
+            errs.append(e)
+
+    ts = [threading.Thread(target=churn, args=(s,), daemon=True)
+          for s, _ in fleet]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join(30)
+    assert not any(t.is_alive() for t in ts), "adopt churn deadlocked"
+    assert not errs, errs
+    st = rs.stats()
+    assert (st["resident_sessions"] + st["host_sessions"]
+            + st["disk_sessions"] + st["corrupt_sessions"]) == 3
+    with rs._lock:
+        states = {id(s): rs._state.get(id(s)) for s, _ in fleet}
+    for s, _ in fleet:
+        if states[id(s)] == "resident":
+            assert s._spill is None  # never resident WITH a record
+        elif states[id(s)] in ("host", "disk"):
+            assert s._spill is not None
+    # the fleet still answers correctly after the storm
+    for s, A64 in fleet:
+        x = np.asarray(s.solve(b))
+        want = np.linalg.solve(A64, b.astype(np.float64))
+        assert (np.linalg.norm(x - want) / np.linalg.norm(want)) < 1e-4
+
+
+def test_corrupt_record_retires_gauges_and_disk_space(tmp_path):
+    plan = _plan()
+    s, _ = _fleet(plan, 1, seed=36)[0]
+    rng = np.random.default_rng(36)
+    b = rng.standard_normal((N, 1)).astype(np.float32)
+    faults = FaultPlan([FaultSpec("disk_write", "nan", count=1)])
+    rs = ResidentSet(disk_dir=str(tmp_path), fault_plan=faults)
+    rs.adopt(s)
+    rs.spill(s)
+    rs.demote(s)
+    rec_path = s._spill.path
+    assert rs.stats()["disk_bytes"] > 0
+    with pytest.raises(RestoreCorrupt) as e1:
+        s.solve(b)
+    # the dead record stops counting against the disk tier and its
+    # directory is reclaimed (a CRC failure is permanent)
+    assert rs.stats()["disk_bytes"] == 0
+    assert not os.path.exists(rec_path)
+    # later touches raise a FRESH copy of the pinned error — the one
+    # instance is never re-raised (and traceback-mutated) across
+    # threads — chained to the original with the same evidence
+    with pytest.raises(RestoreCorrupt) as e2:
+        s.solve(b)
+    assert e2.value is not e1.value
+    assert e2.value.__cause__ is e1.value
+    assert e2.value.evidence == e1.value.evidence
+
+
+def test_fault_in_reports_noop_and_revive_many_counts_real_work():
+    plan = _plan()
+    fleet = _fleet(plan, 3, seed=37)
+    rs = ResidentSet()
+    rs.adopt(*[s for s, _ in fleet])
+    assert rs.fault_in(fleet[0][0]) is False  # resident: a no-op
+    rs.spill(*[s for s, _ in fleet])
+    assert rs.fault_in(fleet[0][0]) is True
+    assert rs.fault_in(fleet[0][0]) is False  # already back
+    # only the two still-spilled sessions count as revived
+    assert rs.revive_many([s for s, _ in fleet]) == 2
+    assert all(s.tier == "device" for s, _ in fleet)
+
+
+def test_revive_many_respects_device_caps():
+    """The stacked group path lands a whole chunk in one h2d — an
+    uncapped group overshot max_sessions with nothing left to evict
+    (caught driving the warm-restart surface). Groups now chunk to the
+    caps: later chunks LRU-evict earlier ones, the high-water stays
+    bounded, and every revived answer is still bitwise."""
+    plan = _plan()
+    fleet = _fleet(plan, 6, seed=39)
+    rng = np.random.default_rng(39)
+    b = rng.standard_normal((N, 1)).astype(np.float32)
+    want = [np.asarray(s.solve(b)) for s, _ in fleet]
+    rs = ResidentSet(max_sessions=3)
+    rs.adopt(*[s for s, _ in fleet])
+    rs.spill(*[s for s, _ in fleet])
+    assert rs.revive_many([s for s, _ in fleet]) == 6
+    st = rs.stats()
+    assert st["resident_sessions"] <= 3, st
+    assert st["resident_high_water"] <= 3, st
+    for (s, _), w in zip(fleet, want):
+        assert np.array_equal(w, np.asarray(s.solve(b)))
+    assert rs.stats()["resident_high_water"] <= 3
+
+
+def test_revive_many_partial_progress_under_backpressure():
+    """A saturated revive lane skips sessions (records intact,
+    `revive_rejects` bumped) instead of aborting the whole batch with
+    the first SessionSpilled; the count reports what actually landed."""
+    plan = _plan()
+    fleet = _fleet(plan, 2, seed=38, drift_rank=1)  # drifted: rest path
+    rs = ResidentSet(max_concurrent_revives=1)
+    rs.adopt(*[s for s, _ in fleet])
+    rs.spill(*[s for s, _ in fleet])
+    h0 = tier.tier_stats()
+    assert rs._revive_sem.acquire(timeout=1)  # saturate the lane
+    try:
+        assert rs.revive_many([s for s, _ in fleet], timeout=0.05) == 0
+        assert all(s.tier == "host" for s, _ in fleet)
+        assert (tier.tier_stats()["revive_rejects"]
+                - h0.get("revive_rejects", 0)) >= 2
+    finally:
+        rs._revive_sem.release()
+    # the lane freed: the same call revives everyone
+    assert rs.revive_many([s for s, _ in fleet]) == 2
+    assert all(s.tier == "device" for s, _ in fleet)
 
 
 # --------------------------------------------------------------------- #
