@@ -56,8 +56,11 @@ def parse_args():
     ap.add_argument("--smoke", action="store_true",
                     help="CI gate: N=512 k=8 single leg, assert the "
                     "refresh path beats full refactor")
-    ap.add_argument("--out", default="BENCH_REFRESH.json",
-                    help="JSON output path")
+    ap.add_argument("--out", default=None,
+                    help="JSON output path (default BENCH_REFRESH.json; "
+                    "--smoke runs default to BENCH_REFRESH_smoke.json so "
+                    "CI smoke numbers never clobber the committed "
+                    "full-shape headline)")
     return ap.parse_args()
 
 
@@ -77,6 +80,9 @@ def main():
 
     cache.enable_persistent_cache()
     profiler.clear()
+    if args.out is None:
+        args.out = ("BENCH_REFRESH_smoke.json" if args.smoke
+                    else "BENCH_REFRESH.json")
 
     if args.smoke:
         args.N, args.k, args.v = 512, 8, 128

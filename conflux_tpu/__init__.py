@@ -100,6 +100,10 @@ def __getattr__(name):
         # factor lane / coalesced cold-start (ISSUE 5)
         "stack_trees": ("conflux_tpu.batched", "stack_trees"),
         "unstack_tree": ("conflux_tpu.batched", "unstack_tree"),
+        # adaptive serve-engine control loop (ISSUE 8)
+        "AdaptiveController": ("conflux_tpu.control", "AdaptiveController"),
+        "ControlLimits": ("conflux_tpu.control", "ControlLimits"),
+        "StatsWindow": ("conflux_tpu.profiler", "StatsWindow"),
     }
     if name in _lazy:
         import importlib
@@ -172,4 +176,7 @@ __all__ = [
     "RhsNonFinite",
     "stack_trees",
     "unstack_tree",
+    "AdaptiveController",
+    "ControlLimits",
+    "StatsWindow",
 ]

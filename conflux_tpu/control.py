@@ -1,0 +1,692 @@
+"""Closed-loop autotuning of the serve engine from live telemetry.
+
+The serving stack's throughput comes from the same trade the 2.5D
+algorithms make — spend a little buffering/latency/redundancy to buy far
+fewer, larger device operations — but until now the knobs that price
+that trade were static: `max_batch_delay` (how long a request waits for
+company), the prewarmed width/stack/factor bucket sets (which coalesced
+shapes are compile-free), `max_pending` (how much backlog admission
+tolerates) and the health guards' sampling rates. `profiler.
+serve_stats()` already measures everything a controller needs — queue
+depth, coalesced means, pad waste, p50/p95/p99 — and real open-loop
+traffic shifts (diurnal ramps, bursts, width-mix drift), so a knob set
+that is right at 9am is wrong at noon.
+
+:class:`AdaptiveController` closes the loop. It runs on its own daemon
+thread inside a :class:`~conflux_tpu.engine.ServeEngine`
+(``ServeEngine(controller=...)``), consumes WINDOWED deltas of the
+engine/health/tier telemetry (`profiler.StatsWindow` — each tick sees
+what changed, not lifetime averages that stop responding after the first
+million requests), and retunes a declared knob set against a latency
+SLO:
+
+- **max_batch_delay** — hill-climbed: widen the window when the
+  coalesced mean is low while the backlog is building (wider dispatches
+  raise effective capacity), shrink it when the window p99 approaches
+  the SLO or traffic is light (the window is then pure added latency).
+- **max_pending / EngineSaturated.retry_after** — sized from the
+  MEASURED drain rate: admission holds roughly what can drain inside
+  the SLO, so under hard overload the completed requests' tail stays
+  near the SLO instead of inheriting a mis-sized queue, and shed
+  clients get a retry hint spaced at the actual completion rate
+  (`ServeEngine._admit`; the static exponential guess remains the
+  no-estimate fallback).
+- **active bucket sets** — grown only through BACKGROUND prewarm: when
+  the width cap keeps splitting chunks (`width_capped` pressure) the
+  controller prewarms the next power-of-two bucket on the engine's
+  recently-served sessions/plans and moves the cap only once
+  `FactorPlan.bucket_ready` reports the program warm, so the steady
+  state stays zero-compile by construction. Cold buckets (no hits for
+  `retire_after` windows) are retired: their compiled programs are
+  dropped through `FactorPlan.release_buckets` and the cap shrinks back
+  to what traffic actually uses. The factor lane's batch buckets get
+  the same treatment.
+- **health guard sampling** — after `relax_health_after` consecutive
+  windows with ZERO guard trips, the submit-time finite guard's sample
+  shrinks and the exact staging guard thins to 1-in-`staging_stride`
+  batches (detection is never lost — the device-side finite verdict
+  and per-request isolation still backstop exactly; only the reporting
+  point moves, see resilience.rhs_finite). ANY trip restores full
+  guarding INSTANTLY, engine-side, on the tripping thread
+  (`ServeEngine._restore_guards`) — the controller then just re-syncs
+  its bookkeeping.
+
+The controller is strictly advisory and strictly opt-in: every write
+goes through the engine's validated, thread-safe :meth:`~conflux_tpu.
+engine.ServeEngine.set_knobs`; a controller tick that throws is counted
+and skipped (the serve path never depends on it); a dead or detached
+controller simply freezes the knobs at their last values; and
+``controller=None`` engines carry ZERO behavioral change — the
+acceptance bar test_engine's bitwise assertions hold untouched.
+
+    ctl = AdaptiveController(slo_p99_ms=25.0, interval=0.25)
+    eng = ServeEngine(max_batch_delay=0.002, controller=ctl)
+    ...traffic...
+    eng.stats()["controller"]   # ticks, decisions, window, knobs
+
+Decisions are recorded in a bounded log (`stats()['decisions_log']`),
+each entry (t, knob, old, new, reason) — the ops-facing answer to "why
+did p50 just change".
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import threading
+import time
+
+from conflux_tpu import profiler
+from conflux_tpu.update import rank_bucket
+
+# the health counters whose window deltas count as "guard trips" — any
+# nonzero sum vetoes (and reverts) guard relaxation
+_TRIP_KEYS = (
+    "rhs_rejects", "staging_isolations", "factor_rejects",
+    "factor_isolations", "output_failures", "factor_unhealthy",
+)
+
+
+def _pow2_at_most(n: int) -> int:
+    """Largest power of two <= n (n >= 1)."""
+    p = 1
+    while p * 2 <= n:
+        p *= 2
+    return p
+
+
+@dataclasses.dataclass(frozen=True)
+class ControlLimits:
+    """Hard bounds every controller move respects — the declared
+    actuation envelope. The controller hill-climbs INSIDE this box; it
+    never widens it, so an operator reading the limits knows the worst
+    case of every knob regardless of what traffic does.
+
+    min/max_batch_delay: the coalescing-window range (seconds).
+    min/max_pending: the admission-bound range.
+    max_coalesce_width / max_factor_batch: the widest buckets the
+        controller may grow to (and therefore prewarm); growth past the
+        engine's construction values happens only through the
+        prewarm-gated path.
+    relaxed_guard_sample: the submit-guard sample size while guards are
+        relaxed (elements scanned per request; the strict policy's own
+        value is the restore point).
+    staging_stride: staging-guard thinning while relaxed (exact check
+        runs on 1-in-stride batches).
+    """
+
+    min_batch_delay: float = 0.0
+    max_batch_delay: float = 0.032
+    min_pending: int = 32
+    max_pending: int = 8192
+    max_coalesce_width: int = 64
+    max_factor_batch: int = 64
+    relaxed_guard_sample: int = 256
+    staging_stride: int = 8
+
+
+class AdaptiveController:
+    """The feedback controller: windowed telemetry in, validated knob
+    moves out (DESIGN §24 has the full telemetry→decision→actuation
+    table).
+
+    slo_p99_ms: the latency objective. The controller treats it as a
+        ceiling to stay under, not a target to fill: knobs that buy
+        throughput (wider windows, deeper admission) grow only while
+        the window p99 keeps `headroom` of slack.
+    interval: seconds between control ticks (each tick one
+        `StatsWindow.delta()`).
+    limits: a :class:`ControlLimits` actuation envelope.
+    headroom: fraction of the SLO at which p99 is "approaching" —
+        shrink-the-window territory.
+    coalesce_target: mean requests/batch below which the window is
+        considered under-coalescing (the widen signal, gated on a
+        building backlog).
+    delay_grow / delay_shrink: multiplicative hill-climb steps for
+        `max_batch_delay`; `delay_floor_step` seeds the climb out of a
+        zero window.
+    pending_slack: admission sizes to `drain_rate * slo * slack` —
+        >1 keeps the pipe full, large values re-grow the mis-sized
+        queues the sizing exists to prevent.
+    pending_deadband: relative change below which max_pending is left
+        alone (actuation hysteresis).
+    ema: weight of the newest window in the drain-rate estimate.
+    grow_after: consecutive windows of width-cap pressure before a
+        bucket grows (debounce — one burst must not inflate the
+        compiled-program set).
+    retire_after: consecutive hit-less windows before a bucket is
+        retired. Retirement drops compiled programs; a later touch
+        re-traces, so this defaults LONG.
+    relax_health_after: consecutive trip-free windows before guard
+        sampling relaxes.
+    min_window_samples: latency samples a window needs before its p99
+        is trusted to steer the delay knob.
+    """
+
+    def __init__(self, *, slo_p99_ms: float = 25.0,
+                 interval: float = 0.25,
+                 limits: ControlLimits | None = None,
+                 headroom: float = 0.8,
+                 coalesce_target: float = 2.0,
+                 delay_grow: float = 1.6,
+                 delay_shrink: float = 0.5,
+                 delay_floor_step: float = 5e-4,
+                 pending_slack: float = 1.5,
+                 pending_deadband: float = 0.25,
+                 ema: float = 0.5,
+                 grow_after: int = 2,
+                 retire_after: int = 120,
+                 relax_health_after: int = 20,
+                 min_window_samples: int = 8,
+                 decision_log: int = 256):
+        if slo_p99_ms <= 0 or interval <= 0:
+            raise ValueError("slo_p99_ms and interval must be > 0")
+        if not 0 < headroom <= 1:
+            raise ValueError("headroom must be in (0, 1]")
+        if delay_grow <= 1 or not 0 < delay_shrink < 1:
+            raise ValueError("need delay_grow > 1 and 0 < delay_shrink < 1")
+        self.slo_p99_ms = float(slo_p99_ms)
+        self.interval = float(interval)
+        self.limits = ControlLimits() if limits is None else limits
+        self.headroom = float(headroom)
+        self.coalesce_target = float(coalesce_target)
+        self.delay_grow = float(delay_grow)
+        self.delay_shrink = float(delay_shrink)
+        self.delay_floor_step = float(delay_floor_step)
+        self.pending_slack = float(pending_slack)
+        self.pending_deadband = float(pending_deadband)
+        self.ema = float(ema)
+        self.grow_after = int(grow_after)
+        self.retire_after = int(retire_after)
+        self.relax_health_after = int(relax_health_after)
+        self.min_window_samples = int(min_window_samples)
+
+        # cross-thread state: step() runs on the controller thread,
+        # stats() on any caller's — everything below is guarded
+        self._lock = threading.Lock()
+        self._engine_ref = None         # guarded-by: _lock (weakref)
+        self._window = None             # guarded-by: _lock
+        self._ticks = 0                 # guarded-by: _lock
+        self._errors = 0                # guarded-by: _lock
+        self._decisions = 0             # guarded-by: _lock
+        self._log: list = []            # guarded-by: _lock (bounded)
+        self._log_cap = int(decision_log)
+        self._last_window: dict = {}    # guarded-by: _lock
+        self._drain_rate: float | None = None  # guarded-by: _lock
+        # decision state machines (controller-thread only, but kept
+        # under the lock so stats() reads a consistent picture)
+        self._widen_pressure = 0        # guarded-by: _lock
+        self._cap_pressure = 0          # guarded-by: _lock
+        self._fcap_pressure = 0         # guarded-by: _lock
+        self._calm_windows = 0          # guarded-by: _lock
+        self._relaxed = False           # guarded-by: _lock
+        self._strict_health = None      # guarded-by: _lock
+        # bucket -> consecutive hit-less windows (solve / factor lanes)
+        self._cold: dict = {}           # guarded-by: _lock
+        self._fcold: dict = {}          # guarded-by: _lock
+        # in-flight background prewarm: (target_bucket, Thread) or None
+        self._width_prewarm = None      # guarded-by: _lock
+        self._fbatch_prewarm = None     # guarded-by: _lock
+
+        self._stop = threading.Event()
+        self._thread: threading.Thread | None = None
+
+    # ------------------------------------------------------------------ #
+    # lifecycle (engine start/close own these; tests drive step() bare)
+    # ------------------------------------------------------------------ #
+
+    def attach(self, engine) -> "AdaptiveController":
+        """Bind to an engine (weakly — the controller must never keep a
+        dead engine alive) and prime the telemetry window. Called by
+        ``ServeEngine(controller=...)``; tests may attach manually and
+        drive :meth:`step` without ever starting the thread."""
+        import weakref
+
+        with self._lock:
+            if self._engine_ref is not None and self._engine_ref() is not None:
+                raise RuntimeError("controller is already attached — one "
+                                   "controller steers one engine")
+            self._engine_ref = weakref.ref(engine)
+            self._window = profiler.StatsWindow(engine)
+            self._strict_health = engine.health
+        return self
+
+    def start(self) -> None:
+        """Spawn the control-loop daemon thread (idempotent)."""
+        if self._thread is not None and self._thread.is_alive():
+            return
+        self._stop.clear()
+        self._thread = threading.Thread(
+            target=self._loop, name="serve-engine-controller", daemon=True)
+        self._thread.start()
+
+    def close(self, timeout: float | None = 5.0) -> None:
+        """Stop the control loop and join it (idempotent). The engine's
+        close() calls this before tearing down the workers; the knobs
+        stay wherever the last tick left them."""
+        self._stop.set()
+        t = self._thread
+        if t is not None and t.is_alive():
+            t.join(timeout)
+
+    def _loop(self) -> None:
+        while not self._stop.wait(self.interval):
+            with self._lock:
+                ref = self._engine_ref
+            eng = None if ref is None else ref()
+            if eng is None or eng._closed:
+                return  # the watchdog tie-in: a closed engine ends us
+            try:
+                self.step()
+            except Exception:  # noqa: BLE001 — the controller is advisory
+                with self._lock:
+                    self._errors += 1
+
+    # ------------------------------------------------------------------ #
+    # the control tick
+    # ------------------------------------------------------------------ #
+
+    def step(self) -> dict | None:
+        """One control tick: take the telemetry window, run every
+        decision block, actuate through `engine.set_knobs`. Public so
+        tests and benches can drive the loop deterministically (no
+        thread, no timing). Returns the window it acted on (None when
+        the engine is gone)."""
+        with self._lock:
+            ref = self._engine_ref
+            window = self._window
+        eng = None if ref is None else ref()
+        if eng is None or window is None:
+            return None
+        d = window.delta()
+        with self._lock:
+            self._ticks += 1
+            self._last_window = d
+        e = d["engine"]
+        self._decide_drain_rate(eng, d, e)
+        self._decide_pending(eng, d, e)
+        self._decide_delay(eng, d, e)
+        self._decide_widths(eng, d, e)
+        self._decide_factor_batches(eng, d, e)
+        self._decide_health(eng, d, e)
+        return d
+
+    def _record(self, knob: str, old, new, reason: str) -> None:
+        with self._lock:
+            self._decisions += 1
+            self._log.append((time.perf_counter(), knob, old, new, reason))
+            if len(self._log) > self._log_cap:
+                del self._log[: len(self._log) - self._log_cap]
+
+    # -- drain rate (feeds retry_after and the admission sizing) -------- #
+
+    def _decide_drain_rate(self, eng, d, e) -> None:
+        if not e["completed"] or d["seconds"] <= 0:
+            return  # nothing drained: keep the last estimate
+        rate = e["completed"] / d["seconds"]
+        with self._lock:
+            prev = self._drain_rate
+            rate = (rate if prev is None
+                    else self.ema * rate + (1 - self.ema) * prev)
+            self._drain_rate = rate
+        eng.set_knobs(drain_rate=rate)
+
+    # -- admission bound: hold what can drain inside the SLO ------------ #
+
+    def _decide_pending(self, eng, d, e) -> None:
+        with self._lock:
+            rate = self._drain_rate
+        if rate is None or rate <= 0:
+            return
+        lim = self.limits
+        want = int(rate * (self.slo_p99_ms * 1e-3) * self.pending_slack)
+        want = max(lim.min_pending, min(lim.max_pending, want))
+        cur = eng.max_pending
+        if abs(want - cur) <= self.pending_deadband * cur:
+            return  # hysteresis: don't thrash the bound over noise
+        eng.set_knobs(max_pending=want)
+        self._record(
+            "max_pending", cur, want,
+            f"drain {rate:.0f}/s x SLO {self.slo_p99_ms:.0f}ms x "
+            f"slack {self.pending_slack:g} — admission holds what can "
+            "drain inside the SLO")
+
+    # -- batch-delay hill climb ----------------------------------------- #
+
+    def _decide_delay(self, eng, d, e) -> None:
+        lim = self.limits
+        cur = eng.max_batch_delay
+        have_p99 = e["latency_samples"] >= self.min_window_samples
+        p99 = e["latency_p99_ms"]
+        if have_p99 and p99 >= self.headroom * self.slo_p99_ms:
+            # p99 approaching the SLO: the window is latency we can
+            # refund — shrink it first (cheapest reversible lever)
+            new = max(lim.min_batch_delay, cur * self.delay_shrink)
+            if new < self.delay_floor_step / 4:
+                new = lim.min_batch_delay  # snap out of the decay tail
+            if cur > lim.min_batch_delay and new < cur:
+                eng.set_knobs(max_batch_delay=new)
+                self._record("max_batch_delay", cur, new,
+                             f"window p99 {p99:.1f}ms >= "
+                             f"{self.headroom:.0%} of SLO "
+                             f"{self.slo_p99_ms:.0f}ms — shrink")
+            return
+        # "backlog building" must mean it, not a 2-deep transient: a
+        # busy-but-stable regime leaves a few requests in flight at any
+        # instant, and widening the window there trades p50/p99 for
+        # nothing (the over-eager version of this test cost the bench's
+        # ramp tail ~60% p99). Require either a meaningful fraction of
+        # the window's arrivals left unserved, or a queue deep relative
+        # to the admission bound.
+        backlog_rising = (
+            e["backlog_delta"] > max(2.0, 0.05 * e["requests"])
+            or e["pending"] > 0.5 * eng.max_pending)
+        under_coalesced = (e["batches"] > 0
+                           and e["coalesced_mean"] < self.coalesce_target)
+        with self._lock:
+            if under_coalesced and backlog_rising:
+                self._widen_pressure += 1
+            else:
+                self._widen_pressure = 0
+            widen = self._widen_pressure >= 2
+        if widen:
+            # demand outpaces narrow dispatches for two consecutive
+            # windows (one Poisson clump must not widen the window —
+            # a transient costs every later request the full delay):
+            # widen so each dispatch amortizes over more requests
+            new = min(lim.max_batch_delay,
+                      max(cur * self.delay_grow,
+                          self.delay_floor_step))
+            if new > cur:
+                eng.set_knobs(max_batch_delay=new)
+                self._record(
+                    "max_batch_delay", cur, new,
+                    f"coalesced mean {e['coalesced_mean']:.1f} < "
+                    f"{self.coalesce_target:g} with backlog "
+                    f"{e['backlog_delta']:+d} — widen")
+            return
+        if (e["requests"] and not backlog_rising
+                and e["coalesced_mean"] <= 1.5
+                and cur > lim.min_batch_delay):
+            # light traffic arriving alone: the window buys nothing and
+            # costs its full length in p50 — decay it
+            new = max(lim.min_batch_delay, cur * self.delay_shrink)
+            if new < self.delay_floor_step / 4:
+                new = lim.min_batch_delay  # snap out of the decay tail
+            if new < cur:
+                eng.set_knobs(max_batch_delay=new)
+                self._record("max_batch_delay", cur, new,
+                             "light solo traffic — the window is pure "
+                             "added latency; decay")
+
+    # -- bucket growth (prewarm-gated) + retirement --------------------- #
+
+    def _decide_widths(self, eng, d, e) -> None:
+        lim = self.limits
+        cur = eng.max_coalesce_width
+        with self._lock:
+            pre = self._width_prewarm
+        # 1. an in-flight growth completes only when every active plan's
+        # target bucket is warm — the knob NEVER moves onto a cold
+        # program (a failed prewarm just drops the attempt)
+        if pre is not None:
+            target, thread = pre
+            if thread.is_alive():
+                return  # still compiling in the background
+            sessions, _plans = eng.active_targets()
+            checked = eng.health is not None and eng.health.check_output
+            ready = [s.plan.bucket_ready(width=target, checked=checked)
+                     for s in sessions]
+            with self._lock:
+                self._width_prewarm = None
+            if ready and all(ready) and target > eng.max_coalesce_width:
+                eng.set_knobs(max_coalesce_width=target)
+                self._record("max_coalesce_width", cur, target,
+                             f"bucket {target} prewarmed on "
+                             f"{len(ready)} session(s) — cap grows "
+                             "onto warm programs only")
+            return
+        # 2. growth pressure: the cap keeps splitting chunks
+        with self._lock:
+            if e.get("width_capped", 0) > 0:
+                self._cap_pressure += 1
+            else:
+                self._cap_pressure = 0
+            pressure = self._cap_pressure
+        have_p99 = e["latency_samples"] >= self.min_window_samples
+        p99_ok = (not have_p99
+                  or e["latency_p99_ms"] < self.headroom * self.slo_p99_ms)
+        if pressure >= self.grow_after and p99_ok \
+                and cur < lim.max_coalesce_width:
+            target = min(lim.max_coalesce_width, 2 * _pow2_at_most(cur))
+            if target > cur:
+                self._launch_width_prewarm(eng, target)
+            return
+        # 3. retirement: buckets with a long zero-hit history drop
+        # their compiled programs and the cap shrinks to what traffic
+        # actually uses
+        self._retire_widths(eng, d, e)
+
+    def _launch_width_prewarm(self, eng, target: int) -> None:
+        sessions, _plans = eng.active_targets()
+        if not sessions:
+            return  # nothing served yet — nothing to warm against
+        # one representative session per plan (the program cache is
+        # per-plan; any session of it warms the bucket)
+        per_plan: dict = {}
+        for s in sessions:
+            per_plan.setdefault(id(s.plan), s)
+
+        def run():
+            for s in per_plan.values():
+                eng.prewarm(s, widths=(target,))
+
+        t = threading.Thread(target=run, daemon=True,
+                             name="serve-engine-controller-prewarm")
+        with self._lock:
+            self._width_prewarm = (target, t)
+        t.start()
+        self._record("prewarm", None, target,
+                     f"width cap pressure: background-prewarming "
+                     f"bucket {target} on {len(per_plan)} plan(s) "
+                     "before any cap move")
+
+    def _retire_widths(self, eng, d, e) -> None:
+        hits = d.get("bucket_hits", {})
+        with self._lock:
+            seen = set(self._cold) | set(hits)
+            for b in seen:
+                self._cold[b] = 0 if hits.get(b, 0) else \
+                    self._cold.get(b, 0) + 1
+            cold = sorted(b for b, n in self._cold.items()
+                          if n >= self.retire_after and b > 1)
+            hot = [b for b, n in self._cold.items()
+                   if n < self.retire_after]
+        if not cold:
+            return
+        sessions, plans = eng.active_targets()
+        all_plans = {id(p): p for p in plans}
+        for s in sessions:
+            all_plans.setdefault(id(s.plan), s.plan)
+        dropped = 0
+        for p in all_plans.values():
+            dropped += p.release_buckets(widths=cold)
+        cur = eng.max_coalesce_width
+        new_cap = max([1] + hot)
+        if new_cap < cur:
+            eng.set_knobs(max_coalesce_width=new_cap)
+        with self._lock:
+            for b in cold:
+                self._cold.pop(b, None)
+        self._record(
+            "release_widths", cur,
+            new_cap if new_cap < cur else cur,
+            f"buckets {cold} cold for {self.retire_after} windows — "
+            f"released {dropped} compiled program(s)"
+            + (f", cap {cur} -> {new_cap}" if new_cap < cur else ""))
+
+    def _decide_factor_batches(self, eng, d, e) -> None:
+        lim = self.limits
+        cur = eng.max_factor_batch
+        with self._lock:
+            pre = self._fbatch_prewarm
+        if pre is not None:
+            target, thread = pre
+            if thread.is_alive():
+                return
+            _sessions, plans = eng.active_targets()
+            checked = eng.health is not None and eng.health.check_output
+            ready = [p.bucket_ready(factor_batch=target, checked=checked)
+                     for p in plans]
+            with self._lock:
+                self._fbatch_prewarm = None
+            if ready and all(ready) and target > eng.max_factor_batch:
+                eng.set_knobs(max_factor_batch=target)
+                self._record("max_factor_batch", cur, target,
+                             f"factor bucket {target} prewarmed on "
+                             f"{len(ready)} plan(s)")
+            return
+        # growth pressure: factor batches keep filling the cap while
+        # cold-start work queues behind them
+        full = (e["factor_batches"] > 0
+                and e["factor_coalesced_mean"] >= 0.9 * cur)
+        with self._lock:
+            self._fcap_pressure = self._fcap_pressure + 1 if full else 0
+            pressure = self._fcap_pressure
+        if pressure >= self.grow_after and cur < lim.max_factor_batch:
+            _sessions, plans = eng.active_targets()
+            if plans:
+                target = min(lim.max_factor_batch, 2 * cur)
+
+                def run():
+                    for p in plans:
+                        eng.prewarm(p, widths=(),
+                                    factor_batches=(target,))
+
+                t = threading.Thread(
+                    target=run, daemon=True,
+                    name="serve-engine-controller-prewarm")
+                with self._lock:
+                    self._fbatch_prewarm = (target, t)
+                t.start()
+                self._record("prewarm", None, target,
+                             f"factor cap pressure: background-"
+                             f"prewarming batch bucket {target}")
+            return
+        # retirement (never bucket 1 — plan.factor's own path)
+        hits = d.get("factor_bucket_hits", {})
+        with self._lock:
+            for b in set(self._fcold) | set(hits):
+                self._fcold[b] = 0 if hits.get(b, 0) else \
+                    self._fcold.get(b, 0) + 1
+            cold = sorted(b for b, n in self._fcold.items()
+                          if n >= self.retire_after and b > 1)
+        if not cold:
+            return
+        _sessions, plans = eng.active_targets()
+        dropped = sum(p.release_buckets(factor_batches=cold)
+                      for p in plans)
+        with self._lock:
+            for b in cold:
+                self._fcold.pop(b, None)
+        if dropped:
+            self._record("release_factor_batches", None, cold,
+                         f"factor buckets {cold} cold for "
+                         f"{self.retire_after} windows — released "
+                         f"{dropped} program(s)")
+
+    # -- guard sampling: back off on silence, restore on any trip ------- #
+
+    def _decide_health(self, eng, d, e) -> None:
+        with self._lock:
+            strict = self._strict_health
+        if strict is None or not strict.check_rhs:
+            return  # nothing to relax
+        trips = sum(d["health"].get(k, 0) for k in _TRIP_KEYS)
+        with self._lock:
+            if trips:
+                self._calm_windows = 0
+                was_relaxed = self._relaxed
+                self._relaxed = False
+            else:
+                self._calm_windows += 1
+                was_relaxed = self._relaxed
+        if trips:
+            # the ENGINE already restored strict guarding on the
+            # tripping thread (`_restore_guards`); this just re-syncs
+            # the controller's bookkeeping and records the event
+            if was_relaxed:
+                eng.set_knobs(health=strict, staging_stride=1)
+                self._record("health", "relaxed", "strict",
+                             f"{trips} guard trip(s) in the window — "
+                             "full guarding restored (engine-side, "
+                             "instantly; this records it)")
+            return
+        with self._lock:
+            calm = self._calm_windows
+            relaxed = self._relaxed
+        if relaxed or calm < self.relax_health_after:
+            return
+        lim = self.limits
+        sample = strict.submit_guard_sample
+        relaxed_sample = (lim.relaxed_guard_sample if sample is None
+                          else min(sample, lim.relaxed_guard_sample))
+        relaxed_policy = dataclasses.replace(
+            strict, submit_guard_sample=relaxed_sample)
+        eng.set_knobs(health=relaxed_policy,
+                      staging_stride=lim.staging_stride)
+        with self._lock:
+            self._relaxed = True
+        self._record(
+            "health", "strict", "relaxed",
+            f"{calm} trip-free windows — submit guard sample -> "
+            f"{relaxed_sample}, staging guard 1-in-"
+            f"{lim.staging_stride} batches (device verdict still "
+            "exact; any trip restores instantly)")
+
+    # ------------------------------------------------------------------ #
+    # observability
+    # ------------------------------------------------------------------ #
+
+    def stats(self) -> dict:
+        """Controller counters for `engine.stats()['controller']`:
+        ticks taken, decisions made, tick errors, the guard-relaxation
+        state, the last telemetry window it acted on, and the tail of
+        the decision log."""
+        with self._lock:
+            return {
+                "ticks": self._ticks,
+                "decisions": self._decisions,
+                "errors": self._errors,
+                "relaxed_guards": self._relaxed,
+                "drain_rate": self._drain_rate,
+                "slo_p99_ms": self.slo_p99_ms,
+                "last_window": dict(self._last_window),
+                "decisions_log": [
+                    {"t": t, "knob": k, "old": o, "new": n, "reason": r}
+                    for t, k, o, n, r in self._log[-16:]],
+            }
+
+    @staticmethod
+    def blank_delta(seconds: float = 0.25) -> dict:
+        """A zeroed `StatsWindow.delta()`-shaped dict — the test/bench
+        harness hook for driving `step()` with synthetic telemetry
+        (stub the attached window's `delta` with edits of this)."""
+        eng = {k: 0 for k in profiler._ENGINE_COUNTERS}
+        eng.update(pending=0, backlog_delta=0, arrival_per_s=0.0,
+                   drain_per_s=0.0, coalesced_mean=0.0,
+                   factor_coalesced_mean=0.0, latency_samples=0,
+                   factor_latency_samples=0)
+        for prefix in ("latency", "factor_latency"):
+            for pct in (50, 95, 99):
+                eng[f"{prefix}_p{pct}_ms"] = 0.0
+        return {
+            "seconds": seconds,
+            "engine": eng,
+            "bucket_hits": {},
+            "factor_bucket_hits": {},
+            "phases": {ph: {"count": 0, "wall_s": 0.0}
+                       for ph in profiler.SERVE_PHASES},
+            "health": {},
+            "tier": {},
+            "tier_gauges": {},
+        }

@@ -99,6 +99,7 @@ from __future__ import annotations
 import dataclasses
 import threading
 import time
+import weakref
 from collections import deque
 from concurrent.futures import Future
 from queue import Empty, Full, Queue
@@ -275,6 +276,15 @@ class ServeEngine:
         bounds how long a saturated revive lane can stall the
         dispatcher before the requests fail with structured
         `SessionSpilled`.
+    controller: a :class:`~conflux_tpu.control.AdaptiveController`
+        (DESIGN §24) — opt-in closed-loop autotuning of the knobs above
+        against a latency SLO, from windowed telemetry, on its own
+        daemon thread. The controller writes exclusively through
+        :meth:`set_knobs` (thread-safe, validated, never holding a lock
+        across a dispatch) and only ever routes traffic onto
+        already-prewarmed bucket programs. None (default) leaves every
+        knob exactly as constructed — the default dispatch path is
+        byte-identical to the controller-free engine.
     """
 
     def __init__(self, *, max_batch_delay: float = 0.002,
@@ -287,7 +297,8 @@ class ServeEngine:
                  health: HealthPolicy | None = None,
                  fault_plan=None,
                  watchdog_interval: float = 0.2,
-                 residency=None, revive_wait: float = 30.0):
+                 residency=None, revive_wait: float = 30.0,
+                 controller=None):
         if on_full not in ("reject", "block"):
             raise ValueError(f"unknown on_full {on_full!r} (reject|block)")
         if max_pending < 1 or max_coalesce_width < 1 or max_stack < 1 \
@@ -356,6 +367,33 @@ class ServeEngine:
         self._factor_pad = 0            # guarded-by: _lock
         self._factor_latencies: deque = deque(  # guarded-by: _lock
             maxlen=int(latency_window))
+        # window-delta telemetry for the adaptive controller (and any
+        # profiler.StatsWindow): total samples ever appended to each
+        # rolling latency window (sequence tokens for latency_window()),
+        # per-bucket dispatch hit counters, and the count of chunks the
+        # coalescing width cap split (the width-growth pressure signal)
+        self._lat_seq = 0               # guarded-by: _lock
+        self._flat_seq = 0              # guarded-by: _lock
+        self._bucket_hits: dict = {}    # guarded-by: _lock
+        self._factor_bucket_hits: dict = {}  # guarded-by: _lock
+        self._width_capped = 0          # guarded-by: _lock
+        # recently-served sessions/plans, weakly held — the adaptive
+        # controller's prewarm targets (active_targets())
+        self._active_sessions: dict = {}  # guarded-by: _lock
+        self._active_plans: dict = {}     # guarded-by: _lock
+        # measured drain rate (completions/s, EMA) installed by the
+        # controller; sizes EngineSaturated.retry_after when present
+        self._drain_rate: float | None = None  # guarded-by: _lock
+        # guard-relaxation state: the controller may thin the staging
+        # guard to 1-in-stride batches and swap in a relaxed policy
+        # after a long clean streak; ANY trip restores both instantly
+        # (engine-side, `_restore_guards` — never waiting for a
+        # controller tick). Benign racy reads by design: both old and
+        # new values are valid, a stale read only moves one batch's
+        # sampling point.
+        self._staging_stride = 1
+        self._staging_tick = 0          # guarded-by: _lock
+        self._health_strict = health
         # every admitted, unanswered request. Resolution OWNERSHIP: a
         # request's future is only ever resolved by the path that removed
         # it from this set under the lock (`_take`), so a wedged worker
@@ -380,6 +418,17 @@ class ServeEngine:
                 target=self._watchdog_loop, name="serve-engine-watchdog",
                 daemon=True)
             self._watchdog.start()
+        # the adaptive controller (conflux_tpu.control) attaches LAST so
+        # its first window observes a fully-constructed engine; close()
+        # stops it first, and its loop exits on its own when a watchdog
+        # trip closes the engine under it (the knobs simply freeze at
+        # their last values — the controller is advisory, never
+        # load-bearing)
+        self._controller = None
+        if controller is not None:
+            controller.attach(self)
+            controller.start()
+            self._controller = controller
 
     # ------------------------------------------------------------------ #
     # client surface
@@ -424,6 +473,7 @@ class ServeEngine:
                 and not resilience.rhs_finite(
                     b2, sample=self.health.submit_guard_sample)):
             resilience.bump("rhs_rejects")
+            self._restore_guards()
             raise RhsNonFinite(
                 "rhs contains NaN/Inf — rejected at admission (a poisoned "
                 "request would corrupt every co-batched answer)")
@@ -466,13 +516,30 @@ class ServeEngine:
                 if self.on_full == "reject":
                     self._sheds += 1
                     self._consec_sheds += 1
-                    hint = min(1.0, 1e-3 * (1 << min(self._consec_sheds - 1,
-                                                     10)))
+                    rate = self._drain_rate
+                    if rate is not None and rate > 0.0:
+                        # measured drain rate (the controller's
+                        # estimator): space a retrying herd at the
+                        # actual completion spacing — the k-th
+                        # consecutive shed backs off k drain intervals,
+                        # so retries land as slots actually free up
+                        # instead of guessing exponentially
+                        hint = min(1.0, max(1e-4,
+                                            self._consec_sheds / rate))
+                        why = (f"retry in ~{1e3 * hint:.0f}ms, sized "
+                               f"from the measured drain rate "
+                               f"{rate:.0f}/s")
+                    else:
+                        # no estimate yet: the original exponential
+                        # backoff guess
+                        hint = min(1.0, 1e-3 * (1 << min(
+                            self._consec_sheds - 1, 10)))
+                        why = (f"retry in ~{1e3 * hint:.0f}ms, backoff "
+                               "hint doubles per consecutive shed")
                     raise EngineSaturated(
                         f"{self._pending} pending requests >= max_pending="
                         f"{self.max_pending} (shed policy 'reject'; "
-                        f"retry in ~{1e3 * hint:.0f}ms, backoff hint "
-                        f"doubles per consecutive shed)", retry_after=hint)
+                        f"{why})", retry_after=hint)
                 while self._pending >= self.max_pending \
                         and not self._closed:
                     self._not_full.wait()
@@ -542,6 +609,7 @@ class ServeEngine:
                 and not resilience.rhs_finite(
                     A2, sample=self.health.submit_guard_sample)):
             resilience.bump("factor_rejects")
+            self._restore_guards()
             raise RhsNonFinite(
                 "matrix contains NaN/Inf — rejected at admission (a "
                 "poisoned system would waste a coalesced factor dispatch)")
@@ -575,6 +643,9 @@ class ServeEngine:
             already = self._closed
             self._closed = True
             self._not_full.notify_all()
+        if self._controller is not None:
+            # stop the knob writer before tearing down what it tunes
+            self._controller.close()
         if not already:
             self._inq.put(_STOP)
         self._dispatcher.join(timeout)
@@ -595,6 +666,127 @@ class ServeEngine:
 
     def __exit__(self, *exc) -> None:
         self.close()
+
+    # ------------------------------------------------------------------ #
+    # knob actuation (the adaptive controller's write surface, DESIGN §24)
+    # ------------------------------------------------------------------ #
+
+    def set_knobs(self, *, max_batch_delay: float | None = None,
+                  max_pending: int | None = None,
+                  max_coalesce_width: int | None = None,
+                  max_factor_batch: int | None = None,
+                  health: HealthPolicy | None = None,
+                  staging_stride: int | None = None,
+                  drain_rate: float | None = None) -> dict:
+        """Thread-safe knob actuation: the write half of the adaptive
+        control loop (`conflux_tpu.control.AdaptiveController`), also a
+        public ops surface. Writes land under the admission lock; the
+        hot paths read each knob once per decision point, so a move
+        applies at the NEXT batch window / admission — never mid-batch,
+        and never with a lock held across a dispatch. Validation mirrors
+        the constructor (`max_factor_batch` rounds up to its power-of-two
+        bucket); raising `max_pending` wakes blocked submitters.
+
+        `health` swaps the active policy object (the first swap records
+        the original as the strict restore point — see
+        `_restore_guards`); `staging_stride` thins the exact staging
+        guard to 1-in-stride batches (any guard trip resets it to 1
+        instantly, engine-side). `drain_rate` installs the measured
+        completions/s estimate that sizes `EngineSaturated.retry_after`
+        (None leaves the current estimate in place). Returns the full
+        knob dict after the move."""
+        if max_batch_delay is not None and max_batch_delay < 0:
+            raise ValueError("max_batch_delay must be >= 0")
+        if (max_pending is not None and max_pending < 1) \
+                or (max_coalesce_width is not None
+                    and max_coalesce_width < 1) \
+                or (max_factor_batch is not None and max_factor_batch < 1):
+            raise ValueError("max_pending, max_coalesce_width and "
+                             "max_factor_batch must be >= 1")
+        if staging_stride is not None and staging_stride < 1:
+            raise ValueError("staging_stride must be >= 1")
+        with self._lock:
+            if max_batch_delay is not None:
+                self.max_batch_delay = float(max_batch_delay)
+            if max_pending is not None:
+                self.max_pending = int(max_pending)
+                self._not_full.notify_all()  # blocked submitters re-check
+            if max_coalesce_width is not None:
+                self.max_coalesce_width = int(max_coalesce_width)
+            if max_factor_batch is not None:
+                self.max_factor_batch = rank_bucket(int(max_factor_batch))
+            if health is not None:
+                if self._health_strict is None:
+                    self._health_strict = self.health
+                self.health = health
+            if staging_stride is not None:
+                self._staging_stride = int(staging_stride)
+            if drain_rate is not None:
+                self._drain_rate = float(drain_rate)
+            return self._knobs_locked()
+
+    # requires-lock: _lock
+    def _knobs_locked(self) -> dict:
+        return {"max_batch_delay": self.max_batch_delay,
+                "max_pending": self.max_pending,
+                "max_coalesce_width": self.max_coalesce_width,
+                "max_factor_batch": self.max_factor_batch,
+                "staging_stride": self._staging_stride,
+                "drain_rate": self._drain_rate,
+                "health_relaxed": (self._health_strict is not None
+                                   and self.health
+                                   is not self._health_strict)}
+
+    def knobs(self) -> dict:
+        """The current knob values (a consistent snapshot)."""
+        with self._lock:
+            return self._knobs_locked()
+
+    def _restore_guards(self) -> None:
+        """Any guard trip restores full-strength guarding INSTANTLY,
+        on the tripping thread: the controller only ever relaxes the
+        sampling knobs on sustained-silence evidence, and the restore
+        path cannot wait for its next tick (a poison burst would ride
+        the relaxed window). Plain attribute stores — benign against
+        concurrent readers, both old and new values are valid."""
+        self._staging_stride = 1
+        strict = self._health_strict
+        if strict is not None and self.health is not strict:
+            self.health = strict
+
+    def _tick_staging(self) -> bool:
+        """True when this batch should run the exact staging guard
+        (1-in-stride sampling while the controller has the guard
+        relaxed; stride 1 = every batch, the default)."""
+        s = self._staging_stride
+        if s <= 1:
+            return True
+        with self._lock:
+            self._staging_tick += 1
+            return self._staging_tick % s == 0
+
+    def active_targets(self) -> tuple:
+        """(sessions, plans) recently served by this engine, live refs
+        only — the controller's prewarm targets when it grows a bucket
+        set. Dead weakrefs are pruned as a side effect."""
+        with self._lock:
+            srefs = list(self._active_sessions.items())
+            prefs = list(self._active_plans.items())
+        sessions, plans, dead_s, dead_p = [], [], [], []
+        for k, ref in srefs:
+            obj = ref()
+            (sessions.append(obj) if obj is not None
+             else dead_s.append(k))
+        for k, ref in prefs:
+            obj = ref()
+            (plans.append(obj) if obj is not None else dead_p.append(k))
+        if dead_s or dead_p:
+            with self._lock:
+                for k in dead_s:
+                    self._active_sessions.pop(k, None)
+                for k in dead_p:
+                    self._active_plans.pop(k, None)
+        return sessions, plans
 
     # ------------------------------------------------------------------ #
     # durable checkpoint / warm restart (DESIGN §23)
@@ -900,6 +1092,10 @@ class ServeEngine:
             if chunk and width + r.width > self.max_coalesce_width:
                 chunks.append(chunk)
                 chunk, width = [], 0
+                with self._lock:
+                    # the width cap split a window's chunk: the
+                    # controller's grow-the-bucket-set pressure signal
+                    self._width_capped += 1
             chunk.append(r)
             width += r.width
         deferred: list = []
@@ -946,6 +1142,7 @@ class ServeEngine:
                 live.append(r)
                 continue
             resilience.bump("staging_isolations")
+            self._restore_guards()
             self._fail([r], RhsNonFinite(
                 "rhs went non-finite after admission — isolated at "
                 "staging (co-batched requests unaffected)"))
@@ -1021,6 +1218,7 @@ class ServeEngine:
             buf, spec = self._stage(reqs)
             if (self.health is not None and self.health.check_rhs
                     and not self.health.check_output
+                    and self._tick_staging()
                     and not resilience.rhs_finite(buf)):
                 # no fused output verdict to backstop the staging guard:
                 # one per-BATCH summation here; the per-request scan
@@ -1038,9 +1236,12 @@ class ServeEngine:
         except Exception as e:  # noqa: BLE001 — engine must survive
             self._redispatch_survivors(reqs, e, solo)
             return
+        wb = buf.shape[-1]
         with self._lock:
             self._batches += 1
             self._coalesced_requests += len(reqs)
+            self._bucket_hits[wb] = self._bucket_hits.get(wb, 0) + 1
+            self._active_sessions[id(session)] = weakref.ref(session)
         self._outq.put((spec, x, verdict, buf))
 
     # futures-owner
@@ -1126,6 +1327,7 @@ class ServeEngine:
                 live.append(r)
                 continue
             resilience.bump("factor_isolations")
+            self._restore_guards()
             self._fail([r], RhsNonFinite(
                 "matrix went non-finite after admission — isolated at "
                 "staging (co-batched factorizations unaffected)"))
@@ -1168,6 +1370,7 @@ class ServeEngine:
         try:
             buf = self._stage_factor(plan, reqs)
             if (self.health is not None and self.health.check_rhs
+                    and self._tick_staging()
                     and not resilience.rhs_finite(buf)):
                 # exact per-batch guard (one summation of the staged
                 # stack — noise next to the O(N^3) factor): poisoned
@@ -1196,6 +1399,10 @@ class ServeEngine:
             self._factor_coalesced += len(reqs)
             self._factor_slots += buf.shape[0]
             self._factor_pad += buf.shape[0] - len(reqs)
+            bb = buf.shape[0]
+            self._factor_bucket_hits[bb] = \
+                self._factor_bucket_hits.get(bb, 0) + 1
+            self._active_plans[id(plan)] = weakref.ref(plan)
         return _FactorBatch(plan, reqs, F, wA, verdict, Ad, solo)
 
     # futures-owner
@@ -1317,6 +1524,7 @@ class ServeEngine:
         with self._lock:
             for r in owned:
                 self._latencies.append(now - r.t_submit)
+            self._lat_seq += len(owned)
             self._completed += len(owned)
         for r, si, lo in spec:
             if r not in owned:
@@ -1370,6 +1578,7 @@ class ServeEngine:
                     healthy = False
                 if not healthy:
                     resilience.bump("output_failures")
+                    self._restore_guards()
                     self._drain_unhealthy(session, spec, buf, finite, res)
                     continue
                 if session._breaker is not None:
@@ -1420,6 +1629,7 @@ class ServeEngine:
             entries = [(i, r) for i, r in entries if verdicts[i][0]]
             for i, r in sick:
                 resilience.bump("factor_unhealthy")
+                self._restore_guards()
                 _h, finite, res = verdicts[i]
                 if fb.solo:
                     limit = self._plan_limit(fb.plan)
@@ -1473,6 +1683,7 @@ class ServeEngine:
             for _i, r in entries:
                 if r in owned:
                     self._factor_latencies.append(now - r.t_submit)
+            self._flat_seq += len(owned)
             self._completed += len(owned)
         plan = fb.plan
         trees = unstack_tree(fb.factors, len(fb.reqs))
@@ -1524,6 +1735,7 @@ class ServeEngine:
                     healthy = False
                 if not healthy:
                     resilience.bump("output_failures")
+                    self._restore_guards()
                     self._escalate_settle(session, spec, buf, finite, res)
                     return
                 if session._breaker is not None:
@@ -1616,6 +1828,34 @@ class ServeEngine:
     # observability (merged into profiler.serve_stats()['engine'])
     # ------------------------------------------------------------------ #
 
+    def counters(self) -> dict:
+        """The raw counter/gauge snapshot WITHOUT the percentile
+        computation — the cheap read the windowed-telemetry path
+        (`profiler.StatsWindow` → the controller tick) takes every
+        interval. `stats()` sorts the full latency rings for its
+        percentiles, which is fine for humans and benches but not for
+        a 4-times-a-second control loop sharing one core with the
+        dispatch path."""
+        with self._lock:
+            return {
+                "pending": self._pending,
+                "queue_peak": self._queue_peak,
+                "requests": self._requests,
+                "completed": self._completed,
+                "failed": self._failed,
+                "shed": self._sheds,
+                "batches": self._batches,
+                "coalesced_requests": self._coalesced_requests,
+                "factor_requests": self._factor_requests,
+                "factor_batches": self._factor_batches,
+                "factor_coalesced_requests": self._factor_coalesced,
+                "factor_slots": self._factor_slots,
+                "factor_pad_slots": self._factor_pad,
+                "width_capped": self._width_capped,
+                "bucket_hits": dict(self._bucket_hits),
+                "factor_bucket_hits": dict(self._factor_bucket_hits),
+            }
+
     def stats(self) -> dict:
         """Engine counters: queue depth high-water mark, batches
         dispatched, mean coalesced batch size, shed count, and
@@ -1657,11 +1897,18 @@ class ServeEngine:
                 "factor_latency_p50_ms": 1e3 * _percentile(flats, 50),
                 "factor_latency_p95_ms": 1e3 * _percentile(flats, 95),
                 "factor_latency_p99_ms": 1e3 * _percentile(flats, 99),
+                "width_capped": self._width_capped,
+                "bucket_hits": dict(self._bucket_hits),
+                "factor_bucket_hits": dict(self._factor_bucket_hits),
+                "knobs": self._knobs_locked(),
             }
         if self.residency is not None:
             # outside the engine lock: the manager takes its own
             # (engine-lock -> manager-lock never nests)
             out["tier"] = self.residency.stats()
+        if self._controller is not None:
+            # likewise outside: the controller's stats take its own lock
+            out["controller"] = self._controller.stats()
         return out
 
     def latency_samples(self) -> list:
@@ -1675,3 +1922,30 @@ class ServeEngine:
         seconds (submit_factor admission -> session resolved)."""
         with self._lock:
             return list(self._factor_latencies)
+
+    def latency_window(self, token: int | None = None) -> tuple:
+        """(new_token, samples): the latencies recorded SINCE `token`
+        (a sequence number returned by a previous call; None = the
+        whole rolling window). The windowed read under the ring buffer:
+        if more samples landed than the ring holds, the overflow is
+        gone and the ring's full contents are returned. This is what
+        `profiler.StatsWindow` (and through it the adaptive controller)
+        percentiles over — tail latency of THIS window, not of the
+        cumulative ring."""
+        with self._lock:
+            seq = self._lat_seq
+            lats = list(self._latencies)
+            if token is None:
+                return seq, lats
+            n = min(len(lats), max(0, seq - token))
+            return seq, lats[len(lats) - n:] if n else []
+
+    def factor_latency_window(self, token: int | None = None) -> tuple:
+        """`latency_window` for the factor lane's session-open window."""
+        with self._lock:
+            seq = self._flat_seq
+            lats = list(self._factor_latencies)
+            if token is None:
+                return seq, lats
+            n = min(len(lats), max(0, seq - token))
+            return seq, lats[len(lats) - n:] if n else []

@@ -263,6 +263,184 @@ def serve_stats() -> dict:
 
 
 # --------------------------------------------------------------------------- #
+# Windowed telemetry (rolling deltas — the adaptive controller's input)
+# --------------------------------------------------------------------------- #
+
+# engine.stats() keys that are monotone counters (windowed by
+# differencing); everything else in the engine dict is a gauge or a
+# derived ratio and passes through / is recomputed over the window
+_ENGINE_COUNTERS = (
+    "requests", "completed", "failed", "shed", "batches",
+    "coalesced_requests", "width_capped", "factor_requests",
+    "factor_batches", "factor_coalesced_requests", "factor_slots",
+    "factor_pad_slots",
+)
+# tier.tier_stats() keys that are NOT counters: per-manager population/
+# byte gauges and the latency percentiles (recomputed cumulatively)
+_TIER_GAUGES = frozenset({
+    "managed_sessions", "resident_sessions", "host_sessions",
+    "disk_sessions", "corrupt_sessions", "device_bytes",
+    "device_bytes_high_water", "resident_high_water", "host_bytes",
+    "disk_bytes", "fault_in_p50_ms", "fault_in_p95_ms",
+    "fault_in_p99_ms",
+})
+
+
+def _diff(cur: dict, prev: dict, keys=None) -> dict:
+    """Per-key counter deltas with reset detection — the `clear()`
+    contract: a counter that went BACKWARDS mid-window was reset, so
+    the window reports the post-clear count (everything that landed
+    after the reset) instead of a negative. Counts that landed between
+    the previous window and the reset are lost with the reset itself —
+    window continuity cannot survive a cumulative reset, but the delta
+    stays non-negative and cumulative consumers (serve_stats) are
+    untouched either way."""
+    if keys is None:
+        keys = [k for k, v in cur.items() if isinstance(v, (int, float))]
+    out = {}
+    for k in keys:
+        c, p = cur.get(k, 0), prev.get(k, 0)
+        out[k] = c - p if c >= p else c
+    return out
+
+
+class StatsWindow:
+    """Rolling-window deltas of the serving telemetry.
+
+    Construction snapshots the cumulative counters; each `delta()` call
+    returns what changed since the PREVIOUS `delta()` (or construction)
+    and advances the window. Counters are differenced (clamped at zero
+    across `clear()` — see `_diff`); population/byte gauges pass
+    through; latency percentiles are recomputed over ONLY the samples
+    that completed inside the window, via per-engine sample-sequence
+    tokens (`ServeEngine.latency_window`), not the engines' cumulative
+    rolling windows. Nothing here is destructive: any number of windows
+    coexist with each other and with every cumulative consumer.
+
+    `engine=None` windows the merged `serve_stats()` surface across all
+    live engines; passing a specific engine windows that engine's own
+    counters (what `conflux_tpu.control.AdaptiveController` consumes).
+    """
+
+    def __init__(self, engine=None):
+        import weakref
+
+        self._engine = None if engine is None else weakref.ref(engine)
+        # per-engine latency sample-sequence tokens, weakly keyed so a
+        # dead engine drops its token with itself
+        self._tokens: "weakref.WeakKeyDictionary" = \
+            weakref.WeakKeyDictionary()
+        self._prev: dict | None = None
+        self._t_prev = time.perf_counter()
+        self.delta()  # prime the baseline snapshot
+
+    def _engines(self) -> list:
+        if self._engine is not None:
+            e = self._engine()
+            return [] if e is None else [e]
+        return _live_engines()
+
+    def _snapshot(self) -> tuple[dict, list, list]:
+        """(cumulative snapshot, window latency samples, window factor
+        latency samples)."""
+        engines = self._engines()
+        eng = {k: 0 for k in _ENGINE_COUNTERS}
+        eng["pending"] = 0
+        bucket_hits: dict[int, int] = {}
+        fbucket_hits: dict[int, int] = {}
+        lats: list = []
+        flats: list = []
+        for e in engines:
+            # counters() skips stats()'s percentile sorts — the window
+            # computes its own percentiles from the token-windowed
+            # samples below, so the cumulative ones would be wasted work
+            s = e.counters() if hasattr(e, "counters") else e.stats()
+            for k in _ENGINE_COUNTERS:
+                eng[k] += s.get(k, 0)
+            eng["pending"] += s["pending"]
+            for w, n in s.get("bucket_hits", {}).items():
+                bucket_hits[w] = bucket_hits.get(w, 0) + n
+            for bb, n in s.get("factor_bucket_hits", {}).items():
+                fbucket_hits[bb] = fbucket_hits.get(bb, 0) + n
+            tok, ftok = self._tokens.get(e, (None, None))
+            tok, new = e.latency_window(tok)
+            ftok, fnew = e.factor_latency_window(ftok)
+            self._tokens[e] = (tok, ftok)
+            lats.extend(new)
+            flats.extend(fnew)
+        times, counts = _snapshot()
+        cur = {
+            "engine": eng,
+            "bucket_hits": bucket_hits,
+            "factor_bucket_hits": fbucket_hits,
+            "phases": {ph: {"count": counts.get(f"serve.{ph}", 0),
+                            "wall_s": times.get(f"serve.{ph}", 0.0)}
+                       for ph in SERVE_PHASES},
+        }
+        from conflux_tpu import resilience, tier
+
+        cur["health"] = resilience.health_stats()
+        t = tier.tier_stats()
+        cur["tier"] = {k: v for k, v in t.items()
+                       if k not in _TIER_GAUGES}
+        cur["tier_gauges"] = {k: t[k] for k in _TIER_GAUGES if k in t}
+        return cur, lats, flats
+
+    def delta(self) -> dict:
+        """The windowed telemetry since the last call; advances the
+        window."""
+        now = time.perf_counter()
+        cur, lats, flats = self._snapshot()
+        prev = self._prev
+        if prev is None:
+            prev = {"engine": {}, "bucket_hits": {},
+                    "factor_bucket_hits": {},
+                    "phases": {ph: {} for ph in SERVE_PHASES},
+                    "health": {}, "tier": {}}
+        dt = max(1e-9, now - self._t_prev)
+        eng = _diff(cur["engine"], prev["engine"], _ENGINE_COUNTERS)
+        eng["pending"] = cur["engine"]["pending"]
+        # queue growth over the window: admissions minus resolutions.
+        # Positive = the backlog is building (arrivals outpace drain)
+        eng["backlog_delta"] = (eng["requests"] - eng["completed"]
+                                - eng["failed"])
+        eng["arrival_per_s"] = eng["requests"] / dt
+        eng["drain_per_s"] = eng["completed"] / dt
+        eng["coalesced_mean"] = (eng["coalesced_requests"] / eng["batches"]
+                                 if eng["batches"] else 0.0)
+        eng["factor_coalesced_mean"] = (
+            eng["factor_coalesced_requests"] / eng["factor_batches"]
+            if eng["factor_batches"] else 0.0)
+        lats.sort()
+        flats.sort()
+        from conflux_tpu.engine import _percentile
+
+        for xs, prefix in ((lats, "latency"), (flats, "factor_latency")):
+            for pct in (50, 95, 99):
+                eng[f"{prefix}_p{pct}_ms"] = 1e3 * _percentile(xs, pct)
+        eng["latency_samples"] = len(lats)
+        eng["factor_latency_samples"] = len(flats)
+        out = {
+            "seconds": dt,
+            "engine": eng,
+            "bucket_hits": _diff(cur["bucket_hits"],
+                                 prev["bucket_hits"]),
+            "factor_bucket_hits": _diff(cur["factor_bucket_hits"],
+                                        prev["factor_bucket_hits"]),
+            "phases": {ph: _diff(cur["phases"][ph],
+                                 prev["phases"].get(ph, {}),
+                                 ("count", "wall_s"))
+                       for ph in SERVE_PHASES},
+            "health": _diff(cur["health"], prev["health"]),
+            "tier": _diff(cur["tier"], prev["tier"]),
+            "tier_gauges": cur.get("tier_gauges", {}),
+        }
+        self._prev = cur
+        self._t_prev = now
+        return out
+
+
+# --------------------------------------------------------------------------- #
 # Device-side per-phase timing (the reference's per-step semiprof table)
 # --------------------------------------------------------------------------- #
 

@@ -128,6 +128,14 @@ class _CompileOnce:
             self._warm = True
         return out
 
+    @property
+    def warm(self) -> bool:
+        """True once the first call completed — the program is traced
+        and cached, so later calls are dispatch-only. The adaptive
+        controller's prewarm gate (`FactorPlan.bucket_ready`) reads
+        this: a knob move may only route traffic onto warm buckets."""
+        return self._warm
+
 
 def clear_plans() -> None:
     """Drop every cached plan (tests; frees the jitted closures)."""
@@ -272,6 +280,77 @@ class FactorPlan:
                 plan = cls(key)
                 _PLANS[key] = plan
         return plan
+
+    # ------------------------------------------------------------------ #
+    # bucket lifecycle (the adaptive controller's actuation surface)
+    # ------------------------------------------------------------------ #
+
+    def bucket_ready(self, *, width: int | None = None,
+                     factor_batch: int | None = None,
+                     checked: bool = False) -> bool:
+        """True when the named bucket's program is built AND warm (first
+        call completed — traced, cached, dispatch-only from here on).
+
+        The prewarm-before-switch gate: `conflux_tpu.control.
+        AdaptiveController` grows an engine's active bucket set by
+        prewarming the target bucket on a background thread and only
+        actuating the knob once this reports True, so a knob move can
+        never put a compile stall on the serving path. `checked` asks
+        about the health-guarded program variant (what an engine with
+        ``check_output`` dispatches)."""
+        if width is not None:
+            key = ("health", int(width)) if checked else int(width)
+            fn = self._solve_cache.get(key)
+            if fn is None or not fn.warm:
+                return False
+        if factor_batch is not None:
+            key = (("factor_health", int(factor_batch)) if checked
+                   else ("factor", int(factor_batch)))
+            fn = self._factor_cache.get(key)
+            if fn is None or not fn.warm:
+                return False
+        return width is not None or factor_batch is not None
+
+    def release_buckets(self, widths=(), factor_batches=()) -> int:
+        """Drop retired bucket programs from the plan's caches — the
+        reverse of prewarming, so a bucket set that grew under a traffic
+        peak does not pin dead compiled programs (and their jitted
+        closures) forever. `widths` drops each RHS bucket's plain,
+        checked, refine, and stacked solve programs from `_solve_cache`;
+        `factor_batches` drops the stacked cold-start programs (plain +
+        checked) from `_factor_cache`. Non-bucket entries — the probe
+        program, the Woodbury update programs — are never touched, and
+        factor bucket 1 is refused outright: ``plan.factor`` itself
+        rides it. Returns the number of cache entries dropped.
+
+        A released bucket is not forbidden, just cold: traffic touching
+        it again rebuilds and re-TRACES the program (`trace_counts`
+        grow), which is exactly why the adaptive controller retires only
+        buckets with a long zero-hit history and the zero-compile
+        steady-state contract is stated over the ACTIVE bucket set. A
+        dispatcher holding a wrapper it fetched before the release keeps
+        using it safely — release only unlinks the cache entry."""
+        dropped = 0
+        with self._compile_lock:
+            for w in widths:
+                wb = int(w)
+                keys = [wb, ("health", wb), ("refine", wb)]
+                keys += [k for k in self._solve_cache
+                         if isinstance(k, tuple) and len(k) == 3
+                         and k[0] == "stacked" and k[2] == wb]
+                for key in keys:
+                    dropped += self._solve_cache.pop(key, None) is not None
+            for bb in factor_batches:
+                bb = int(bb)
+                if bb == 1:
+                    raise ValueError(
+                        "factor bucket 1 is the plan.factor/refactor "
+                        "path itself (FactorPlan._factor_once) — it is "
+                        "not a retirable coalescing bucket")
+                for key in (("factor", bb), ("factor_health", bb)):
+                    dropped += (self._factor_cache.pop(key, None)
+                                is not None)
+        return dropped
 
     # ------------------------------------------------------------------ #
     # program builders

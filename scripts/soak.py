@@ -379,6 +379,156 @@ def run_serve_trial(seed: int) -> tuple[bool, str]:
                   f"evictions={h['evictions']}")
 
 
+def run_adaptive_trial(seed: int) -> tuple[bool, str]:
+    """One chaos trial of the serving stack WITH the adaptive
+    controller in the loop (ISSUE 8).
+
+    The run_serve_trial shape — fleet of (possibly drifted) sessions,
+    sampled FaultPlan, mixed clean/poisoned/expired traffic — plus an
+    `AdaptiveController` ticking fast (10ms) against a random SLO while
+    the faults fire, with a traffic profile that shifts mid-trial
+    (quiet dribble, then a tight burst) so the knobs actually move.
+    Extra invariants on top of the serve-trial ones: the controller
+    never errors a tick; every knob it leaves behind is inside its
+    declared `ControlLimits` envelope; if any guard tripped, the engine
+    is back at full guarding (strict policy, staging stride 1 — the
+    instant-restore contract); and close() stops the controller
+    thread."""
+    import jax.numpy as jnp
+
+    from conflux_tpu import resilience, serve
+    from conflux_tpu.control import AdaptiveController, ControlLimits
+    from conflux_tpu.engine import EngineSaturated, ServeEngine
+    from conflux_tpu.resilience import (
+        DeadlineExceeded,
+        FaultPlan,
+        FaultSpec,
+        HealthPolicy,
+        InjectedFault,
+        RhsNonFinite,
+        SessionQuarantined,
+        SolveUnhealthy,
+    )
+
+    rng = np.random.default_rng(seed)
+    serve.clear_plans()
+    N = int(rng.choice([32, 64]))
+    S = int(rng.integers(1, 4))
+    plan = serve.FactorPlan.create((N, N), jnp.float32, v=16)
+    As, sessions = [], []
+    for _ in range(S):
+        A = (rng.standard_normal((N, N)) / np.sqrt(N)
+             + 2.0 * np.eye(N)).astype(np.float32)
+        sess = plan.factor(jnp.asarray(A))
+        if rng.integers(2):
+            k = int(rng.integers(1, 4))
+            U = (0.01 * rng.standard_normal((N, k))).astype(np.float32)
+            Vm = (0.01 * rng.standard_normal((N, k))).astype(np.float32)
+            sess.update(U, Vm)
+            A = A + U @ Vm.T
+        As.append(A.astype(np.float64))
+        sessions.append(sess)
+    menu = [
+        FaultSpec("staging", "nan", prob=0.3,
+                  count=int(rng.integers(1, 4))),
+        FaultSpec("dispatch", "delay", prob=0.3, delay_s=0.002, count=3),
+        FaultSpec("drain", "crash", prob=0.5, count=1),
+        FaultSpec("d2h", "delay", prob=0.3, delay_s=0.002, count=3),
+        FaultSpec("solve", "unhealthy", prob=0.4,
+                  count=int(rng.integers(1, 3))),
+    ]
+    picks = [m for m in menu if rng.integers(2)]
+    faults = FaultPlan(picks, seed=seed)
+    limits = ControlLimits(max_batch_delay=0.008, min_pending=8,
+                           max_pending=256, max_coalesce_width=16)
+    ctl = AdaptiveController(
+        slo_p99_ms=float(rng.choice([10.0, 25.0, 50.0])),
+        interval=0.01, limits=limits,
+        grow_after=1, relax_health_after=2, retire_after=10**6)
+    label = (f"seed={seed} adaptive N={N} S={S} slo={ctl.slo_p99_ms:g} "
+             f"faults={[(f.site, f.kind) for f in picks]}")
+    strict = HealthPolicy(quarantine_after=3, quarantine_cooldown=0.05)
+    eng = ServeEngine(
+        max_batch_delay=float(rng.choice([0.0, 0.002])),
+        max_pending=64, max_coalesce_width=8,
+        health=strict, fault_plan=faults,
+        watchdog_interval=0.05, controller=ctl)
+    reqs = []
+    try:
+        for i in range(36):
+            si = int(rng.integers(S))
+            w = int(rng.choice([1, 1, 2, 3]))
+            b = rng.standard_normal((N, w)).astype(np.float32)
+            kind = int(rng.integers(8))
+            deadline = None
+            if kind == 0:
+                b[int(rng.integers(N)), 0] = np.nan
+            elif kind == 1:
+                deadline = 0.0
+            try:
+                fut = eng.submit(sessions[si], b, deadline=deadline)
+            except (RhsNonFinite, SessionQuarantined, EngineSaturated):
+                continue
+            reqs.append((si, b, fut))
+            if i < 12:
+                time.sleep(0.002)  # quiet dribble...
+            # ...then the burst half: submit as fast as the loop runs,
+            # so the controller sees the regime shift mid-faults
+        wedged = eng.close(timeout=120)
+        if wedged:
+            return False, f"{label}: close() wedged {wedged}"
+    finally:
+        eng.close(timeout=10)
+    if ctl._thread is not None and ctl._thread.is_alive():
+        return False, f"{label}: close() left the controller running"
+    cst = ctl.stats()
+    if cst["errors"]:
+        return False, f"{label}: {cst['errors']} controller tick errors"
+    knobs = eng.knobs()
+    if not (limits.min_batch_delay <= knobs["max_batch_delay"]
+            <= limits.max_batch_delay):
+        return False, f"{label}: max_batch_delay escaped its limits"
+    if knobs["max_pending"] > limits.max_pending \
+            or knobs["max_pending"] < min(limits.min_pending, 64):
+        return False, f"{label}: max_pending escaped its limits"
+    if knobs["max_coalesce_width"] > limits.max_coalesce_width:
+        return False, f"{label}: max_coalesce_width escaped its limits"
+    h = resilience.health_stats()
+    tripped = any(h.get(k, 0) for k in
+                  ("rhs_rejects", "staging_isolations", "output_failures",
+                   "factor_isolations"))
+    if tripped and (eng.health is not strict or eng._staging_stride != 1):
+        return False, (f"{label}: guards tripped but full guarding was "
+                       "not restored (instant-restore contract)")
+    ok_exc = (RhsNonFinite, DeadlineExceeded, SolveUnhealthy,
+              SessionQuarantined, InjectedFault)
+    answered = 0
+    for si, b, fut in reqs:
+        if not fut.done():
+            return False, f"{label}: close() left a future unresolved"
+        try:
+            x = np.asarray(fut.result(0))
+        except ok_exc:
+            continue
+        except Exception as e:  # noqa: BLE001 — any other leak is a bug
+            return False, (f"{label}: UNSTRUCTURED "
+                           f"{type(e).__name__}: {e}")
+        want = np.linalg.solve(As[si], b.astype(np.float64))
+        err = (np.linalg.norm(x - want)
+               / max(np.linalg.norm(want), 1e-30))
+        if not (err < 1e-3):
+            return False, f"{label}: answer off oracle ({err:.2e})"
+        answered += 1
+    stats = eng.stats()
+    if stats["pending"] != 0:
+        return False, f"{label}: {stats['pending']} pending slots leaked"
+    if stats["completed"] + stats["failed"] != stats["requests"]:
+        return False, f"{label}: counters incoherent"
+    return True, (f"{label}: ok {answered}/{len(reqs)} answered, "
+                  f"ticks={cst['ticks']}, decisions={cst['decisions']}, "
+                  f"injected={sum(faults.injected.values())}")
+
+
 def run_tier_trial(seed: int) -> tuple[bool, str]:
     """One chaos trial of the tiered-residency layer (ISSUE 7).
 
@@ -549,6 +699,15 @@ def main(argv=None) -> int:
     ap.add_argument("--serve", action="store_true",
                     help="chaos-soak the serving stack (engine + "
                     "resilience layer) instead of the factor cores")
+    ap.add_argument("--adaptive", action="store_true",
+                    help="chaos-soak the serving stack WITH the "
+                    "AdaptiveController in the loop: fast control "
+                    "ticks against a random SLO while faults fire and "
+                    "the traffic shifts; asserts the serve invariants "
+                    "plus controller-specific ones (zero tick errors, "
+                    "knobs inside their ControlLimits envelope, "
+                    "instant guard restore after any trip, controller "
+                    "stops with close())")
     ap.add_argument("--tier", action="store_true",
                     help="chaos-soak the tiered-residency layer: Zipf "
                     "traffic over a fleet >> device capacity with the "
@@ -565,6 +724,7 @@ def main(argv=None) -> int:
     args = ap.parse_args(argv)
 
     trial = (run_tier_trial if args.tier
+             else run_adaptive_trial if args.adaptive
              else run_serve_trial if args.serve else run_trial)
 
     import contextlib
