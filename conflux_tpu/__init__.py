@@ -104,6 +104,11 @@ def __getattr__(name):
         "AdaptiveController": ("conflux_tpu.control", "AdaptiveController"),
         "ControlLimits": ("conflux_tpu.control", "ControlLimits"),
         "StatsWindow": ("conflux_tpu.profiler", "StatsWindow"),
+        # mesh-sharded serve fleet (ISSUE 9)
+        "DeviceLane": ("conflux_tpu.engine", "DeviceLane"),
+        "place_session": ("conflux_tpu.engine", "place_session"),
+        "MeshPlanUnsupported": (
+            "conflux_tpu.resilience", "MeshPlanUnsupported"),
     }
     if name in _lazy:
         import importlib
@@ -179,4 +184,7 @@ __all__ = [
     "AdaptiveController",
     "ControlLimits",
     "StatsWindow",
+    "DeviceLane",
+    "place_session",
+    "MeshPlanUnsupported",
 ]

@@ -91,6 +91,32 @@ def _pad_batch(arrs, B: int, nshards: int, fill: str = "first"):
     return out, Bp
 
 
+def put_tree(tree, device):
+    """`jax.device_put` a pytree onto `device`, preserving leaf ALIASING:
+    leaves that are the same buffer in (arrive as one object) leave as
+    one object on the target device. A plain tree-mapped device_put
+    copies an aliased leaf once per appearance — a `SolveSession` whose
+    `_A` IS its `_A0` would come back holding two device buffers, and
+    the session's identity-deduplicated `nbytes` accounting (and the
+    tier manager's byte caps built on it) would double-count the base.
+    `device=None` is the identity (no transfer, no copy) — the
+    single-lane engine's path stays byte-for-byte untouched."""
+    if device is None:
+        return tree
+    seen: dict[int, object] = {}
+
+    def _put(leaf):
+        if leaf is None:
+            return None
+        got = seen.get(id(leaf))
+        if got is None:
+            got = jax.device_put(leaf, device)
+            seen[id(leaf)] = got
+        return got
+
+    return jax.tree_util.tree_map(_put, tree)
+
+
 def stack_trees(trees):
     """Stack identical-structure pytrees along a new leading axis.
 

@@ -226,6 +226,11 @@ class AdaptiveController:
         # in-flight background prewarm: (target_bucket, Thread) or None
         self._width_prewarm = None      # guarded-by: _lock
         self._fbatch_prewarm = None     # guarded-by: _lock
+        # per-lane delay tuning state (multi-lane engines, DESIGN §25):
+        # the previous tick's per-lane counter rows and each lane's
+        # debounced widen-pressure count
+        self._lane_prev: dict = {}      # guarded-by: _lock
+        self._lane_widen: dict = {}     # guarded-by: _lock
 
         self._stop = threading.Event()
         self._thread: threading.Thread | None = None
@@ -305,6 +310,7 @@ class AdaptiveController:
         self._decide_drain_rate(eng, d, e)
         self._decide_pending(eng, d, e)
         self._decide_delay(eng, d, e)
+        self._decide_lane_delays(eng, d, e)
         self._decide_widths(eng, d, e)
         self._decide_factor_batches(eng, d, e)
         self._decide_health(eng, d, e)
@@ -417,6 +423,65 @@ class AdaptiveController:
                 self._record("max_batch_delay", cur, new,
                              "light solo traffic — the window is pure "
                              "added latency; decay")
+
+    # -- per-lane batch-delay trim (mesh-sharded fleets, DESIGN §25) ---- #
+
+    def _decide_lane_delays(self, eng, d, e) -> None:
+        """Tune each lane's coalescing window INDEPENDENTLY on a
+        multi-lane engine: the fleet's devices see different traffic
+        (hot sessions pin to one lane), so the engine-wide window that
+        `_decide_delay` hill-climbs is only the default — a lane whose
+        own dispatches stay narrow while ITS queue builds widens its
+        override (debounced two windows, like the global climb), and a
+        lane coalescing fine on solo traffic decays back toward the
+        engine-wide value. Writes ride the same `set_knobs` rails
+        (`lane=` scope), inside the same `ControlLimits` envelope."""
+        lanes = eng.counters().get("lanes", ())
+        if len(lanes) < 2:
+            return
+        lim = self.limits
+        base = eng.max_batch_delay
+        with self._lock:
+            prev = self._lane_prev
+            self._lane_prev = {ln["lane"]: ln for ln in lanes}
+        for ln in lanes:
+            i = ln["lane"]
+            if ln.get("dead"):
+                continue
+            p = prev.get(i, {})
+            batches = ln["batches"] - p.get("batches", 0)
+            coalesced = (ln["coalesced_requests"]
+                         - p.get("coalesced_requests", 0))
+            mean = coalesced / batches if batches else 0.0
+            depth = ln.get("queue_depth", 0)
+            cur = ln.get("delay", base)
+            under = (batches > 0 and mean < self.coalesce_target
+                     and depth > 1)
+            with self._lock:
+                n = self._lane_widen.get(i, 0) + 1 if under else 0
+                self._lane_widen[i] = n
+            if n >= 2:
+                new = min(lim.max_batch_delay,
+                          max(cur * self.delay_grow,
+                              self.delay_floor_step))
+                if new > cur:
+                    eng.set_knobs(lane=i, max_batch_delay=new)
+                    self._record(
+                        f"lane{i}.max_batch_delay", cur, new,
+                        f"lane {i} coalesced mean {mean:.1f} < "
+                        f"{self.coalesce_target:g} with queue depth "
+                        f"{depth} — widen this lane only")
+                continue
+            if (batches > 0 and depth == 0 and mean <= 1.5
+                    and cur > base):
+                # solo traffic on an over-widened lane: decay its
+                # override toward the engine-wide default
+                new = max(base, cur * self.delay_shrink)
+                eng.set_knobs(lane=i, max_batch_delay=new)
+                self._record(
+                    f"lane{i}.max_batch_delay", cur, new,
+                    f"lane {i} light solo traffic — decay toward the "
+                    f"engine-wide window {base * 1e3:.1f}ms")
 
     # -- bucket growth (prewarm-gated) + retirement --------------------- #
 

@@ -100,6 +100,7 @@ import dataclasses
 import threading
 import time
 import weakref
+import zlib
 from collections import deque
 from concurrent.futures import Future
 from queue import Empty, Full, Queue
@@ -111,16 +112,34 @@ import jax
 import jax.numpy as jnp
 
 from conflux_tpu import profiler, resilience
-from conflux_tpu.batched import _shard_batch, stack_trees, unstack_tree
+from conflux_tpu.batched import _shard_batch, put_tree, stack_trees, \
+    unstack_tree
 from conflux_tpu.resilience import (
     DeadlineExceeded,
     HealthPolicy,
+    MeshPlanUnsupported,
     RhsNonFinite,
     SessionQuarantined,
     SolveUnhealthy,
 )
 from conflux_tpu.serve import FactorPlan, SolveSession
 from conflux_tpu.update import rank_bucket
+
+
+def _devkey(device):
+    """Hashable identity of a jax device (None = the default device).
+    Keys the per-device program-warmth registry
+    (`FactorPlan._warm_devices`) and the engine's device→lane map."""
+    return None if device is None else (device.platform, device.id)
+
+
+def place_session(sid, devices):
+    """Deterministic consistent placement: map a stable session id onto
+    one of `devices` by CRC32 hash. Equal sids land on equal devices for
+    any fixed device list — across engines, and across process restarts
+    (the warm-restart path re-pins a restored fleet identically). The
+    mesh-sharded serve fleet's placement function (DESIGN §25)."""
+    return devices[zlib.crc32(str(sid).encode()) % len(devices)]
 
 
 class EngineSaturated(RuntimeError):
@@ -149,6 +168,7 @@ class _Request:
     t_submit: float       # perf_counter at admission (latency clock)
     expiry: float | None = None  # perf_counter deadline (lazy eviction)
     carried: bool = False  # deferred once already — never defer again
+    lane: Any = None      # the DeviceLane that owns this request
 
     __hash__ = object.__hash__
 
@@ -167,6 +187,10 @@ class _FactorRequest:
     t_submit: float       # perf_counter at admission (latency clock)
     expiry: float | None = None  # perf_counter deadline (lazy eviction)
     carried: bool = False  # deferred once already — never defer again
+    lane: Any = None      # owning lane (None while in the shared pool)
+    pool: bool = False    # admitted into the work-stealing factor pool
+    sid: Any = None       # stable session id for the opened session
+    device: Any = None    # explicit device pin for the opened session
 
     __hash__ = object.__hash__
 
@@ -217,6 +241,9 @@ def _normalize_rhs(session, b):
 
 
 _STOP = object()
+# a lane nudge: "run a dispatch window, there may be pooled factor work"
+# — carries no request itself (multi-lane cold-start load balancing)
+_WAKE = object()
 
 
 def _percentile(sorted_vals, pct: float) -> float:
@@ -226,6 +253,997 @@ def _percentile(sorted_vals, pct: float) -> float:
     idx = min(len(sorted_vals) - 1,
               max(0, int(round(pct / 100.0 * len(sorted_vals) + 0.5)) - 1))
     return sorted_vals[idx]
+
+
+class DeviceLane:
+    """One device's worth of the serve engine: a dispatcher/drain pair,
+    their staging buffers and bucket carry-over, and per-lane telemetry
+    — the unit the mesh-sharded fleet scales by (DESIGN §25).
+
+    A :class:`ServeEngine` owns one lane per serving device, all behind
+    ONE admission front: the engine keeps the bounded pending set,
+    deadlines, health guards, knobs, and the resolution-ownership
+    `_live` set; each lane owns its input queue, its 2-deep dispatched-
+    batch handoff queue, its two worker threads, and its small-remainder
+    carry-over. Requests route to the lane that owns their session
+    (pinned at open — consistent hash of the session id over the
+    engine's devices, `device=` overrides); cold-start factorizations
+    load-balance through the engine's shared pool, which any lane with
+    a free dispatch round drains (work-stealing). A single-lane engine
+    (`lanes=1`, the default, or a one-device host) is EXACTLY the
+    pre-fleet engine: `device=None`, no placement, no pool — the same
+    code on the same default device, byte-identical behavior.
+
+    Fault domain: a lane. A poisoned request, a crashed dispatch, or a
+    dead worker thread fails only work routed to its lane; the per-lane
+    watchdog respawns dead lane workers (`lane_revives` budget) while
+    the other lanes keep serving. Shared engine state (counters,
+    admission) is touched only under the ENGINE's admission lock —
+    lane-local counters ride the same lock; `busy_*_s` gauges are
+    single-writer by construction (each written only by its own worker
+    thread) and read racily by design."""
+
+    def __init__(self, eng: "ServeEngine", index: int, device):
+        self.eng = eng
+        self.index = index
+        self.device = device  # jax.Device, or None = default device
+        # per-lane coalescing window override (the adaptive controller's
+        # per-lane knob; None = the engine-wide max_batch_delay)
+        self.delay_override: float | None = None
+        self._inq: Queue = Queue()
+        # bounded at 2: the double buffer (see ServeEngine.__init__)
+        self._outq: Queue = Queue(maxsize=2)
+        # per-lane telemetry — written under the ENGINE lock next to the
+        # engine-wide counters (cross-object, so annotated in prose):
+        self.batches = 0
+        self.coalesced = 0
+        self.bucket_hits: dict = {}
+        self.factor_batches = 0
+        self.factor_coalesced = 0
+        # queue high-water: monotone max, racy update by design
+        self.queue_hw = 0
+        # single-writer busy gauges (dispatcher / drainer respectively)
+        self.busy_dispatch_s = 0.0
+        self.busy_drain_s = 0.0
+        self.t_start = time.perf_counter()
+        # per-lane fault-domain state: watchdog revival budget spent,
+        # permanently-dead flag (admission routes around a dead lane),
+        # (thread name, exc) post-mortem — write-once by the dying
+        # worker, racy reads tolerate staleness by design
+        self.revives = 0
+        self.dead = False
+        self._dead: tuple | None = None
+        # serializes concurrent trips (dying thread + watchdog poll)
+        self._trip_lock = threading.Lock()
+        self._dispatcher: threading.Thread | None = None
+        self._drainer: threading.Thread | None = None
+
+    @property
+    def delay(self) -> float:
+        """This lane's coalescing window: its own override when the
+        controller set one (`ServeEngine.set_knobs(lane=...)`), else
+        the engine-wide `max_batch_delay`."""
+        d = self.delay_override
+        return self.eng.max_batch_delay if d is None else d
+
+    def _tname(self, role: str) -> str:
+        """Worker thread name: the pre-fleet names on a single-lane
+        engine (ops tooling and tests key on them), lane-suffixed on a
+        fleet."""
+        if len(self.eng._lanes) == 1:
+            return f"serve-engine-{role}"
+        return f"serve-engine-{role}-L{self.index}"
+
+    def start(self) -> None:
+        self._dispatcher = threading.Thread(
+            target=self._dispatch_loop,
+            name=self._tname("dispatch"), daemon=True)
+        self._drainer = threading.Thread(
+            target=self._drain_loop,
+            name=self._tname("drain"), daemon=True)
+        self._dispatcher.start()
+        self._drainer.start()
+
+    def revive(self, exclude=None) -> None:
+        """Respawn this lane's dead worker threads — the per-lane
+        watchdog's recovery action. The queues and carry state survive;
+        requests the trip already failed are no longer in the engine's
+        `_live` set, so a late re-dispatch of one resolves nothing
+        (resolution ownership). `exclude` is the currently-dying thread
+        (alive while it runs its own post-mortem, but done the moment
+        it returns — replace it too)."""
+        self._dead = None
+        if self._dispatcher is None or not self._dispatcher.is_alive() \
+                or self._dispatcher is exclude:
+            self._dispatcher = threading.Thread(
+                target=self._dispatch_loop,
+                name=self._tname("dispatch"), daemon=True)
+            self._dispatcher.start()
+        if self._drainer is None or not self._drainer.is_alive() \
+                or self._drainer is exclude:
+            self._drainer = threading.Thread(
+                target=self._drain_loop,
+                name=self._tname("drain"), daemon=True)
+            self._drainer.start()
+        self.revives += 1
+
+    def _to_device(self, host_buf):
+        """Commit a host-staged buffer to this lane's device — the ONE
+        h2d per coalesced batch. The default-device lane keeps the
+        pre-fleet `jnp.asarray` byte-for-byte."""
+        if self.device is None:
+            return jnp.asarray(host_buf)
+        return jax.device_put(host_buf, self.device)
+
+    # ------------------------------------------------------------------ #
+    # dispatcher: collect a window, coalesce, dispatch async
+    # ------------------------------------------------------------------ #
+
+    # futures-owner (post-mortem wrapper: escapes reach _thread_died)
+    def _dispatch_loop(self) -> None:
+        try:
+            self._dispatch_inner()
+        except BaseException as e:  # noqa: BLE001 — post-mortem + watchdog
+            self._thread_died(threading.current_thread(), e)
+
+    def _thread_died(self, thread, exc: BaseException) -> None:
+        """Post-mortem hook run ON the dying worker thread: record the
+        cause and trip the watchdog path immediately (the polling
+        watchdog is the backstop for silent deaths). Single-lane
+        engines trip the whole engine — exactly the pre-fleet
+        behavior; multi-lane engines trip only this lane."""
+        self.eng._lane_died(self, thread, exc)
+
+    def _wait_bound(self, reqs, remaining: float) -> float:
+        """Cap a collect wait at the soonest request deadline, so lazy
+        eviction runs when a deadline passes mid-window instead of after
+        the whole `max_batch_delay` (or a blocked slot's whole wait)."""
+        exps = [r.expiry for r in reqs if r.expiry is not None]
+        if not exps:
+            return remaining
+        return min(remaining,
+                   max(0.0, min(exps) - time.perf_counter()) + 1e-4)
+
+    def _prune_expired(self, reqs) -> list:
+        """Lazy deadline eviction: fail expired requests with
+        :class:`DeadlineExceeded` (releasing their pending slots — this
+        is what un-wedges an `on_full='block'` submitter whose queue is
+        full of abandoned work) and return the survivors."""
+        now = time.perf_counter()
+        live = []
+        for r in reqs:
+            if r.expiry is not None and now > r.expiry:
+                resilience.bump("evictions")
+                self.eng._fail([r], DeadlineExceeded(
+                    f"deadline passed {now - r.expiry:.3f}s before "
+                    "dispatch (lazily evicted; pending slot released)"))
+            else:
+                live.append(r)
+        return live
+
+    # hot-path, futures-owner (the dispatcher loop)
+    def _dispatch_inner(self) -> None:
+        eng = self.eng
+        stop = False
+        carry: list = []  # small remainder chunks deferred to this round
+        while not stop:
+            if carry:
+                try:
+                    first = self._inq.get(
+                        timeout=self._wait_bound(carry, self.delay))
+                except Empty:
+                    first = None  # window spent waiting on the carry
+            else:
+                first = self._inq.get()
+            batch = list(carry)
+            carry = []
+            collect = True
+            if first is _STOP:
+                stop = True
+                collect = False
+            elif first is None:
+                collect = False
+            elif first is not _WAKE:
+                batch.append(first)
+            if collect:
+                deadline = time.perf_counter() + self.delay
+                while True:
+                    batch = self._prune_expired(batch)
+                    remaining = deadline - time.perf_counter()
+                    if remaining <= 0:
+                        # the window is over, but anything ALREADY queued
+                        # still coalesces (the burst shape: a backlog
+                        # should never dispatch one request at a time)
+                        try:
+                            r = self._inq.get_nowait()
+                        except Empty:
+                            break
+                    else:
+                        try:
+                            r = self._inq.get(
+                                timeout=self._wait_bound(batch, remaining))
+                        except Empty:
+                            # the wait may have been truncated by a batch
+                            # member's deadline — loop: prune, recompute,
+                            # and let the remaining<=0 path end the window
+                            continue
+                    if r is _STOP:
+                        stop = True
+                        break
+                    if r is _WAKE:
+                        continue  # pooled work is drawn at dispatch time
+                    batch.append(r)
+                    if len(batch) >= eng.max_pending:
+                        break
+            if batch:
+                batch = self._prune_expired(batch)
+            if batch or eng._pool_pending():
+                try:
+                    resilience.maybe_fault(eng._faults, "dispatch")
+                    t0 = time.perf_counter()
+                    carry = self._dispatch(
+                        batch,
+                        may_defer=not stop and not self._inq.empty())
+                    self.busy_dispatch_s += time.perf_counter() - t0
+                except Exception as e:  # noqa: BLE001 — engine survives
+                    eng._fail(batch, e)
+        # close-time drain: the carry AND any still-pooled cold starts
+        # are answered, not dropped (every lane races to empty the pool;
+        # each pooled request is popped exactly once)
+        tail = self._prune_expired(carry) + eng._pool_draw(None)
+        if tail:
+            self._dispatch(tail, may_defer=False)
+        self._outq.put(_STOP)
+
+    # hot-path, futures-owner
+    def _dispatch(self, batch, may_defer: bool = False) -> list:
+        """Group a window's requests and dispatch each group as one
+        device program (async — nothing here blocks on device work).
+        With `may_defer` (more traffic already queued), each session's
+        small remainder chunk is handed back once to ride the next
+        window instead of wasting a whole dispatch on a sliver. Factor
+        requests ride the same window: lane-pinned ones arrive in the
+        batch, and on multi-lane engines each dispatch round also DRAWS
+        from the engine's shared cold-start pool (up to one batch
+        bucket per round, so a fast lane cannot vacuum the backlog
+        while another idles — this is the work-stealing half of the
+        factor lane's load balancing). They group per PLAN and coalesce
+        into stacked factor dispatches."""
+        eng = self.eng
+        freqs = [r for r in batch if isinstance(r, _FactorRequest)]
+        if len(eng._lanes) > 1:
+            freqs += eng._pool_draw(eng.max_factor_batch)
+        deferred: list = []
+        if freqs:
+            deferred += self._dispatch_factors(freqs, may_defer)
+            batch = [r for r in batch if not isinstance(r, _FactorRequest)]
+        groups: dict[int, list[_Request]] = {}
+        order = []
+        for r in batch:
+            key = id(r.session)
+            if key not in groups:
+                groups[key] = []
+                order.append(r.session)
+            groups[key].append(r)
+        stackable: dict[int, list] = {}
+        plan_order = []
+        for session in order:
+            reqs = groups[id(session)]
+            if (eng.stack_sessions and not session.plan.batched
+                    and session._upd is None):
+                pk = id(session.plan)
+                if pk not in stackable:
+                    stackable[pk] = []
+                    plan_order.append(session.plan)
+                stackable[pk].append((session, reqs))
+            else:
+                deferred += self._dispatch_session(session, reqs,
+                                                   may_defer)
+        for plan in plan_order:
+            entries = stackable[id(plan)]
+            if len(entries) == 1:
+                deferred += self._dispatch_session(*entries[0], may_defer)
+            else:
+                self._dispatch_stacked(plan, entries)
+        if len(eng._lanes) > 1 and eng._pool_pending() \
+                and not self.dead:
+            # backlog left after this round's draw: keep draining it
+            # through THIS lane (burst locality — each device's stream
+            # executes serially, so consecutive buckets on one device
+            # run back-to-back instead of N devices crunching O(N^3)
+            # batches concurrently and thrashing a small core count;
+            # measured 20% of churn throughput on the 1-core runner).
+            # Lanes serving their own traffic still steal: every
+            # dispatch round draws the pool.
+            with eng._lock:
+                eng._pool_waked = True
+            self._inq.put(_WAKE)
+        return deferred
+
+    # hot-path
+    def _dispatch_session(self, session, reqs,
+                          may_defer: bool = False) -> list:
+        """Per-session coalescing: concatenate RHS columns up to the
+        width cap and run each chunk through `session.solve` (which
+        already buckets, pads, shards, and counts). Returns the deferred
+        remainder (at most one small chunk, each request deferred at most
+        once — the latency cost is bounded by one extra window)."""
+        eng = self.eng
+        chunks: list[list[_Request]] = []
+        chunk: list[_Request] = []
+        width = 0
+        for r in reqs:
+            if chunk and width + r.width > eng.max_coalesce_width:
+                chunks.append(chunk)
+                chunk, width = [], 0
+                with eng._lock:
+                    # the width cap split a window's chunk: the
+                    # controller's grow-the-bucket-set pressure signal
+                    eng._width_capped += 1
+            chunk.append(r)
+            width += r.width
+        deferred: list = []
+        if chunk:
+            if (may_defer and width <= eng.max_coalesce_width // 2
+                    and not any(r.carried for r in chunk)):
+                for r in chunk:
+                    r.carried = True
+                deferred = chunk
+            else:
+                chunks.append(chunk)
+        for c in chunks:
+            self._run_chunk(session, c)
+        return deferred
+
+    # hot-path
+    def _admit_stage(self, reqs) -> list:
+        """Pre-staging admission on the dispatch path: lazy deadline
+        eviction and the 'staging' fault site (poisons the request's OWN
+        host copy, upstream of the guard — exactly what a corrupted
+        staging write looks like)."""
+        eng = self.eng
+        reqs = self._prune_expired(reqs)
+        if eng._faults is not None or resilience.active_faults():
+            for r in reqs:
+                if resilience.data_fault(eng._faults, "staging",
+                                         "nan") is not None:
+                    # conflint: disable=CFX-HOSTSYNC fault-injection copy of host-staged numpy
+                    poisoned = np.array(r.b2, copy=True)
+                    poisoned[..., 0] = np.nan
+                    r.b2 = poisoned
+        return reqs
+
+    # hot-path, futures-owner
+    def _isolate_poisoned(self, reqs) -> list:
+        """The SECOND finite guard (staging): one summation over the
+        coalesced buffer answers 'is anything poisoned?' per BATCH; only
+        on suspicion does the per-request scan run to fail the culprits
+        alone. Requests poisoned after submit-time admission (or by an
+        injected fault) therefore never reach the device, and the
+        co-batched answers stay exactly what they would have been."""
+        eng = self.eng
+        live = []
+        for r in reqs:
+            if resilience.rhs_finite(r.b2):
+                live.append(r)
+                continue
+            resilience.bump("staging_isolations")
+            eng._restore_guards()
+            eng._fail([r], RhsNonFinite(
+                "rhs went non-finite after admission — isolated at "
+                "staging (co-batched requests unaffected)"))
+        return live
+
+    # hot-path (numpy staging IS the point: one h2d per batch)
+    def _stage(self, reqs):
+        """Host-stage a session chunk: memcpy every request's columns
+        into ONE bucket-width buffer (zero-padded — exactly the padding
+        `SolveSession.solve` would add, so answers stay bitwise). A numpy
+        buffer keeps staging off the device and, crucially, off the
+        compiler: the device sees one transfer of one already-bucketed
+        shape, never a fresh concatenate signature. Returns (buf, spec)
+        with spec the (request, stack-slot, column-offset) scatter plan
+        for the drain thread."""
+        W = sum(r.width for r in reqs)
+        wb = rank_bucket(W)
+        lead = reqs[0].b2.shape[:-1]
+        buf = np.zeros(lead + (wb,), reqs[0].b2.dtype)
+        spec = []
+        lo = 0
+        for r in reqs:
+            buf[..., lo:lo + r.width] = r.b2
+            spec.append((r, None, lo))
+            lo += r.width
+        return buf, spec
+
+    # hot-path
+    def _revive_for(self, session, reqs) -> None:
+        """Deadline-aware fault-in ahead of a dispatch to a spilled
+        session (DESIGN §23): the revive-lane wait is capped at the
+        requests' soonest deadline (else `revive_wait`), so a request
+        expiring mid-revival fails with `DeadlineExceeded`/
+        `SessionSpilled` through the usual survivor machinery — its
+        admission slot released, the session left FULLY spilled with
+        its record intact — instead of wedging the dispatcher. The
+        resident fast path costs two attribute reads."""
+        rs = getattr(session, "_residency", None)
+        # racy fast-path read by design: fault_in re-checks under the
+        # session lock, and a session cannot spill mid-dispatch (the
+        # manager needs the session lock we are about to take)
+        if rs is None or session._spill is None:
+            return
+        timeout = self.eng.revive_wait
+        exps = [r.expiry for r in reqs if r.expiry is not None]
+        if exps:
+            timeout = max(0.0, min(exps) - time.perf_counter())
+        rs.fault_in(session, timeout=timeout)
+
+    # hot-path
+    def _solve_session(self, session, buf):
+        """One dispatch through the session, checked when the policy
+        says so. Holds the session lock so a drain-thread escalation
+        (factor swap) is atomic against this dispatcher."""
+        eng = self.eng
+        with session._lock:
+            if eng.health is not None and eng.health.check_output:
+                return session.solve_checked(buf)
+            return session.solve(buf), None
+
+    # hot-path, futures-owner
+    def _run_chunk(self, session, reqs, solo: bool = False) -> None:
+        eng = self.eng
+        reqs = self._admit_stage(reqs)
+        if not reqs:
+            return
+        try:
+            buf, spec = self._stage(reqs)
+            if (eng.health is not None and eng.health.check_rhs
+                    and not eng.health.check_output
+                    and eng._tick_staging()
+                    and not resilience.rhs_finite(buf)):
+                # no fused output verdict to backstop the staging guard:
+                # one per-BATCH summation here; the per-request scan
+                # runs only on suspicion. (With check_output on, the
+                # device-side finite verdict detects staged poison for
+                # FREE — NaN stays in its own answer column — and the
+                # drain isolates the culprit with the same exact scan,
+                # so the clean path stages without re-reading a byte.)
+                reqs = self._isolate_poisoned(reqs)
+                if not reqs:
+                    return
+                buf, spec = self._stage(reqs)
+            self._revive_for(session, reqs)
+            x, verdict = self._solve_session(session, buf)
+        except Exception as e:  # noqa: BLE001 — engine must survive
+            self._redispatch_survivors(reqs, e, solo)
+            return
+        wb = buf.shape[-1]
+        with eng._lock:
+            eng._batches += 1
+            eng._coalesced_requests += len(reqs)
+            eng._bucket_hits[wb] = eng._bucket_hits.get(wb, 0) + 1
+            eng._active_sessions[id(session)] = weakref.ref(session)
+            self.batches += 1
+            self.coalesced += len(reqs)
+            self.bucket_hits[wb] = self.bucket_hits.get(wb, 0) + 1
+        self._outq.put((spec, x, verdict, buf))
+
+    # futures-owner
+    def _redispatch_survivors(self, reqs, exc, solo: bool = False) -> None:
+        """A batch-attributable failure (dispatch exception, failed d2h
+        copy, unhealthy verdict on a multi-request batch) re-dispatches
+        each member INDIVIDUALLY instead of failing all of them with the
+        same exception: the innocent co-batched requests get their
+        answers; only the actually-sick request fails (possibly after
+        its own escalation ladder). One level deep — a solo request that
+        fails again fails for real."""
+        if solo or len(reqs) == 1:
+            self.eng._fail(reqs, exc)
+            return
+        resilience.bump("survivor_redispatches", len(reqs))
+        for r in reqs:
+            self._run_chunk(r.session, [r], solo=True)
+
+    # ------------------------------------------------------------------ #
+    # the factor lane: coalesced cold-start dispatch
+    # ------------------------------------------------------------------ #
+
+    # hot-path
+    def _dispatch_factors(self, reqs, may_defer: bool = False) -> list:
+        """Per-plan coalescing of factor requests: same-plan requests
+        stack into chunks of up to `max_factor_batch` matrices, each
+        chunk one vmapped batched factor dispatch. Returns the deferred
+        remainder (with `may_defer`, a small trailing chunk rides the
+        next window once instead of wasting a whole bucket on a
+        sliver — the solve lane's carry-over discipline)."""
+        eng = self.eng
+        groups: dict[int, list] = {}
+        order = []
+        for r in reqs:
+            key = id(r.plan)
+            if key not in groups:
+                groups[key] = []
+                order.append(r.plan)
+            groups[key].append(r)
+        deferred: list = []
+        for plan in order:
+            greqs = groups[id(plan)]
+            chunks = [greqs[i:i + eng.max_factor_batch]
+                      for i in range(0, len(greqs), eng.max_factor_batch)]
+            last = chunks[-1]
+            if (may_defer and len(last) <= eng.max_factor_batch // 2
+                    and not any(r.carried for r in last)):
+                for r in last:
+                    r.carried = True
+                deferred += last
+                chunks = chunks[:-1]
+            for c in chunks:
+                self._run_factor_chunk(plan, c)
+        return deferred
+
+    # hot-path
+    def _admit_stage_factor(self, reqs) -> list:
+        """Pre-staging admission for the factor lane: lazy deadline
+        eviction plus the 'factor' nan fault site (poisons the request's
+        OWN host matrix, upstream of the staging guard — a corrupted
+        staging write)."""
+        eng = self.eng
+        reqs = self._prune_expired(reqs)
+        if eng._faults is not None or resilience.active_faults():
+            for r in reqs:
+                if resilience.data_fault(eng._faults, "factor",
+                                         "nan") is not None:
+                    # conflint: disable=CFX-HOSTSYNC fault-injection copy of host-staged numpy
+                    poisoned = np.array(r.A, copy=True)
+                    poisoned[..., 0, 0] = np.nan
+                    r.A = poisoned
+        return reqs
+
+    # hot-path, futures-owner
+    def _isolate_poisoned_A(self, reqs) -> list:
+        """Factor-lane staging guard: a matrix gone non-finite after
+        admission fails its OWN future and is dropped from the staged
+        stack; co-batched factorizations are untouched (the vmapped
+        factor body never mixes slots). One per-batch summation answers
+        'anything poisoned?'; the per-request scan runs only on
+        suspicion."""
+        eng = self.eng
+        live = []
+        for r in reqs:
+            if resilience.rhs_finite(r.A):
+                live.append(r)
+                continue
+            resilience.bump("factor_isolations")
+            eng._restore_guards()
+            eng._fail([r], RhsNonFinite(
+                "matrix went non-finite after admission — isolated at "
+                "staging (co-batched factorizations unaffected)"))
+        return live
+
+    # hot-path (numpy staging: one h2d per factor batch)
+    def _stage_factor(self, plan, reqs):
+        """Host-stage a factor chunk: memcpy every request's matrix into
+        ONE (bucket,)+shape staging buffer — the factor-lane mirror of
+        `_stage`, with `_pad_batch`'s fill='eye' discipline in numpy:
+        pad slots carry identity matrices (well-conditioned by
+        construction, never a copy of a request that might itself be
+        poisoned). The device sees one transfer and one prewarmed
+        program per batch regardless of how many requests coalesced."""
+        bb = rank_bucket(len(reqs))
+        buf = np.empty((bb,) + plan.key.shape, np.dtype(plan.key.dtype))
+        for i, r in enumerate(reqs):
+            buf[i] = r.A
+        if bb != len(reqs):
+            buf[len(reqs):] = np.eye(plan.N, dtype=buf.dtype)
+        return buf
+
+    # hot-path
+    def _run_factor_chunk(self, plan, reqs, solo: bool = False) -> None:
+        fb = self._build_factor_batch(plan, reqs, solo)
+        if fb is not None:
+            self._outq.put(fb)
+
+    # hot-path, futures-owner
+    def _build_factor_batch(self, plan, reqs, solo: bool = False):
+        """Stage and dispatch one coalesced factor chunk (async —
+        nothing blocks on device work here); returns the
+        :class:`_FactorBatch` for the drain thread, or None when every
+        request was already failed/evicted. A batch-attributable
+        exception re-dispatches the members solo (`_redispatch_factor_
+        survivors`), mirroring `_run_chunk`. The staged stack commits to
+        THIS lane's device, so the factor program compiles and runs
+        there and the opened sessions are lane-resident."""
+        eng = self.eng
+        reqs = self._admit_stage_factor(reqs)
+        if not reqs:
+            return None
+        try:
+            buf = self._stage_factor(plan, reqs)
+            if (eng.health is not None and eng.health.check_rhs
+                    and eng._tick_staging()
+                    and not resilience.rhs_finite(buf)):
+                # exact per-batch guard (one summation of the staged
+                # stack — noise next to the O(N^3) factor): poisoned
+                # matrices fail alone BEFORE burning a factor dispatch,
+                # and always as RhsNonFinite (exact attribution), even
+                # when the fused verdict would also have caught them
+                reqs = self._isolate_poisoned_A(reqs)
+                if not reqs:
+                    return None
+                buf = self._stage_factor(plan, reqs)
+            checked = (eng.health is not None
+                       and eng.health.check_output)
+            Ad = self._to_device(buf)
+            with profiler.region("serve.factor"):
+                if checked:
+                    F, wA, verdict = plan._factor_health_fn(
+                        buf.shape[0])(Ad)
+                else:
+                    F = plan._stacked_factor_fn(buf.shape[0])(Ad)
+                    wA = verdict = None
+        except Exception as e:  # noqa: BLE001 — engine must survive
+            self._redispatch_factor_survivors(reqs, e, solo)
+            return None
+        with eng._lock:
+            eng._factor_batches += 1
+            eng._factor_coalesced += len(reqs)
+            eng._factor_slots += buf.shape[0]
+            eng._factor_pad += buf.shape[0] - len(reqs)
+            bb = buf.shape[0]
+            eng._factor_bucket_hits[bb] = \
+                eng._factor_bucket_hits.get(bb, 0) + 1
+            eng._active_plans[id(plan)] = weakref.ref(plan)
+            self.factor_batches += 1
+            self.factor_coalesced += len(reqs)
+        return _FactorBatch(plan, reqs, F, wA, verdict, Ad, solo)
+
+    # futures-owner
+    def _redispatch_factor_survivors(self, reqs, exc,
+                                     solo: bool = False) -> None:
+        """Batch-attributable factor-dispatch failure: re-dispatch each
+        member individually (one level deep) so innocents still get
+        their sessions; a solo retry that fails again fails for real."""
+        if solo or len(reqs) == 1:
+            self.eng._fail(reqs, exc)
+            return
+        resilience.bump("survivor_redispatches", len(reqs))
+        for r in reqs:
+            self._run_factor_chunk(r.plan, [r], solo=True)
+
+    # hot-path
+    def _dispatch_stacked(self, plan, entries) -> None:
+        """Cross-session coalescing for single-system plans: per-session
+        RHS concat first (width-capped; overflow falls back to per-session
+        dispatch), then up to `max_stack` sessions stack factors along a
+        new leading axis into one vmapped dispatch. The health verdict is
+        not fused into the stacked program — stacked batches still get
+        exception-level survivor re-dispatch, and stacking is opt-in.
+        All sessions here are pinned to THIS lane (requests route by
+        session placement), so the stacked factors share one device."""
+        eng = self.eng
+        ready = []
+        for session, reqs in entries:
+            reqs = self._admit_stage(reqs)
+            chunk: list[_Request] = []
+            width = 0
+            rest: list[_Request] = []
+            for r in reqs:
+                if not rest and (not chunk or width + r.width
+                                 <= eng.max_coalesce_width):
+                    chunk.append(r)
+                    width += r.width
+                else:
+                    rest.append(r)
+            if chunk:
+                ready.append((session, chunk, width))
+            if rest:
+                self._dispatch_session(session, rest)
+        for i in range(0, len(ready), eng.max_stack):
+            part = ready[i:i + eng.max_stack]
+            if len(part) == 1:
+                self._run_chunk(part[0][0], part[0][1])
+            else:
+                self._run_stack(plan, part)
+
+    # hot-path, futures-owner
+    def _run_stack(self, plan, part) -> None:
+        eng = self.eng
+        reqs_all = [r for _, reqs, _ in part for r in reqs]
+        try:
+            wb = rank_bucket(max(w for _, _, w in part))
+            sb = rank_bucket(len(part))
+            # host-stage the whole stack in one (sb, N, wb) buffer; the
+            # pad slots repeat session 0's factors against zero columns
+            buf = np.zeros((sb, plan.N, wb),
+                           part[0][1][0].b2.dtype)
+            spec = []
+            factors, As = [], []
+            for si, (session, reqs, _w) in enumerate(part):
+                lo = 0
+                for r in reqs:
+                    buf[si, :, lo:lo + r.width] = r.b2
+                    spec.append((r, si, lo))
+                    lo += r.width
+                # read the resident state under the session lock: a
+                # drain-thread escalation must never hand this stack a
+                # half-swapped factor pytree (conflint CFX-LOCK is
+                # self-scoped; cross-object discipline is on us here)
+                with session._lock:
+                    session._ensure_resident()  # spilled: fault in now
+                    factors.append(session._factors)
+                    As.append(session._A)
+            while len(factors) < sb:
+                factors.append(factors[0])
+                As.append(As[0])
+            F = stack_trees(factors)
+            A = None if As[0] is None else jnp.stack(As)
+            with profiler.region("serve.solve"):
+                X = plan._stacked_solve_fn(sb, wb)(F, A, buf)
+        except Exception as e:  # noqa: BLE001
+            self._redispatch_survivors(reqs_all, e)
+            return
+        for session, _reqs, _w in part:
+            with session._lock:  # solves is guarded-by the session lock
+                session.solves += 1
+        with eng._lock:
+            eng._batches += 1
+            eng._coalesced_requests += len(reqs_all)
+            self.batches += 1
+            self.coalesced += len(reqs_all)
+        self._outq.put((spec, X, None, None))
+
+    # ------------------------------------------------------------------ #
+    # drain: the only lane thread that blocks on device work
+    # ------------------------------------------------------------------ #
+
+    # futures-owner (post-mortem wrapper: escapes reach _thread_died)
+    def _drain_loop(self) -> None:
+        try:
+            self._drain_inner()
+        except BaseException as e:  # noqa: BLE001 — post-mortem + watchdog
+            self._thread_died(threading.current_thread(), e)
+
+    # futures-owner (the drain loop — the one thread that MAY block)
+    def _drain_inner(self) -> None:
+        eng = self.eng
+        while True:
+            item = self._outq.get()
+            if item is _STOP:
+                break
+            t0 = time.perf_counter()
+            try:
+                if isinstance(item, _FactorBatch):
+                    self._drain_factor(item)
+                    continue
+                spec, block_on, verdict, buf = item
+                reqs = [r for r, _si, _lo in spec]
+                try:
+                    resilience.maybe_fault(eng._faults, "drain")
+                    resilience.maybe_fault(eng._faults, "d2h")
+                    # ONE blocking device->host copy per coalesced
+                    # batch; the per-request scatter is numpy views of
+                    # it, so answering N requests costs zero extra
+                    # device dispatches
+                    xh = np.asarray(block_on)
+                except Exception as e:  # noqa: BLE001
+                    # batch-attributable drain failure routes through
+                    # survivor re-dispatch, not batch-wide _fail
+                    self._drain_redispatch(reqs, e)
+                    continue
+                if verdict is not None:
+                    session = reqs[0].session
+                    limit = eng._limit(session)
+                    healthy, finite, res = resilience.evaluate(verdict,
+                                                               limit)
+                    if resilience.data_fault(eng._faults, "solve",
+                                             "unhealthy") is not None:
+                        healthy = False
+                    if not healthy:
+                        resilience.bump("output_failures")
+                        eng._restore_guards()
+                        self._drain_unhealthy(session, spec, buf,
+                                              finite, res)
+                        continue
+                    if session._breaker is not None:
+                        session._breaker.record_success()
+                self.eng._settle(spec, xh)
+            finally:
+                self.busy_drain_s += time.perf_counter() - t0
+
+    # ------------------------------------------------------------------ #
+    # the factor lane: drain, per-slot health, slice-out
+    # ------------------------------------------------------------------ #
+
+    # futures-owner
+    def _drain_factor(self, fb: _FactorBatch) -> None:
+        """Drain one coalesced factor batch: ONE block on the dispatched
+        program (the factors never cross to the host — only the tiny
+        verdict does, when checked), per-slot health evaluation, then
+        device-side slice-out into independent resident sessions. Slot
+        verdicts are independent, so — unlike the solve lane, which must
+        re-dispatch to ATTRIBUTE a batch verdict — healthy neighbours of
+        a sick slot settle in place; only the sick slot re-runs solo
+        (distinguishing transient staged corruption from a genuinely
+        unfactorable matrix) and fails alone with evidence."""
+        eng = self.eng
+        reqs = fb.reqs
+        try:
+            resilience.maybe_fault(eng._faults, "drain")
+            verdicts = None
+            if fb.verdict is not None:
+                limit = eng._plan_limit(fb.plan)
+                verdicts = resilience.evaluate_slots(fb.verdict, limit)
+                if resilience.data_fault(eng._faults, "factor",
+                                         "unhealthy") is not None:
+                    verdicts = [(False, fin, res)
+                                for _h, fin, res in verdicts]
+            else:
+                jax.block_until_ready(fb.factors)
+        except Exception as e:  # noqa: BLE001
+            self._drain_factor_redispatch(reqs, e)
+            return
+        entries = list(enumerate(reqs))
+        if verdicts is not None:
+            sick = [(i, r) for i, r in entries if not verdicts[i][0]]
+            entries = [(i, r) for i, r in entries if verdicts[i][0]]
+            for i, r in sick:
+                resilience.bump("factor_unhealthy")
+                eng._restore_guards()
+                _h, finite, res = verdicts[i]
+                if fb.solo:
+                    limit = eng._plan_limit(fb.plan)
+                    eng._fail([r], SolveUnhealthy(
+                        f"coalesced factorization unhealthy after solo "
+                        f"re-dispatch: finite={finite} res={res:.3e} "
+                        f"(limit {limit:.3e})",
+                        {"rungs": [{"rung": "factor", "finite": finite,
+                                    "residual": res}],
+                         "residual_limit": limit}))
+                else:
+                    self._solo_factor_drain(fb.plan, r)
+        if entries:
+            self._settle_factor(fb, entries)
+
+    # futures-owner
+    def _drain_factor_redispatch(self, reqs, exc) -> None:
+        """Drain-side batch-attributable factor failure: re-run each
+        request solo, inline (the rare path — the drain thread may
+        block)."""
+        if len(reqs) == 1:
+            self.eng._fail(reqs, exc)
+            return
+        resilience.bump("survivor_redispatches", len(reqs))
+        for r in reqs:
+            self._solo_factor_drain(r.plan, r)
+
+    # futures-owner
+    def _solo_factor_drain(self, plan, r) -> None:
+        """One factor request, re-dispatched and drained inline on the
+        drain thread with its own per-slot verdict (solo, so a second
+        failure is final)."""
+        fb = self._build_factor_batch(plan, [r], solo=True)
+        if fb is not None:
+            self._drain_factor(fb)
+
+    # futures-owner
+    def _settle_factor(self, fb: _FactorBatch, entries) -> None:
+        """Resolve a drained factor batch: slice each live slot's factor
+        pytree, base matrix, and (when checked) probe row out of the
+        stacked device arrays — `batched.unstack_tree`, lazy device
+        indexing, zero host copies — and open one independent resident
+        :class:`~conflux_tpu.serve.SolveSession` per request. The
+        session is constructed exactly as `plan.factor` constructs it
+        (same keep-A rule, same policy plumbing), so every downstream
+        path — solve, update, drift refactor, the §20 health ladder —
+        behaves identically. Sessions open PINNED to this lane's device
+        (sid from the request, so re-submits route straight back
+        here)."""
+        eng = self.eng
+        now = time.perf_counter()
+        owned = eng._take([r for _i, r in entries])
+        with eng._lock:
+            for _i, r in entries:
+                if r in owned:
+                    eng._factor_latencies.append(now - r.t_submit)
+            eng._flat_seq += len(owned)
+            eng._completed += len(owned)
+        plan = fb.plan
+        trees = unstack_tree(fb.factors, len(fb.reqs))
+        for i, r in entries:
+            if r not in owned:
+                continue
+            A_i = fb.A[i]
+            session = SolveSession(plan, trees[i],
+                                   A_i if plan.key.refine else None,
+                                   A_i, r.policy,
+                                   device=self.device, sid=r.sid)
+            if fb.wA is not None:
+                # the probe row wA = w^T A0 came out of the checked
+                # factor dispatch — the session opens with its half of
+                # the Freivalds check already resident
+                session._probe = fb.wA[i]
+            r.future.set_result(session)
+
+    # futures-owner
+    def _drain_redispatch(self, reqs, exc) -> None:
+        """Survivor re-dispatch from the drain side: re-solve each
+        request solo, synchronously (this is the rare failure path — the
+        drain thread may block)."""
+        if len(reqs) == 1:
+            self.eng._fail(reqs, exc)
+            return
+        resilience.bump("survivor_redispatches", len(reqs))
+        for r in reqs:
+            self._solo_drain(r)
+
+    # futures-owner
+    def _solo_drain(self, r) -> None:
+        """One request, re-dispatched and drained inline, with its own
+        health verdict and (if needed) escalation ladder."""
+        eng = self.eng
+        session = r.session
+        if not self._admit_stage([r]):
+            return
+        try:
+            buf, spec = self._stage([r])
+            if (eng.health is not None and eng.health.check_rhs
+                    and not self._isolate_poisoned([r])):
+                return
+            self._revive_for(session, [r])
+            x, verdict = self._solve_session(session, buf)
+            if verdict is not None:
+                limit = eng._limit(session)
+                healthy, finite, res = resilience.evaluate(verdict, limit)
+                if resilience.data_fault(eng._faults, "solve",
+                                         "unhealthy") is not None:
+                    healthy = False
+                if not healthy:
+                    resilience.bump("output_failures")
+                    eng._restore_guards()
+                    self._escalate_settle(session, spec, buf, finite, res)
+                    return
+                if session._breaker is not None:
+                    session._breaker.record_success()
+            eng._settle(spec, np.asarray(x))
+        except Exception as e:  # noqa: BLE001
+            eng._fail([r], e)
+
+    # futures-owner
+    def _drain_unhealthy(self, session, spec, buf, finite, res) -> None:
+        """An unhealthy verdict on a drained batch: multi-request
+        batches isolate first (solo re-dispatch finds the sick request —
+        a poisoned column fails alone, the survivors answer); a solo
+        batch climbs the escalation ladder directly."""
+        reqs = [r for r, _si, _lo in spec]
+        if len(reqs) > 1:
+            resilience.bump("survivor_redispatches", len(reqs))
+            for r in reqs:
+                self._solo_drain(r)
+            return
+        self._escalate_settle(session, spec, buf, finite, res)
+
+    # futures-owner
+    def _escalate_settle(self, session, spec, buf, finite, res) -> None:
+        """Run the ladder for one request's staged buffer; settle on
+        recovery, fail with the structured evidence (and count toward
+        quarantine) otherwise."""
+        eng = self.eng
+        reqs = [r for r, _si, _lo in spec]
+        br = session._breaker
+        try:
+            xh = resilience.escalate(
+                session, buf, eng.health, eng._limit(session),
+                evidence0={"rung": "dispatch", "finite": finite,
+                           "residual": res},
+                faults=eng._faults)
+        except Exception as e:  # noqa: BLE001 — SolveUnhealthy et al.
+            if br is not None:
+                br.record_failure()
+            eng._fail(reqs, e)
+            return
+        if br is not None:
+            br.record_success()
+        eng._settle(spec, xh)
 
 
 class ServeEngine:
@@ -298,13 +1316,41 @@ class ServeEngine:
                  fault_plan=None,
                  watchdog_interval: float = 0.2,
                  residency=None, revive_wait: float = 30.0,
-                 controller=None):
+                 controller=None,
+                 lanes: int | str = 1, devices=None,
+                 max_lane_revives: int = 8):
         if on_full not in ("reject", "block"):
             raise ValueError(f"unknown on_full {on_full!r} (reject|block)")
         if max_pending < 1 or max_coalesce_width < 1 or max_stack < 1 \
                 or max_factor_batch < 1:
             raise ValueError("max_pending, max_coalesce_width, max_stack "
                              "and max_factor_batch must be >= 1")
+        # ---- the lane fleet (DESIGN §25) -------------------------------
+        # lanes=1 (default) or a one-device host: ONE lane on the default
+        # device — the pre-fleet engine, byte-identical. lanes='auto':
+        # one lane per jax device. devices=: an explicit device list.
+        if devices is not None:
+            devs = list(devices)
+            if not devs:
+                raise ValueError("devices must name at least one device")
+        else:
+            n = jax.device_count() if lanes == "auto" else int(lanes)
+            if n < 1:
+                raise ValueError("lanes must be >= 1 or 'auto'")
+            if n == 1:
+                devs = [None]
+            else:
+                avail = jax.devices()
+                if n > len(avail):
+                    raise ValueError(
+                        f"lanes={n} exceeds jax.device_count()="
+                        f"{len(avail)}")
+                devs = list(avail[:n])
+        if len(devs) == 1 and devices is None:
+            devs = [None]  # single lane rides the default device
+        if max_lane_revives < 0:
+            raise ValueError("max_lane_revives must be >= 0")
+        self.max_lane_revives = int(max_lane_revives)
         if persistent_cache:
             from conflux_tpu import cache
 
@@ -326,11 +1372,28 @@ class ServeEngine:
             # revivals (tier.ResidentSet._revive_refactor)
             residency.engine = self
 
-        self._inq: Queue = Queue()
-        # bounded at 2: the double buffer. The dispatcher stages/dispatches
-        # batch i+1 while the drain thread waits on batch i; a third batch
-        # blocks the dispatcher instead of growing in-flight device work.
-        self._outq: Queue = Queue(maxsize=2)
+        # per-device lanes: each owns its input queue, its 2-deep
+        # double-buffer handoff queue (the dispatcher stages/dispatches
+        # batch i+1 while the drain thread waits on batch i; a third
+        # batch blocks the dispatcher instead of growing in-flight
+        # device work), its dispatcher/drain threads, and its carry
+        self._lanes: tuple = tuple(
+            DeviceLane(self, i, d) for i, d in enumerate(devs))
+        self._lane_by_dev: dict = {_devkey(ln.device): ln
+                                   for ln in self._lanes}
+        # the shared cold-start pool (multi-lane only): factor requests
+        # with no explicit placement queue here and any lane with a free
+        # dispatch round draws them — work-stealing load balance.
+        # Guarded by _lock for mutation; emptiness fast-checks are racy
+        # by design (a missed draw is picked up by the next wake).
+        self._factor_pool: deque = deque()
+        # one wake in flight at a time: a submission burst must not fan
+        # WAKEs across every lane (each waked lane would draw a sliver
+        # and the burst would factor in fragments instead of full
+        # buckets) — the flag clears at the next pool draw, and the
+        # drawing lane re-wakes if backlog remains
+        self._pool_waked = False        # guarded-by: _lock
+        self._sid_seq = 0               # guarded-by: _lock
         # the admission lock: every counter and the live set below are
         # `# guarded-by: _lock` (conflint CFX-LOCK enforces it). This
         # lock must NEVER be held across a device dispatch — the
@@ -405,13 +1468,8 @@ class ServeEngine:
         self._dead: tuple | None = None
 
         profiler.register_engine(self)
-        self._dispatcher = threading.Thread(
-            target=self._dispatch_loop, name="serve-engine-dispatch",
-            daemon=True)
-        self._drainer = threading.Thread(
-            target=self._drain_loop, name="serve-engine-drain", daemon=True)
-        self._dispatcher.start()
-        self._drainer.start()
+        for lane in self._lanes:
+            lane.start()
         self._watchdog = None
         if self.watchdog_interval > 0:
             self._watchdog = threading.Thread(
@@ -480,6 +1538,11 @@ class ServeEngine:
         now = time.perf_counter()
         req = _Request(session, b2, int(b2.shape[-1]), squeeze, Future(),
                        now, None if deadline is None else now + deadline)
+        # resolve the owning lane BEFORE admission (placement may move a
+        # not-yet-pinned session's state — device work, so never under
+        # the admission lock), so every live request is lane-attributed
+        # for the per-lane watchdog
+        req.lane = self._lane_for(session)
         return self._admit(req)
 
     def _admit(self, req) -> Future:
@@ -553,12 +1616,142 @@ class ServeEngine:
             self._live.add(req)
             if self._pending > self._queue_peak:
                 self._queue_peak = self._pending
-        self._inq.put(req)
+        self._route(req)
         return req.future
+
+    def _route(self, req) -> None:
+        """Hand an admitted request to its lane's queue — or, for an
+        unpinned cold-start on a multi-lane engine, to the shared
+        work-stealing pool (waking the least-loaded lane). The dead-lane
+        re-sweep closes the race between a lane dying and a request
+        landing in its queue: either the trip's sweep of `_live` sees
+        the request, or this sees `dead` — resolution ownership makes a
+        double sweep harmless."""
+        if isinstance(req, _FactorRequest) and req.pool:
+            with self._lock:
+                self._factor_pool.append(req)
+            self._wake_lane()
+            return
+        lane = req.lane
+        d = lane._inq.qsize() + 1
+        if d > lane.queue_hw:  # monotone high-water; racy max by design
+            lane.queue_hw = d
+        lane._inq.put(req)
+        if lane.dead:
+            with self._lock:
+                leftover = [r for r in self._live
+                            if getattr(r, "lane", None) is lane]
+            self._fail(leftover, EngineClosed(
+                f"lane {lane.index} is dead (worker threads exhausted "
+                f"their revival budget) — request failed by the "
+                "admission front"))
+
+    # hot-path (placement: at most one state move per session, ever)
+    def _lane_for(self, session):
+        """The lane that owns `session`, pinning it on first contact.
+
+        Placement is deterministic (DESIGN §25): an explicit
+        `session.device` wins; otherwise the consistent hash of the
+        session id over the engine's devices (`place_session`) — a
+        session with no sid gets one assigned (stable for its lifetime;
+        give sessions stable sids for restart-deterministic placement).
+        Mesh-sharded sessions are never pinned — their state spans the
+        whole mesh — and ride lane 0. Sessions on a device no lane
+        serves (or a dead lane) are served by the first live lane:
+        dispatch follows the committed factors, so answers are
+        unaffected, only the thread that runs them."""
+        lanes = self._lanes
+        if len(lanes) == 1:
+            return lanes[0]
+        if session.plan.mesh is not None:
+            return lanes[0]
+        dev = session.device
+        if dev is None:
+            with session._lock:
+                dev = session.device
+                if dev is None:
+                    if session.sid is None:
+                        session.sid = self._auto_sid()
+                    alive = [ln.device for ln in lanes if not ln.dead]
+                    dev = place_session(
+                        session.sid,
+                        alive or [ln.device for ln in lanes])
+                    session.to_device(dev)
+        lane = self._lane_by_dev.get(_devkey(dev))
+        if lane is None or lane.dead:
+            for ln in lanes:
+                if not ln.dead:
+                    return ln
+            return lanes[0]
+        return lane
+
+    def _auto_sid(self) -> str:
+        with self._lock:
+            self._sid_seq += 1
+            return f"auto-{self._sid_seq}"
+
+    @property
+    def lanes(self) -> tuple:
+        """The engine's :class:`DeviceLane`s, in device order (length 1
+        on a single-lane engine)."""
+        return self._lanes
+
+    @property
+    def devices(self) -> tuple:
+        """The lane devices (a single None = the default device)."""
+        return tuple(ln.device for ln in self._lanes)
+
+    def placement(self, sid):
+        """The device `place_session` pins `sid` to on THIS engine's
+        device list — the ops-facing "where would this session land"
+        query."""
+        return place_session(sid, [ln.device for ln in self._lanes])
+
+    def _pool_pending(self) -> bool:
+        # racy emptiness fast-check by design (see __init__)
+        return bool(self._factor_pool)
+
+    def _pool_draw(self, n) -> list:
+        """Pop up to `n` queued cold-start requests from the shared
+        factor pool (None = all) — lane dispatchers call this every
+        round, so any lane with a free round takes work. Drawing clears
+        the wake-in-flight flag: the next submission burst gets a fresh
+        wake."""
+        if not self._factor_pool:
+            return []
+        out: list = []
+        with self._lock:
+            while self._factor_pool and (n is None or len(out) < n):
+                out.append(self._factor_pool.popleft())
+            self._pool_waked = False
+        return out
+
+    def _wake_lane(self, force: bool = False) -> None:
+        """Nudge the least-loaded live lane (queue depth, ties to the
+        lowest index) — the admission half of cold-start load
+        balancing; the dispatch-round pool draw is the stealing half.
+        At most one wake rides between draws (see `_pool_waked`) so a
+        burst coalesces into full buckets; `force` bypasses that (lane
+        death re-arms the pool)."""
+        with self._lock:
+            if self._pool_waked and not force:
+                return
+            self._pool_waked = True
+        best = None
+        best_load = None
+        for ln in self._lanes:
+            if ln.dead:
+                continue
+            load = ln._inq.qsize()
+            if best is None or load < best_load:
+                best, best_load = ln, load
+        if best is not None:
+            best._inq.put(_WAKE)
 
     # hot-path (admission: host work only, no device syncs)
     def submit_factor(self, plan, A, *, policy=None,
-                      deadline: float | None = None) -> Future:
+                      deadline: float | None = None,
+                      sid=None, device=None) -> Future:
         """Enqueue one factorization against `plan`; returns a Future
         whose result is a device-resident
         :class:`~conflux_tpu.serve.SolveSession` — exactly what
@@ -580,8 +1773,17 @@ class ServeEngine:
         fused per-slot post-factor finite/probe-residual verdict —
         a sick slot re-dispatches solo and fails alone with structured
         evidence (:class:`SolveUnhealthy`), its co-batched neighbours
-        untouched. Mesh-sharded plans are rejected: their factor program
-        is batch-sharded already — call ``plan.factor`` directly."""
+        untouched. Mesh-sharded plans are rejected with the structured
+        :class:`~conflux_tpu.resilience.MeshPlanUnsupported` (a
+        ValueError subclass): their factor program is batch-sharded
+        already — catch it and call ``plan.factor`` directly.
+
+        On a multi-lane engine the cold start LOAD-BALANCES: with no
+        `sid`/`device` the request joins the shared pool and whichever
+        lane has a free dispatch round takes it (work-stealing);
+        `sid=` pins the opened session by consistent hash
+        (`place_session` — deterministic across restarts), `device=`
+        pins it explicitly."""
         # conflint: disable=CFX-LOCK benign racy fast-fail; _admit re-checks locked
         if self._closed:
             raise EngineClosed("submit_factor() on a closed ServeEngine")
@@ -593,10 +1795,11 @@ class ServeEngine:
                             f"{type(plan).__name__} (submit() serves "
                             "sessions)")
         if plan.mesh is not None:
-            raise ValueError(
+            raise MeshPlanUnsupported(
                 "the factor lane serves unsharded plans only (the stacked "
                 "cold-start program has no mesh variant) — factor "
-                "mesh-sharded plans through plan.factor directly")
+                "mesh-sharded plans through plan.factor directly",
+                surface="factor_lane")
         # conflint: disable=CFX-HOSTSYNC host request ingestion, not a device readback
         A2 = np.asarray(A)
         if tuple(A2.shape) != plan.key.shape:
@@ -615,16 +1818,37 @@ class ServeEngine:
                 "poisoned system would waste a coalesced factor dispatch)")
         now = time.perf_counter()
         req = _FactorRequest(plan, A2, policy, Future(), now,
-                             None if deadline is None else now + deadline)
+                             None if deadline is None else now + deadline,
+                             sid=sid, device=device)
+        # lane resolution (multi-lane): an explicit device pins the lane,
+        # a sid pins it by consistent hash, otherwise the request joins
+        # the shared pool and the lanes load-balance it between them
+        if len(self._lanes) == 1:
+            req.lane = self._lanes[0]
+        elif device is not None:
+            lane = self._lane_by_dev.get(_devkey(device))
+            if lane is None:
+                raise ValueError(
+                    f"device {device} is not one of this engine's lane "
+                    "devices — open the session with plan.factor, or "
+                    "build the engine with devices= including it")
+            req.lane = lane
+        elif sid is not None:
+            req.lane = self._lane_by_dev[_devkey(self.placement(sid))]
+            req.device = req.lane.device
+        else:
+            req.pool = True
         return self._admit(req)
 
     def factor(self, plan, A, timeout: float | None = None, *,
-               policy=None, deadline: float | None = None):
+               policy=None, deadline: float | None = None,
+               sid=None, device=None):
         """Blocking convenience (the mirror of :meth:`solve`):
         ``submit_factor(plan, A).result(timeout)`` — returns the opened
         :class:`~conflux_tpu.serve.SolveSession`."""
         return self.submit_factor(plan, A, policy=policy,
-                                  deadline=deadline).result(timeout)
+                                  deadline=deadline, sid=sid,
+                                  device=device).result(timeout)
 
     def solve(self, session, b, timeout: float | None = None,
               deadline: float | None = None):
@@ -647,11 +1871,14 @@ class ServeEngine:
             # stop the knob writer before tearing down what it tunes
             self._controller.close()
         if not already:
-            self._inq.put(_STOP)
-        self._dispatcher.join(timeout)
-        self._drainer.join(timeout)
-        wedged = [t.name for t in (self._dispatcher, self._drainer)
-                  if t.is_alive()]
+            for lane in self._lanes:
+                lane._inq.put(_STOP)
+        wedged = []
+        for lane in self._lanes:
+            lane._dispatcher.join(timeout)
+            lane._drainer.join(timeout)
+            wedged += [t.name for t in (lane._dispatcher, lane._drainer)
+                       if t.is_alive() and not lane.dead]
         if wedged:
             with self._lock:
                 leftover = list(self._live)
@@ -677,7 +1904,8 @@ class ServeEngine:
                   max_factor_batch: int | None = None,
                   health: HealthPolicy | None = None,
                   staging_stride: int | None = None,
-                  drain_rate: float | None = None) -> dict:
+                  drain_rate: float | None = None,
+                  lane: int | None = None) -> dict:
         """Thread-safe knob actuation: the write half of the adaptive
         control loop (`conflux_tpu.control.AdaptiveController`), also a
         public ops surface. Writes land under the admission lock; the
@@ -694,9 +1922,30 @@ class ServeEngine:
         instantly, engine-side). `drain_rate` installs the measured
         completions/s estimate that sizes `EngineSaturated.retry_after`
         (None leaves the current estimate in place). Returns the full
-        knob dict after the move."""
+        knob dict after the move.
+
+        `lane=` scopes the move to ONE lane: only `max_batch_delay` may
+        ride it (the per-lane coalescing window the adaptive controller
+        tunes independently per device, DESIGN §25) — the write lands as
+        that lane's `delay_override`, leaving the engine-wide default
+        and every other lane untouched."""
         if max_batch_delay is not None and max_batch_delay < 0:
             raise ValueError("max_batch_delay must be >= 0")
+        if lane is not None:
+            if not 0 <= int(lane) < len(self._lanes):
+                raise ValueError(f"lane {lane} out of range "
+                                 f"(engine has {len(self._lanes)})")
+            if max_batch_delay is None or any(
+                    v is not None for v in (max_pending,
+                                            max_coalesce_width,
+                                            max_factor_batch, health,
+                                            staging_stride, drain_rate)):
+                raise ValueError("lane= scopes exactly one knob: "
+                                 "max_batch_delay")
+            with self._lock:
+                self._lanes[int(lane)].delay_override = \
+                    float(max_batch_delay)
+                return self._knobs_locked()
         if (max_pending is not None and max_pending < 1) \
                 or (max_coalesce_width is not None
                     and max_coalesce_width < 1) \
@@ -735,7 +1984,11 @@ class ServeEngine:
                 "drain_rate": self._drain_rate,
                 "health_relaxed": (self._health_strict is not None
                                    and self.health
-                                   is not self._health_strict)}
+                                   is not self._health_strict),
+                "lanes": len(self._lanes),
+                "lane_delays": {ln.index: ln.delay_override
+                                for ln in self._lanes
+                                if ln.delay_override is not None}}
 
     def knobs(self) -> dict:
         """The current knob values (a consistent snapshot)."""
@@ -890,609 +2143,103 @@ class ServeEngine:
         return t
 
     def _prewarm_width(self, session, wb: int) -> None:
+        """Warm one RHS bucket on EVERY lane device. A jitted program
+        traces once per shape but compiles one executable per device,
+        so each lane must eat its own first-dispatch compile here —
+        dedupe rides the plan's (kind, bucket, device) warm registry,
+        so warming two sessions of one plan (or calling prewarm twice)
+        repeats nothing."""
         plan = session.plan
+        checked = self.health is not None and self.health.check_output
+        kind = "solve_health" if checked else "solve"
         shape = ((plan.B, plan.N, wb) if plan.batched else (plan.N, wb))
-        b2 = jnp.zeros(shape, jnp.dtype(plan.key.dtype))
-        if plan.mesh is not None:
-            (b2,) = _shard_batch((b2,), plan.mesh)
-        if self.health is not None and self.health.check_output:
-            x, _ = plan._solve_health_fn(wb)(
-                session._factors, session._A0, session._probe_row(), b2)
-            x.block_until_ready()
-        else:
-            plan._solve_fn(wb)(session._factors, session._A,
-                               b2).block_until_ready()
+        for lane in self._lanes:
+            dk = _devkey(lane.device)
+            if plan.device_warm(kind, wb, dk):
+                continue
+            b2 = jnp.zeros(shape, jnp.dtype(plan.key.dtype))
+            if plan.mesh is not None:
+                (b2,) = _shard_batch((b2,), plan.mesh)
+            with session._lock:
+                session._ensure_resident()
+                F, A, A0 = session._factors, session._A, session._A0
+                probe = session._probe_row() if checked else None
+            if lane.device is not None:
+                # temporary per-device copies: compile-time only, freed
+                # with this loop iteration. The RHS stays UNCOMMITTED —
+                # traffic dispatches host-staged RHS buffers the same
+                # way, and the executable cache keys on the commitment
+                # signature, so a committed prewarm RHS would warm a
+                # program traffic never runs
+                F = put_tree(F, lane.device)
+                A = put_tree(A, lane.device)
+                A0 = put_tree(A0, lane.device)
+                probe = put_tree(probe, lane.device)
+            if checked:
+                x, _ = plan._solve_health_fn(wb)(F, A0, probe, b2)
+                x.block_until_ready()
+            else:
+                plan._solve_fn(wb)(F, A, b2).block_until_ready()
+            plan.mark_device_warm(kind, wb, dk)
 
     def _prewarm_stack(self, session, sb: int, wb: int) -> None:
         plan = session.plan
         if plan.batched:
             raise ValueError(
                 "stacks= prewarming applies to single-system plans only")
-        F = stack_trees([session._factors] * sb)
-        A = None if session._A is None else jnp.stack([session._A] * sb)
-        b = jnp.zeros((sb, plan.N, wb), jnp.dtype(plan.key.dtype))
-        plan._stacked_solve_fn(sb, wb)(F, A, b).block_until_ready()
+        for lane in self._lanes:
+            dk = _devkey(lane.device)
+            if plan.device_warm("stacked", (sb, wb), dk):
+                continue
+            with session._lock:
+                session._ensure_resident()
+                F0, A0 = session._factors, session._A
+            if lane.device is not None:
+                F0 = put_tree(F0, lane.device)
+                A0 = put_tree(A0, lane.device)
+            F = stack_trees([F0] * sb)
+            A = None if A0 is None else jnp.stack([A0] * sb)
+            # the RHS stays uncommitted, matching traffic (see
+            # _prewarm_width)
+            b = jnp.zeros((sb, plan.N, wb), jnp.dtype(plan.key.dtype))
+            plan._stacked_solve_fn(sb, wb)(F, A, b).block_until_ready()
+            plan.mark_device_warm("stacked", (sb, wb), dk)
 
     def _prewarm_factor(self, plan, bb: int) -> None:
         if plan.mesh is not None:
-            raise ValueError(
+            raise MeshPlanUnsupported(
                 "the factor lane serves unsharded plans only — factor "
-                "mesh-sharded plans through plan.factor directly")
+                "mesh-sharded plans through plan.factor directly",
+                surface="prewarm")
+        checked = self.health is not None and self.health.check_output
+        kind = "factor_health" if checked else "factor"
         # identity stacks: well-conditioned in every mode (LU, Cholesky,
         # trsm and inv substitution) — the same filler the pad slots use
         buf = np.empty((bb,) + plan.key.shape, np.dtype(plan.key.dtype))
         buf[:] = np.eye(plan.N, dtype=buf.dtype)
-        Ad = jnp.asarray(buf)
-        if self.health is not None and self.health.check_output:
-            _f, _w, v = plan._factor_health_fn(bb)(Ad)
-            v.block_until_ready()
-        else:
-            jax.block_until_ready(plan._stacked_factor_fn(bb)(Ad))
-
-    # ------------------------------------------------------------------ #
-    # dispatcher: collect a window, coalesce, dispatch async
-    # ------------------------------------------------------------------ #
-
-    # futures-owner (post-mortem wrapper: escapes reach _thread_died)
-    def _dispatch_loop(self) -> None:
-        try:
-            self._dispatch_inner()
-        except BaseException as e:  # noqa: BLE001 — post-mortem + watchdog
-            self._thread_died(self._dispatcher.name, e)
-
-    def _wait_bound(self, reqs, remaining: float) -> float:
-        """Cap a collect wait at the soonest request deadline, so lazy
-        eviction runs when a deadline passes mid-window instead of after
-        the whole `max_batch_delay` (or a blocked slot's whole wait)."""
-        exps = [r.expiry for r in reqs if r.expiry is not None]
-        if not exps:
-            return remaining
-        return min(remaining,
-                   max(0.0, min(exps) - time.perf_counter()) + 1e-4)
-
-    def _prune_expired(self, reqs) -> list:
-        """Lazy deadline eviction: fail expired requests with
-        :class:`DeadlineExceeded` (releasing their pending slots — this
-        is what un-wedges an `on_full='block'` submitter whose queue is
-        full of abandoned work) and return the survivors."""
-        now = time.perf_counter()
-        live = []
-        for r in reqs:
-            if r.expiry is not None and now > r.expiry:
-                resilience.bump("evictions")
-                self._fail([r], DeadlineExceeded(
-                    f"deadline passed {now - r.expiry:.3f}s before "
-                    "dispatch (lazily evicted; pending slot released)"))
-            else:
-                live.append(r)
-        return live
-
-    # hot-path, futures-owner (the dispatcher loop)
-    def _dispatch_inner(self) -> None:
-        stop = False
-        carry: list = []  # small remainder chunks deferred to this round
-        while not stop:
-            if carry:
-                try:
-                    first = self._inq.get(
-                        timeout=self._wait_bound(carry,
-                                                 self.max_batch_delay))
-                except Empty:
-                    first = None  # window spent waiting on the carry
-            else:
-                first = self._inq.get()
-            batch = list(carry)
-            carry = []
-            collect = True
-            if first is _STOP:
-                stop = True
-                collect = False
-            elif first is None:
-                collect = False
-            else:
-                batch.append(first)
-            if collect:
-                deadline = time.perf_counter() + self.max_batch_delay
-                while True:
-                    batch = self._prune_expired(batch)
-                    remaining = deadline - time.perf_counter()
-                    if remaining <= 0:
-                        # the window is over, but anything ALREADY queued
-                        # still coalesces (the burst shape: a backlog
-                        # should never dispatch one request at a time)
-                        try:
-                            r = self._inq.get_nowait()
-                        except Empty:
-                            break
-                    else:
-                        try:
-                            r = self._inq.get(
-                                timeout=self._wait_bound(batch, remaining))
-                        except Empty:
-                            # the wait may have been truncated by a batch
-                            # member's deadline — loop: prune, recompute,
-                            # and let the remaining<=0 path end the window
-                            continue
-                    if r is _STOP:
-                        stop = True
-                        break
-                    batch.append(r)
-                    if len(batch) >= self.max_pending:
-                        break
-            if batch:
-                batch = self._prune_expired(batch)
-            if batch:
-                try:
-                    resilience.maybe_fault(self._faults, "dispatch")
-                    carry = self._dispatch(
-                        batch,
-                        may_defer=not stop and not self._inq.empty())
-                except Exception as e:  # noqa: BLE001 — engine survives
-                    self._fail(batch, e)
-        if carry:
-            self._dispatch(self._prune_expired(carry), may_defer=False)
-        self._outq.put(_STOP)
-
-    # hot-path, futures-owner
-    def _dispatch(self, batch, may_defer: bool = False) -> list:
-        """Group a window's requests and dispatch each group as one
-        device program (async — nothing here blocks on device work).
-        With `may_defer` (more traffic already queued), each session's
-        small remainder chunk is handed back once to ride the next
-        window instead of wasting a whole dispatch on a sliver. Factor
-        requests ride the same window: they group per PLAN and coalesce
-        into stacked factor dispatches."""
-        freqs = [r for r in batch if isinstance(r, _FactorRequest)]
-        deferred: list = []
-        if freqs:
-            deferred += self._dispatch_factors(freqs, may_defer)
-            batch = [r for r in batch if not isinstance(r, _FactorRequest)]
-        groups: dict[int, list[_Request]] = {}
-        order = []
-        for r in batch:
-            key = id(r.session)
-            if key not in groups:
-                groups[key] = []
-                order.append(r.session)
-            groups[key].append(r)
-        stackable: dict[int, list] = {}
-        plan_order = []
-        for session in order:
-            reqs = groups[id(session)]
-            if (self.stack_sessions and not session.plan.batched
-                    and session._upd is None):
-                pk = id(session.plan)
-                if pk not in stackable:
-                    stackable[pk] = []
-                    plan_order.append(session.plan)
-                stackable[pk].append((session, reqs))
-            else:
-                deferred += self._dispatch_session(session, reqs,
-                                                   may_defer)
-        for plan in plan_order:
-            entries = stackable[id(plan)]
-            if len(entries) == 1:
-                deferred += self._dispatch_session(*entries[0], may_defer)
-            else:
-                self._dispatch_stacked(plan, entries)
-        return deferred
-
-    # hot-path
-    def _dispatch_session(self, session, reqs,
-                          may_defer: bool = False) -> list:
-        """Per-session coalescing: concatenate RHS columns up to the
-        width cap and run each chunk through `session.solve` (which
-        already buckets, pads, shards, and counts). Returns the deferred
-        remainder (at most one small chunk, each request deferred at most
-        once — the latency cost is bounded by one extra window)."""
-        chunks: list[list[_Request]] = []
-        chunk: list[_Request] = []
-        width = 0
-        for r in reqs:
-            if chunk and width + r.width > self.max_coalesce_width:
-                chunks.append(chunk)
-                chunk, width = [], 0
-                with self._lock:
-                    # the width cap split a window's chunk: the
-                    # controller's grow-the-bucket-set pressure signal
-                    self._width_capped += 1
-            chunk.append(r)
-            width += r.width
-        deferred: list = []
-        if chunk:
-            if (may_defer and width <= self.max_coalesce_width // 2
-                    and not any(r.carried for r in chunk)):
-                for r in chunk:
-                    r.carried = True
-                deferred = chunk
-            else:
-                chunks.append(chunk)
-        for c in chunks:
-            self._run_chunk(session, c)
-        return deferred
-
-    # hot-path
-    def _admit_stage(self, reqs) -> list:
-        """Pre-staging admission on the dispatch path: lazy deadline
-        eviction and the 'staging' fault site (poisons the request's OWN
-        host copy, upstream of the guard — exactly what a corrupted
-        staging write looks like)."""
-        reqs = self._prune_expired(reqs)
-        if self._faults is not None or resilience.active_faults():
-            for r in reqs:
-                if resilience.data_fault(self._faults, "staging",
-                                         "nan") is not None:
-                    # conflint: disable=CFX-HOSTSYNC fault-injection copy of host-staged numpy
-                    poisoned = np.array(r.b2, copy=True)
-                    poisoned[..., 0] = np.nan
-                    r.b2 = poisoned
-        return reqs
-
-    # hot-path, futures-owner
-    def _isolate_poisoned(self, reqs) -> list:
-        """The SECOND finite guard (staging): one summation over the
-        coalesced buffer answers 'is anything poisoned?' per BATCH; only
-        on suspicion does the per-request scan run to fail the culprits
-        alone. Requests poisoned after submit-time admission (or by an
-        injected fault) therefore never reach the device, and the
-        co-batched answers stay exactly what they would have been."""
-        live = []
-        for r in reqs:
-            if resilience.rhs_finite(r.b2):
-                live.append(r)
+        for lane in self._lanes:
+            dk = _devkey(lane.device)
+            if plan.device_warm(kind, bb, dk):
                 continue
-            resilience.bump("staging_isolations")
-            self._restore_guards()
-            self._fail([r], RhsNonFinite(
-                "rhs went non-finite after admission — isolated at "
-                "staging (co-batched requests unaffected)"))
-        return live
-
-    # hot-path (numpy staging IS the point: one h2d per batch)
-    def _stage(self, reqs):
-        """Host-stage a session chunk: memcpy every request's columns
-        into ONE bucket-width buffer (zero-padded — exactly the padding
-        `SolveSession.solve` would add, so answers stay bitwise). A numpy
-        buffer keeps staging off the device and, crucially, off the
-        compiler: the device sees one transfer of one already-bucketed
-        shape, never a fresh concatenate signature. Returns (buf, spec)
-        with spec the (request, stack-slot, column-offset) scatter plan
-        for the drain thread."""
-        W = sum(r.width for r in reqs)
-        wb = rank_bucket(W)
-        lead = reqs[0].b2.shape[:-1]
-        buf = np.zeros(lead + (wb,), reqs[0].b2.dtype)
-        spec = []
-        lo = 0
-        for r in reqs:
-            buf[..., lo:lo + r.width] = r.b2
-            spec.append((r, None, lo))
-            lo += r.width
-        return buf, spec
-
-    def _is_worker_thread(self) -> bool:
-        """True on the dispatcher/drain threads — the tier manager's
-        refactor-revival must not block on the factor lane from them
-        (a worker waiting on its own queue would deadlock)."""
-        t = threading.current_thread()
-        return t is self._dispatcher or t is self._drainer
-
-    # hot-path
-    def _revive_for(self, session, reqs) -> None:
-        """Deadline-aware fault-in ahead of a dispatch to a spilled
-        session (DESIGN §23): the revive-lane wait is capped at the
-        requests' soonest deadline (else `revive_wait`), so a request
-        expiring mid-revival fails with `DeadlineExceeded`/
-        `SessionSpilled` through the usual survivor machinery — its
-        admission slot released, the session left FULLY spilled with
-        its record intact — instead of wedging the dispatcher. The
-        resident fast path costs two attribute reads."""
-        rs = getattr(session, "_residency", None)
-        # racy fast-path read by design: fault_in re-checks under the
-        # session lock, and a session cannot spill mid-dispatch (the
-        # manager needs the session lock we are about to take)
-        if rs is None or session._spill is None:
-            return
-        timeout = self.revive_wait
-        exps = [r.expiry for r in reqs if r.expiry is not None]
-        if exps:
-            timeout = max(0.0, min(exps) - time.perf_counter())
-        rs.fault_in(session, timeout=timeout)
-
-    # hot-path
-    def _solve_session(self, session, buf):
-        """One dispatch through the session, checked when the policy
-        says so. Holds the session lock so a drain-thread escalation
-        (factor swap) is atomic against this dispatcher."""
-        with session._lock:
-            if self.health is not None and self.health.check_output:
-                return session.solve_checked(buf)
-            return session.solve(buf), None
-
-    # hot-path, futures-owner
-    def _run_chunk(self, session, reqs, solo: bool = False) -> None:
-        reqs = self._admit_stage(reqs)
-        if not reqs:
-            return
-        try:
-            buf, spec = self._stage(reqs)
-            if (self.health is not None and self.health.check_rhs
-                    and not self.health.check_output
-                    and self._tick_staging()
-                    and not resilience.rhs_finite(buf)):
-                # no fused output verdict to backstop the staging guard:
-                # one per-BATCH summation here; the per-request scan
-                # runs only on suspicion. (With check_output on, the
-                # device-side finite verdict detects staged poison for
-                # FREE — NaN stays in its own answer column — and the
-                # drain isolates the culprit with the same exact scan,
-                # so the clean path stages without re-reading a byte.)
-                reqs = self._isolate_poisoned(reqs)
-                if not reqs:
-                    return
-                buf, spec = self._stage(reqs)
-            self._revive_for(session, reqs)
-            x, verdict = self._solve_session(session, buf)
-        except Exception as e:  # noqa: BLE001 — engine must survive
-            self._redispatch_survivors(reqs, e, solo)
-            return
-        wb = buf.shape[-1]
-        with self._lock:
-            self._batches += 1
-            self._coalesced_requests += len(reqs)
-            self._bucket_hits[wb] = self._bucket_hits.get(wb, 0) + 1
-            self._active_sessions[id(session)] = weakref.ref(session)
-        self._outq.put((spec, x, verdict, buf))
-
-    # futures-owner
-    def _redispatch_survivors(self, reqs, exc, solo: bool = False) -> None:
-        """A batch-attributable failure (dispatch exception, failed d2h
-        copy, unhealthy verdict on a multi-request batch) re-dispatches
-        each member INDIVIDUALLY instead of failing all of them with the
-        same exception: the innocent co-batched requests get their
-        answers; only the actually-sick request fails (possibly after
-        its own escalation ladder). One level deep — a solo request that
-        fails again fails for real."""
-        if solo or len(reqs) == 1:
-            self._fail(reqs, exc)
-            return
-        resilience.bump("survivor_redispatches", len(reqs))
-        for r in reqs:
-            self._run_chunk(r.session, [r], solo=True)
-
-    # ------------------------------------------------------------------ #
-    # the factor lane: coalesced cold-start dispatch
-    # ------------------------------------------------------------------ #
-
-    # hot-path
-    def _dispatch_factors(self, reqs, may_defer: bool = False) -> list:
-        """Per-plan coalescing of factor requests: same-plan requests
-        stack into chunks of up to `max_factor_batch` matrices, each
-        chunk one vmapped batched factor dispatch. Returns the deferred
-        remainder (with `may_defer`, a small trailing chunk rides the
-        next window once instead of wasting a whole bucket on a
-        sliver — the solve lane's carry-over discipline)."""
-        groups: dict[int, list] = {}
-        order = []
-        for r in reqs:
-            key = id(r.plan)
-            if key not in groups:
-                groups[key] = []
-                order.append(r.plan)
-            groups[key].append(r)
-        deferred: list = []
-        for plan in order:
-            greqs = groups[id(plan)]
-            chunks = [greqs[i:i + self.max_factor_batch]
-                      for i in range(0, len(greqs), self.max_factor_batch)]
-            last = chunks[-1]
-            if (may_defer and len(last) <= self.max_factor_batch // 2
-                    and not any(r.carried for r in last)):
-                for r in last:
-                    r.carried = True
-                deferred += last
-                chunks = chunks[:-1]
-            for c in chunks:
-                self._run_factor_chunk(plan, c)
-        return deferred
-
-    # hot-path
-    def _admit_stage_factor(self, reqs) -> list:
-        """Pre-staging admission for the factor lane: lazy deadline
-        eviction plus the 'factor' nan fault site (poisons the request's
-        OWN host matrix, upstream of the staging guard — a corrupted
-        staging write)."""
-        reqs = self._prune_expired(reqs)
-        if self._faults is not None or resilience.active_faults():
-            for r in reqs:
-                if resilience.data_fault(self._faults, "factor",
-                                         "nan") is not None:
-                    # conflint: disable=CFX-HOSTSYNC fault-injection copy of host-staged numpy
-                    poisoned = np.array(r.A, copy=True)
-                    poisoned[..., 0, 0] = np.nan
-                    r.A = poisoned
-        return reqs
-
-    # hot-path, futures-owner
-    def _isolate_poisoned_A(self, reqs) -> list:
-        """Factor-lane staging guard: a matrix gone non-finite after
-        admission fails its OWN future and is dropped from the staged
-        stack; co-batched factorizations are untouched (the vmapped
-        factor body never mixes slots). One per-batch summation answers
-        'anything poisoned?'; the per-request scan runs only on
-        suspicion."""
-        live = []
-        for r in reqs:
-            if resilience.rhs_finite(r.A):
-                live.append(r)
-                continue
-            resilience.bump("factor_isolations")
-            self._restore_guards()
-            self._fail([r], RhsNonFinite(
-                "matrix went non-finite after admission — isolated at "
-                "staging (co-batched factorizations unaffected)"))
-        return live
-
-    # hot-path (numpy staging: one h2d per factor batch)
-    def _stage_factor(self, plan, reqs):
-        """Host-stage a factor chunk: memcpy every request's matrix into
-        ONE (bucket,)+shape staging buffer — the factor-lane mirror of
-        `_stage`, with `_pad_batch`'s fill='eye' discipline in numpy:
-        pad slots carry identity matrices (well-conditioned by
-        construction, never a copy of a request that might itself be
-        poisoned). The device sees one transfer and one prewarmed
-        program per batch regardless of how many requests coalesced."""
-        bb = rank_bucket(len(reqs))
-        buf = np.empty((bb,) + plan.key.shape, np.dtype(plan.key.dtype))
-        for i, r in enumerate(reqs):
-            buf[i] = r.A
-        if bb != len(reqs):
-            buf[len(reqs):] = np.eye(plan.N, dtype=buf.dtype)
-        return buf
-
-    # hot-path
-    def _run_factor_chunk(self, plan, reqs, solo: bool = False) -> None:
-        fb = self._build_factor_batch(plan, reqs, solo)
-        if fb is not None:
-            self._outq.put(fb)
-
-    # hot-path, futures-owner
-    def _build_factor_batch(self, plan, reqs, solo: bool = False):
-        """Stage and dispatch one coalesced factor chunk (async —
-        nothing blocks on device work here); returns the
-        :class:`_FactorBatch` for the drain thread, or None when every
-        request was already failed/evicted. A batch-attributable
-        exception re-dispatches the members solo (`_redispatch_factor_
-        survivors`), mirroring `_run_chunk`."""
-        reqs = self._admit_stage_factor(reqs)
-        if not reqs:
-            return None
-        try:
-            buf = self._stage_factor(plan, reqs)
-            if (self.health is not None and self.health.check_rhs
-                    and self._tick_staging()
-                    and not resilience.rhs_finite(buf)):
-                # exact per-batch guard (one summation of the staged
-                # stack — noise next to the O(N^3) factor): poisoned
-                # matrices fail alone BEFORE burning a factor dispatch,
-                # and always as RhsNonFinite (exact attribution), even
-                # when the fused verdict would also have caught them
-                reqs = self._isolate_poisoned_A(reqs)
-                if not reqs:
-                    return None
-                buf = self._stage_factor(plan, reqs)
-            checked = (self.health is not None
-                       and self.health.check_output)
-            Ad = jnp.asarray(buf)
-            with profiler.region("serve.factor"):
-                if checked:
-                    F, wA, verdict = plan._factor_health_fn(
-                        buf.shape[0])(Ad)
-                else:
-                    F = plan._stacked_factor_fn(buf.shape[0])(Ad)
-                    wA = verdict = None
-        except Exception as e:  # noqa: BLE001 — engine must survive
-            self._redispatch_factor_survivors(reqs, e, solo)
-            return None
-        with self._lock:
-            self._factor_batches += 1
-            self._factor_coalesced += len(reqs)
-            self._factor_slots += buf.shape[0]
-            self._factor_pad += buf.shape[0] - len(reqs)
-            bb = buf.shape[0]
-            self._factor_bucket_hits[bb] = \
-                self._factor_bucket_hits.get(bb, 0) + 1
-            self._active_plans[id(plan)] = weakref.ref(plan)
-        return _FactorBatch(plan, reqs, F, wA, verdict, Ad, solo)
-
-    # futures-owner
-    def _redispatch_factor_survivors(self, reqs, exc,
-                                     solo: bool = False) -> None:
-        """Batch-attributable factor-dispatch failure: re-dispatch each
-        member individually (one level deep) so innocents still get
-        their sessions; a solo retry that fails again fails for real."""
-        if solo or len(reqs) == 1:
-            self._fail(reqs, exc)
-            return
-        resilience.bump("survivor_redispatches", len(reqs))
-        for r in reqs:
-            self._run_factor_chunk(r.plan, [r], solo=True)
-
-    # hot-path
-    def _dispatch_stacked(self, plan, entries) -> None:
-        """Cross-session coalescing for single-system plans: per-session
-        RHS concat first (width-capped; overflow falls back to per-session
-        dispatch), then up to `max_stack` sessions stack factors along a
-        new leading axis into one vmapped dispatch. The health verdict is
-        not fused into the stacked program — stacked batches still get
-        exception-level survivor re-dispatch, and stacking is opt-in."""
-        ready = []
-        for session, reqs in entries:
-            reqs = self._admit_stage(reqs)
-            chunk: list[_Request] = []
-            width = 0
-            rest: list[_Request] = []
-            for r in reqs:
-                if not rest and (not chunk or width + r.width
-                                 <= self.max_coalesce_width):
-                    chunk.append(r)
-                    width += r.width
-                else:
-                    rest.append(r)
-            if chunk:
-                ready.append((session, chunk, width))
-            if rest:
-                self._dispatch_session(session, rest)
-        for i in range(0, len(ready), self.max_stack):
-            part = ready[i:i + self.max_stack]
-            if len(part) == 1:
-                self._run_chunk(part[0][0], part[0][1])
+            Ad = lane._to_device(buf)
+            if checked:
+                F, wA, v = plan._factor_health_fn(bb)(Ad)
+                v.block_until_ready()
             else:
-                self._run_stack(plan, part)
-
-    # hot-path, futures-owner
-    def _run_stack(self, plan, part) -> None:
-        reqs_all = [r for _, reqs, _ in part for r in reqs]
-        try:
-            wb = rank_bucket(max(w for _, _, w in part))
-            sb = rank_bucket(len(part))
-            # host-stage the whole stack in one (sb, N, wb) buffer; the
-            # pad slots repeat session 0's factors against zero columns
-            buf = np.zeros((sb, plan.N, wb),
-                           part[0][1][0].b2.dtype)
-            spec = []
-            factors, As = [], []
-            for si, (session, reqs, _w) in enumerate(part):
-                lo = 0
-                for r in reqs:
-                    buf[si, :, lo:lo + r.width] = r.b2
-                    spec.append((r, si, lo))
-                    lo += r.width
-                # read the resident state under the session lock: a
-                # drain-thread escalation must never hand this stack a
-                # half-swapped factor pytree (conflint CFX-LOCK is
-                # self-scoped; cross-object discipline is on us here)
-                with session._lock:
-                    session._ensure_resident()  # spilled: fault in now
-                    factors.append(session._factors)
-                    As.append(session._A)
-            while len(factors) < sb:
-                factors.append(factors[0])
-                As.append(As[0])
-            F = stack_trees(factors)
-            A = None if As[0] is None else jnp.stack(As)
-            with profiler.region("serve.solve"):
-                X = plan._stacked_solve_fn(sb, wb)(F, A, buf)
-        except Exception as e:  # noqa: BLE001
-            self._redispatch_survivors(reqs_all, e)
-            return
-        for session, _reqs, _w in part:
-            with session._lock:  # solves is guarded-by the session lock
-                session.solves += 1
-        with self._lock:
-            self._batches += 1
-            self._coalesced_requests += len(reqs_all)
-        self._outq.put((spec, X, None, None))
+                F = plan._stacked_factor_fn(bb)(Ad)
+                wA = None
+            # warm the drain-side slice-out too: `_settle_factor` slices
+            # each slot out of the stacked device arrays with eager
+            # indexing, and each (slot, shape, device) slice is its own
+            # tiny compiled program — cold ones would put first-batch
+            # compile stalls on every NEW lane even with the factor
+            # program warm
+            slots = unstack_tree(F, bb)
+            jax.block_until_ready(slots)
+            jax.block_until_ready([Ad[i] for i in range(bb)])
+            if wA is not None:
+                jax.block_until_ready([wA[i] for i in range(bb)])
+            plan.mark_device_warm(kind, bb, dk)
 
     # ------------------------------------------------------------------ #
     # resolution ownership + failure bookkeeping
@@ -1535,56 +2282,6 @@ class ServeEngine:
                 xs = xs[..., 0]
             r.future.set_result(xs)
 
-    # ------------------------------------------------------------------ #
-    # drain: the only thread that blocks on device work
-    # ------------------------------------------------------------------ #
-
-    # futures-owner (post-mortem wrapper: escapes reach _thread_died)
-    def _drain_loop(self) -> None:
-        try:
-            self._drain_inner()
-        except BaseException as e:  # noqa: BLE001 — post-mortem + watchdog
-            self._thread_died(self._drainer.name, e)
-
-    # futures-owner (the drain loop — the one thread that MAY block)
-    def _drain_inner(self) -> None:
-        while True:
-            item = self._outq.get()
-            if item is _STOP:
-                break
-            if isinstance(item, _FactorBatch):
-                self._drain_factor(item)
-                continue
-            spec, block_on, verdict, buf = item
-            reqs = [r for r, _si, _lo in spec]
-            try:
-                resilience.maybe_fault(self._faults, "drain")
-                resilience.maybe_fault(self._faults, "d2h")
-                # ONE blocking device->host copy per coalesced batch; the
-                # per-request scatter is numpy views of it, so answering N
-                # requests costs zero extra device dispatches
-                xh = np.asarray(block_on)
-            except Exception as e:  # noqa: BLE001
-                # satellite: batch-attributable drain failure routes
-                # through survivor re-dispatch, not batch-wide _fail
-                self._drain_redispatch(reqs, e)
-                continue
-            if verdict is not None:
-                session = reqs[0].session
-                limit = self._limit(session)
-                healthy, finite, res = resilience.evaluate(verdict, limit)
-                if resilience.data_fault(self._faults, "solve",
-                                         "unhealthy") is not None:
-                    healthy = False
-                if not healthy:
-                    resilience.bump("output_failures")
-                    self._restore_guards()
-                    self._drain_unhealthy(session, spec, buf, finite, res)
-                    continue
-                if session._breaker is not None:
-                    session._breaker.record_success()
-            self._settle(spec, xh)
-
     def _limit(self, session) -> float:
         return self._plan_limit(session.plan)
 
@@ -1593,203 +2290,69 @@ class ServeEngine:
             np.dtype(plan.key.dtype), plan.N)
 
     # ------------------------------------------------------------------ #
-    # the factor lane: drain, per-slot health, slice-out
-    # ------------------------------------------------------------------ #
-
-    # futures-owner
-    def _drain_factor(self, fb: _FactorBatch) -> None:
-        """Drain one coalesced factor batch: ONE block on the dispatched
-        program (the factors never cross to the host — only the tiny
-        verdict does, when checked), per-slot health evaluation, then
-        device-side slice-out into independent resident sessions. Slot
-        verdicts are independent, so — unlike the solve lane, which must
-        re-dispatch to ATTRIBUTE a batch verdict — healthy neighbours of
-        a sick slot settle in place; only the sick slot re-runs solo
-        (distinguishing transient staged corruption from a genuinely
-        unfactorable matrix) and fails alone with evidence."""
-        reqs = fb.reqs
-        try:
-            resilience.maybe_fault(self._faults, "drain")
-            verdicts = None
-            if fb.verdict is not None:
-                limit = self._plan_limit(fb.plan)
-                verdicts = resilience.evaluate_slots(fb.verdict, limit)
-                if resilience.data_fault(self._faults, "factor",
-                                         "unhealthy") is not None:
-                    verdicts = [(False, fin, res)
-                                for _h, fin, res in verdicts]
-            else:
-                jax.block_until_ready(fb.factors)
-        except Exception as e:  # noqa: BLE001
-            self._drain_factor_redispatch(reqs, e)
-            return
-        entries = list(enumerate(reqs))
-        if verdicts is not None:
-            sick = [(i, r) for i, r in entries if not verdicts[i][0]]
-            entries = [(i, r) for i, r in entries if verdicts[i][0]]
-            for i, r in sick:
-                resilience.bump("factor_unhealthy")
-                self._restore_guards()
-                _h, finite, res = verdicts[i]
-                if fb.solo:
-                    limit = self._plan_limit(fb.plan)
-                    self._fail([r], SolveUnhealthy(
-                        f"coalesced factorization unhealthy after solo "
-                        f"re-dispatch: finite={finite} res={res:.3e} "
-                        f"(limit {limit:.3e})",
-                        {"rungs": [{"rung": "factor", "finite": finite,
-                                    "residual": res}],
-                         "residual_limit": limit}))
-                else:
-                    self._solo_factor_drain(fb.plan, r)
-        if entries:
-            self._settle_factor(fb, entries)
-
-    # futures-owner
-    def _drain_factor_redispatch(self, reqs, exc) -> None:
-        """Drain-side batch-attributable factor failure: re-run each
-        request solo, inline (the rare path — the drain thread may
-        block)."""
-        if len(reqs) == 1:
-            self._fail(reqs, exc)
-            return
-        resilience.bump("survivor_redispatches", len(reqs))
-        for r in reqs:
-            self._solo_factor_drain(r.plan, r)
-
-    # futures-owner
-    def _solo_factor_drain(self, plan, r) -> None:
-        """One factor request, re-dispatched and drained inline on the
-        drain thread with its own per-slot verdict (solo, so a second
-        failure is final)."""
-        fb = self._build_factor_batch(plan, [r], solo=True)
-        if fb is not None:
-            self._drain_factor(fb)
-
-    # futures-owner
-    def _settle_factor(self, fb: _FactorBatch, entries) -> None:
-        """Resolve a drained factor batch: slice each live slot's factor
-        pytree, base matrix, and (when checked) probe row out of the
-        stacked device arrays — `batched.unstack_tree`, lazy device
-        indexing, zero host copies — and open one independent resident
-        :class:`~conflux_tpu.serve.SolveSession` per request. The
-        session is constructed exactly as `plan.factor` constructs it
-        (same keep-A rule, same policy plumbing), so every downstream
-        path — solve, update, drift refactor, the §20 health ladder —
-        behaves identically."""
-        now = time.perf_counter()
-        owned = self._take([r for _i, r in entries])
-        with self._lock:
-            for _i, r in entries:
-                if r in owned:
-                    self._factor_latencies.append(now - r.t_submit)
-            self._flat_seq += len(owned)
-            self._completed += len(owned)
-        plan = fb.plan
-        trees = unstack_tree(fb.factors, len(fb.reqs))
-        for i, r in entries:
-            if r not in owned:
-                continue
-            A_i = fb.A[i]
-            session = SolveSession(plan, trees[i],
-                                   A_i if plan.key.refine else None,
-                                   A_i, r.policy)
-            if fb.wA is not None:
-                # the probe row wA = w^T A0 came out of the checked
-                # factor dispatch — the session opens with its half of
-                # the Freivalds check already resident
-                session._probe = fb.wA[i]
-            r.future.set_result(session)
-
-    # futures-owner
-    def _drain_redispatch(self, reqs, exc) -> None:
-        """Survivor re-dispatch from the drain side: re-solve each
-        request solo, synchronously (this is the rare failure path — the
-        drain thread may block)."""
-        if len(reqs) == 1:
-            self._fail(reqs, exc)
-            return
-        resilience.bump("survivor_redispatches", len(reqs))
-        for r in reqs:
-            self._solo_drain(r)
-
-    # futures-owner
-    def _solo_drain(self, r) -> None:
-        """One request, re-dispatched and drained inline, with its own
-        health verdict and (if needed) escalation ladder."""
-        session = r.session
-        if not self._admit_stage([r]):
-            return
-        try:
-            buf, spec = self._stage([r])
-            if (self.health is not None and self.health.check_rhs
-                    and not self._isolate_poisoned([r])):
-                return
-            self._revive_for(session, [r])
-            x, verdict = self._solve_session(session, buf)
-            if verdict is not None:
-                limit = self._limit(session)
-                healthy, finite, res = resilience.evaluate(verdict, limit)
-                if resilience.data_fault(self._faults, "solve",
-                                         "unhealthy") is not None:
-                    healthy = False
-                if not healthy:
-                    resilience.bump("output_failures")
-                    self._restore_guards()
-                    self._escalate_settle(session, spec, buf, finite, res)
-                    return
-                if session._breaker is not None:
-                    session._breaker.record_success()
-            self._settle(spec, np.asarray(x))
-        except Exception as e:  # noqa: BLE001
-            self._fail([r], e)
-
-    # futures-owner
-    def _drain_unhealthy(self, session, spec, buf, finite, res) -> None:
-        """An unhealthy verdict on a drained batch: multi-request
-        batches isolate first (solo re-dispatch finds the sick request —
-        a poisoned column fails alone, the survivors answer); a solo
-        batch climbs the escalation ladder directly."""
-        reqs = [r for r, _si, _lo in spec]
-        if len(reqs) > 1:
-            resilience.bump("survivor_redispatches", len(reqs))
-            for r in reqs:
-                self._solo_drain(r)
-            return
-        self._escalate_settle(session, spec, buf, finite, res)
-
-    # futures-owner
-    def _escalate_settle(self, session, spec, buf, finite, res) -> None:
-        """Run the ladder for one request's staged buffer; settle on
-        recovery, fail with the structured evidence (and count toward
-        quarantine) otherwise."""
-        reqs = [r for r, _si, _lo in spec]
-        br = session._breaker
-        try:
-            xh = resilience.escalate(
-                session, buf, self.health, self._limit(session),
-                evidence0={"rung": "dispatch", "finite": finite,
-                           "residual": res},
-                faults=self._faults)
-        except Exception as e:  # noqa: BLE001 — SolveUnhealthy et al.
-            if br is not None:
-                br.record_failure()
-            self._fail(reqs, e)
-            return
-        if br is not None:
-            br.record_success()
-        self._settle(spec, xh)
-
-    # ------------------------------------------------------------------ #
     # watchdog: a dead worker fails pending work instead of queueing
+    # (multi-lane: a dead LANE fails only its own work, then revives)
     # ------------------------------------------------------------------ #
 
-    def _thread_died(self, name: str, exc: BaseException) -> None:
-        """Post-mortem hook run ON the dying worker thread: record the
-        cause and trip the watchdog path immediately (the polling
-        watchdog is the backstop for silent deaths)."""
-        self._dead = (name, exc)
-        self._watchdog_trip([name], exc)
+    def _is_worker_thread(self) -> bool:
+        """True on any lane's dispatcher/drain thread — the tier
+        manager's refactor-revival must not block on the factor lane
+        from one (a worker waiting on its own queue would deadlock)."""
+        t = threading.current_thread()
+        for ln in self._lanes:
+            if t is ln._dispatcher or t is ln._drainer:
+                return True
+        return False
+
+    def _lane_died(self, lane, thread, exc: BaseException) -> None:
+        """Post-mortem hook run ON a dying lane worker thread: record
+        the cause and trip the watchdog path immediately (the polling
+        watchdog is the backstop for silent deaths). A single-lane
+        engine trips whole — exactly the pre-fleet behavior; a
+        multi-lane engine trips ONLY the dead lane (its fault domain)
+        and leaves the rest of the fleet serving."""
+        lane._dead = (thread.name, exc)
+        if len(self._lanes) == 1:
+            self._dead = (thread.name, exc)
+            self._watchdog_trip([thread.name], exc)
+        else:
+            self._lane_trip(lane, [thread.name], exc, dying=thread)
+
+    # futures-owner
+    def _lane_trip(self, lane, names, exc, dying=None) -> None:
+        """Per-lane watchdog action (multi-lane engines): the blast
+        radius of a dead lane worker is ITS lane. Fail the live
+        requests routed to that lane (queued or in flight — resolution
+        ownership makes failing an about-to-settle one harmless), then
+        respawn the dead threads, bounded by `max_lane_revives`; past
+        the budget the lane is marked dead and the admission front
+        routes around it (all lanes dead = the global trip). Other
+        lanes' work never notices."""
+        if not lane._trip_lock.acquire(blocking=False):
+            return  # a concurrent trip (dying thread + poll) owns it
+        try:
+            resilience.bump("watchdog_trips")
+            with self._lock:
+                revive = (lane.revives < self.max_lane_revives
+                          and not self._closed)
+                if not revive:
+                    lane.dead = True
+                leftover = [r for r in self._live
+                            if getattr(r, "lane", None) is lane]
+            self._fail(leftover, EngineClosed(
+                f"lane {lane.index} worker thread(s) {names} died"
+                + (f" ({exc!r})" if exc is not None else "")
+                + f" — {len(leftover)} pending request(s) on this lane "
+                "failed by the watchdog; other lanes unaffected"))
+            if revive:
+                lane.revive(exclude=dying)
+                resilience.bump("lane_revives")
+            if self._pool_pending():
+                # the dead lane may have held the one in-flight wake:
+                # re-arm the pool so queued cold starts aren't stranded
+                self._wake_lane(force=True)
+        finally:
+            lane._trip_lock.release()
 
     # futures-owner
     def _watchdog_trip(self, names, exc) -> None:
@@ -1803,13 +2366,14 @@ class ServeEngine:
             + (f" ({exc!r})" if exc is not None else "")
             + f" — {len(leftover)} pending request(s) failed by the "
             "watchdog instead of queueing forever"))
-        # unwedge whichever worker survived
-        self._inq.put(_STOP)
-        try:
-            self._outq.put_nowait(_STOP)
-        # conflint: disable=CFX-FUTURE a full outq already wakes the drain; nothing owned here
-        except Full:
-            pass
+        # unwedge whichever workers survived
+        for lane in self._lanes:
+            lane._inq.put(_STOP)
+            try:
+                lane._outq.put_nowait(_STOP)
+            # conflint: disable=CFX-FUTURE a full outq already wakes the drain; nothing owned here
+            except Full:
+                pass
 
     def _watchdog_loop(self) -> None:
         while True:
@@ -1817,11 +2381,29 @@ class ServeEngine:
             # conflint: disable=CFX-LOCK benign racy poll; a stale read only delays one tick
             if self._closed:
                 return
-            dead = [t.name for t in (self._dispatcher, self._drainer)
-                    if not t.is_alive()]
-            if dead:
-                exc = self._dead[1] if self._dead is not None else None
-                self._watchdog_trip(dead, exc)
+            if len(self._lanes) == 1:
+                lane = self._lanes[0]
+                dead = [t.name for t in (lane._dispatcher, lane._drainer)
+                        if not t.is_alive()]
+                if dead:
+                    exc = (lane._dead[1] if lane._dead is not None
+                           else None)
+                    self._watchdog_trip(dead, exc)
+                    return
+                continue
+            for lane in self._lanes:
+                if lane.dead:
+                    continue
+                dead = [t.name for t in (lane._dispatcher, lane._drainer)
+                        if not t.is_alive()]
+                if dead:
+                    exc = (lane._dead[1] if lane._dead is not None
+                           else None)
+                    self._lane_trip(lane, dead, exc)
+            if all(ln.dead for ln in self._lanes):
+                # nothing left to serve: the global trip fails whatever
+                # is still pending and closes the engine
+                self._watchdog_trip(["all lanes"], None)
                 return
 
     # ------------------------------------------------------------------ #
@@ -1854,7 +2436,39 @@ class ServeEngine:
                 "width_capped": self._width_capped,
                 "bucket_hits": dict(self._bucket_hits),
                 "factor_bucket_hits": dict(self._factor_bucket_hits),
+                "lanes": self._lane_rows_locked(),
             }
+
+    # requires-lock: _lock
+    def _lane_rows_locked(self) -> list:
+        """Per-lane telemetry rows — SORT-FREE (counters() ships these
+        to the 10 Hz controller tick): per-device batches and coalesced
+        means, cold-start batches, queue depth/high-water, busy-time
+        occupancy, the resolved coalescing window, and the fault-domain
+        state (revivals spent, dead flag)."""
+        now = time.perf_counter()
+        rows = []
+        for ln in self._lanes:
+            wall = max(1e-9, now - ln.t_start)
+            busy = max(ln.busy_dispatch_s, ln.busy_drain_s)
+            rows.append({
+                "lane": ln.index,
+                "device": (None if ln.device is None else str(ln.device)),
+                "delay": ln.delay,
+                "batches": ln.batches,
+                "coalesced_requests": ln.coalesced,
+                "coalesced_mean": (ln.coalesced / ln.batches
+                                   if ln.batches else 0.0),
+                "factor_batches": ln.factor_batches,
+                "factor_coalesced_requests": ln.factor_coalesced,
+                "bucket_hits": dict(ln.bucket_hits),
+                "queue_depth": ln._inq.qsize(),
+                "queue_peak": ln.queue_hw,
+                "occupancy": min(1.0, busy / wall),
+                "revives": ln.revives,
+                "dead": ln.dead,
+            })
+        return rows
 
     def stats(self) -> dict:
         """Engine counters: queue depth high-water mark, batches
@@ -1900,6 +2514,7 @@ class ServeEngine:
                 "width_capped": self._width_capped,
                 "bucket_hits": dict(self._bucket_hits),
                 "factor_bucket_hits": dict(self._factor_bucket_hits),
+                "lanes": self._lane_rows_locked(),
                 "knobs": self._knobs_locked(),
             }
         if self.residency is not None:

@@ -44,6 +44,44 @@ def enable(on: bool = True) -> None:
     _enabled = on
 
 
+# ---------------------------------------------------------------------- #
+# XLA compile counter (the per-DEVICE zero-compile gate's instrument)
+# ---------------------------------------------------------------------- #
+#
+# One jitted program traces ONCE per shape signature but compiles one
+# executable PER DEVICE it dispatches on — the plan-level trace counters
+# (`FactorPlan.trace_counts`) therefore cannot see a cold lane paying a
+# first-dispatch compile on its own device. jax's monitoring stream
+# reports every backend compile; counting it here gives tests and
+# benches the exact "zero compiles after prewarm, on every lane" gate.
+
+_COMPILE_EVENT = "/jax/core/compile/backend_compile_duration"
+_compiles = 0  # guarded-by: _PROF_LOCK
+
+
+def _count_compile(event, duration, **kwargs) -> None:
+    global _compiles
+    if event == _COMPILE_EVENT:
+        with _PROF_LOCK:
+            _compiles += 1
+
+
+try:  # private jax surface: degrade to a frozen counter if it moves
+    from jax._src import monitoring as _jax_monitoring
+
+    _jax_monitoring.register_event_duration_secs_listener(_count_compile)
+except Exception:  # noqa: BLE001 — the counter is observability only
+    _jax_monitoring = None
+
+
+def compile_count() -> int:
+    """Total XLA backend compiles this process has paid (all devices,
+    all programs — monotone; window it by differencing). 0 forever when
+    the jax monitoring hook is unavailable."""
+    with _PROF_LOCK:
+        return _compiles
+
+
 @contextlib.contextmanager
 def region(name: str):
     """Profiled named scope: `with profiler.region('step1_pivoting'): ...`"""
@@ -180,7 +218,9 @@ def engine_stats() -> dict:
            "factor_requests": 0, "factor_batches": 0,
            "factor_coalesced_mean": 0.0, "factor_pad_waste": 0.0,
            "factor_latency_p50_ms": 0.0, "factor_latency_p95_ms": 0.0,
-           "factor_latency_p99_ms": 0.0}
+           "factor_latency_p99_ms": 0.0,
+           "lanes": 0, "lane_batches_max": 0, "lane_batches_min": 0,
+           "lane_occupancy_max": 0.0}
     coalesced = 0
     fcoalesced = fslots = fpad = 0
     samples: list = []
@@ -200,6 +240,17 @@ def engine_stats() -> dict:
         fpad += s["factor_pad_slots"]
         samples.extend(e.latency_samples())
         fsamples.extend(e.factor_latency_samples())
+        # per-lane fleet view (PR 9): lane count and the dispatch-balance
+        # extremes across every engine's lanes — the one-glance answer
+        # to "is one device starving while another drowns"
+        for ln in s.get("lanes", ()):
+            out["lanes"] += 1
+            b = ln.get("batches", 0) + ln.get("factor_batches", 0)
+            out["lane_batches_max"] = max(out["lane_batches_max"], b)
+            out["lane_batches_min"] = (b if out["lanes"] == 1
+                                       else min(out["lane_batches_min"], b))
+            out["lane_occupancy_max"] = max(out["lane_occupancy_max"],
+                                            ln.get("occupancy", 0.0))
     if out["batches"]:
         out["coalesced_mean"] = coalesced / out["batches"]
     if out["factor_batches"]:

@@ -64,6 +64,24 @@ class RhsNonFinite(ValueError):
     isolated at staging so it never contaminates a coalesced batch."""
 
 
+class MeshPlanUnsupported(ValueError):
+    """A mesh-sharded (batch-sharded) plan hit a serving surface that
+    only speaks unsharded program families — the engine's coalesced
+    factor lane, per-lane device placement, tier adoption. Structured
+    (a ValueError subclass, so legacy string-matching callers keep
+    working) so callers can route mesh plans programmatically: catch
+    this and fall back to ``plan.factor`` / the batch-sharded programs,
+    which serve mesh plans directly. Every raise is counted in
+    ``profiler.serve_stats()['health']['mesh_plan_unsupported']``.
+    `surface` names the rejecting surface (e.g. 'factor_lane',
+    'prewarm', 'tier')."""
+
+    def __init__(self, msg: str, surface: str = ""):
+        super().__init__(msg)
+        self.surface = surface
+        bump("mesh_plan_unsupported")
+
+
 class DeadlineExceeded(TimeoutError):
     """The request's deadline passed while it was queued; its pending
     slot has been released (lazy eviction, `ServeEngine.submit`)."""
@@ -152,6 +170,9 @@ _HEALTH_KEYS = (
     "quarantine_probes",
     "quarantine_recoveries",
     "watchdog_trips",
+    "lane_revives",           # per-lane watchdog trips that respawned a lane
+    "mesh_plan_unsupported",  # MeshPlanUnsupported raised (mesh plan routed
+                              # at an unsharded-only serving surface)
     "faults_injected",
 )
 

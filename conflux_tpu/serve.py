@@ -60,7 +60,12 @@ from jax import lax
 
 from conflux_tpu.ops import blas
 from conflux_tpu import profiler
-from conflux_tpu.batched import _batch_spec, _shard_batch, unstack_tree
+from conflux_tpu.batched import (
+    _batch_spec,
+    _shard_batch,
+    put_tree,
+    unstack_tree,
+)
 from conflux_tpu.parallel.mesh import lookup_mesh, mesh_cache_key
 from conflux_tpu.update import (
     DriftPolicy,
@@ -189,6 +194,14 @@ class FactorPlan:
         # the factor lane's stacked cold-start programs, keyed by batch
         # bucket (kept apart from _solve_cache, whose keys tests assert)
         self._factor_cache: dict[tuple, Any] = {}
+        # per-DEVICE warm registry (kept apart from the program caches,
+        # whose key sets tests assert): one jitted program traces once
+        # per shape but compiles one executable per device it runs on,
+        # so a mesh-sharded serve fleet must warm each (kind, bucket)
+        # once per LANE device. Engine prewarm records completions here
+        # and dedupes identical (plan, bucket, device) work across
+        # sessions/lanes; devkey None is the default device.
+        self._warm_devices: set = set()  # guarded-by: _compile_lock
 
     def _memo(self, cache: dict, key, build):
         """Double-checked get-or-build of a compiled-program cache entry;
@@ -332,6 +345,8 @@ class FactorPlan:
         using it safely — release only unlinks the cache entry."""
         dropped = 0
         with self._compile_lock:
+            wbs = {int(w) for w in widths}
+            fbs = set()
             for w in widths:
                 wb = int(w)
                 keys = [wb, ("health", wb), ("refine", wb)]
@@ -347,10 +362,37 @@ class FactorPlan:
                         "factor bucket 1 is the plan.factor/refactor "
                         "path itself (FactorPlan._factor_once) — it is "
                         "not a retirable coalescing bucket")
+                fbs.add(bb)
                 for key in (("factor", bb), ("factor_health", bb)):
                     dropped += (self._factor_cache.pop(key, None)
                                 is not None)
+            # a released bucket is COLD again on every device: drop its
+            # per-device warm records too, or a later regrow would skip
+            # the re-warm and put the first-dispatch compile back on
+            # the serving path
+            self._warm_devices = {
+                k for k in self._warm_devices
+                if not (
+                    (k[0] in ("solve", "solve_health") and k[1] in wbs)
+                    or (k[0] == "stacked"
+                        and isinstance(k[1], tuple) and k[1][1] in wbs)
+                    or (k[0] in ("factor", "factor_health")
+                        and k[1] in fbs))}
         return dropped
+
+    def device_warm(self, kind: str, bucket: int, devkey) -> bool:
+        """True when (kind, bucket) has completed a warm-up dispatch on
+        the device identified by `devkey` (see `engine._devkey`; None =
+        the default device). The per-lane prewarm dedupe read."""
+        with self._compile_lock:
+            return (kind, int(bucket), devkey) in self._warm_devices
+
+    def mark_device_warm(self, kind: str, bucket: int, devkey) -> None:
+        """Record a completed (kind, bucket, device) warm-up. Called by
+        the engine AFTER the warming dispatch finished, so a crashed
+        prewarm never poisons the registry."""
+        with self._compile_lock:
+            self._warm_devices.add((kind, int(bucket), devkey))
 
     # ------------------------------------------------------------------ #
     # program builders
@@ -824,7 +866,8 @@ class FactorPlan:
             raise ValueError(f"A dtype {A.dtype} does not match the plan's "
                              f"{self.key.dtype}")
 
-    def factor(self, A, *, policy: DriftPolicy | None = None) -> "SolveSession":
+    def factor(self, A, *, policy: DriftPolicy | None = None,
+               device=None, sid=None) -> "SolveSession":
         """Run the factor program on A and open a device-resident session.
 
         The returned session holds the factors (and A itself — the
@@ -833,15 +876,36 @@ class FactorPlan:
         is substitution-only. `policy` governs when `session.update`
         drifts trigger a true refactorization (default
         :class:`DriftPolicy`).
+
+        `device` pins the session to one device of the serve fleet: A is
+        committed there before factoring, so the factors (and every
+        later substitution) live and run on that device — the mesh-
+        sharded engine's per-lane placement (DESIGN §25). None keeps the
+        default device (byte-identical to the pre-fleet behavior).
+        `sid` is an optional STABLE session id; the engine's consistent-
+        hash placement (`engine.place_session`) maps equal sids to equal
+        devices across engine restarts. Mesh plans refuse `device=`:
+        their state is already sharded across the whole mesh.
         """
+        if device is not None and self.mesh is not None:
+            from conflux_tpu.resilience import MeshPlanUnsupported
+
+            raise MeshPlanUnsupported(
+                "device= pins a session to ONE device, but a "
+                "mesh-sharded plan's state is batch-sharded across the "
+                "whole mesh already — factor mesh plans without a "
+                "device pin", surface="factor")
         A = jnp.asarray(A)
         self._check_A(A)
         if self.mesh is not None:
             (A,) = _shard_batch((A,), self.mesh)
+        elif device is not None:
+            A = jax.device_put(A, device)
         with profiler.region("serve.factor"):
             factors = self._factor_once(A)
         keep_A = A if self.key.refine else None
-        return SolveSession(self, factors, keep_A, A, policy)
+        return SolveSession(self, factors, keep_A, A, policy,
+                            device=device, sid=sid)
 
     def solve(self, A, b):
         """One-shot convenience: factor + solve in one call (a fresh
@@ -868,8 +932,17 @@ class SolveSession:
     """
 
     def __init__(self, plan: FactorPlan, factors, A, A_base=None,
-                 policy: DriftPolicy | None = None):
+                 policy: DriftPolicy | None = None, *,
+                 device=None, sid=None):
         self.plan = plan
+        # fleet placement (DESIGN §25): the device this session's state
+        # lives on (None = default device — the pre-fleet behavior,
+        # byte-identical) and an optional STABLE id the engine's
+        # consistent-hash placement keys on. Both write-once-ish: the
+        # engine pins an unplaced session at first submit (under the
+        # session lock) and never re-pins a placed one.
+        self.device = device
+        self.sid = sid
         # resilience + concurrency state: every factor/drift mutation
         # and every read of the resident state happens under this
         # re-entrant lock (conflint CFX-LOCK enforces the guarded-by
@@ -985,6 +1058,43 @@ class SolveSession:
                 if leaf is not None:
                     seen[id(leaf)] = int(leaf.nbytes)
             return sum(seen.values())
+
+    def to_device(self, device) -> "SolveSession":
+        """Move the session's resident state to `device` and pin it
+        there — the engine's placement hook (a not-yet-placed session
+        submitted to a mesh-sharded fleet lands on its consistent-hash
+        lane through this). One `jax.device_put` per UNIQUE buffer
+        (`batched.put_tree` preserves the `_A is _A0` alias, so the
+        byte accounting stays deduplicated); `device=None` or an
+        already-there session is a no-op. Runs under the session RLock
+        — a concurrent solve never observes half-moved state. Mesh
+        plans refuse: their state is sharded across the whole mesh."""
+        if device is None:
+            return self
+        if self.plan.mesh is not None:
+            from conflux_tpu.resilience import MeshPlanUnsupported
+
+            raise MeshPlanUnsupported(
+                "a mesh-sharded session's state is batch-sharded "
+                "across the whole mesh — it cannot move to one device",
+                surface="to_device")
+        with self._lock:
+            self._ensure_resident()
+            moved = put_tree(
+                {"f": self._factors, "A": self._A, "A0": self._A0,
+                 "probe": self._probe,
+                 "upd": (None if self._upd is None else
+                         {k: self._upd[k]
+                          for k in ("Up", "Vp", "Y", "Cinv")})},
+                device)
+            self._factors = moved["f"]
+            self._A = moved["A"]
+            self._A0 = moved["A0"]
+            self._probe = moved["probe"]
+            if self._upd is not None:
+                self._upd = {**self._upd, **moved["upd"]}
+            self.device = device
+        return self
 
     def _rhs(self, b):
         plan = self.plan

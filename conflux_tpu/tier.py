@@ -371,6 +371,13 @@ def _read_record(dirpath: str, faults=None) -> tuple[dict, dict]:
 # --------------------------------------------------------------------------- #
 
 
+def _session_devkey(s):
+    """Hashable device identity of a session's placement (None = the
+    default device) — the per-device cap accounting key (DESIGN §25)."""
+    d = getattr(s, "device", None)
+    return None if d is None else (d.platform, d.id)
+
+
 class _SpillRecord:
     """Where a non-resident session's state lives. `tier` walks
     'transit' (device arrays stashed, d2h pending — a racing fault-in
@@ -448,6 +455,8 @@ class ResidentSet:
 
     def __init__(self, *, max_sessions: int | None = None,
                  max_bytes: int | None = None,
+                 max_sessions_per_device: int | None = None,
+                 max_bytes_per_device: int | None = None,
                  host_max_sessions: int | None = None,
                  host_max_bytes: int | None = None,
                  disk_dir: str | None = None,
@@ -458,10 +467,24 @@ class ResidentSet:
         if max_sessions is not None and max_sessions < 1:
             raise ValueError("max_sessions must be >= 1 (a zero-session "
                              "device tier cannot serve)")
+        if max_sessions_per_device is not None \
+                and max_sessions_per_device < 1:
+            raise ValueError("max_sessions_per_device must be >= 1")
         if evict_batch < 1:
             raise ValueError("evict_batch must be >= 1")
         self.max_sessions = max_sessions
         self.max_bytes = max_bytes
+        # per-DEVICE caps (DESIGN §25): on a mesh-sharded fleet the
+        # global caps alone let one hot device's revival storm evict
+        # sessions fleet-wide — victims are picked by LRU regardless of
+        # where they live, so a cold device's residents pay for a hot
+        # device's pressure AND the hot device still overshoots its own
+        # HBM. With these set, each device's population/bytes are
+        # bounded separately and victims for a device's overage come
+        # from THAT device only. None (default) = global-only, the
+        # pre-fleet behavior.
+        self.max_sessions_per_device = max_sessions_per_device
+        self.max_bytes_per_device = max_bytes_per_device
         self.host_max_sessions = host_max_sessions
         self.host_max_bytes = host_max_bytes
         self.disk_dir = disk_dir
@@ -486,13 +509,13 @@ class ResidentSet:
         # enforcers don't double-spill it.
         self._state: dict[int, str] = {}     # guarded-by: _lock
         self._bytes: dict[int, int] = {}     # guarded-by: _lock
-        # in-flight capacity claims {token: (bytes, sessions)}: a
+        # in-flight capacity claims {token: (bytes, sessions, devkey)}: a
         # fault-in/adopt registers its incoming footprint here BEFORE
         # making room, so two concurrent revivals each see the other's
         # reservation and the victim math never lets them land past
         # the caps together (the capacity race the tier chaos soak
         # caught: both sized their eviction against the same snapshot)
-        self._claims: dict[int, tuple[int, int]] = {}  # guarded-by: _lock
+        self._claims: dict[int, tuple] = {}  # guarded-by: _lock
         self._claim_seq = itertools.count()
         self._device_bytes = 0               # guarded-by: _lock
         self._device_hw = 0                  # guarded-by: _lock
@@ -518,9 +541,10 @@ class ResidentSet:
         device buffers the host tiers cannot round-trip. Chainable."""
         for s in sessions:
             if s.plan.mesh is not None:
-                raise ValueError(
+                raise resilience.MeshPlanUnsupported(
                     "ResidentSet manages unsharded plans only — a "
-                    "mesh-sharded session's state lives across devices")
+                    "mesh-sharded session's state lives across devices",
+                    surface="tier")
             if s._residency is not None and s._residency is not self:
                 raise ValueError("session is already managed by a "
                                  "different ResidentSet")
@@ -550,7 +574,8 @@ class ResidentSet:
                             # re-adoption spill its own adoptee through
                             # the reentrant RLock (review-caught)
                             token = next(self._claim_seq)
-                            self._claims[token] = (nb, 1)
+                            self._claims[token] = (nb, 1,
+                                                   _session_devkey(s))
                             self._state[sid] = "reviving"
                         elif state == "resident":
                             # re-adoption of a managed resident
@@ -799,18 +824,20 @@ class ResidentSet:
         (the accounted-byte gauge retires victims at stash time for
         the same reason)."""
         res = sum(1 for x in self._state.values() if x == "resident")
-        return res + sum(cn for _cb, cn in self._claims.values())
+        return res + sum(cn for _cb, cn, _dk in self._claims.values())
 
-    def _claim(self, nbytes: int, count: int) -> int:
+    def _claim(self, nbytes: int, count: int, devkey=None) -> int:
         """Reserve incoming device capacity ahead of a fault-in/adopt.
         The reservation participates in every concurrent caller's
         victim math (`_pick_victims`) until released, so simultaneous
         revivals cannot each size their eviction against a snapshot
-        blind to the other and land past the caps together. Returns
-        the release token for :meth:`_unclaim`."""
+        blind to the other and land past the caps together. `devkey`
+        attributes the incoming footprint to one device for the
+        per-device caps. Returns the release token for
+        :meth:`_unclaim`."""
         token = next(self._claim_seq)
         with self._lock:
-            self._claims[token] = (int(nbytes), int(count))
+            self._claims[token] = (int(nbytes), int(count), devkey)
         return token
 
     def _unclaim(self, token: int) -> None:
@@ -834,7 +861,7 @@ class ResidentSet:
                         if self._state.get(sid) == "resident"]
             resident.sort(key=lambda e: e[1]._tier_stamp)
             claimed_b = claimed_n = 0
-            for cb, cn in self._claims.values():
+            for cb, cn, _dk in self._claims.values():
                 claimed_b += cb
                 claimed_n += cn
             need_n = 0
@@ -860,6 +887,48 @@ class ResidentSet:
                     if len(victims) >= self.evict_batch:
                         break
                     victims.append(s)
+            # per-DEVICE caps (DESIGN §25): each device's overage is
+            # relieved by victims living ON that device — LRU within
+            # the device — so one hot device's pressure never evicts a
+            # cold device's residents, and the hot device itself stays
+            # under its own cap. Already-picked global victims credit
+            # their device's relief first.
+            if self.max_sessions_per_device is not None \
+                    or self.max_bytes_per_device is not None:
+                picked = {id(s) for s in victims}
+                by_dev: dict = {}
+                for sid, s in resident:
+                    by_dev.setdefault(_session_devkey(s),
+                                      []).append((sid, s))
+                cl_n: dict = {}
+                cl_b: dict = {}
+                for cb, cn, dk in self._claims.values():
+                    cl_n[dk] = cl_n.get(dk, 0) + cn
+                    cl_b[dk] = cl_b.get(dk, 0) + cb
+                for dk, members in by_dev.items():
+                    need_n_d = need_b_d = 0
+                    if self.max_sessions_per_device is not None:
+                        need_n_d = (len(members) + cl_n.get(dk, 0)
+                                    - self.max_sessions_per_device)
+                    if self.max_bytes_per_device is not None:
+                        res_b = sum(self._bytes.get(sid, 0)
+                                    for sid, _s in members)
+                        need_b_d = (res_b + cl_b.get(dk, 0)
+                                    - self.max_bytes_per_device)
+                    taken = freed_d = 0
+                    for sid, s in members:
+                        if sid in picked:
+                            taken += 1
+                            freed_d += self._bytes.get(sid, 0)
+                    for sid, s in members:  # members keep LRU order
+                        if taken >= need_n_d and freed_d >= need_b_d:
+                            break
+                        if sid in picked:
+                            continue
+                        victims.append(s)
+                        picked.add(sid)
+                        taken += 1
+                        freed_d += self._bytes.get(sid, 0)
             for s in victims:
                 self._state[id(s)] = "spilling"
         return victims
@@ -1021,7 +1090,7 @@ class ResidentSet:
             # past the caps together
             incoming = (0 if rec.tier == "transit"
                         else _host_nbytes(leaves))
-            token = self._claim(incoming, 1)
+            token = self._claim(incoming, 1, _session_devkey(session))
             try:
                 self._make_room(0, 0)
                 if stale and rec.tier != "transit":
@@ -1031,8 +1100,16 @@ class ResidentSet:
                     _implant(session, leaves, meta)
                     bump("revives_h2d")
                 else:
-                    dev = {k: jnp.asarray(v)
-                           for k, v in leaves.items()}
+                    # restores land on the session's PINNED device (the
+                    # mesh-sharded fleet's placement); unpinned sessions
+                    # keep the default-device path byte-for-byte
+                    target = getattr(session, "device", None)
+                    if target is None:
+                        dev = {k: jnp.asarray(v)
+                               for k, v in leaves.items()}
+                    else:
+                        dev = {k: jax.device_put(v, target)
+                               for k, v in leaves.items()}
                     _implant(session, dev, meta)
                     bump("revives_h2d")
                 if from_disk:
@@ -1084,11 +1161,17 @@ class ResidentSet:
             A1 = A0
         eng = self.engine
         fresh = None
-        if eng is not None and not eng._is_worker_thread():
+        target = getattr(session, "device", None)
+        # the lane path honors a pinned session's placement only when
+        # the engine actually serves that device; otherwise the direct
+        # path below factors in place (state stays on its device)
+        servable = target is None or target in getattr(eng, "devices", ())
+        if eng is not None and not eng._is_worker_thread() and servable:
             from conflux_tpu.engine import EngineClosed, EngineSaturated
 
             try:
-                fresh = eng.factor(plan, A1, policy=session.policy)
+                fresh = eng.factor(plan, A1, policy=session.policy,
+                                   device=target)
             except (EngineClosed, EngineSaturated):
                 fresh = None  # lane unavailable: direct path below
         if fresh is not None:
@@ -1096,7 +1179,9 @@ class ResidentSet:
             session._A0 = fresh._A0
             session._probe = fresh._probe
         else:
-            Ad = jnp.asarray(A1)
+            target = getattr(session, "device", None)
+            Ad = (jnp.asarray(A1) if target is None
+                  else jax.device_put(A1, target))
             with profiler.region("serve.refactor"):
                 session._factors = plan._factor_once(Ad)
             session._A0 = Ad
@@ -1118,14 +1203,21 @@ class ResidentSet:
         tail ends up resident. Oversized singletons land anyway — the
         `fault_in` semantics: eviction did its best, cap softly
         exceeded."""
+        cap_n = self.max_sessions
+        if self.max_sessions_per_device is not None:
+            cap_n = (self.max_sessions_per_device if cap_n is None
+                     else min(cap_n, self.max_sessions_per_device))
+        cap_b = self.max_bytes
+        if self.max_bytes_per_device is not None:
+            cap_b = (self.max_bytes_per_device if cap_b is None
+                     else min(cap_b, self.max_bytes_per_device))
         out: list = []
         cur: list = []
         cb = 0
         for s, rec in recs:
-            over_n = (self.max_sessions is not None
-                      and len(cur) >= self.max_sessions)
-            over_b = (self.max_bytes is not None and cur
-                      and cb + rec.nbytes > self.max_bytes)
+            over_n = (cap_n is not None and len(cur) >= cap_n)
+            over_b = (cap_b is not None and cur
+                      and cb + rec.nbytes > cap_b)
             if cur and (over_n or over_b):
                 out.append(cur)
                 cur, cb = [], 0
@@ -1168,7 +1260,8 @@ class ResidentSet:
                     rest.append(s)
                     continue
                 key = (id(s.plan), rec.meta["n_factors"],
-                       rec.meta["has_probe"], rec.meta["keep_A"])
+                       rec.meta["has_probe"], rec.meta["keep_A"],
+                       _session_devkey(s))
                 groups.setdefault(key, []).append(s)
         n = 0
         for group in groups.values():
@@ -1202,12 +1295,21 @@ class ResidentSet:
                 for chunk in self._group_chunks(recs):
                     token = self._claim(
                         sum(rec.nbytes for _s, rec in chunk),
-                        len(chunk))
+                        len(chunk), _session_devkey(chunk[0][0]))
                     try:
                         with profiler.region("serve.revive"):
                             self._make_room(0, 0)
                             stacked = stack_host_trees(
                                 [rec.leaves for _s, rec in chunk])
+                            target = getattr(chunk[0][0], "device",
+                                             None)
+                            if target is not None:
+                                # the grouped h2d lands on the group's
+                                # pinned device (groups are keyed by
+                                # device, so the chunk is homogeneous)
+                                stacked = {
+                                    k: jax.device_put(v, target)
+                                    for k, v in stacked.items()}
                             slots = unstack_tree(stacked, len(chunk))
                         for (s, rec), dev in zip(chunk, slots):
                             with s._lock:
@@ -1222,10 +1324,12 @@ class ResidentSet:
                                 # retire this slot's share of the
                                 # chunk claim in the same lock
                                 # acquisition that counts it landed
-                                cb, cn = self._claims.get(token, (0, 0))
+                                cb, cn, cdk = self._claims.get(
+                                    token, (0, 0, None))
                                 if cn > 1:
                                     self._claims[token] = (
-                                        max(0, cb - rec.nbytes), cn - 1)
+                                        max(0, cb - rec.nbytes),
+                                        cn - 1, cdk)
                                 else:
                                     self._claims.pop(token, None)
                                 self._state[sid] = "resident"
@@ -1281,7 +1385,25 @@ class ResidentSet:
                 "disk_bytes": self._disk_bytes,
                 "max_sessions": self.max_sessions,
                 "max_bytes": self.max_bytes,
+                "max_sessions_per_device": self.max_sessions_per_device,
+                "max_bytes_per_device": self.max_bytes_per_device,
+                "per_device": self._per_device_locked(),
             }
+
+    # requires-lock: _lock
+    def _per_device_locked(self) -> dict:
+        """Resident population/bytes per device — the balance gauge the
+        per-device caps are judged by (str devkey -> counts; 'None' is
+        the default device)."""
+        out: dict = {}
+        for sid, s in self._sessions.items():
+            if self._state.get(sid) != "resident":
+                continue
+            dk = str(_session_devkey(s))
+            d = out.setdefault(dk, {"sessions": 0, "bytes": 0})
+            d["sessions"] += 1
+            d["bytes"] += self._bytes.get(sid, 0)
+        return out
 
 
 # --------------------------------------------------------------------------- #
@@ -1371,6 +1493,13 @@ def save_fleet(path: str, sessions, names=None) -> dict:
                     dict(rec.error.evidence)) from rec.error
             meta = dict(meta)
             meta["policy"] = _policy_fields(s.policy)
+            # the stable session id rides the checkpoint (placement
+            # identity): a restored fleet re-pins deterministically
+            # through engine.place_session. Devices themselves are NOT
+            # persisted — the restoring process may have a different
+            # device list
+            if getattr(s, "sid", None) is not None:
+                meta["sid"] = s.sid
             nbytes = _write_record(os.path.join(path, name), leaves,
                                    meta)
         entries.append({"name": name, "dir": name,
@@ -1409,7 +1538,8 @@ def load_fleet(path: str, *, residency: ResidentSet | None = None):
         leaves, meta = _read_record(os.path.join(path, e["dir"]))
         pol = (DriftPolicy(**meta["policy"])
                if meta.get("policy") is not None else None)
-        s = SolveSession(plan, None, None, None, pol)
+        s = SolveSession(plan, None, None, None, pol,
+                         sid=meta.get("sid"))
         rec = _SpillRecord("host", leaves, meta,
                            nbytes=_host_nbytes(leaves))
         with s._lock:

@@ -686,6 +686,197 @@ def run_tier_trial(seed: int) -> tuple[bool, str]:
                       f"corrupt={st['corrupt_sessions']}")
 
 
+def run_fleet_trial(seed: int) -> tuple[bool, str]:
+    """One chaos trial of the MESH-SHARDED serve fleet (ISSUE 9):
+    mixed solve + cold-start traffic over a lanes='auto' engine (one
+    DeviceLane per simulated device; sessions pinned by sid hash,
+    explicit device, or the work-stealing pool) under the serve fault
+    menu PLUS lane-thread kills.
+
+    Invariants (per-lane fault domains, never silent corruption):
+    every future resolves; failures are STRUCTURED resilience errors
+    (EngineClosed only for work on a killed lane); clean answers match
+    the f64 oracle regardless of which lane served them; a killed
+    lane's workers are respawned and BOTH that lane and the rest of
+    the fleet serve afterwards (the engine never closes); pending==0
+    and coherent counters at close."""
+    import jax
+
+    import jax.numpy as jnp
+
+    from conflux_tpu import resilience, serve
+    from conflux_tpu.engine import EngineClosed, EngineSaturated, \
+        ServeEngine
+    from conflux_tpu.resilience import (
+        DeadlineExceeded,
+        FaultPlan,
+        FaultSpec,
+        HealthPolicy,
+        InjectedFault,
+        RhsNonFinite,
+        SessionQuarantined,
+        SolveUnhealthy,
+    )
+
+    rng = np.random.default_rng(seed)
+    serve.clear_plans()
+    N = int(rng.choice([32, 64]))
+    S = int(rng.integers(2, 5))
+    plan = serve.FactorPlan.create((N, N), jnp.float32, v=16)
+    devs = jax.devices()
+    As, sessions = [], []
+    for si in range(S):
+        A = (rng.standard_normal((N, N)) / np.sqrt(N)
+             + 2.0 * np.eye(N)).astype(np.float32)
+        # placement mix: sid hash / explicit device / unpinned
+        mode = int(rng.integers(3))
+        if mode == 0:
+            sess = plan.factor(jnp.asarray(A), sid=f"soak-{seed}-{si}")
+        elif mode == 1:
+            sess = plan.factor(jnp.asarray(A),
+                               device=devs[int(rng.integers(len(devs)))])
+        else:
+            sess = plan.factor(jnp.asarray(A))
+        As.append(A.astype(np.float64))
+        sessions.append(sess)
+    menu = [
+        FaultSpec("staging", "nan", prob=0.3,
+                  count=int(rng.integers(1, 4))),
+        FaultSpec("factor", "nan", prob=0.3, count=1),
+        FaultSpec("dispatch", "delay", prob=0.3, delay_s=0.002, count=3),
+        FaultSpec("dispatch", "kill", prob=0.3, count=1),
+        FaultSpec("drain", "crash", prob=0.4, count=1),
+        FaultSpec("d2h", "crash", prob=0.4, count=1),
+        FaultSpec("solve", "unhealthy", prob=0.3,
+                  count=int(rng.integers(1, 3))),
+    ]
+    picks = [m for m in menu if rng.integers(2)]
+    faults = FaultPlan(picks, seed=seed)
+    killful = any(f.site == "dispatch" and f.kind == "kill"
+                  for f in picks)
+    label = (f"seed={seed} fleet N={N} S={S} "
+             f"faults={[(f.site, f.kind) for f in picks]}")
+    eng = ServeEngine(
+        max_batch_delay=float(rng.choice([0.0, 0.002])),
+        max_pending=128, max_coalesce_width=8, max_factor_batch=4,
+        lanes="auto",
+        health=HealthPolicy(quarantine_after=2,
+                            quarantine_cooldown=0.05),
+        fault_plan=faults, watchdog_interval=0.02)
+    reqs = []
+    cold = []
+    try:
+        for i in range(24):
+            if rng.integers(4) == 0:  # cold start through the pool
+                Ac = (rng.standard_normal((N, N)) / np.sqrt(N)
+                      + 2.0 * np.eye(N)).astype(np.float32)
+                try:
+                    cold.append((Ac.astype(np.float64),
+                                 eng.submit_factor(plan, Ac)))
+                except (RhsNonFinite, EngineSaturated):
+                    pass
+                continue
+            si = int(rng.integers(S))
+            w = int(rng.choice([1, 1, 2, 3]))
+            b = rng.standard_normal((N, w)).astype(np.float32)
+            deadline = None
+            kind = int(rng.integers(8))
+            if kind == 0:
+                b[int(rng.integers(N)), 0] = np.nan
+            elif kind == 1:
+                deadline = 0.0
+            try:
+                reqs.append((si, b,
+                             eng.submit(sessions[si], b,
+                                        deadline=deadline)))
+            except (RhsNonFinite, SessionQuarantined, EngineSaturated,
+                    EngineClosed):
+                continue
+        # a killed lane must not take the fleet down: the engine still
+        # admits and answers (possibly on other lanes) after the menu
+        time.sleep(0.1)
+        if killful:
+            revived = [ln for ln in eng.lanes if ln.revives]
+            if not revived and faults.injected.get(
+                    ("dispatch", "kill"), 0):
+                # the kill fired but no lane revived yet: give the
+                # watchdog one more interval
+                time.sleep(0.2)
+        for si, ln in ((0, None),):
+            b = rng.standard_normal((N, 1)).astype(np.float32)
+            try:
+                x = np.asarray(eng.solve(sessions[si], b, timeout=60))
+            except (SolveUnhealthy, SessionQuarantined, InjectedFault,
+                    RhsNonFinite, EngineClosed) as e:
+                if isinstance(e, EngineClosed) and not killful:
+                    return False, f"{label}: engine died without a kill"
+            else:
+                want = np.linalg.solve(As[si], b.astype(np.float64))
+                err = (np.linalg.norm(x - want)
+                       / max(np.linalg.norm(want), 1e-30))
+                if not (err < 1e-3):
+                    return False, (f"{label}: post-chaos answer off "
+                                   f"oracle ({err:.2e})")
+        wedged = eng.close(timeout=120)
+        if wedged:
+            return False, f"{label}: close() wedged {wedged}"
+    finally:
+        eng.close(timeout=10)
+    ok_exc = (RhsNonFinite, DeadlineExceeded, SolveUnhealthy,
+              SessionQuarantined, InjectedFault, EngineClosed)
+    answered = 0
+    for si, b, fut in reqs:
+        if not fut.done():
+            return False, f"{label}: close() left a future unresolved"
+        try:
+            x = np.asarray(fut.result(0))
+        except ok_exc as e:
+            if isinstance(e, EngineClosed) and not killful \
+                    and "lane" in str(e):
+                return False, f"{label}: lane died without a kill"
+            continue
+        except Exception as e:  # noqa: BLE001 — any other leak is a bug
+            return False, (f"{label}: UNSTRUCTURED "
+                           f"{type(e).__name__}: {e}")
+        want = np.linalg.solve(As[si], b.astype(np.float64))
+        err = (np.linalg.norm(x - want)
+               / max(np.linalg.norm(want), 1e-30))
+        if not (err < 1e-3):
+            return False, f"{label}: answer off oracle ({err:.2e})"
+        answered += 1
+    opened = 0
+    for Ad, fut in cold:
+        if not fut.done():
+            return False, f"{label}: cold-start future unresolved"
+        try:
+            s = fut.result(0)
+        except ok_exc:
+            continue
+        except Exception as e:  # noqa: BLE001
+            return False, (f"{label}: UNSTRUCTURED cold-start "
+                           f"{type(e).__name__}: {e}")
+        b = rng.standard_normal((N, 1)).astype(np.float32)
+        x = np.asarray(s.solve(b))
+        want = np.linalg.solve(Ad, b.astype(np.float64))
+        err = (np.linalg.norm(x - want)
+               / max(np.linalg.norm(want), 1e-30))
+        if not (err < 1e-3):
+            return False, (f"{label}: cold-start session off oracle "
+                           f"({err:.2e})")
+        opened += 1
+    stats = eng.stats()
+    if stats["pending"] != 0:
+        return False, f"{label}: {stats['pending']} pending slots leaked"
+    if stats["completed"] + stats["failed"] != stats["requests"]:
+        return False, f"{label}: counters incoherent"
+    revives = sum(ln["revives"] for ln in stats["lanes"])
+    return True, (f"{label}: ok {answered}/{len(reqs)} solves, "
+                  f"{opened}/{len(cold)} cold starts, "
+                  f"lanes={len(stats['lanes'])}, "
+                  f"lane_revives={revives}, "
+                  f"injected={sum(faults.injected.values())}")
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser()
     ap.add_argument("--trials", type=int, default=200)
@@ -715,6 +906,17 @@ def main(argv=None) -> int:
                     "enabled; asserts structured failures only, "
                     "per-session oracle answers (zero cross-session "
                     "corruption) and a conserved session count")
+    ap.add_argument("--fleet", action="store_true",
+                    help="chaos-soak the mesh-sharded serve fleet: "
+                    "mixed solve + cold-start traffic over a "
+                    "lanes='auto' engine (per-device DeviceLanes, "
+                    "pooled work-stealing cold starts, sid/device "
+                    "placement mix) under the serve fault menu PLUS "
+                    "lane-thread kills; asserts per-lane fault "
+                    "domains (a killed lane's work fails alone, the "
+                    "lane revives, the fleet keeps serving), "
+                    "structured failures only, and per-session f64 "
+                    "oracle answers on every lane")
     ap.add_argument("--lockcheck", action="store_true",
                     help="run trials under the conflint runtime "
                     "lock-order harness (conflux_tpu.analysis."
@@ -723,7 +925,8 @@ def main(argv=None) -> int:
                     "cycle or lock-held-across-dispatch fails the soak")
     args = ap.parse_args(argv)
 
-    trial = (run_tier_trial if args.tier
+    trial = (run_fleet_trial if args.fleet
+             else run_tier_trial if args.tier
              else run_adaptive_trial if args.adaptive
              else run_serve_trial if args.serve else run_trial)
 
