@@ -109,6 +109,10 @@ def __getattr__(name):
         "place_session": ("conflux_tpu.engine", "place_session"),
         "MeshPlanUnsupported": (
             "conflux_tpu.resilience", "MeshPlanUnsupported"),
+        # gang-resident session stacking (ISSUE 10)
+        "SessionGang": ("conflux_tpu.gang", "SessionGang"),
+        "write_slot_tree": ("conflux_tpu.batched", "write_slot_tree"),
+        "grow_stack_tree": ("conflux_tpu.batched", "grow_stack_tree"),
     }
     if name in _lazy:
         import importlib
@@ -187,4 +191,7 @@ __all__ = [
     "DeviceLane",
     "place_session",
     "MeshPlanUnsupported",
+    "SessionGang",
+    "write_slot_tree",
+    "grow_stack_tree",
 ]

@@ -152,6 +152,61 @@ def stack_host_trees(trees):
                                   is_leaf=lambda x: x is None)
 
 
+@functools.lru_cache(maxsize=512)
+def _slot_writer(shape: tuple, dtype_name: str, donate: bool):
+    """One compiled slot-write program per (stack shape, dtype): write a
+    per-slot array into row `i` of a stacked array. The slot index is a
+    traced argument, so every slot of a bucket shares the program. With
+    `donate` the superseded stack buffer is handed to XLA (the output
+    replaces it in place) — the gang layer's write-back discipline: the
+    gang OWNS its stacks (they come out of its own builds/writes), so
+    donation never invalidates a caller's array, and a stacked update
+    costs one row write instead of a full-stack copy."""
+    fn = lambda S, x, i: lax.dynamic_update_index_in_dim(S, x, i, 0)  # noqa: E731
+    return jax.jit(fn, donate_argnums=(0,) if donate else ())
+
+
+def write_slot_tree(stack, sub, i: int, donate: bool = True):
+    """Write per-slot pytree `sub` into slot `i` of stacked pytree
+    `stack`, leafwise — the gang layer's slot write-back primitive
+    (`conflux_tpu.gang.SessionGang`). Bitwise: a written slot
+    round-trips through :func:`unstack_tree` carrying exactly the bits
+    of `sub` (a dynamic-update-slice is pure data movement), the same
+    contract `stack_trees`/`unstack_tree` already pin. None leaves must
+    agree (stay None). `donate=True` donates each superseded stack leaf
+    (see `_slot_writer`) — only pass stacks the caller owns."""
+    def one(S, x):
+        if S is None:
+            return None
+        return _slot_writer(tuple(S.shape), S.dtype.name, donate)(S, x, i)
+
+    return jax.tree_util.tree_map(one, stack, sub,
+                                  is_leaf=lambda x: x is None)
+
+
+def grow_stack_tree(stack, cap: int, fill: str = "first"):
+    """Grow a stacked pytree's leading axis to `cap` slots (a no-op when
+    already there). `fill='first'` pads with copies of slot 0 — the gang
+    pad rule (pad slots self-reference slot 0, exactly what the engine's
+    per-dispatch stacking repeated); `fill='zero'` pads with zeros (the
+    gang's drift-state pad: zero U/V columns are Woodbury-inert). Slots
+    0..old-1 keep their bits (a concatenate moves, never computes)."""
+    def one(S):
+        if S is None:
+            return None
+        n = S.shape[0]
+        if n >= cap:
+            return S
+        if fill == "zero":
+            pad = jnp.zeros((cap - n,) + S.shape[1:], S.dtype)
+        else:
+            pad = jnp.broadcast_to(S[:1], (cap - n,) + S.shape[1:])
+        return jnp.concatenate([S, pad], axis=0)
+
+    return jax.tree_util.tree_map(one, stack,
+                                  is_leaf=lambda x: x is None)
+
+
 def unstack_tree(tree, B: int):
     """Split the first `B` slots of a stacked pytree back into a list of
     per-slot trees — the inverse of :func:`stack_trees` (bitwise: slot i

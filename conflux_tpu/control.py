@@ -120,6 +120,7 @@ class ControlLimits:
     max_pending: int = 8192
     max_coalesce_width: int = 64
     max_factor_batch: int = 64
+    max_stack: int = 16
     relaxed_guard_sample: int = 256
     staging_stride: int = 8
 
@@ -176,6 +177,8 @@ class AdaptiveController:
                  grow_after: int = 2,
                  retire_after: int = 120,
                  relax_health_after: int = 20,
+                 stack_after: int = 2,
+                 unstack_after: int = 30,
                  min_window_samples: int = 8,
                  decision_log: int = 256):
         if slo_p99_ms <= 0 or interval <= 0:
@@ -198,6 +201,8 @@ class AdaptiveController:
         self.grow_after = int(grow_after)
         self.retire_after = int(retire_after)
         self.relax_health_after = int(relax_health_after)
+        self.stack_after = int(stack_after)
+        self.unstack_after = int(unstack_after)
         self.min_window_samples = int(min_window_samples)
 
         # cross-thread state: step() runs on the controller thread,
@@ -226,6 +231,13 @@ class AdaptiveController:
         # in-flight background prewarm: (target_bucket, Thread) or None
         self._width_prewarm = None      # guarded-by: _lock
         self._fbatch_prewarm = None     # guarded-by: _lock
+        # gang-stacking steering state (DESIGN §26): consecutive
+        # windows of missed stacking opportunity / of an idle enabled
+        # gang path, and the in-flight stacked-bucket prewarm
+        # ((max_stack target, width, Thread) or None)
+        self._stack_pressure = 0        # guarded-by: _lock
+        self._stack_idle = 0            # guarded-by: _lock
+        self._stack_prewarm = None      # guarded-by: _lock
         # per-lane delay tuning state (multi-lane engines, DESIGN §25):
         # the previous tick's per-lane counter rows and each lane's
         # debounced widen-pressure count
@@ -313,6 +325,7 @@ class AdaptiveController:
         self._decide_lane_delays(eng, d, e)
         self._decide_widths(eng, d, e)
         self._decide_factor_batches(eng, d, e)
+        self._decide_stacking(eng, d, e)
         self._decide_health(eng, d, e)
         return d
 
@@ -658,6 +671,97 @@ class AdaptiveController:
                          f"factor buckets {cold} cold for "
                          f"{self.retire_after} windows — released "
                          f"{dropped} program(s)")
+
+    # -- gang stacking: enable on missed opportunity, prewarm-gated ----- #
+
+    def _decide_stacking(self, eng, d, e) -> None:
+        """Steer `stack_sessions` / `max_stack` (DESIGN §26): with
+        stacking OFF the engine counts, per window, the same-plan
+        sessions it dispatched solo that a gang would have stacked
+        (`gang_opportunity`); sustained opportunity prewarms the
+        stacked bucket for the traffic's dominant width on every
+        active single-system plan (BACKGROUND thread) and flips the
+        knob only once `FactorPlan.bucket_ready(stack=...)` reports
+        every program warm — the same prewarm-gated discipline as
+        every other bucket move, so the switch itself never puts a
+        compile on the serving path. With stacking ON, sustained
+        windows of dispatches with ZERO stacked batches mean the
+        fleet stopped offering pairs — disable, refunding the (tiny)
+        per-window grouping work."""
+        lim = self.limits
+        with self._lock:
+            pre = self._stack_prewarm
+        if pre is not None:
+            target, wb, thread = pre
+            if thread.is_alive():
+                return
+            sessions, _plans = eng.active_targets()
+            checked = eng.health is not None and eng.health.check_output
+            ready = [s.plan.bucket_ready(stack=(target, wb),
+                                         checked=checked)
+                     for s in sessions
+                     if not s.plan.batched and s.plan.mesh is None]
+            with self._lock:
+                self._stack_prewarm = None
+            if ready and all(ready) and not eng.stack_sessions:
+                eng.set_knobs(stack_sessions=True, max_stack=target)
+                self._record(
+                    "stack_sessions", False, target,
+                    f"stacked bucket ({target}, {wb}) prewarmed on "
+                    f"{len(ready)} session(s) — gang stacking enabled "
+                    "onto warm programs only")
+            return
+        opp = e.get("gang_opportunity", 0)
+        if not eng.stack_sessions:
+            with self._lock:
+                self._stack_pressure = (self._stack_pressure + 1
+                                        if opp >= 2 else 0)
+                pressure = self._stack_pressure
+            if pressure < self.stack_after:
+                return
+            sessions, _plans = eng.active_targets()
+            targets = {}
+            for s in sessions:
+                if not s.plan.batched and s.plan.mesh is None:
+                    targets.setdefault(id(s.plan), s)
+            if not targets:
+                return
+            target = max(2, min(_pow2_at_most(lim.max_stack),
+                                rank_bucket(max(2, opp))))
+            hits = d.get("bucket_hits", {})
+            wb = max(hits, key=hits.get) if hits else 1
+            reps = list(targets.values())
+
+            def run():
+                for s in reps:
+                    eng.prewarm(s, widths=(wb,), stacks=(target,))
+
+            t = threading.Thread(target=run, daemon=True,
+                                 name="serve-engine-controller-prewarm")
+            with self._lock:
+                self._stack_pressure = 0
+                self._stack_prewarm = (target, wb, t)
+            t.start()
+            self._record(
+                "prewarm", None, (target, wb),
+                f"{opp} stackable session(s) dispatched solo this "
+                f"window: background-prewarming the ({target}, {wb}) "
+                "stacked bucket before any knob move")
+            return
+        # stacking is on: watch for a fleet that stopped pairing up
+        idle = (e["batches"] > 0 and e.get("gang_batches", 0) == 0)
+        with self._lock:
+            self._stack_idle = self._stack_idle + 1 if idle else 0
+            idle_n = self._stack_idle
+        if idle_n >= self.unstack_after:
+            eng.set_knobs(stack_sessions=False)
+            with self._lock:
+                self._stack_idle = 0
+            self._record(
+                "stack_sessions", True, False,
+                f"{idle_n} consecutive windows dispatched without a "
+                "single stacked batch — gang stacking disabled (gangs "
+                "keep their resident state for a later re-enable)")
 
     # -- guard sampling: back off on silence, restore on any trip ------- #
 

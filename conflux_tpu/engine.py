@@ -21,9 +21,16 @@ the request level, and :class:`ServeEngine` makes it:
   changes shape with the coalesced width, so their coalesced answers are
   allclose, bitwise only within a bucket. With ``stack_sessions=True``,
   requests against DIFFERENT sessions of one single-system plan
-  additionally stack their factor pytrees on a new leading axis and ride
-  one vmapped dispatch (`FactorPlan._stacked_solve_fn`) — allclose to,
-  but not bitwise, the per-session programs, so it is opt-in.
+  additionally ride one vmapped dispatch off a device-resident GANG
+  (`conflux_tpu.gang.SessionGang`, DESIGN §26): member sessions hold
+  slots in a shared stacked factor pytree living on their lane device,
+  so the stacked solve indexes resident state directly — zero
+  per-dispatch restacking, zero per-dispatch factor movement. Drifted
+  sessions ride a stacked rank-bucketed Woodbury correction and checked
+  engines a fused per-slot verdict, so neither falls off the stacked
+  path; answers are allclose to, but not bitwise, the per-session
+  programs (bitwise within a stack bucket for plain sessions), so it is
+  opt-in — and the AdaptiveController can steer it from live telemetry.
 
 - **Double-buffered async dispatch** — a dispatcher thread stages and
   dispatches batch i+1 while a drain thread waits on batch i: the
@@ -114,6 +121,7 @@ import jax.numpy as jnp
 from conflux_tpu import profiler, resilience
 from conflux_tpu.batched import _shard_batch, put_tree, stack_trees, \
     unstack_tree
+from conflux_tpu.gang import SessionGang
 from conflux_tpu.resilience import (
     DeadlineExceeded,
     HealthPolicy,
@@ -169,6 +177,7 @@ class _Request:
     expiry: float | None = None  # perf_counter deadline (lazy eviction)
     carried: bool = False  # deferred once already — never defer again
     lane: Any = None      # the DeviceLane that owns this request
+    lane_slot: bool = False  # counted against the lane's pending slice
 
     __hash__ = object.__hash__
 
@@ -188,6 +197,7 @@ class _FactorRequest:
     expiry: float | None = None  # perf_counter deadline (lazy eviction)
     carried: bool = False  # deferred once already — never defer again
     lane: Any = None      # owning lane (None while in the shared pool)
+    lane_slot: bool = False  # counted against the lane's pending slice
     pool: bool = False    # admitted into the work-stealing factor pool
     sid: Any = None       # stable session id for the opened session
     device: Any = None    # explicit device pin for the opened session
@@ -209,6 +219,22 @@ class _FactorBatch:
     verdict: Any          # (2, bucket) device verdict (checked) or None
     A: Any                # the staged (bucket,)+shape device A stack
     solo: bool = False    # a solo re-dispatch: no second retry
+
+
+@dataclasses.dataclass
+class _StackBatch:
+    """A dispatched CHECKED gang-stacked batch in flight to the drain
+    thread: the stacked answer plus the fused (2, cap) per-slot verdict
+    (`update.health_spot_check_slots`) and the slot -> session map the
+    drain needs to attribute a sick slot without re-dispatching its
+    gang-mates. Unchecked gang dispatches ride the plain drain tuple
+    (their verdict is None, like any other batch)."""
+
+    plan: Any
+    spec: list            # (request, slot, column-offset) scatter plan
+    x: Any                # (cap, N, wb) stacked device answer
+    verdict: Any          # (2, cap) device verdict block
+    sessions: dict        # slot -> session, live-request slots only
 
 
 def _normalize_rhs(session, b):
@@ -300,6 +326,17 @@ class DeviceLane:
         self.bucket_hits: dict = {}
         self.factor_batches = 0
         self.factor_coalesced = 0
+        self.gang_batches = 0
+        self.gang_coalesced = 0
+        # per-lane pending slice (max_lane_pending): requests admitted
+        # against this lane, and sheds its slice caused — one lane's
+        # backlog must not starve admission fleet-wide
+        self.pending = 0
+        self.sheds = 0
+        # the lane's device-resident gangs, one per plan (DESIGN §26);
+        # mutation of the DICT is engine-lock guarded, the gangs
+        # themselves carry their own RLock
+        self._gangs: dict = {}
         # queue high-water: monotone max, racy update by design
         self.queue_hw = 0
         # single-writer busy gauges (dispatcher / drainer respectively)
@@ -527,24 +564,42 @@ class DeviceLane:
             groups[key].append(r)
         stackable: dict[int, list] = {}
         plan_order = []
+        opportunity: dict[int, int] = {}
         for session in order:
             reqs = groups[id(session)]
-            if (eng.stack_sessions and not session.plan.batched
-                    and session._upd is None):
-                pk = id(session.plan)
+            plan = session.plan
+            if eng.stack_sessions and not plan.batched:
+                # gang eligibility (DESIGN §26): single-system plans
+                # only — a non-batched plan is never mesh-sharded, and
+                # drifted (`_upd`) / checked sessions now STACK (the
+                # stacked Woodbury + per-slot-verdict programs closed
+                # the old exclusion holes)
+                pk = id(plan)
                 if pk not in stackable:
                     stackable[pk] = []
-                    plan_order.append(session.plan)
+                    plan_order.append(plan)
                 stackable[pk].append((session, reqs))
-            else:
-                deferred += self._dispatch_session(session, reqs,
-                                                   may_defer)
+                continue
+            if eng.stack_sessions:
+                eng._note_exclusion(
+                    "mesh" if plan.mesh is not None else "batched")
+            elif not plan.batched:
+                # stacking disabled: count the opportunity the window
+                # left on the table (the controller's enable signal)
+                opportunity[id(plan)] = opportunity.get(id(plan), 0) + 1
+            deferred += self._dispatch_session(session, reqs,
+                                               may_defer)
+        missed = sum(c for c in opportunity.values() if c >= 2)
+        if missed:
+            with eng._lock:
+                eng._gang_opportunity += missed
         for plan in plan_order:
             entries = stackable[id(plan)]
             if len(entries) == 1:
+                eng._note_exclusion("singleton")
                 deferred += self._dispatch_session(*entries[0], may_defer)
             else:
-                self._dispatch_stacked(plan, entries)
+                self._dispatch_gang(plan, entries)
         if len(eng._lanes) > 1 and eng._pool_pending() \
                 and not self.dead:
             # backlog left after this round's draw: keep draining it
@@ -910,16 +965,32 @@ class DeviceLane:
         for r in reqs:
             self._run_factor_chunk(r.plan, [r], solo=True)
 
+    def _gang_for(self, plan) -> SessionGang:
+        """This lane's device-resident gang for `plan`, created on
+        first stacked contact (DESIGN §26). Dict mutation rides the
+        engine lock; the gang carries its own RLock."""
+        g = self._gangs.get(id(plan))
+        if g is None:
+            with self.eng._lock:
+                g = self._gangs.get(id(plan))
+                if g is None:
+                    g = SessionGang(plan, self.device)
+                    self._gangs[id(plan)] = g
+        return g
+
     # hot-path
-    def _dispatch_stacked(self, plan, entries) -> None:
-        """Cross-session coalescing for single-system plans: per-session
-        RHS concat first (width-capped; overflow falls back to per-session
-        dispatch), then up to `max_stack` sessions stack factors along a
-        new leading axis into one vmapped dispatch. The health verdict is
-        not fused into the stacked program — stacked batches still get
-        exception-level survivor re-dispatch, and stacking is opt-in.
-        All sessions here are pinned to THIS lane (requests route by
-        session placement), so the stacked factors share one device."""
+    def _dispatch_gang(self, plan, entries) -> None:
+        """Cross-session coalescing through the plan's device-resident
+        gang (DESIGN §26): per-session RHS concat first (width-capped;
+        overflow falls back to per-session dispatch), then every
+        request-carrying session dispatches from its resident gang slot
+        in ONE vmapped program. Drifted sessions ride the stacked
+        rank-bucketed Woodbury correction and checked engines the fused
+        per-slot verdict, so neither excludes a session from stacking
+        any more; what still falls back solo is counted per reason
+        (`stack_exclusions`). All sessions here are pinned to THIS lane
+        (requests route by session placement), so the gang's stacks
+        share one device."""
         eng = self.eng
         ready = []
         for session, reqs in entries:
@@ -938,59 +1009,135 @@ class DeviceLane:
                 ready.append((session, chunk, width))
             if rest:
                 self._dispatch_session(session, rest)
-        for i in range(0, len(ready), eng.max_stack):
-            part = ready[i:i + eng.max_stack]
-            if len(part) == 1:
-                self._run_chunk(part[0][0], part[0][1])
+        if len(ready) < 2:
+            for session, chunk, _w in ready:
+                eng._note_exclusion("singleton")
+                self._run_chunk(session, chunk)
+            return
+        gang = self._gang_for(plan)
+        checked = eng.health is not None and eng.health.check_output
+        try:
+            admitted, excluded = gang.ensure(
+                [s for s, _c, _w in ready], eng.max_stack, checked)
+        except Exception:  # noqa: BLE001 — adoption is best-effort
+            admitted = {}
+            excluded = {id(s): "error" for s, _c, _w in ready}
+        part = []
+        for session, chunk, w in ready:
+            if id(session) in admitted:
+                part.append((session, chunk, w))
             else:
-                self._run_stack(plan, part)
+                eng._note_exclusion(excluded.get(id(session), "error"))
+                self._run_chunk(session, chunk)
+        if len(part) == 1:
+            eng._note_exclusion("singleton")
+            self._run_chunk(part[0][0], part[0][1])
+            return
+        if part:
+            self._run_gang(plan, gang, part, checked)
 
     # hot-path, futures-owner
-    def _run_stack(self, plan, part) -> None:
+    def _run_gang(self, plan, gang, part, checked: bool) -> None:
+        """One dispatch for the whole gang window: stage the RHS into a
+        (cap, N, wb) host buffer (one h2d — idle slots keep zero
+        columns; the paper's trade, pay flops on idle slots to move no
+        factor bytes) and solve straight off the RESIDENT stacks. Zero
+        per-dispatch stack_trees, zero per-dispatch factor movement —
+        the whole point of gang residency. The gang RLock is held
+        across the dispatch (legal — the session-RLock precedent) so a
+        concurrent adopt's donating slot write can never invalidate the
+        snapshot mid-enqueue."""
         eng = self.eng
-        reqs_all = [r for _, reqs, _ in part for r in reqs]
+        reqs_all = [r for _s, chunk, _w in part for r in chunk]
+        verdict = None
+        poisoned = False
         try:
-            wb = rank_bucket(max(w for _, _, w in part))
-            sb = rank_bucket(len(part))
-            # host-stage the whole stack in one (sb, N, wb) buffer; the
-            # pad slots repeat session 0's factors against zero columns
-            buf = np.zeros((sb, plan.N, wb),
-                           part[0][1][0].b2.dtype)
-            spec = []
-            factors, As = [], []
-            for si, (session, reqs, _w) in enumerate(part):
-                lo = 0
-                for r in reqs:
-                    buf[si, :, lo:lo + r.width] = r.b2
-                    spec.append((r, si, lo))
-                    lo += r.width
-                # read the resident state under the session lock: a
-                # drain-thread escalation must never hand this stack a
-                # half-swapped factor pytree (conflint CFX-LOCK is
-                # self-scoped; cross-object discipline is on us here)
-                with session._lock:
-                    session._ensure_resident()  # spilled: fault in now
-                    factors.append(session._factors)
-                    As.append(session._A)
-            while len(factors) < sb:
-                factors.append(factors[0])
-                As.append(As[0])
-            F = stack_trees(factors)
-            A = None if As[0] is None else jnp.stack(As)
-            with profiler.region("serve.solve"):
-                X = plan._stacked_solve_fn(sb, wb)(F, A, buf)
+            wb = rank_bucket(max(w for _s, _c, w in part))
+            with gang._lock:
+                snap = gang.prepare([s for s, _c, _w in part])
+                cap = snap["cap"]
+                buf = np.zeros((cap, plan.N, wb),
+                               part[0][1][0].b2.dtype)
+                spec = []
+                slot_sessions = {}
+                for session, chunk, _w in part:
+                    si = snap["slots"][id(session)]
+                    slot_sessions[si] = session
+                    lo = 0
+                    for r in chunk:
+                        buf[si, :, lo:lo + r.width] = r.b2
+                        spec.append((r, si, lo))
+                        lo += r.width
+                if (eng.health is not None and eng.health.check_rhs
+                        and not checked and eng._tick_staging()
+                        and not resilience.rhs_finite(buf)):
+                    # no fused verdict to backstop (check_output off):
+                    # the per-batch staging guard runs here; culprits
+                    # isolate per session chunk below, outside the lock
+                    poisoned = True
+                else:
+                    if checked and snap["wA"] is None:
+                        # a checked upgrade did not complete (snapshot
+                        # failures mid-rebuild) — solo-dispatch this
+                        # window; the next ensure() retries the upgrade
+                        raise RuntimeError(
+                            "gang probe stack unavailable for checked "
+                            "dispatch")
+                    with profiler.region("serve.solve"):
+                        if snap["kb"]:
+                            A0u = snap["A0"] if snap["sweeps"] else None
+                            fn = (plan._stacked_update_solve_health_fn
+                                  if checked
+                                  else plan._stacked_update_solve_fn)(
+                                cap, snap["kb"], wb, snap["sweeps"])
+                            if checked:
+                                X, verdict = fn(
+                                    snap["F"], A0u, snap["Up"],
+                                    snap["Vp"], snap["Y"],
+                                    snap["Cinv"], snap["wA"], buf)
+                            else:
+                                X = fn(snap["F"], A0u, snap["Up"],
+                                       snap["Vp"], snap["Y"],
+                                       snap["Cinv"], buf)
+                        elif checked:
+                            X, verdict = plan._stacked_solve_health_fn(
+                                cap, wb)(snap["F"],
+                                         snap["A0"] if plan.key.refine
+                                         else None, snap["wA"], buf)
+                        else:
+                            X = plan._stacked_solve_fn(cap, wb)(
+                                snap["F"],
+                                snap["A0"] if plan.key.refine else None,
+                                buf)
         except Exception as e:  # noqa: BLE001
             self._redispatch_survivors(reqs_all, e)
             return
-        for session, _reqs, _w in part:
+        if poisoned:
+            for session, chunk, _w in part:
+                live = self._isolate_poisoned(chunk)
+                if live:
+                    self._run_chunk(session, live)
+            return
+        for session, _c, _w in part:
             with session._lock:  # solves is guarded-by the session lock
                 session.solves += 1
         with eng._lock:
             eng._batches += 1
             eng._coalesced_requests += len(reqs_all)
+            eng._gang_batches += 1
+            eng._gang_coalesced += len(reqs_all)
+            eng._bucket_hits[wb] = eng._bucket_hits.get(wb, 0) + 1
+            for session, _c, _w in part:
+                eng._active_sessions[id(session)] = weakref.ref(session)
             self.batches += 1
             self.coalesced += len(reqs_all)
-        self._outq.put((spec, X, None, None))
+            self.gang_batches += 1
+            self.gang_coalesced += len(reqs_all)
+        if verdict is None:
+            self._outq.put((spec, X, None, None))
+        else:
+            self._outq.put(_StackBatch(plan, spec, X, verdict,
+                                       slot_sessions))
 
     # ------------------------------------------------------------------ #
     # drain: the only lane thread that blocks on device work
@@ -1014,6 +1161,9 @@ class DeviceLane:
             try:
                 if isinstance(item, _FactorBatch):
                     self._drain_factor(item)
+                    continue
+                if isinstance(item, _StackBatch):
+                    self._drain_stack(item)
                     continue
                 spec, block_on, verdict, buf = item
                 reqs = [r for r, _si, _lo in spec]
@@ -1049,6 +1199,52 @@ class DeviceLane:
                 self.eng._settle(spec, xh)
             finally:
                 self.busy_drain_s += time.perf_counter() - t0
+
+    # futures-owner
+    def _drain_stack(self, sb: _StackBatch) -> None:
+        """Drain one CHECKED gang-stacked batch: ONE blocking d2h for
+        the stacked answer, then per-slot verdict evaluation
+        (`resilience.evaluate_slots` — slot verdicts are independent by
+        construction). Healthy slots settle in place and their
+        sessions' breakers record the success; each sick slot's
+        requests re-dispatch SOLO through the escalation machinery
+        (`_solo_drain`, the factor lane's solo-survivor shape), so a
+        sick session never costs its gang-mates a re-dispatch."""
+        eng = self.eng
+        reqs = [r for r, _si, _lo in sb.spec]
+        try:
+            resilience.maybe_fault(eng._faults, "drain")
+            resilience.maybe_fault(eng._faults, "d2h")
+            xh = np.asarray(sb.x)
+            limit = eng._plan_limit(sb.plan)
+            verdicts = resilience.evaluate_slots(sb.verdict, limit)
+            if resilience.data_fault(eng._faults, "solve",
+                                     "unhealthy") is not None:
+                verdicts = [(False, fin, res)
+                            for _h, fin, res in verdicts]
+        except Exception as e:  # noqa: BLE001
+            self._drain_redispatch(reqs, e)
+            return
+        healthy_spec, sick = [], []
+        for r, si, lo in sb.spec:
+            if verdicts[si][0]:
+                healthy_spec.append((r, si, lo))
+            else:
+                sick.append(r)
+        for slot, session in sb.sessions.items():
+            if verdicts[slot][0] and session._breaker is not None:
+                session._breaker.record_success()
+        if sick:
+            nslots = len({si for _r, si, _lo in sb.spec
+                          if not verdicts[si][0]})
+            resilience.bump("output_failures", nslots)
+            resilience.bump("gang_unhealthy_slots", nslots)
+            eng._restore_guards()
+            resilience.bump("survivor_redispatches", len(sick))
+            for r in sick:
+                self._solo_drain(r)
+        if healthy_spec:
+            eng._settle(healthy_spec, xh)
 
     # ------------------------------------------------------------------ #
     # the factor lane: drain, per-slot health, slice-out
@@ -1265,8 +1461,16 @@ class ServeEngine:
     max_factor_batch: cap on coalesced factorizations per factor-lane
         dispatch (rounded up to a power of two — the batch buckets) and
         the widest `factor_batches` bucket `prewarm` needs to cover.
-    stack_sessions / max_stack: opt-in cross-session stacking for
-        single-system plans (see module docstring).
+    stack_sessions / max_stack: opt-in gang-resident cross-session
+        stacking for single-system plans (see module docstring;
+        `max_stack` caps a gang's membership). Both are live knobs
+        (`set_knobs`), which is how the adaptive controller steers
+        them.
+    max_lane_pending: optional per-lane slice of the pending bound on
+        multi-lane engines — one lane's backlog sheds its own overflow
+        (per-lane `sheds` counted in the lane stats rows) instead of
+        filling `max_pending` and starving every other lane's
+        admission. None (default) keeps the single global bound.
     latency_window: how many completed-request latencies the percentile
         window keeps.
     health: a :class:`~conflux_tpu.resilience.HealthPolicy` switches on
@@ -1310,6 +1514,7 @@ class ServeEngine:
                  max_coalesce_width: int = 32,
                  max_factor_batch: int = 32,
                  stack_sessions: bool = False, max_stack: int = 8,
+                 max_lane_pending: int | None = None,
                  latency_window: int = 8192,
                  persistent_cache: bool = True,
                  health: HealthPolicy | None = None,
@@ -1362,6 +1567,15 @@ class ServeEngine:
         self.max_factor_batch = rank_bucket(int(max_factor_batch))
         self.stack_sessions = bool(stack_sessions)
         self.max_stack = int(max_stack)
+        # per-lane pending slice (DESIGN §25 follow-on): with a value
+        # set, a multi-lane engine bounds each lane's share of the
+        # pending set so one lane's backlog cannot starve admission
+        # fleet-wide. None (default) keeps the single global bound —
+        # byte-identical to the PR 9 engine.
+        if max_lane_pending is not None and max_lane_pending < 1:
+            raise ValueError("max_lane_pending must be >= 1 (or None)")
+        self.max_lane_pending = (None if max_lane_pending is None
+                                 else int(max_lane_pending))
         self.health = health
         self._faults = fault_plan
         self.watchdog_interval = float(watchdog_interval)
@@ -1440,6 +1654,21 @@ class ServeEngine:
         self._bucket_hits: dict = {}    # guarded-by: _lock
         self._factor_bucket_hits: dict = {}  # guarded-by: _lock
         self._width_capped = 0          # guarded-by: _lock
+        # gang-stacked serving telemetry (DESIGN §26): stacked batches
+        # dispatched and the requests they carried; per-reason counts
+        # of sessions that fell back to a solo dispatch instead of
+        # stacking (the exclusion trace); and, with stacking DISABLED,
+        # the per-window count of same-plan sessions that would have
+        # stacked — the controller's enable signal
+        self._gang_batches = 0          # guarded-by: _lock
+        self._gang_coalesced = 0        # guarded-by: _lock
+        self._gang_opportunity = 0      # guarded-by: _lock
+        # pre-seeded so the closed holes are PROVABLY closed: a bench
+        # or ops read sees upd_pending/checked at literal zero, not
+        # merely absent (they only move if a regression reopens them)
+        self._stack_exclusions: dict = {  # guarded-by: _lock
+            k: 0 for k in ("upd_pending", "checked", "mesh", "batched",
+                           "singleton", "stack_cap", "error")}
         # recently-served sessions/plans, weakly held — the adaptive
         # controller's prewarm targets (active_targets())
         self._active_sessions: dict = {}  # guarded-by: _lock
@@ -1579,26 +1808,7 @@ class ServeEngine:
                 if self.on_full == "reject":
                     self._sheds += 1
                     self._consec_sheds += 1
-                    rate = self._drain_rate
-                    if rate is not None and rate > 0.0:
-                        # measured drain rate (the controller's
-                        # estimator): space a retrying herd at the
-                        # actual completion spacing — the k-th
-                        # consecutive shed backs off k drain intervals,
-                        # so retries land as slots actually free up
-                        # instead of guessing exponentially
-                        hint = min(1.0, max(1e-4,
-                                            self._consec_sheds / rate))
-                        why = (f"retry in ~{1e3 * hint:.0f}ms, sized "
-                               f"from the measured drain rate "
-                               f"{rate:.0f}/s")
-                    else:
-                        # no estimate yet: the original exponential
-                        # backoff guess
-                        hint = min(1.0, 1e-3 * (1 << min(
-                            self._consec_sheds - 1, 10)))
-                        why = (f"retry in ~{1e3 * hint:.0f}ms, backoff "
-                               "hint doubles per consecutive shed")
+                    hint, why = self._shed_hint_locked()
                     raise EngineSaturated(
                         f"{self._pending} pending requests >= max_pending="
                         f"{self.max_pending} (shed policy 'reject'; "
@@ -1608,6 +1818,31 @@ class ServeEngine:
                     self._not_full.wait()
                 if self._closed:
                     raise EngineClosed("engine closed while blocked")
+            lane = getattr(req, "lane", None)
+            slice_cap = self.max_lane_pending
+            if (slice_cap is not None and lane is not None
+                    and len(self._lanes) > 1):
+                # the per-lane pending slice: one hot lane's backlog
+                # sheds ITS OWN overflow instead of filling the global
+                # bound and starving every other lane's admission
+                if lane.pending >= slice_cap:
+                    if self.on_full == "reject":
+                        self._sheds += 1
+                        self._consec_sheds += 1
+                        lane.sheds += 1
+                        hint, why = self._shed_hint_locked()
+                        raise EngineSaturated(
+                            f"lane {lane.index} holds {lane.pending} "
+                            f"pending >= max_lane_pending={slice_cap} "
+                            f"(per-lane slice; other lanes keep "
+                            f"admitting — {why})", retry_after=hint)
+                    while lane.pending >= slice_cap \
+                            and not self._closed:
+                        self._not_full.wait()
+                    if self._closed:
+                        raise EngineClosed("engine closed while blocked")
+                req.lane_slot = True
+                lane.pending += 1
             self._consec_sheds = 0
             self._pending += 1
             self._requests += 1
@@ -1618,6 +1853,38 @@ class ServeEngine:
                 self._queue_peak = self._pending
         self._route(req)
         return req.future
+
+    # requires-lock: _lock
+    def _shed_hint_locked(self) -> tuple:
+        """(retry_after, reason) for an EngineSaturated shed — sized
+        from the measured drain rate when the controller installed one
+        (the k-th consecutive shed backs off k drain intervals, so a
+        retrying herd lands as slots actually free up), else the
+        original exponential-backoff guess."""
+        rate = self._drain_rate
+        if rate is not None and rate > 0.0:
+            hint = min(1.0, max(1e-4, self._consec_sheds / rate))
+            why = (f"retry in ~{1e3 * hint:.0f}ms, sized "
+                   f"from the measured drain rate "
+                   f"{rate:.0f}/s")
+        else:
+            hint = min(1.0, 1e-3 * (1 << min(
+                self._consec_sheds - 1, 10)))
+            why = (f"retry in ~{1e3 * hint:.0f}ms, backoff "
+                   "hint doubles per consecutive shed")
+        return hint, why
+
+    def _note_exclusion(self, reason: str) -> None:
+        """Count one stacking exclusion: a session the gang path COULD
+        have stacked fell back to a solo dispatch. Before PR 10 a
+        disqualified session left no trace of why — these per-reason
+        counters ('upd_pending', 'checked', 'mesh', 'batched',
+        'singleton', 'stack_cap', 'error') are the trace, surfaced in
+        `stats()`/`counters()` and merged into
+        `profiler.serve_stats()['engine']`."""
+        with self._lock:
+            self._stack_exclusions[reason] = \
+                self._stack_exclusions.get(reason, 0) + 1
 
     def _route(self, req) -> None:
         """Hand an admitted request to its lane's queue — or, for an
@@ -1902,6 +2169,9 @@ class ServeEngine:
                   max_pending: int | None = None,
                   max_coalesce_width: int | None = None,
                   max_factor_batch: int | None = None,
+                  stack_sessions: bool | None = None,
+                  max_stack: int | None = None,
+                  max_lane_pending: int | None = None,
                   health: HealthPolicy | None = None,
                   staging_stride: int | None = None,
                   drain_rate: float | None = None,
@@ -1938,7 +2208,9 @@ class ServeEngine:
             if max_batch_delay is None or any(
                     v is not None for v in (max_pending,
                                             max_coalesce_width,
-                                            max_factor_batch, health,
+                                            max_factor_batch,
+                                            stack_sessions, max_stack,
+                                            max_lane_pending, health,
                                             staging_stride, drain_rate)):
                 raise ValueError("lane= scopes exactly one knob: "
                                  "max_batch_delay")
@@ -1954,6 +2226,10 @@ class ServeEngine:
                              "max_factor_batch must be >= 1")
         if staging_stride is not None and staging_stride < 1:
             raise ValueError("staging_stride must be >= 1")
+        if max_stack is not None and max_stack < 1:
+            raise ValueError("max_stack must be >= 1")
+        if max_lane_pending is not None and max_lane_pending < 1:
+            raise ValueError("max_lane_pending must be >= 1")
         with self._lock:
             if max_batch_delay is not None:
                 self.max_batch_delay = float(max_batch_delay)
@@ -1964,6 +2240,18 @@ class ServeEngine:
                 self.max_coalesce_width = int(max_coalesce_width)
             if max_factor_batch is not None:
                 self.max_factor_batch = rank_bucket(int(max_factor_batch))
+            if stack_sessions is not None:
+                # flipping stacking is always safe mid-flight: the
+                # dispatcher reads the flag once per window, gangs keep
+                # their resident state across an off/on cycle, and the
+                # controller only flips ON after prewarming the stacked
+                # bucket (`FactorPlan.bucket_ready(stack=...)`)
+                self.stack_sessions = bool(stack_sessions)
+            if max_stack is not None:
+                self.max_stack = int(max_stack)
+            if max_lane_pending is not None:
+                self.max_lane_pending = int(max_lane_pending)
+                self._not_full.notify_all()  # blocked submitters re-check
             if health is not None:
                 if self._health_strict is None:
                     self._health_strict = self.health
@@ -1980,6 +2268,9 @@ class ServeEngine:
                 "max_pending": self.max_pending,
                 "max_coalesce_width": self.max_coalesce_width,
                 "max_factor_batch": self.max_factor_batch,
+                "stack_sessions": self.stack_sessions,
+                "max_stack": self.max_stack,
+                "max_lane_pending": self.max_lane_pending,
                 "staging_stride": self._staging_stride,
                 "drain_rate": self._drain_rate,
                 "health_relaxed": (self._health_strict is not None
@@ -2041,6 +2332,34 @@ class ServeEngine:
                     self._active_plans.pop(k, None)
         return sessions, plans
 
+    # (not a futures-owner: readoption never touches request futures)
+    def _gang_readopt(self, sessions) -> None:
+        """Adopt revived sessions straight back into their lane gangs —
+        the tier layer's grouped-revival hook (`tier.ResidentSet.
+        revive_many`): by the time traffic touches a revived fleet its
+        slots are already written, so a revival storm rejoins the
+        stacked path without a first-window solo straggle. Advisory
+        and best-effort — any failure leaves dispatch-time adoption to
+        pick the sessions up; called WITHOUT any session lock held."""
+        if not self.stack_sessions:
+            return
+        groups: dict = {}
+        for s in sessions:
+            if s.plan.batched or s.plan.mesh is not None:
+                continue
+            lane = self._lane_for(s)
+            key = (id(s.plan), lane.index)
+            if key not in groups:
+                groups[key] = (lane, s.plan, [])
+            groups[key][2].append(s)
+        checked = self.health is not None and self.health.check_output
+        for lane, plan, group in groups.values():
+            try:
+                lane._gang_for(plan).ensure(group, self.max_stack,
+                                            checked)
+            except Exception:  # noqa: BLE001 — adoption is advisory
+                pass
+
     # ------------------------------------------------------------------ #
     # durable checkpoint / warm restart (DESIGN §23)
     # ------------------------------------------------------------------ #
@@ -2100,7 +2419,7 @@ class ServeEngine:
     # ------------------------------------------------------------------ #
 
     def prewarm(self, target, widths=(1,), stacks=(), factor_batches=(),
-                wait: bool = True):
+                update_ranks=(), wait: bool = True):
         """Compile the declared traffic's programs before it lands.
 
         `target` is a SolveSession (solve-lane warming) or a FactorPlan
@@ -2130,7 +2449,7 @@ class ServeEngine:
                         self._prewarm_width(session, wb)
                         for s in stacks:
                             self._prewarm_stack(session, rank_bucket(s),
-                                                wb)
+                                                wb, update_ranks)
                 for fbk in sorted({rank_bucket(n) for n in factor_batches}):
                     self._prewarm_factor(plan, fbk)
 
@@ -2182,28 +2501,86 @@ class ServeEngine:
                 plan._solve_fn(wb)(F, A, b2).block_until_ready()
             plan.mark_device_warm(kind, wb, dk)
 
-    def _prewarm_stack(self, session, sb: int, wb: int) -> None:
+    def _prewarm_stack(self, session, sb: int, wb: int,
+                       update_ranks=()) -> None:
+        """Warm the gang-stacked programs for one (stack, width)
+        bucket on every lane device: the plain stacked solve (or the
+        checked per-slot-verdict variant when this engine's policy
+        checks outputs), plus — for each rank bucket in
+        `update_ranks` — the stacked Woodbury programs a drifting gang
+        will dispatch, fed zero drift state (the clean-slot shape, the
+        exact signature a mixed clean/drifted gang uses). Also warms
+        the gang's slot-write programs (`batched.write_slot_tree`), so
+        adoption itself stays compile-free after prewarm."""
         plan = session.plan
         if plan.batched:
             raise ValueError(
                 "stacks= prewarming applies to single-system plans only")
+        checked = self.health is not None and self.health.check_output
+        kind = "stacked_health" if checked else "stacked"
         for lane in self._lanes:
             dk = _devkey(lane.device)
-            if plan.device_warm("stacked", (sb, wb), dk):
+            ranks = sorted({rank_bucket(k) for k in update_ranks
+                            if not plan.device_warm(
+                                "stacked_usolve",
+                                (sb, rank_bucket(k), wb), dk)})
+            if plan.device_warm(kind, (sb, wb), dk) and not ranks:
                 continue
             with session._lock:
                 session._ensure_resident()
-                F0, A0 = session._factors, session._A
+                F0, A0, A0full = (session._factors, session._A,
+                                  session._A0)
+                probe = session._probe_row() if checked else None
             if lane.device is not None:
                 F0 = put_tree(F0, lane.device)
                 A0 = put_tree(A0, lane.device)
+                A0full = put_tree(A0full, lane.device)
+                probe = put_tree(probe, lane.device)
             F = stack_trees([F0] * sb)
             A = None if A0 is None else jnp.stack([A0] * sb)
+            wA = None if probe is None else jnp.stack([probe] * sb)
             # the RHS stays uncommitted, matching traffic (see
             # _prewarm_width)
             b = jnp.zeros((sb, plan.N, wb), jnp.dtype(plan.key.dtype))
-            plan._stacked_solve_fn(sb, wb)(F, A, b).block_until_ready()
-            plan.mark_device_warm("stacked", (sb, wb), dk)
+            if not plan.device_warm(kind, (sb, wb), dk):
+                if checked:
+                    x, _v = plan._stacked_solve_health_fn(sb, wb)(
+                        F, A, wA, b)
+                    x.block_until_ready()
+                else:
+                    plan._stacked_solve_fn(sb, wb)(
+                        F, A, b).block_until_ready()
+                # warm the gang's adopt/write-back row writes too (one
+                # program per stacked leaf shape)
+                from conflux_tpu.batched import write_slot_tree
+
+                jax.block_until_ready(
+                    write_slot_tree(stack_trees([F0] * sb), F0, 0))
+                plan.mark_device_warm(kind, (sb, wb), dk)
+            if not ranks:
+                continue
+            from conflux_tpu.update import zero_update_state
+
+            sweeps = plan.key.refine + session.policy.refine
+            A0s = jnp.stack([A0full] * sb) if sweeps else None
+            for kb in ranks:
+                z = zero_update_state(plan.N, kb, plan.key.dtype,
+                                      plan.key.factor_dtype)
+                Up = jnp.stack([z[0]] * sb)
+                Vp = jnp.stack([z[1]] * sb)
+                Y = jnp.stack([z[2]] * sb)
+                Ci = jnp.stack([z[3]] * sb)
+                if checked:
+                    x, _v = plan._stacked_update_solve_health_fn(
+                        sb, kb, wb, sweeps)(F, A0s, Up, Vp, Y, Ci,
+                                            wA, b)
+                    x.block_until_ready()
+                else:
+                    plan._stacked_update_solve_fn(
+                        sb, kb, wb, sweeps)(
+                        F, A0s, Up, Vp, Y, Ci, b).block_until_ready()
+                plan.mark_device_warm("stacked_usolve", (sb, kb, wb),
+                                      dk)
 
     def _prewarm_factor(self, plan, bb: int) -> None:
         if plan.mesh is not None:
@@ -2253,6 +2630,10 @@ class ServeEngine:
             owned = {r for r in reqs if r in self._live}
             self._live.difference_update(owned)
             self._pending -= len(owned)
+            for r in owned:
+                if r.lane_slot and r.lane is not None:
+                    r.lane.pending -= 1
+                    r.lane_slot = False
             self._not_full.notify_all()
         return owned
 
@@ -2434,10 +2815,37 @@ class ServeEngine:
                 "factor_slots": self._factor_slots,
                 "factor_pad_slots": self._factor_pad,
                 "width_capped": self._width_capped,
+                "gang_batches": self._gang_batches,
+                "gang_coalesced_requests": self._gang_coalesced,
+                "gang_opportunity": self._gang_opportunity,
+                "stack_exclusions": dict(self._stack_exclusions),
+                "gang": self._gang_locked(),
                 "bucket_hits": dict(self._bucket_hits),
                 "factor_bucket_hits": dict(self._factor_bucket_hits),
                 "lanes": self._lane_rows_locked(),
             }
+
+    # requires-lock: _lock
+    def _gang_locked(self) -> dict:
+        """Aggregate gang-residency gauges across every lane's gangs —
+        SORT-FREE and lock-free on the gang side (racy reads of
+        monotone counters by design; this rides the 10 Hz counters()
+        path)."""
+        gangs = members = slots = 0
+        adopts = releases = refreshes = rebuilds = 0
+        for ln in self._lanes:
+            for g in ln._gangs.values():
+                gangs += 1
+                members += len(g._by_id)
+                slots += g.cap
+                adopts += g.adopts
+                releases += g.releases
+                refreshes += g.refreshes
+                rebuilds += g.rebuilds
+        return {"gangs": gangs, "sessions": members,
+                "capacity_slots": slots, "adopts": adopts,
+                "releases": releases, "refreshes": refreshes,
+                "rebuilds": rebuilds}
 
     # requires-lock: _lock
     def _lane_rows_locked(self) -> list:
@@ -2461,7 +2869,11 @@ class ServeEngine:
                                    if ln.batches else 0.0),
                 "factor_batches": ln.factor_batches,
                 "factor_coalesced_requests": ln.factor_coalesced,
+                "gang_batches": ln.gang_batches,
+                "gang_coalesced_requests": ln.gang_coalesced,
                 "bucket_hits": dict(ln.bucket_hits),
+                "pending": ln.pending,
+                "sheds": ln.sheds,
                 "queue_depth": ln._inq.qsize(),
                 "queue_peak": ln.queue_hw,
                 "occupancy": min(1.0, busy / wall),
@@ -2512,6 +2924,14 @@ class ServeEngine:
                 "factor_latency_p95_ms": 1e3 * _percentile(flats, 95),
                 "factor_latency_p99_ms": 1e3 * _percentile(flats, 99),
                 "width_capped": self._width_capped,
+                "gang_batches": self._gang_batches,
+                "gang_coalesced_requests": self._gang_coalesced,
+                "gang_coalesced_mean": (self._gang_coalesced
+                                        / self._gang_batches
+                                        if self._gang_batches else 0.0),
+                "gang_opportunity": self._gang_opportunity,
+                "stack_exclusions": dict(self._stack_exclusions),
+                "gang": self._gang_locked(),
                 "bucket_hits": dict(self._bucket_hits),
                 "factor_bucket_hits": dict(self._factor_bucket_hits),
                 "lanes": self._lane_rows_locked(),

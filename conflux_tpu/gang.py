@@ -1,0 +1,517 @@
+"""Gang residency: device-resident stacked fleets for cross-session
+serving (DESIGN.md §26).
+
+The engine's original ``stack_sessions`` path bought one dispatch per
+coalescing window, but paid for it per dispatch: every window re-stacked
+the member sessions' factor pytrees (`batched.stack_trees` + a
+``jnp.stack`` of the bases) before the vmapped solve could run, and any
+session carrying pending Woodbury drift or a checked health program was
+silently excluded — exactly the sessions production traffic has. That
+violates the CONFLUX thesis on the hottest path: pay the flops, never
+pay redundant data movement.
+
+A :class:`SessionGang` fixes the movement half by making the stacked
+state *resident*: same-``PlanKey`` non-mesh sessions adopt into a shared
+stacked factor pytree (plus base/probe/drift stacks) that lives on their
+pinned device. Slots are assigned at adopt and freed on close/spill/GC;
+pad slots self-reference slot 0 (the same well-conditioned fill the
+per-dispatch stacking used); a slot round-trips bitwise through the
+existing `stack_trees`/`write_slot_tree`/`unstack_tree` contract. A
+stacked solve then indexes the resident stack directly — zero
+per-dispatch restacking, zero per-dispatch h2d beyond the RHS staging
+the solo path pays anyway — and session mutations (``update`` /
+``refactor`` / drift-refactor) re-sync their owning slot lazily through
+a per-session version counter, written back with the PR 3 donation
+discipline (`batched.write_slot_tree` donates the gang-owned superseded
+stack, so a write-back is one row write, not a full-stack copy).
+
+Both exclusion holes are closed here: the gang maintains a stacked
+rank-bucketed Woodbury state (per-slot U/V/Y zero-padded to the gang
+rank bucket, ``Cinv`` extended block-diagonally with the identity —
+`update.pad_update_state`), so drifting sessions ride the same dispatch
+as clean ones, and a checked gang maintains the stacked probe rows
+``wA`` so the §20 Freivalds verdict fuses into the stacked program
+per-slot (`update.health_spot_check_slots`, read by the factor lane's
+existing `resilience.evaluate_slots` + solo-survivor machinery).
+
+Locking (the tier layer's discipline, §23): the gang RLock orders AFTER
+any session RLock — write paths that hold a session lock (tier spill,
+``to_device``) may call :meth:`release`; the adopt/refresh path
+(:meth:`ensure`) therefore NEVER takes a session lock while holding the
+gang lock (snapshot phase B runs lock-free between two gang-locked
+phases). Holding the gang RLock across the stacked dispatch is legal
+(the session-RLock-across-dispatch precedent) and is what makes the
+donating write-backs safe against in-flight snapshots.
+"""
+
+from __future__ import annotations
+
+import threading
+import weakref
+
+import jax.numpy as jnp
+
+from conflux_tpu.batched import (
+    grow_stack_tree,
+    stack_trees,
+    write_slot_tree,
+)
+from conflux_tpu.update import pad_update_state, rank_bucket
+
+
+class SessionGang:
+    """One plan's device-resident stacked fleet on one lane device.
+
+    Owned by a `DeviceLane` (one gang per (plan, lane)); the lane's
+    dispatcher adopts sessions on first stacked contact, refreshes
+    dirty slots (version mismatch) before dispatching, and frees slots
+    when the tier layer spills a member (or a member is GC'd — slot
+    reclamation rides a lock-free weakref-callback list). All stacked
+    arrays are gang-OWNED: they come out of the gang's own builds and
+    donating slot writes, never out of a caller's hands, which is what
+    licenses `write_slot_tree`'s buffer donation.
+    """
+
+    def __init__(self, plan, device):
+        self.plan = plan
+        self.device = device
+        # the gang RLock: every attribute below is guarded by it; it
+        # may be held across the stacked dispatch (RLock, gang.py-born
+        # — the lockcheck dispatch rule only forbids engine.py plain
+        # Locks) so donating writes serialize with dispatch snapshots
+        self._lock = threading.RLock()
+        self.cap = 0                    # guarded-by: _lock
+        self._slots: list = []          # guarded-by: _lock (weakref|None)
+        self._vers: list = []           # guarded-by: _lock (applied ver)
+        self._free: list = []           # guarded-by: _lock
+        self._by_id: dict = {}          # guarded-by: _lock (id -> slot)
+        self._cancelled: set = set()    # guarded-by: _lock
+        # per-slot drift occupancy: current rank bucket (0 = clean) and
+        # the drifted slot's DriftPolicy.refine (sweeps uniformity)
+        self._upd_kb: list = []         # guarded-by: _lock
+        self._upd_refine: list = []     # guarded-by: _lock
+        # stacked device state (immutable jax arrays, refs swapped
+        # under the lock; in-flight dispatches hold their own refs)
+        self._F = None                  # guarded-by: _lock
+        self._A0 = None                 # guarded-by: _lock
+        self._wA = None                 # guarded-by: _lock
+        self._KB = 0                    # guarded-by: _lock
+        self._Up = None                 # guarded-by: _lock
+        self._Vp = None                 # guarded-by: _lock
+        self._Y = None                  # guarded-by: _lock
+        self._Cinv = None               # guarded-by: _lock
+        self._checked = False           # guarded-by: _lock
+        # GC-freed slots: (slot, id) appended by weakref callbacks
+        # WITHOUT any lock (list.append is GIL-atomic; callbacks must
+        # never block on gang state), drained under the lock
+        self._dead: list = []
+        # counters (read by engine.stats/counters)
+        self.adopts = 0                 # guarded-by: _lock
+        self.releases = 0               # guarded-by: _lock
+        self.refreshes = 0              # guarded-by: _lock
+        self.rebuilds = 0               # guarded-by: _lock
+
+    # ------------------------------------------------------------------ #
+    # membership bookkeeping
+    # ------------------------------------------------------------------ #
+
+    @property
+    def members(self) -> int:
+        with self._lock:
+            self._drain_dead_locked()
+            return len(self._by_id)
+
+    def slot_of(self, session):
+        """The session's slot, or None (not a member)."""
+        with self._lock:
+            return self._by_id.get(id(session))
+
+    def _make_ref(self, session, slot: int):
+        dead = self._dead
+        sid = id(session)
+
+        def cb(_ref, dead=dead, slot=slot, sid=sid):
+            # GC context: append only — never touch gang state or locks
+            dead.append((slot, sid))
+
+        return weakref.ref(session, cb)
+
+    # requires-lock: _lock
+    def _drain_dead_locked(self) -> None:
+        while self._dead:
+            try:
+                slot, sid = self._dead.pop()
+            except IndexError:  # pragma: no cover — racing GC append
+                break
+            # id() reuse guard: only free when the id still maps to the
+            # slot the dead session held
+            if self._by_id.get(sid) == slot:
+                del self._by_id[sid]
+                self._free_slot_locked(slot)
+                self.releases += 1
+
+    # requires-lock: _lock
+    def _free_slot_locked(self, slot: int) -> None:
+        self._slots[slot] = None
+        self._vers[slot] = -1
+        self._upd_kb[slot] = 0
+        self._upd_refine[slot] = 0
+        self._free.append(slot)
+        if not self._by_id:
+            self._reset_locked()
+
+    # requires-lock: _lock
+    def _reset_locked(self) -> None:
+        """Empty gang: drop every stacked array (frees the device
+        memory) and return to the unbuilt state."""
+        self.cap = 0
+        self._slots = []
+        self._vers = []
+        self._free = []
+        self._upd_kb = []
+        self._upd_refine = []
+        self._F = self._A0 = self._wA = None
+        self._Up = self._Vp = self._Y = self._Cinv = None
+        self._KB = 0
+
+    def release(self, session) -> None:
+        """Free the session's slot (tier spill, `to_device`, engine
+        teardown). The CALLER must hold the session's RLock — release
+        is the one gang entry point reached from under a session lock,
+        which is why `ensure` never nests the locks the other way. A
+        release that races a still-pending adoption cancels it. The
+        freed slot's stale stack contents are numerically inert (slots
+        never interact; a later adopt overwrites them)."""
+        sid = id(session)
+        with self._lock:
+            self._drain_dead_locked()
+            slot = self._by_id.pop(sid, None)
+            if slot is None:
+                self._cancelled.add(sid)
+            else:
+                self._free_slot_locked(slot)
+                self.releases += 1
+        session._gang = None
+        session._gang_slot = None
+
+    # ------------------------------------------------------------------ #
+    # adopt / refresh (the dispatcher's pre-dispatch sync)
+    # ------------------------------------------------------------------ #
+
+    def _snap(self, session, checked: bool) -> dict:
+        """Snapshot one session's resident state under ITS lock (no
+        gang lock held — phase B). Marks tentative membership so a
+        concurrent spill's release cancels the pending adoption."""
+        with session._lock:
+            session._ensure_resident()
+            session._gang = self
+            probe = session._probe_row() if checked else None
+            u = session._upd
+            upd = None
+            if u is not None:
+                upd = (u["kb"], u["Up"], u["Vp"], u["Y"], u["Cinv"],
+                       int(session.policy.refine))
+            return {"session": session, "ver": session._gang_ver,
+                    "F": session._factors, "A0": session._A0,
+                    "probe": probe, "upd": upd}
+
+    def ensure(self, sessions, max_stack: int, checked: bool):
+        """Adopt any non-member `sessions` (capacity permitting),
+        refresh dirty members (version mismatch — a member mutated
+        since its slot was written), and upgrade the gang to checked
+        residency when the engine's health policy asks for it. Returns
+        ``(admitted, excluded)``: admitted maps id(session) -> slot for
+        every requested session that is a member after the call;
+        excluded maps id(session) -> reason ('stack_cap' | 'error')
+        for the rest. Never takes a session lock while holding the
+        gang lock (see module docstring)."""
+        # ---- phase A (gang lock): plan the work -----------------------
+        with self._lock:
+            self._drain_dead_locked()
+            nmem = len(self._by_id)
+            space = max(0, int(max_stack) - nmem)
+            news, excluded = [], {}
+            seen = set()
+            for s in sessions:
+                sid = id(s)
+                if sid in seen:
+                    continue
+                seen.add(sid)
+                if sid in self._by_id:
+                    continue
+                if space > 0:
+                    news.append(s)
+                    space -= 1
+                else:
+                    excluded[sid] = "stack_cap"
+            total = nmem + len(news)
+            rebuild = total >= 2 and (
+                self.cap == 0
+                or (checked and not self._checked)
+                or self.cap > 2 * rank_bucket(max(2, total)))
+            if checked:
+                self._checked = True
+            use_checked = self._checked
+            dirty = []
+            if not rebuild:
+                for s in sessions:
+                    slot = self._by_id.get(id(s))
+                    if slot is not None \
+                            and self._vers[slot] != s._gang_ver:
+                        dirty.append(s)
+            live = []
+            if rebuild:
+                for ref in self._slots:
+                    s = None if ref is None else ref()
+                    if s is not None:
+                        live.append(s)
+        # ---- phase B (no gang lock): snapshot under session locks -----
+        need = (live + news) if rebuild else (news + dirty)
+        snaps: dict[int, dict] = {}
+        for s in need:
+            sid = id(s)
+            if sid in snaps:
+                continue
+            try:
+                snaps[sid] = self._snap(s, use_checked)
+            except Exception:  # noqa: BLE001 — adoption is best-effort
+                excluded[sid] = "error"
+        # ---- phase C (gang lock): apply -------------------------------
+        with self._lock:
+            self._drain_dead_locked()
+            for sid in list(snaps):
+                if sid in self._cancelled:
+                    self._cancelled.discard(sid)
+                    snaps.pop(sid)
+            if rebuild:
+                order = [snaps[id(s)] for s in (live + news)
+                         if id(s) in snaps]
+                if len(order) >= 2:
+                    self._install_build_locked(order)
+                # sessions that failed their snapshot mid-rebuild are
+                # no longer members (their state never made the stack)
+                for s in live:
+                    if id(s) not in snaps and id(s) in self._by_id:
+                        del self._by_id[id(s)]
+            else:
+                for s in news:
+                    snap = snaps.get(id(s))
+                    if snap is None:
+                        continue
+                    if self.cap == 0:
+                        # a lone adoptee cannot build a stack (co-
+                        # adoptees failed their snapshots): report it
+                        # unadmitted; the engine dispatches it solo
+                        excluded.setdefault(id(s), "singleton")
+                        continue
+                    self._adopt_one_locked(snap)
+                for s in dirty:
+                    snap = snaps.get(id(s))
+                    if snap is None:
+                        continue
+                    slot = self._by_id.get(id(s))
+                    if slot is not None:
+                        self._write_slot_locked(slot, snap)
+                        self.refreshes += 1
+            admitted = {}
+            for s in sessions:
+                slot = self._by_id.get(id(s))
+                if slot is not None:
+                    admitted[id(s)] = slot
+                    s._gang_slot = slot
+                elif id(s) not in excluded:
+                    excluded[id(s)] = "error"
+            return admitted, excluded
+
+    # requires-lock: _lock
+    def _install_build_locked(self, snaps: list) -> None:
+        """(Re)build every stacked array from scratch: first adoption
+        of a pair, a checked upgrade (the probe stack must cover every
+        member), or a compaction after the live set shrank well below
+        the bucket. Pad slots self-reference slot 0."""
+        n = len(snaps)
+        cap = rank_bucket(max(2, n))
+        pads = cap - n
+        self._F = stack_trees([s["F"] for s in snaps]
+                              + [snaps[0]["F"]] * pads)
+        self._A0 = jnp.stack([s["A0"] for s in snaps]
+                             + [snaps[0]["A0"]] * pads)
+        if self._checked:
+            self._wA = jnp.stack([s["probe"] for s in snaps]
+                                 + [snaps[0]["probe"]] * pads)
+        else:
+            self._wA = None
+        self.cap = cap
+        self._by_id = {}
+        self._slots = [None] * cap
+        self._vers = [-1] * cap
+        self._free = list(range(n, cap))[::-1]
+        self._upd_kb = [0] * cap
+        self._upd_refine = [0] * cap
+        self._KB = 0
+        self._Up = self._Vp = self._Y = self._Cinv = None
+        kbs = [s["upd"][0] for s in snaps if s["upd"] is not None]
+        if kbs:
+            self._alloc_drift_locked(max(kbs),
+                                     next(s["upd"] for s in snaps
+                                          if s["upd"] is not None))
+        for i, snap in enumerate(snaps):
+            session = snap["session"]
+            self._by_id[id(session)] = i
+            self._slots[i] = self._make_ref(session, i)
+            self._vers[i] = snap["ver"]
+            if self._KB:
+                self._write_drift_locked(i, snap["upd"])
+            elif snap["upd"] is not None:  # pragma: no cover — allocated above
+                raise AssertionError("drift stack missing")
+            self.adopts += 1
+        self.rebuilds += 1
+
+    # requires-lock: _lock
+    def _adopt_one_locked(self, snap: dict) -> None:
+        """Adopt one session into a free slot (growing the bucket when
+        none is free) — the steady-state adopt: one donated row write
+        per stacked component, no rebuild."""
+        session = snap["session"]
+        if not self._free:
+            new_cap = rank_bucket(self.cap + 1)
+            self._grow_locked(new_cap)
+        slot = self._free.pop()
+        self._by_id[id(session)] = slot
+        self._slots[slot] = self._make_ref(session, slot)
+        self._write_slot_locked(slot, snap)
+        self.adopts += 1
+
+    # requires-lock: _lock
+    def _grow_locked(self, new_cap: int) -> None:
+        self._F = grow_stack_tree(self._F, new_cap)
+        self._A0 = grow_stack_tree(self._A0, new_cap)
+        if self._wA is not None:
+            self._wA = grow_stack_tree(self._wA, new_cap)
+        if self._KB:
+            self._Up = grow_stack_tree(self._Up, new_cap, fill="zero")
+            self._Vp = grow_stack_tree(self._Vp, new_cap, fill="zero")
+            self._Y = grow_stack_tree(self._Y, new_cap, fill="zero")
+            self._Cinv = grow_stack_tree(self._Cinv, new_cap)
+        self._free.extend(range(self.cap, new_cap)[::-1])
+        self._slots += [None] * (new_cap - self.cap)
+        self._vers += [-1] * (new_cap - self.cap)
+        self._upd_kb += [0] * (new_cap - self.cap)
+        self._upd_refine += [0] * (new_cap - self.cap)
+        self.cap = new_cap
+
+    # requires-lock: _lock
+    def _write_slot_locked(self, slot: int, snap: dict) -> None:
+        """Write one session's state into its slot — donated row writes
+        into the gang-owned stacks (adopt and dirty-refresh share
+        this). Bitwise: the slot reads back exactly the session's
+        resident bits (`write_slot_tree`'s contract)."""
+        self._F = write_slot_tree(self._F, snap["F"], slot)
+        self._A0 = write_slot_tree(self._A0, snap["A0"], slot)
+        if self._wA is not None:
+            probe = snap["probe"]
+            if probe is None:  # pragma: no cover — snap matches checked
+                raise AssertionError("checked gang snap without probe")
+            self._wA = write_slot_tree(self._wA, probe, slot)
+        u = snap["upd"]
+        if u is not None and u[0] > self._KB:
+            if self._KB == 0:
+                self._alloc_drift_locked(u[0], u)
+            else:
+                self._repad_drift_locked(u[0])
+        if self._KB:
+            self._write_drift_locked(slot, u)
+        self._vers[slot] = snap["ver"]
+
+    # requires-lock: _lock
+    def _alloc_drift_locked(self, kb: int, template: tuple) -> None:
+        """First drifted member: allocate the stacked Woodbury state at
+        rank bucket kb — zero U/V/Y (inert) and identity Cinv rows, in
+        the template's dtypes (Y/Cinv ride the plan's compute dtype,
+        which only a real capacitance output names authoritatively)."""
+        _kb, Up, Vp, Y, Cinv, _r = template
+        n = Up.shape[-2]
+        cap = self.cap
+        self._Up = jnp.zeros((cap, n, kb), Up.dtype)
+        self._Vp = jnp.zeros((cap, n, kb), Vp.dtype)
+        self._Y = jnp.zeros((cap, n, kb), Y.dtype)
+        eye = jnp.zeros((cap, kb, kb), Cinv.dtype)
+        idx = jnp.arange(kb)
+        self._Cinv = eye.at[:, idx, idx].set(1.0)
+        self._KB = kb
+
+    # requires-lock: _lock
+    def _repad_drift_locked(self, kb2: int) -> None:
+        """Grow the gang rank bucket: zero-pad U/V/Y columns, extend
+        Cinv block-diagonally with the identity (inert for every
+        existing slot — the `pad_update_state` algebra applied to the
+        whole stack at once). The bucket is sticky until the gang
+        rebuilds: shrinking on every refactor would thrash the pad."""
+        kb = self._KB
+        pad = [(0, 0), (0, 0), (0, kb2 - kb)]
+        self._Up = jnp.pad(self._Up, pad)
+        self._Vp = jnp.pad(self._Vp, pad)
+        self._Y = jnp.pad(self._Y, pad)
+        C = jnp.zeros((self.cap, kb2, kb2), self._Cinv.dtype)
+        C = C.at[:, :kb, :kb].set(self._Cinv)
+        idx = jnp.arange(kb, kb2)
+        self._Cinv = C.at[:, idx, idx].set(1.0)
+        self._KB = kb2
+
+    # requires-lock: _lock
+    def _write_drift_locked(self, slot: int, upd) -> None:
+        kb = self._KB
+        if upd is None:
+            up = jnp.zeros(self._Up.shape[1:], self._Up.dtype)
+            vp = jnp.zeros(self._Vp.shape[1:], self._Vp.dtype)
+            y = jnp.zeros(self._Y.shape[1:], self._Y.dtype)
+            ci = jnp.eye(kb, dtype=self._Cinv.dtype)
+            self._upd_kb[slot] = 0
+            self._upd_refine[slot] = 0
+        else:
+            k0, Up, Vp, Y, Cinv, refine = upd
+            up, vp, y, ci = pad_update_state(Up, Vp, Y, Cinv, kb)
+            self._upd_kb[slot] = k0
+            self._upd_refine[slot] = refine
+        self._Up = write_slot_tree(self._Up, up, slot)
+        self._Vp = write_slot_tree(self._Vp, vp, slot)
+        self._Y = write_slot_tree(self._Y, y, slot)
+        self._Cinv = write_slot_tree(self._Cinv, ci, slot)
+
+    # ------------------------------------------------------------------ #
+    # dispatch-side reads
+    # ------------------------------------------------------------------ #
+
+    # requires-lock: _lock
+    def prepare(self, sessions) -> dict:
+        """Consistent dispatch snapshot (refs only, no device work) for
+        the given request-carrying sessions. The CALLER holds the gang
+        lock across this AND the dispatch itself, so a concurrent
+        adopt's donating write can never invalidate the refs mid-
+        enqueue. Raises KeyError when a session lost its slot since
+        `ensure` (a racing spill) — the engine routes that through the
+        solo survivor path, which revives and answers."""
+        slots = {}
+        for s in sessions:
+            slots[id(s)] = self._by_id[id(s)]
+        drifted = [(k, r) for k, r in zip(self._upd_kb, self._upd_refine)
+                   if k]
+        kb = self._KB if drifted else 0
+        sweeps = self.plan.key.refine
+        if drifted:
+            sweeps += max(r for _k, r in drifted)
+        return {"cap": self.cap, "slots": slots, "F": self._F,
+                "A0": self._A0, "wA": self._wA, "kb": kb,
+                "sweeps": sweeps, "Up": self._Up, "Vp": self._Vp,
+                "Y": self._Y, "Cinv": self._Cinv,
+                "checked": self._checked}
+
+    def stats(self) -> dict:
+        with self._lock:
+            self._drain_dead_locked()
+            return {"members": len(self._by_id), "cap": self.cap,
+                    "rank_bucket": self._KB,
+                    "checked": self._checked, "adopts": self.adopts,
+                    "releases": self.releases,
+                    "refreshes": self.refreshes,
+                    "rebuilds": self.rebuilds}

@@ -220,9 +220,13 @@ def engine_stats() -> dict:
            "factor_latency_p50_ms": 0.0, "factor_latency_p95_ms": 0.0,
            "factor_latency_p99_ms": 0.0,
            "lanes": 0, "lane_batches_max": 0, "lane_batches_min": 0,
-           "lane_occupancy_max": 0.0}
+           "lane_occupancy_max": 0.0, "lane_sheds": 0,
+           "gang_batches": 0, "gang_coalesced_mean": 0.0,
+           "gang_sessions": 0, "gang_opportunity": 0,
+           "stack_exclusions": {}}
     coalesced = 0
     fcoalesced = fslots = fpad = 0
+    gcoalesced = 0
     samples: list = []
     fsamples: list = []
     for e in engines:
@@ -240,6 +244,15 @@ def engine_stats() -> dict:
         fpad += s["factor_pad_slots"]
         samples.extend(e.latency_samples())
         fsamples.extend(e.factor_latency_samples())
+        # gang-stacked serving (PR 10): stacked dispatch counters, gang
+        # population, and the per-reason exclusion trace, fleet-merged
+        out["gang_batches"] += s.get("gang_batches", 0)
+        gcoalesced += s.get("gang_coalesced_requests", 0)
+        out["gang_sessions"] += s.get("gang", {}).get("sessions", 0)
+        out["gang_opportunity"] += s.get("gang_opportunity", 0)
+        for k, v in s.get("stack_exclusions", {}).items():
+            out["stack_exclusions"][k] = \
+                out["stack_exclusions"].get(k, 0) + v
         # per-lane fleet view (PR 9): lane count and the dispatch-balance
         # extremes across every engine's lanes — the one-glance answer
         # to "is one device starving while another drowns"
@@ -251,8 +264,11 @@ def engine_stats() -> dict:
                                        else min(out["lane_batches_min"], b))
             out["lane_occupancy_max"] = max(out["lane_occupancy_max"],
                                             ln.get("occupancy", 0.0))
+            out["lane_sheds"] += ln.get("sheds", 0)
     if out["batches"]:
         out["coalesced_mean"] = coalesced / out["batches"]
+    if out["gang_batches"]:
+        out["gang_coalesced_mean"] = gcoalesced / out["gang_batches"]
     if out["factor_batches"]:
         out["factor_coalesced_mean"] = fcoalesced / out["factor_batches"]
     if fslots:
@@ -324,7 +340,8 @@ _ENGINE_COUNTERS = (
     "requests", "completed", "failed", "shed", "batches",
     "coalesced_requests", "width_capped", "factor_requests",
     "factor_batches", "factor_coalesced_requests", "factor_slots",
-    "factor_pad_slots",
+    "factor_pad_slots", "gang_batches", "gang_coalesced_requests",
+    "gang_opportunity",
 )
 # tier.tier_stats() keys that are NOT counters: per-manager population/
 # byte gauges and the latency percentiles (recomputed cumulatively)

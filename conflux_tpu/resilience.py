@@ -157,6 +157,8 @@ _HEALTH_KEYS = (
     "rhs_rejects",            # submit()-time finite-guard trips
     "staging_isolations",     # poisoned requests failed alone at staging
     "output_failures",        # dispatched solves that failed the check
+    "gang_unhealthy_slots",   # gang-stacked slots failing their per-slot
+                              # verdict (requests re-dispatched solo)
     "survivor_redispatches",  # innocent requests re-dispatched solo
     "factor_rejects",         # submit_factor()-time A finite-guard trips
     "factor_isolations",      # poisoned A matrices failed alone at staging

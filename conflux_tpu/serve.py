@@ -71,6 +71,7 @@ from conflux_tpu.update import (
     DriftPolicy,
     capacitance,
     health_spot_check,
+    health_spot_check_slots,
     probe_row,
     probe_vector,
     rank_bucket,
@@ -300,6 +301,7 @@ class FactorPlan:
 
     def bucket_ready(self, *, width: int | None = None,
                      factor_batch: int | None = None,
+                     stack=None,
                      checked: bool = False) -> bool:
         """True when the named bucket's program is built AND warm (first
         call completed — traced, cached, dispatch-only from here on).
@@ -322,7 +324,18 @@ class FactorPlan:
             fn = self._factor_cache.get(key)
             if fn is None or not fn.warm:
                 return False
-        return width is not None or factor_batch is not None
+        if stack is not None:
+            # stack = (sessions, width): the gang-stacked bucket the
+            # adaptive controller prewarm-gates before flipping
+            # `stack_sessions` on (DESIGN §26)
+            sb, wb = int(stack[0]), int(stack[1])
+            key = (("gstack_health", sb, wb) if checked
+                   else ("stacked", sb, wb))
+            fn = self._solve_cache.get(key)
+            if fn is None or not fn.warm:
+                return False
+        return (width is not None or factor_batch is not None
+                or stack is not None)
 
     def release_buckets(self, widths=(), factor_batches=()) -> int:
         """Drop retired bucket programs from the plan's caches — the
@@ -352,7 +365,8 @@ class FactorPlan:
                 keys = [wb, ("health", wb), ("refine", wb)]
                 keys += [k for k in self._solve_cache
                          if isinstance(k, tuple) and len(k) == 3
-                         and k[0] == "stacked" and k[2] == wb]
+                         and k[0] in ("stacked", "gstack_health")
+                         and k[2] == wb]
                 for key in keys:
                     dropped += self._solve_cache.pop(key, None) is not None
             for bb in factor_batches:
@@ -374,25 +388,38 @@ class FactorPlan:
                 k for k in self._warm_devices
                 if not (
                     (k[0] in ("solve", "solve_health") and k[1] in wbs)
-                    or (k[0] == "stacked"
+                    or (k[0] in ("stacked", "stacked_health")
                         and isinstance(k[1], tuple) and k[1][1] in wbs)
+                    or (k[0] == "stacked_usolve"
+                        and isinstance(k[1], tuple) and k[1][2] in wbs)
                     or (k[0] in ("factor", "factor_health")
                         and k[1] in fbs))}
         return dropped
 
-    def device_warm(self, kind: str, bucket: int, devkey) -> bool:
+    @staticmethod
+    def _warm_key(kind: str, bucket, devkey) -> tuple:
+        # composite buckets ((stack, width), (stack, rank, width)) pass
+        # through as tuples; int() on them was a latent crash
+        b = (tuple(int(x) for x in bucket) if isinstance(bucket, tuple)
+             else int(bucket))
+        return (kind, b, devkey)
+
+    def device_warm(self, kind: str, bucket, devkey) -> bool:
         """True when (kind, bucket) has completed a warm-up dispatch on
         the device identified by `devkey` (see `engine._devkey`; None =
-        the default device). The per-lane prewarm dedupe read."""
+        the default device). The per-lane prewarm dedupe read. `bucket`
+        is an int for the width/factor families and a tuple for the
+        stacked ones."""
         with self._compile_lock:
-            return (kind, int(bucket), devkey) in self._warm_devices
+            return self._warm_key(kind, bucket, devkey) \
+                in self._warm_devices
 
-    def mark_device_warm(self, kind: str, bucket: int, devkey) -> None:
+    def mark_device_warm(self, kind: str, bucket, devkey) -> None:
         """Record a completed (kind, bucket, device) warm-up. Called by
         the engine AFTER the warming dispatch finished, so a crashed
         prewarm never poisons the registry."""
         with self._compile_lock:
-            self._warm_devices.add((kind, int(bucket), devkey))
+            self._warm_devices.add(self._warm_key(kind, bucket, devkey))
 
     # ------------------------------------------------------------------ #
     # program builders
@@ -516,25 +543,107 @@ class FactorPlan:
 
         return self._memo(self._solve_cache, nrhs, build)
 
-    def _stacked_solve_fn(self, ns: int, nrhs: int):
-        """The engine's cross-session program: `ns` sessions of this
-        (single-system) plan stack their factor pytrees on a new leading
-        axis and ride ONE vmapped substitution dispatch (`ServeEngine`
-        with ``stack_sessions=True``). Bucketed like everything else —
-        power-of-two session count and RHS width; the engine pads by
-        repeating a session slot / zero columns and slices back. The
-        stacked result is allclose to, but not bitwise, the per-session
-        dispatch (XLA batches the GEMMs differently under vmap)."""
+    def _check_stack_bucket(self, what: str, ns: int, nrhs: int) -> None:
         if self.batched:
             raise AssertionError(
                 "stacked dispatch is for single-system plans — batched "
                 "plans already amortize over their own batch axis")
         if ns & (ns - 1) or ns < 1 or nrhs & (nrhs - 1) or nrhs < 1:
             raise AssertionError(
-                f"_stacked_solve_fn takes power-of-two buckets, got "
+                f"{what} takes power-of-two buckets, got "
                 f"({ns}, {nrhs}) — route requests through ServeEngine")
+
+    def _stacked_solve_fn(self, ns: int, nrhs: int):
+        """The engine's cross-session program: `ns` sessions of this
+        (single-system) plan stack their factor pytrees on a new leading
+        axis and ride ONE vmapped substitution dispatch (`ServeEngine`
+        with ``stack_sessions=True`` — the gang-resident stacks of
+        `conflux_tpu.gang` index their device-resident state straight
+        into this program). Bucketed like everything else — power-of-two
+        session count and RHS width; the engine pads by repeating a
+        session slot / zero columns and slices back. The stacked result
+        is allclose to, but not bitwise, the per-session dispatch (XLA
+        batches the GEMMs differently under vmap); it IS bitwise
+        invariant to the stack bucket size and the pad-slot contents
+        (slots never interact), which is the gang's within-a-bucket
+        contract for plain sessions."""
+        self._check_stack_bucket("_stacked_solve_fn", ns, nrhs)
         return self._memo(self._solve_cache, ("stacked", ns, nrhs),
                           lambda: jax.jit(jax.vmap(self._one_solve)))
+
+    def _stacked_solve_health_fn(self, ns: int, nrhs: int):
+        """Checked stacked program — what closes the gang's `checked`
+        exclusion hole: (F, A0, wA, b) -> (x, (2, ns) verdict) with the
+        §20 Freivalds verdict fused PER SLOT
+        (`update.health_spot_check_slots`), so health-guarded sessions
+        ride the same one-dispatch stacked path as plain ones and a
+        sick slot is attributed without re-dispatching its gang-mates
+        (the factor lane's per-slot-flags machinery,
+        `resilience.evaluate_slots`). A0 is None for refine-free plans
+        (the body never consumes it); wA is the stacked probe rows the
+        gang keeps resident."""
+        self._check_stack_bucket("_stacked_solve_health_fn", ns, nrhs)
+
+        def build():
+            w = self.probe_w
+            body = jax.vmap(self._one_solve)
+
+            def f(factors, A0, wA, b2):
+                self._bump("health")  # trace-time, not per call
+                x = body(factors, A0, b2)
+                return x, health_spot_check_slots(w, wA, x, b2)
+
+            return jax.jit(f)
+
+        return self._memo(self._solve_cache, ("gstack_health", ns, nrhs),
+                          build)
+
+    def _stacked_update_solve_fn(self, ns: int, kb: int, nrhs: int,
+                                 sweeps: int):
+        """Stacked rank-bucketed Woodbury program — what closes the
+        gang's `upd_pending` exclusion hole: every slot rides the base
+        substitution plus a kb-bucketed capacitance correction
+        (`update.woodbury_apply` via `_one_update_solve`), with clean
+        slots carrying zero U/V (exactly-zero correction) and drifted
+        slots their `pad_update_state`-padded state. A0 is None when
+        sweeps == 0. Signature: (F, A0, Up, Vp, Y, Cinv, b) -> x."""
+        self._check_stack_bucket("_stacked_update_solve_fn", ns, nrhs)
+
+        def build():
+            import functools
+
+            one = functools.partial(self._one_update_solve, sweeps)
+            return jax.jit(jax.vmap(one))
+
+        return self._memo(self._update_cache,
+                          ("gusolve", ns, kb, nrhs, sweeps), build)
+
+    def _stacked_update_solve_health_fn(self, ns: int, kb: int, nrhs: int,
+                                        sweeps: int):
+        """Checked stacked Woodbury program: drifted AND health-guarded
+        sessions in one dispatch. The per-slot projected residual
+        routes through each slot's DRIFTED matrix
+        (w^T A1 = wA + (w^T Up) Vp^H; zero-padded columns inert), so
+        SMW garbage trips its own slot's verdict only."""
+        self._check_stack_bucket("_stacked_update_solve_health_fn",
+                                 ns, nrhs)
+
+        def build():
+            import functools
+
+            one = functools.partial(self._one_update_solve, sweeps)
+            w = self.probe_w
+            body = jax.vmap(one)
+
+            def f(factors, A0, Up, Vp, Y, Cinv, wA, b2):
+                self._bump("health")  # trace-time, not per call
+                x = body(factors, A0, Up, Vp, Y, Cinv, b2)
+                return x, health_spot_check_slots(w, wA, x, b2, Up, Vp)
+
+            return jax.jit(f)
+
+        return self._memo(self._update_cache,
+                          ("guhealth", ns, kb, nrhs, sweeps), build)
 
     # ------------------------------------------------------------------ #
     # stacked (cold-start) factor programs — the engine's factor lane
@@ -986,6 +1095,20 @@ class SolveSession:
         self._residency = None
         self._spill = None         # guarded-by: _lock
         self._tier_stamp = 0
+        # gang residency (conflux_tpu.gang, DESIGN §26): `_gang` is the
+        # SessionGang holding this session's stacked slot (None =
+        # unganged, zero behavioral change), `_gang_slot` its slot
+        # index. Both are written by the gang under ITS protocol (the
+        # gang lock orders after this session lock, so they are plain
+        # attribute writes here — racy reads tolerated by design).
+        # `_gang_ver` is the write-back sync: every state mutation
+        # below bumps it under this lock, and the engine's dispatcher
+        # re-syncs a stale slot before the next stacked dispatch —
+        # write-back is LAZY, so no mutation path ever needs the gang
+        # lock while holding this one.
+        self._gang = None
+        self._gang_slot = None
+        self._gang_ver = 0         # guarded-by: _lock
 
     @property
     def factors(self):
@@ -1094,6 +1217,12 @@ class SolveSession:
             if self._upd is not None:
                 self._upd = {**self._upd, **moved["upd"]}
             self.device = device
+            self._gang_ver += 1
+            if self._gang is not None:
+                # the gang's stack lives on the OLD device — leave it
+                # (release requires this held session lock; the session
+                # re-adopts into its new lane's gang at next dispatch)
+                self._gang.release(self)
         return self
 
     def _rhs(self, b):
@@ -1264,6 +1393,7 @@ class SolveSession:
                 self._factors = self.plan._factor_once(self._A0)
             self.factorizations += 1
             self.refactors += 1
+            self._gang_ver += 1  # the gang slot is stale; lazy re-sync
             return self
 
     # ------------------------------------------------------------------ #
@@ -1343,6 +1473,7 @@ class SolveSession:
             self._upd = {"k": k, "kb": kb, "Up": U, "Vp": V,
                          "Y": Y, "Cinv": Cinv}
             self.updates += 1
+            self._gang_ver += 1  # the gang slot is stale; lazy re-sync
             if self._residency is not None:
                 # footprint grew by the Woodbury state: refresh the
                 # manager's byte gauge (nbytes under this held lock,
@@ -1385,5 +1516,6 @@ class SolveSession:
             self._factors = plan._factor_once(A_new)
             self.factorizations += 1
             self.refactors += 1
+            self._gang_ver += 1  # the gang slot is stale; lazy re-sync
             if self._residency is not None:
                 self._residency._note_bytes(self)

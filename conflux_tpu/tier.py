@@ -279,6 +279,10 @@ def _implant(session, leaves: dict, meta: dict,
                      "Up": leaves["Up"], "Vp": leaves["Vp"],
                      "Y": leaves["Y"], "Cinv": leaves["Cinv"]})
     session._owns_base = meta["owns_base"]
+    # the restored buffers are NEW device arrays: any gang slot written
+    # from the pre-spill state is stale (spill released the slot, but a
+    # version bump keeps the lazy re-sync honest on every implant path)
+    session._gang_ver += 1
     if counters:
         c = meta["counters"]
         session.factorizations = c["factorizations"]
@@ -706,6 +710,14 @@ class ResidentSet:
                 s._A0 = None
                 s._probe = None
                 s._upd = None
+                g = s._gang
+                if g is not None:
+                    # eviction frees the gang slot (DESIGN §26) —
+                    # under THIS held session lock, the one legal
+                    # session->gang lock order; revival re-adopts
+                    # (grouped revivals straight into gang slots via
+                    # engine._gang_readopt, singles at next dispatch)
+                    g.release(s)
             with self._lock:
                 self._state[sid] = "host"
                 self._device_bytes -= self._bytes.get(sid, 0)
@@ -1251,6 +1263,7 @@ class ResidentSet:
 
         groups: dict[tuple, list] = {}
         rest = []
+        landed: list = []
         for s in sessions:
             with s._lock:
                 rec = s._spill
@@ -1343,6 +1356,7 @@ class ResidentSet:
                                     self._resident_now())
                             bump("revives_h2d")
                             _note_latency(time.perf_counter() - t0)
+                            landed.append(s)
                             n += 1
                     finally:
                         self._unclaim(token)
@@ -1352,12 +1366,21 @@ class ResidentSet:
         for s in rest:
             try:
                 if self.fault_in(s, timeout=timeout):
+                    landed.append(s)
                     n += 1
             except SessionSpilled:
                 # per-session backpressure (lane slot or session lock
                 # busy past the budget): this session stays spilled,
                 # the rest still get their revival attempt
                 continue
+        eng = self.engine
+        if landed and eng is not None \
+                and hasattr(eng, "_gang_readopt"):
+            # grouped revivals land straight into gang slots (DESIGN
+            # §26): adopt the revived fleet eagerly so its first
+            # window already dispatches stacked. Advisory; no session
+            # lock is held here.
+            eng._gang_readopt(landed)
         return n
 
     # -------------------------------------------------------------- #

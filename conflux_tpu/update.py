@@ -221,6 +221,83 @@ def health_spot_check(w, wA, x, b, Up=None, Vp=None):
                       jnp.max(num / den).astype(jnp.float32)])
 
 
+def health_spot_check_slots(w, wA, x, b, Up=None, Vp=None):
+    """Per-slot fused health verdict for a STACKED (gang) solve — the
+    cross-session analog of :func:`health_spot_check`, returning a
+    (2, S) float32 block instead of a (2,) scalar pair: row 0 the
+    per-slot finite flags, row 1 the per-slot projected residuals.
+    Slot i's verdict depends only on slot i's factors/RHS (the vmapped
+    solve never mixes slots), so one sick session can never contaminate
+    its gang-mates' evidence — the same blast-radius-isolation shape as
+    the factor lane's per-slot verdict (`FactorPlan._factor_health_fn`),
+    read host-side by the same `resilience.evaluate_slots`.
+
+    x is (S, N, w), wA is (S, N), b is (S, N, w); Up/Vp (S, N, kb)
+    extend the projection to each slot's drifted matrix (zero-padded
+    columns inert — a clean slot carries zero U/V). Idle gang slots
+    (zero RHS columns) evaluate finite with residual 0. Deliberately
+    op-lean: a handful of batched reductions OUTSIDE the vmap (the
+    XLA-CPU fixed-op-cost rule, §20) — per-slot sums, never
+    per-element ops. Traceable; single-system plans only."""
+    cdtype = x[..., 0].dtype
+    xs = jnp.sum(x, axis=tuple(range(1, x.ndim)))            # (S,)
+    finite = jnp.isfinite(xs)
+    x0 = x[..., 0].astype(cdtype)                            # (S, N)
+    b0 = b[..., 0].astype(cdtype)
+    wc = w.astype(cdtype)
+    ax = jnp.sum(wA.astype(cdtype) * x0, axis=-1)            # (S,)
+    if Up is not None:
+        wU = jnp.sum(wc[None, :, None] * Up.astype(cdtype),
+                     axis=-2)                                # (S, kb)
+        vx = jnp.sum(Vp.astype(cdtype).conj()
+                     * x0[..., :, None], axis=-2)            # (S, kb)
+        ax = ax + jnp.sum(wU * vx, axis=-1)
+    num = jnp.abs(jnp.sum(wc * b0, axis=-1) - ax)
+    den = (jnp.sqrt(jnp.sum(jnp.abs(b0) ** 2, axis=-1))
+           + jnp.finfo(cdtype).tiny)
+    return jnp.stack([finite.astype(jnp.float32),
+                      (num / den).astype(jnp.float32)])
+
+
+def pad_update_state(Up, Vp, Y, Cinv, kb: int):
+    """Zero-pad one session's Woodbury state from its own rank bucket
+    k0 = Up.shape[-1] up to the gang bucket `kb` — what lets sessions
+    at DIFFERENT drift ranks share one stacked rank-bucketed Woodbury
+    dispatch. U/V/Y gain zero columns (inert: a zero column contributes
+    nothing to V^H z or to Y @ (...)); Cinv extends block-diagonally
+    with the identity — exactly the capacitance :func:`capacitance`
+    would have produced from the zero-padded U/V (C = I + V^H Y is
+    block-diag [C_k0, I], so its inverse is [Cinv_k0, I]), built here
+    by construction instead of re-inverting. The padded slot's
+    correction therefore equals the unpadded one up to reduction
+    order (allclose, the gang contract for drifted slots)."""
+    k0 = Up.shape[-1]
+    if k0 == kb:
+        return Up, Vp, Y, Cinv
+    if k0 > kb:
+        raise ValueError(f"cannot pad rank {k0} down to bucket {kb}")
+    pad = [(0, 0)] * (Up.ndim - 1) + [(0, kb - k0)]
+    Up2 = jnp.pad(Up, pad)
+    Vp2 = jnp.pad(Vp, pad)
+    Y2 = jnp.pad(Y, pad)
+    C2 = jnp.eye(kb, dtype=Cinv.dtype).at[:k0, :k0].set(Cinv)
+    return Up2, Vp2, Y2, C2
+
+
+def zero_update_state(n: int, kb: int, dtype, factor_dtype=None):
+    """The Woodbury state of an UNdrifted gang slot at rank bucket kb:
+    zero U/V/Y and an identity capacitance inverse. Riding the stacked
+    Woodbury program with this state reproduces the plain substitution
+    (the correction term is exactly zero — Y is the zero matrix), so a
+    mixed clean/drifted gang dispatches ONE program. Y/Cinv take the
+    compute dtype of `factor_dtype` (default `dtype`) — the dtype a
+    real :func:`capacitance` output carries, so a prewarmed program
+    signature matches live drift traffic."""
+    cdtype = blas.compute_dtype(jnp.dtype(factor_dtype or dtype))
+    z = jnp.zeros((n, kb), jnp.dtype(dtype))
+    return z, z, jnp.zeros((n, kb), cdtype), jnp.eye(kb, dtype=cdtype)
+
+
 def apply_update(A0, U, V):
     """Materialize the drifted matrix A0 + U V^H in A0's dtype — the
     refactor path's input (and the bench's full-refactor oracle).

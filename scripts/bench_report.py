@@ -1,0 +1,149 @@
+"""Aggregate the committed BENCH_*.json headlines into one markdown
+trajectory table.
+
+Nine benches now carry the serving stack's perf story (engine, refresh,
+cold start, resilience overhead, working set, adaptive control, fleet,
+gang, serve) and reading it means opening nine JSON files. This script
+folds every committed headline into a single table — metric, value,
+speedup/gate column, and the git date of the last change to each file —
+so the perf trajectory is reviewable at a glance. CI runs it and uploads
+BENCH_REPORT.md as an artifact.
+
+Usage: python scripts/bench_report.py [--repo DIR] [--out BENCH_REPORT.md]
+
+Smoke artifacts (BENCH_*_smoke.json, gitignored) and the raw
+chip-health round logs (BENCH_r0*.json) are excluded: the table is the
+COMMITTED full-shape story. Files holding multiple JSON records (one
+per line) contribute one row per record.
+"""
+
+from __future__ import annotations
+
+import argparse
+import glob
+import json
+import os
+import subprocess
+import sys
+
+# keys (in priority order) that carry each bench's speedup/gate story
+_RATIO_KEYS = (
+    "speedup_vs_per_session_dispatch", "speedup_vs_sequential",
+    "speedup_vs_always_refactor", "speedup_vs_seq_async",
+    "ratio_solves_vs_single_lane", "overhead_pct",
+    "single_speedup_vs_refactor", "speedup_vs_naive",
+    "transitions_won",
+)
+_GATE_KEYS = (
+    "speedup_gate_x", "gate_ratio", "overhead_gate_pct",
+    "steady_slack_gate_pct", "tier_gate_x",
+)
+
+
+def _git_date(repo: str, path: str) -> str:
+    try:
+        out = subprocess.run(
+            ["git", "log", "-1", "--format=%ad", "--date=short", "--",
+             os.path.basename(path)],
+            cwd=repo, capture_output=True, text=True, timeout=30)
+        return out.stdout.strip() or "-"
+    except Exception:  # noqa: BLE001 — the date column is best-effort
+        return "-"
+
+
+def _records(path: str):
+    """Yield every JSON record in the file (some benches append one
+    record per run, one per line)."""
+    with open(path) as f:
+        text = f.read()
+    try:
+        yield json.loads(text)
+        return
+    except json.JSONDecodeError:
+        pass
+    for line in text.splitlines():
+        line = line.strip()
+        if not line:
+            continue
+        try:
+            yield json.loads(line)
+        except json.JSONDecodeError:
+            continue
+
+
+def _pick(d: dict, keys) -> tuple[str, str]:
+    for k in keys:
+        if k in d:
+            return k, str(d[k])
+    return "-", "-"
+
+
+def build_rows(repo: str) -> list:
+    rows = []
+    for path in sorted(glob.glob(os.path.join(repo, "BENCH_*.json"))):
+        name = os.path.basename(path)
+        if "_smoke" in name or name.startswith("BENCH_r0"):
+            continue
+        date = _git_date(repo, path)
+        for rec in _records(path):
+            if not isinstance(rec, dict) or "metric" not in rec:
+                continue
+            rk, rv = _pick(rec, _RATIO_KEYS)
+            gk, gv = _pick(rec, _GATE_KEYS)
+            rows.append({
+                "file": name,
+                "metric": str(rec.get("metric", "-")),
+                "value": f"{rec.get('value', '-')}"
+                         f" {rec.get('unit', '')}".strip(),
+                "ratio": f"{rk}={rv}" if rk != "-" else "-",
+                "gate": f"{gk}={gv}" if gk != "-" else "-",
+                "date": date,
+            })
+    return rows
+
+
+def to_markdown(rows: list) -> str:
+    lines = [
+        "# Bench trajectory",
+        "",
+        "The committed full-shape headlines, one row per recorded "
+        "result (smoke artifacts excluded). Regenerate with "
+        "`python scripts/bench_report.py`.",
+        "",
+        "| file | metric | value | speedup / overhead | gate | date |",
+        "|---|---|---|---|---|---|",
+    ]
+    for r in rows:
+        metric = r["metric"].replace("|", "\\|")
+        lines.append(f"| {r['file']} | {metric} | {r['value']} | "
+                     f"{r['ratio']} | {r['gate']} | {r['date']} |")
+    lines.append("")
+    return "\n".join(lines)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser("bench_report")
+    ap.add_argument("--repo", default=os.path.dirname(
+        os.path.dirname(os.path.abspath(__file__))),
+        help="repository root holding the BENCH_*.json files")
+    ap.add_argument("--out", default="BENCH_REPORT.md",
+                    help="markdown output path (relative to --repo "
+                    "unless absolute)")
+    args = ap.parse_args(argv)
+    rows = build_rows(args.repo)
+    if not rows:
+        print("no committed BENCH_*.json headlines found",
+              file=sys.stderr)
+        return 1
+    md = to_markdown(rows)
+    out = (args.out if os.path.isabs(args.out)
+           else os.path.join(args.repo, args.out))
+    with open(out, "w") as f:
+        f.write(md)
+    print(md)
+    print(f"[{len(rows)} rows -> {out}]", file=sys.stderr)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -877,6 +877,204 @@ def run_fleet_trial(seed: int) -> tuple[bool, str]:
                   f"injected={sum(faults.injected.values())}")
 
 
+def run_gang_trial(seed: int) -> tuple[bool, str]:
+    """One chaos trial of the gang-resident stacked serving path
+    (ISSUE 10).
+
+    A same-plan single-system fleet serves round-barriered phases
+    through a ``stack_sessions=True`` engine while the serve fault menu
+    fires; between phases sessions mutate (Woodbury drift, forced
+    refactors) and — when tiered — the tier layer spills and revives
+    gang members, churning slot assignments. Invariants: every future
+    resolves; failures are STRUCTURED resilience errors only; clean
+    answers match each session's OWN f64 oracle (a gang slot leaking
+    state between sessions would miss it); the closed exclusion holes
+    stay closed (`upd_pending` == `checked` == 0 — drifted and checked
+    sessions ride the stacked path); a spilled session is never a gang
+    member; gang membership never exceeds `max_stack`; and the engine
+    closes un-wedged with zero pending and coherent counters."""
+    import jax.numpy as jnp
+
+    from conflux_tpu import resilience, serve
+    from conflux_tpu.engine import EngineSaturated, ServeEngine
+    from conflux_tpu.resilience import (
+        DeadlineExceeded,
+        FaultPlan,
+        FaultSpec,
+        HealthPolicy,
+        InjectedFault,
+        RhsNonFinite,
+        SessionQuarantined,
+        SessionSpilled,
+        SolveUnhealthy,
+    )
+    from conflux_tpu.tier import ResidentSet
+
+    rng = np.random.default_rng(seed)
+    serve.clear_plans()
+    N = int(rng.choice([32, 64]))
+    S = int(rng.integers(3, 8))
+    sub = str(rng.choice(["trsm", "inv"]))
+    max_stack = int(rng.choice([2, 4, 8]))
+    tiered = bool(rng.integers(2))
+    checked = bool(rng.integers(2))
+    plan = serve.FactorPlan.create((N, N), jnp.float32, v=16,
+                                   substitution=sub)
+    As, fleet = [], []
+    for i in range(S):
+        A = (rng.standard_normal((N, N)) / np.sqrt(N)
+             + 2.0 * np.eye(N)).astype(np.float32)
+        sess = plan.factor(jnp.asarray(A), sid=f"gang-{i}")
+        A64 = A.astype(np.float64)
+        if rng.integers(2):  # pre-traffic drift: upd_pending food
+            k = int(rng.integers(1, 4))
+            U = (0.01 * rng.standard_normal((N, k))).astype(np.float32)
+            Vm = (0.01 * rng.standard_normal((N, k))).astype(np.float32)
+            sess.update(U, Vm)
+            A64 = A64 + U.astype(np.float64) @ Vm.astype(np.float64).T
+        As.append(A64)
+        fleet.append(sess)
+    rs = None
+    if tiered:
+        # capacity holds the whole fleet: a gang can only stack what
+        # fits the device, so a working set larger than capacity
+        # degenerates to (correct) solo-dispatch thrash — the churn
+        # this soak wants comes from the explicit inter-phase
+        # spill_lru/revive_many cycles over freed gang slots instead
+        rs = ResidentSet(max_sessions=S)
+        rs.adopt(*fleet)
+    menu = [
+        FaultSpec("dispatch", "delay", prob=0.3, delay_s=0.002, count=3),
+        FaultSpec("drain", "crash", prob=0.5, count=1),
+        FaultSpec("d2h", "crash", prob=0.5, count=1),
+        FaultSpec("refresh", "delay", prob=0.5, delay_s=0.002, count=2),
+    ]
+    if checked:
+        # data faults need the guards to be meaningful: an unguarded
+        # engine answering a post-admission-poisoned request with NaN
+        # is CORRECT behavior, not a failure
+        menu += [
+            FaultSpec("staging", "nan", prob=0.3,
+                      count=int(rng.integers(1, 4))),
+            FaultSpec("solve", "unhealthy", prob=0.3,
+                      count=int(rng.integers(1, 3))),
+        ]
+    if tiered:
+        menu += [
+            FaultSpec("spill", "crash", prob=0.4, count=1),
+            FaultSpec("revive", "delay", prob=0.4, delay_s=0.002,
+                      count=2),
+        ]
+    picks = [m for m in menu if rng.integers(2)]
+    faults = FaultPlan(picks, seed=seed)
+    label = (f"seed={seed} gang N={N} S={S} sub={sub} "
+             f"max_stack={max_stack} tiered={tiered} checked={checked} "
+             f"faults={[(f.site, f.kind) for f in picks]}")
+    eng = ServeEngine(
+        # a real coalescing window always: stacked dispatch IS the
+        # path under test (0-delay traffic degenerates to singletons)
+        max_batch_delay=0.002,
+        max_pending=256, max_coalesce_width=8,
+        stack_sessions=True, max_stack=max_stack,
+        health=(HealthPolicy(quarantine_after=3,
+                             quarantine_cooldown=0.05)
+                if checked else None),
+        fault_plan=faults, residency=rs, watchdog_interval=0.05)
+    resilience.install_faults(faults)
+    ok_exc = (RhsNonFinite, DeadlineExceeded, SolveUnhealthy,
+              SessionQuarantined, SessionSpilled, InjectedFault)
+    answered = total = 0
+    try:
+        for phase in range(4):
+            reqs = []
+            for _rnd in range(3):
+                for si in range(S):
+                    w = int(rng.choice([1, 1, 2]))
+                    b = rng.standard_normal((N, w)).astype(np.float32)
+                    kind = int(rng.integers(12))
+                    deadline = None
+                    if kind == 0 and checked:
+                        # admission-guard food (only meaningful with
+                        # guards: an unguarded engine answers NaN for
+                        # NaN, correctly)
+                        b[int(rng.integers(N)), 0] = np.nan
+                    elif kind == 1:
+                        deadline = 0.0
+                    try:
+                        fut = eng.submit(fleet[si], b,
+                                         deadline=deadline)
+                    except (RhsNonFinite, SessionQuarantined,
+                            EngineSaturated):
+                        continue
+                    reqs.append((si, b, fut))
+            total += len(reqs)
+            for si, b, fut in reqs:
+                try:
+                    x = np.asarray(fut.result(120))
+                except ok_exc:
+                    continue
+                except Exception as e:  # noqa: BLE001
+                    return False, (f"{label}: UNSTRUCTURED "
+                                   f"{type(e).__name__}: {e}")
+                want = np.linalg.solve(As[si], b.astype(np.float64))
+                err = (np.linalg.norm(x - want)
+                       / max(np.linalg.norm(want), 1e-30))
+                if not (err < 1e-3):
+                    return False, (f"{label}: session {si} off its "
+                                   f"oracle ({err:.2e}) — slot leak?")
+                answered += 1
+            # quiesced inter-phase mutations: drift, refactor, tiering
+            for si in range(S):
+                r = int(rng.integers(6))
+                try:
+                    if r == 0:
+                        k = int(rng.integers(1, 4))
+                        U = (0.01 * rng.standard_normal((N, k))
+                             ).astype(np.float32)
+                        Vm = (0.01 * rng.standard_normal((N, k))
+                              ).astype(np.float32)
+                        fleet[si].update(U, Vm)
+                        As[si] = (As[si] + U.astype(np.float64)
+                                  @ Vm.astype(np.float64).T)
+                    elif r == 1:
+                        fleet[si].refactor()
+                except (InjectedFault, SessionSpilled):
+                    continue  # structured mutation outcomes are fine
+            if tiered and rng.integers(2):
+                rs.spill_lru(int(rng.integers(1, S)))
+                for s in fleet:
+                    if s.tier != "device" and s._gang is not None:
+                        return False, (f"{label}: spilled session "
+                                       "kept its gang slot")
+                if rng.integers(2):
+                    rs.revive_many(fleet)
+        wedged = eng.close(timeout=120)
+        if wedged:
+            return False, f"{label}: close() wedged {wedged}"
+    finally:
+        resilience.install_faults(None)
+        eng.close(timeout=10)
+    st = eng.stats()
+    if st["pending"] != 0:
+        return False, f"{label}: {st['pending']} pending slots leaked"
+    if st["completed"] + st["failed"] != st["requests"]:
+        return False, f"{label}: counters incoherent"
+    excl = st["stack_exclusions"]
+    for key in ("upd_pending", "checked", "mesh", "batched"):
+        if excl.get(key, 0):
+            return False, (f"{label}: exclusion hole reopened: "
+                           f"{key}={excl[key]} ({excl})")
+    gang = st["gang"]
+    if gang["gangs"] and gang["sessions"] > gang["gangs"] * max_stack:
+        return False, (f"{label}: gang membership {gang['sessions']} "
+                       f"exceeds max_stack={max_stack}")
+    return True, (f"{label}: ok {answered}/{total} answered, "
+                  f"gang_batches={st['gang_batches']}, "
+                  f"adopts={gang['adopts']}, "
+                  f"releases={gang['releases']}, "
+                  f"injected={sum(faults.injected.values())}")
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser()
     ap.add_argument("--trials", type=int, default=200)
@@ -917,6 +1115,16 @@ def main(argv=None) -> int:
                     "lane revives, the fleet keeps serving), "
                     "structured failures only, and per-session f64 "
                     "oracle answers on every lane")
+    ap.add_argument("--gang", action="store_true",
+                    help="chaos-soak the gang-resident stacked serving "
+                    "path: a same-plan single-system fleet under a "
+                    "stack_sessions=True engine with drift/refactor "
+                    "mutations and (when tiered) spill/revive slot "
+                    "churn between phases; asserts structured failures "
+                    "only, per-session f64 oracle answers (zero "
+                    "cross-slot corruption), the closed exclusion "
+                    "holes staying closed, and slot/membership "
+                    "accounting")
     ap.add_argument("--lockcheck", action="store_true",
                     help="run trials under the conflint runtime "
                     "lock-order harness (conflux_tpu.analysis."
@@ -925,7 +1133,8 @@ def main(argv=None) -> int:
                     "cycle or lock-held-across-dispatch fails the soak")
     args = ap.parse_args(argv)
 
-    trial = (run_fleet_trial if args.fleet
+    trial = (run_gang_trial if args.gang
+             else run_fleet_trial if args.fleet
              else run_tier_trial if args.tier
              else run_adaptive_trial if args.adaptive
              else run_serve_trial if args.serve else run_trial)
