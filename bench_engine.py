@@ -175,6 +175,25 @@ def parse_args():
     ap.add_argument("--gang-gate", type=float, default=2.0,
                     help="min solves/s speedup vs the per-session-"
                     "dispatch baseline (--gang, full shape)")
+    ap.add_argument("--trsm", action="store_true",
+                    help="measure the ISSUE 11 blocked-trsm engine "
+                    "instead (DESIGN §27): (a) ops-level — the blocked "
+                    "batched trsm versus XLA's serial batched "
+                    "triangular_solve at the production shape "
+                    "(B=32, N=256, 1-wide RHS), gate >= --trsm-gate; "
+                    "(b) serving — a substitution='blocked' gang leg "
+                    "versus the 'inv' gang leg on the BENCH_GANG "
+                    "round-barrier methodology, gate within "
+                    "--trsm-parity-gate of inv, zero compiles after "
+                    "prewarm, bucket/pad bitwise invariance and "
+                    "exclusion/health counters at zero on the blocked "
+                    "legs; write BENCH_TRSM.json")
+    ap.add_argument("--trsm-gate", type=float, default=2.0,
+                    help="min blocked-vs-XLA-trsm solves/s speedup "
+                    "(--trsm, full shape)")
+    ap.add_argument("--trsm-parity-gate", type=float, default=1.2,
+                    help="max blocked/inv gang wall-clock ratio "
+                    "(--trsm, full shape)")
     ap.add_argument("--out", default=None,
                     help="JSON output path. Defaults to the mode's "
                     "BENCH_*.json; --smoke runs default to "
@@ -213,12 +232,303 @@ def main():
                     else "BENCH_ADAPTIVE.json" if args.adaptive
                     else "BENCH_FLEET.json" if args.fleet
                     else "BENCH_GANG.json" if args.gang
+                    else "BENCH_TRSM.json" if args.trsm
                     else "BENCH_ENGINE.json")
         if args.smoke:
             # smoke shapes are not the headline shapes: write them to a
             # sibling (gitignored) file so a CI/dev smoke run never
             # clobbers the committed full-shape numbers
             args.out = args.out.replace(".json", "_smoke.json")
+
+    # ---------------- trsm mode: the blocked substitution engine --------- #
+    # the ISSUE 11 acceptance numbers (DESIGN §27). Leg A is ops-level:
+    # the blocked batched trsm (diagonal-block inverses precomputed, the
+    # factor-time amortization the serve layer performs) versus XLA's
+    # batched small-rhs triangular_solve — the measured ~70x serial
+    # cliff of §17 — at the production shape B=32 N=256, 1-wide RHS.
+    # Leg B is serving: a substitution='blocked' gang fleet versus the
+    # historical 'inv' gang fleet on the BENCH_GANG round-barrier
+    # methodology (same trace, interleaved alternating legs, median of
+    # per-rep ratios, <= 3 re-measures), gating that blocked gangs land
+    # within --trsm-parity-gate of inv wall-clock — the "gang plans
+    # must open with inv" rule is retired, not merely bent. The blocked
+    # legs also gate: zero XLA compiles after prewarm (solve, factor
+    # lane, and gang dispatches), bucket/pad bitwise invariance of the
+    # blocked stacked program, and exclusion + escalation counters at
+    # literal zero on clean AND drifted+checked traffic.
+    if args.trsm:
+        from jax import lax
+
+        from conflux_tpu.batched import stack_trees
+        from conflux_tpu.ops import batched_trsm as bt
+
+        if args.smoke:
+            args.batch, args.N, args.v = 8, 128, 64
+            args.gang_fleet = 8
+            args.requests = 64
+            args.reps = min(args.reps, 3)
+        if args.delay_ms == 2.0:
+            args.delay_ms = 0.3  # round-barrier methodology (see --gang)
+        B, N, v = args.batch, args.N, args.v
+        S, R = args.gang_fleet, args.requests
+        rng = np.random.default_rng(0)
+
+        def median(xs):
+            xs = sorted(xs)
+            return xs[len(xs) // 2]
+
+        # ---- leg A: ops-level blocked vs XLA batched trsm ------------ #
+        A = (rng.standard_normal((B, N, N)) / np.sqrt(N)
+             + 2.0 * np.eye(N)).astype(np.float32)
+        L = np.tril(A)
+        b1 = rng.standard_normal((B, N, 1)).astype(np.float32)
+        Ld, bd = jnp.asarray(L), jnp.asarray(b1)
+        # conflint: disable=CFX-RECOMPILE one-shot factor-time inversion
+        dinv = jax.jit(jax.vmap(
+            lambda t: bt.diag_block_inverses(t, lower=True)))(Ld)
+        dinv.block_until_ready()
+        blocked_fn = jax.jit(
+            lambda T, d, r: bt.blocked_trsm(T, r, lower=True, dinv=d,
+                                            backend="xla"))
+        xla_fn = jax.jit(
+            lambda T, r: lax.linalg.triangular_solve(
+                T, r, left_side=True, lower=True))
+        xb = blocked_fn(Ld, dinv, bd)
+        xx = xla_fn(Ld, bd)
+        jax.block_until_ready((xb, xx))
+        if not np.allclose(np.asarray(xb), np.asarray(xx),
+                           rtol=1e-4, atol=1e-5):
+            raise SystemExit("blocked trsm diverged from XLA trsm")
+        R_ops = 10 if args.smoke else 20
+
+        def ops_leg(fn, *fargs):
+            t0 = time.perf_counter()
+            for _ in range(R_ops):
+                fn(*fargs).block_until_ready()
+            return time.perf_counter() - t0
+
+        def measure_ops():
+            tbs, txs, ratios = [], [], []
+            for rep in range(args.reps):
+                if rep % 2 == 0:
+                    tb = ops_leg(blocked_fn, Ld, dinv, bd)
+                    tx = ops_leg(xla_fn, Ld, bd)
+                else:
+                    tx = ops_leg(xla_fn, Ld, bd)
+                    tb = ops_leg(blocked_fn, Ld, dinv, bd)
+                tbs.append(tb)
+                txs.append(tx)
+                ratios.append(tx / tb)
+            return median(ratios), median(tbs), median(txs)
+
+        ops_gate = 1.0 if args.smoke else args.trsm_gate
+        ops_est = [measure_ops()]
+        while ops_est[-1][0] < ops_gate and len(ops_est) < 3:
+            ops_est.append(measure_ops())
+        ops_speedup, tb_med, tx_med = max(ops_est, key=lambda e: e[0])
+
+        # ---- leg B: gang parity — blocked vs inv --------------------- #
+        widths = [1, 1, 1, 2]
+        plan_inv = serve.FactorPlan.create((N, N), jnp.float32, v=v,
+                                           substitution="inv")
+        plan_blk = serve.FactorPlan.create((N, N), jnp.float32, v=v,
+                                           substitution="blocked")
+        Af = (rng.standard_normal((S, N, N)) / np.sqrt(N)
+              + 2.0 * np.eye(N)).astype(np.float32)
+        fleet_inv = [plan_inv.factor(jnp.asarray(Af[s]), sid=f"i{s}")
+                     for s in range(S)]
+        fleet_blk = [plan_blk.factor(jnp.asarray(Af[s]), sid=f"b{s}")
+                     for s in range(S)]
+        trace = []
+        for i in range(R):
+            w = widths[(i // S) % len(widths)]
+            trace.append((i % S,
+                          rng.standard_normal((N, w))
+                          .astype(np.float32)))
+        gang_solves = sum(bb.shape[-1] for _, bb in trace)
+        sb = rank_bucket(S)
+
+        def mk_engine(sess0, health=None):
+            eng = ServeEngine(max_batch_delay=args.delay_ms * 1e-3,
+                              max_pending=max(4 * R, 64),
+                              max_coalesce_width=args.max_width,
+                              stack_sessions=True, max_stack=sb,
+                              health=health)
+            eng.prewarm(sess0, widths=(1, 2), stacks=(sb,))
+            return eng
+
+        eng_i = mk_engine(fleet_inv[0])
+        eng_b = mk_engine(fleet_blk[0])
+
+        def gang_leg(eng, fleet):
+            t0 = time.perf_counter()
+            xs = []
+            for r0 in range(0, len(trace), S):
+                futs = [eng.submit(fleet[s], bb)
+                        for s, bb in trace[r0:r0 + S]]
+                xs += [f.result(timeout=300) for f in futs]
+            return time.perf_counter() - t0, xs
+
+        for eng, fl in ((eng_i, fleet_inv), (eng_b, fleet_blk)):
+            gang_leg(eng, fl)  # warm adoption + thread handoff
+        compiles0 = profiler.compile_count()
+        traces0 = dict(plan_blk.trace_counts)
+
+        def measure_gang():
+            ratios, tis, tbs = [], [], []
+            xg = None
+            for rep in range(args.reps):
+                if rep % 2 == 0:
+                    tb2, xg = gang_leg(eng_b, fleet_blk)
+                    ti, _ = gang_leg(eng_i, fleet_inv)
+                else:
+                    ti, _ = gang_leg(eng_i, fleet_inv)
+                    tb2, xg = gang_leg(eng_b, fleet_blk)
+                ratios.append(tb2 / ti)
+                tis.append(ti)
+                tbs.append(tb2)
+            return median(ratios), median(tis), median(tbs), xg
+
+        parity_gate = 2.0 if args.smoke else args.trsm_parity_gate
+        gang_est = [measure_gang()]
+        while gang_est[-1][0] > parity_gate and len(gang_est) < 3:
+            gang_est.append(measure_gang())
+        parity, ti_med, tb2_med, x_gang = min(gang_est,
+                                              key=lambda e: e[0])
+        gang_compiles = profiler.compile_count() - compiles0
+        if plan_blk.trace_counts != traces0:
+            raise SystemExit(
+                "blocked gang traffic traced after prewarm — the "
+                "bucket set is wrong")
+        if eng_b.stats()["gang_batches"] == 0:
+            raise SystemExit("blocked engine never dispatched stacked")
+        # numerics: blocked gang answers allclose to solo dispatch
+        x_solo = [np.asarray(fleet_blk[s].solve(bb))
+                  for s, bb in trace]
+        for i2, (xg2, xs2) in enumerate(zip(x_gang, x_solo)):
+            if not np.allclose(np.asarray(xg2), xs2, rtol=1e-4,
+                               atol=1e-6):
+                raise SystemExit(
+                    f"blocked gang answer {i2} diverged from solo")
+        # bucket/pad bitwise invariance of the blocked stacked program
+        # (resident slots vs a hand-built 2-stack — the §26 probe)
+        g = eng_b.lanes[0]._gangs[id(plan_blk)]
+        bprobe = rng.standard_normal((N, 1)).astype(np.float32)
+        nprobes = min(4, S)
+        n_bitwise = 0
+        with g._lock:
+            Fres, cap = g._F, g.cap
+            slots = {s: g._by_id[id(fleet_blk[s])]
+                     for s in range(nprobes)}
+        for s in range(nprobes):
+            bufc = np.zeros((cap, N, 1), np.float32)
+            bufc[slots[s]] = bprobe
+            got = np.asarray(plan_blk._stacked_solve_fn(cap, 1)(
+                Fres, None, bufc))[slots[s]]
+            other = (s + 1) % S
+            with fleet_blk[s]._lock, fleet_blk[other]._lock:
+                F2 = stack_trees([fleet_blk[s]._factors,
+                                  fleet_blk[other]._factors])
+            buf2 = np.zeros((2, N, 1), np.float32)
+            buf2[0] = bprobe
+            ref = np.asarray(plan_blk._stacked_solve_fn(2, 1)(
+                F2, None, buf2))[0]
+            n_bitwise += int(np.array_equal(got, ref))
+        excl = eng_b.stats()["stack_exclusions"]
+        # blocked factor lane: coalesced cold starts stay compile-free
+        eng_b.prewarm(plan_blk, factor_batches=(1, 2, 4))
+
+        def factor_round():
+            futs = [eng_b.submit_factor(plan_blk, jnp.asarray(Af[s]))
+                    for s in range(4)]
+            return [f.result(timeout=300) for f in futs]
+
+        factor_round()
+        cf0 = profiler.compile_count()
+        factor_round()
+        factor_compiles = profiler.compile_count() - cf0
+        eng_i.close()
+        eng_b.close()
+        # drifted + checked blocked leg: the closed holes stay closed
+        # and the fused verdict trips nothing on clean traffic
+        Ud = (0.01 * rng.standard_normal((N, 3))).astype(np.float32)
+        Vd = (0.01 * rng.standard_normal((N, 3))).astype(np.float32)
+        for s in range(0, S, 2):
+            fleet_blk[s].update(Ud, Vd)
+        engH = mk_engine(fleet_blk[0], health=HealthPolicy())
+        gang_leg(engH, fleet_blk)  # warm round (checked gang build)
+        esc0 = resilience.health_stats().get("escalations", 0)
+        tH, xH = gang_leg(engH, fleet_blk)
+        exclH = engH.stats()["stack_exclusions"]
+        escH = resilience.health_stats().get("escalations", 0) - esc0
+        x_solo2 = [np.asarray(fleet_blk[s].solve(bb))
+                   for s, bb in trace]
+        for i2, (xh, xs2) in enumerate(zip(xH, x_solo2)):
+            if not np.allclose(np.asarray(xh), xs2, rtol=1e-4,
+                               atol=1e-6):
+                raise SystemExit(
+                    f"drifted+checked blocked answer {i2} diverged")
+        engH.close()
+
+        out = {
+            "metric": (f"blocked batched trsm solves/s B={B} N={N} "
+                       f"1-wide RHS f32, + blocked-vs-inv gang parity "
+                       f"fleet={S} R={R} v={v}"
+                       + (" (smoke)" if args.smoke else "")),
+            "value": round(B * R_ops / tb_med, 2),
+            "unit": "solves/s",
+            "xla_trsm_solves_per_s": round(B * R_ops / tx_med, 2),
+            "speedup_vs_xla_trsm": round(ops_speedup, 2),
+            "speedup_estimates": [round(e[0], 2) for e in ops_est],
+            "speedup_gate_x": ops_gate,
+            "gang_blocked_solves_per_s": round(gang_solves / tb2_med,
+                                               2),
+            "gang_inv_solves_per_s": round(gang_solves / ti_med, 2),
+            "gang_blocked_vs_inv_x": round(parity, 3),
+            "gang_parity_estimates": [round(e[0], 3) for e in gang_est],
+            "gang_parity_gate_x": parity_gate,
+            "reps": args.reps,
+            "compiles_after_prewarm": gang_compiles,
+            "factor_lane_compiles_after_prewarm": factor_compiles,
+            "bitwise_within_bucket_probes": f"{n_bitwise}/{nprobes}",
+            "stack_exclusions": excl,
+            "stack_exclusions_drifted_checked": exclH,
+            "checked_escalations": escH,
+            "baseline": "XLA batched triangular_solve (ops leg); "
+                        "substitution='inv' gang engine, identical "
+                        "trace (serving leg)",
+            "persistent_cache": cache.cache_dir(),
+        }
+        with open(args.out, "w") as f:
+            json.dump(out, f, indent=2)
+            f.write("\n")
+        print(json.dumps(out))
+        if ops_speedup < ops_gate:
+            raise SystemExit(
+                f"gate: blocked trsm {ops_speedup:.2f}x < {ops_gate}x "
+                "over XLA batched triangular_solve")
+        if parity > parity_gate:
+            raise SystemExit(
+                f"gate: blocked gang leg {parity:.2f}x inv wall-clock "
+                f"> {parity_gate}x parity gate")
+        if gang_compiles or factor_compiles:
+            raise SystemExit(
+                f"gate: {gang_compiles}+{factor_compiles} XLA compiles "
+                "after prewarm on the blocked legs")
+        if n_bitwise != nprobes:
+            raise SystemExit(
+                f"gate: bucket/pad bitwise invariance broke "
+                f"({n_bitwise}/{nprobes} probes)")
+        for key in ("upd_pending", "checked", "mesh"):
+            if excl.get(key, 0) or exclH.get(key, 0):
+                raise SystemExit(
+                    f"gate: exclusion counter {key} nonzero on the "
+                    f"blocked legs: clean={excl} checked={exclH}")
+        if escH:
+            raise SystemExit(
+                f"gate: {escH} escalations on clean drifted+checked "
+                "blocked traffic — the fused verdict misfired")
+        return
 
     # ---------------- gang mode: device-resident stacked fleets ---------- #
     # the ISSUE 10 acceptance numbers: a many-session fleet of

@@ -386,30 +386,66 @@ def cholesky_solve_batched(L, b, *, mesh=None):
     return x[:, :, 0] if squeeze else x
 
 
+def _batched_corr(spd: bool, substitution: str, precision, backend: str,
+                  Af, v: int, panel_algo: str):
+    """Factor ONE system of the vmapped one-shot bodies and return its
+    substitution closure. `substitution='blocked'` routes through the
+    blocked-trsm engine (`ops.batched_trsm`, DESIGN §27) — under the
+    callers' vmap every block step is a batched GEMM, sidestepping
+    XLA's serial batched small-rhs TriangularSolve; 'trsm' keeps the
+    classic substitutions (the historical bits)."""
+    from conflux_tpu.cholesky.single import _cholesky_blocked
+    from conflux_tpu.lu.single import _lu_factor_blocked
+    from conflux_tpu.ops.batched_trsm import (
+        blocked_solve,
+        diag_block_inverses,
+    )
+    from conflux_tpu.solvers import cholesky_solve, lu_solve
+
+    cdtype = blas.compute_dtype(Af.dtype)
+    if spd:
+        L = _cholesky_blocked(Af, v, precision, backend)
+        if substitution != "blocked":
+            return lambda r: cholesky_solve(L, r)
+        Lc = L.astype(cdtype)
+        Dl = diag_block_inverses(Lc, lower=True)
+        Du = jnp.swapaxes(Dl.conj(), -1, -2)
+
+        def corr(r):
+            y = blocked_solve(Lc, Dl, r.astype(cdtype), lower=True)
+            return blocked_solve(Lc.conj().T, Du, y, lower=False)
+
+        return corr
+    LUf, perm = _lu_factor_blocked(Af, v, precision, backend, panel_algo)
+    if substitution != "blocked":
+        return lambda r: lu_solve(LUf, perm, r)
+    LUc = LUf.astype(cdtype)
+    Dl = diag_block_inverses(LUc, lower=True, unit_diagonal=True)
+    Du = diag_block_inverses(LUc, lower=False)
+
+    def corr(r):
+        y = blocked_solve(LUc, Dl, r.astype(cdtype)[perm], lower=True)
+        return blocked_solve(LUc, Du, y, lower=False)
+
+    return corr
+
+
 @functools.lru_cache(maxsize=32)
 def _build_solve(B: int, N: int, k: int, dtype_name: str,
                  fdtype_name: str, v: int, refine: int, spd: bool,
-                 precision, backend: str, panel_algo: str, mesh_key):
+                 precision, backend: str, panel_algo: str, mesh_key,
+                 substitution: str = "trsm"):
     """One compiled program for the whole batched pipeline: factor (in the
     factor dtype) + substitution + `refine` classic-IR sweeps, vmapped and
     batch-sharded. Keeping factor and solve in a single program lets XLA
     fuse the dtype casts and skip materializing intermediates the solve
     does not need."""
-    from conflux_tpu.cholesky.single import _cholesky_blocked
-    from conflux_tpu.lu.single import _lu_factor_blocked
-    from conflux_tpu.solvers import cholesky_solve, lu_solve
-
     fdtype = jnp.dtype(fdtype_name)
 
     def one(A, b2):
         Af = A.astype(fdtype)
-        if spd:
-            L = _cholesky_blocked(Af, v, precision, backend)
-            solve_corr = lambda r: cholesky_solve(L, r)
-        else:
-            LUf, perm = _lu_factor_blocked(Af, v, precision, backend,
-                                           panel_algo)
-            solve_corr = lambda r: lu_solve(LUf, perm, r)
+        solve_corr = _batched_corr(spd, substitution, precision, backend,
+                                   Af, v, panel_algo)
         cdtype = blas.compute_dtype(A.dtype)
         Ac = A.astype(cdtype)
         bc = b2.astype(cdtype)
@@ -429,28 +465,21 @@ def _build_solve(B: int, N: int, k: int, dtype_name: str,
 @functools.lru_cache(maxsize=32)
 def _build_solve_updated(B: int, N: int, k: int, nrhs: int, dtype_name: str,
                          fdtype_name: str, v: int, refine: int, spd: bool,
-                         precision, backend: str, panel_algo: str, mesh_key):
+                         precision, backend: str, panel_algo: str, mesh_key,
+                         substitution: str = "trsm"):
     """One compiled program for a fleet of drifting systems: factor each
     base A[i], then solve (A[i] + U[i] V[i]^H) x[i] = b[i] through the
     Woodbury capacitance correction — vmapped and batch-sharded like
     `_build_solve`, so B rank-k drifts update together without any
     per-element dispatch."""
-    from conflux_tpu.cholesky.single import _cholesky_blocked
-    from conflux_tpu.lu.single import _lu_factor_blocked
-    from conflux_tpu.solvers import cholesky_solve, lu_solve
     from conflux_tpu.update import woodbury_solve
 
     fdtype = jnp.dtype(fdtype_name)
 
     def one(A, U, V, b2):
         Af = A.astype(fdtype)
-        if spd:
-            L = _cholesky_blocked(Af, v, precision, backend)
-            base = lambda r: cholesky_solve(L, r)
-        else:
-            LUf, perm = _lu_factor_blocked(Af, v, precision, backend,
-                                           panel_algo)
-            base = lambda r: lu_solve(LUf, perm, r)
+        base = _batched_corr(spd, substitution, precision, backend,
+                             Af, v, panel_algo)
         return woodbury_solve(base, A if refine else None, U, V, b2,
                               refine=refine)
 
@@ -463,15 +492,20 @@ def _build_solve_updated(B: int, N: int, k: int, nrhs: int, dtype_name: str,
 
 def solve_updated_batched(A, U, V, b, *, v: int = 256, factor_dtype=None,
                           refine: int = 0, spd: bool = False, mesh=None,
-                          precision=None, backend: str | None = None):
+                          precision=None, backend: str | None = None,
+                          substitution: str = "trsm"):
     """Solve B drifted systems (A[i] + U[i] V[i]^H) x[i] = b[i] in one
     program — the batched counterpart of `solvers.solve_updated` for
     fleets whose systems drift by a low-rank correction together. A is
     (B, N, N), U/V are (B, N, k) with k << N, b is (B, N) or (B, N, nrhs);
     only the BASE matrices are factored (O(N^3) each), the corrections
     ride k x k capacitance systems. With a `batch_mesh` the batch is
-    data-parallel across its devices; `spd` refers to the base matrices.
+    data-parallel across its devices; `spd` refers to the base matrices;
+    `substitution` as in :func:`solve_batched`.
     """
+    if substitution not in ("trsm", "blocked"):
+        raise ValueError(
+            f"unknown substitution {substitution!r} (trsm|blocked)")
     A = jnp.asarray(A)
     _check_batched_square(A)
     B, N = A.shape[0], A.shape[1]
@@ -494,21 +528,29 @@ def solve_updated_batched(A, U, V, b, *, v: int = 256, factor_dtype=None,
     Ap, Up, Vp, bp = _shard_batch((Ap, Up, Vp, bp), mesh)
     fn = _build_solve_updated(Bp, N, U.shape[-1], b3.shape[2], A.dtype.name,
                               fdtype.name, v, refine, spd, precision,
-                              backend, blas.get_panel_algo(), key)
+                              backend, blas.get_panel_algo(), key,
+                              substitution)
     x = fn(Ap, Up, Vp, bp)[:B]
     return x[:, :, 0] if squeeze else x
 
 
 def solve_batched(A, b, *, v: int = 256, factor_dtype=None, refine: int = 0,
                   spd: bool = False, mesh=None, precision=None,
-                  backend: str | None = None):
+                  backend: str | None = None, substitution: str = "trsm"):
     """Solve B independent systems A[i] x[i] = b[i] in one program.
 
     The batched counterpart of `solvers.solve` (same `factor_dtype` /
     `refine` HPL-MxP recipe, same `spd` Cholesky switch): A is (B, N, N),
     b is (B, N) or (B, N, k); returns x of b's shape. With a `batch_mesh`
     the batch rides data-parallel across its devices.
+    `substitution='blocked'` substitutes through the blocked-trsm
+    engine (`ops.batched_trsm`, DESIGN §27 — GEMM steps instead of
+    XLA's serial batched trsm; the serve layer's default); 'trsm'
+    (default here) keeps this one-shot entry's historical bits.
     """
+    if substitution not in ("trsm", "blocked"):
+        raise ValueError(
+            f"unknown substitution {substitution!r} (trsm|blocked)")
     A = jnp.asarray(A)
     _check_batched_square(A)
     B, N = A.shape[0], A.shape[1]
@@ -526,6 +568,6 @@ def solve_batched(A, b, *, v: int = 256, factor_dtype=None, refine: int = 0,
     Ap, bp = _shard_batch((Ap, bp), mesh)
     fn = _build_solve(Bp, N, b3.shape[2], A.dtype.name, fdtype.name, v,
                       refine, spd, precision, backend,
-                      blas.get_panel_algo(), key)
+                      blas.get_panel_algo(), key, substitution)
     x = fn(Ap, bp)[:B]
     return x[:, :, 0] if squeeze else x

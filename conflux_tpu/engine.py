@@ -2591,7 +2591,9 @@ class ServeEngine:
         checked = self.health is not None and self.health.check_output
         kind = "factor_health" if checked else "factor"
         # identity stacks: well-conditioned in every mode (LU, Cholesky,
-        # trsm and inv substitution) — the same filler the pad slots use
+        # trsm/blocked/inv substitution — an identity's diagonal-block
+        # inverses are identities too) — the same filler the pad slots
+        # use
         buf = np.empty((bb,) + plan.key.shape, np.dtype(plan.key.dtype))
         buf[:] = np.eye(plan.N, dtype=buf.dtype)
         for lane in self._lanes:

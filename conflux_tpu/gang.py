@@ -34,6 +34,15 @@ as clean ones, and a checked gang maintains the stacked probe rows
 per-slot (`update.health_spot_check_slots`, read by the factor lane's
 existing `resilience.evaluate_slots` + solo-survivor machinery).
 
+The old "gang plans must open with ``substitution='inv'``" rule is
+RETIRED (DESIGN §27): the stacked programs are vmapped, and the
+``'blocked'`` substitution engine (`ops.batched_trsm`) keeps every
+vmapped block step a batched GEMM — ``substitution='auto'`` plans gang
+at full speed with triangular-grade accuracy, the checked stacked
+program fusing its Freivalds epilogue into the final block steps
+(`FactorPlan._stacked_solve_health_fn`). ``'inv'`` remains an explicit
+opt-in, not a gang prerequisite.
+
 Locking (the tier layer's discipline, §23): the gang RLock orders AFTER
 any session RLock — write paths that hold a session lock (tier spill,
 ``to_device``) may call :meth:`release`; the adopt/refresh path

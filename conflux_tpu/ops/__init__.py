@@ -7,6 +7,7 @@ LU, potrf) with an XLA backend and, for the hot ops, Pallas TPU kernels.
 
 from conflux_tpu.ops.blas import (
     gemm,
+    blocked_trsm,
     trsm_left_lower_unit,
     trsm_right_upper,
     panel_lu,
@@ -17,6 +18,7 @@ from conflux_tpu.ops.blas import (
 
 __all__ = [
     "gemm",
+    "blocked_trsm",
     "trsm_left_lower_unit",
     "trsm_right_upper",
     "panel_lu",

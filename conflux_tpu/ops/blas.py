@@ -163,6 +163,26 @@ def trsm_left_lower_unit_t(L: jax.Array, B: jax.Array) -> jax.Array:
     )
 
 
+def blocked_trsm(T: jax.Array, B: jax.Array, *, lower: bool = True,
+                 unit_diagonal: bool = False, dinv=None,
+                 block_size: int | None = None, precision=None,
+                 backend: str | None = None) -> jax.Array:
+    """Blocked batched triangular solve (DESIGN §27): diagonal-block
+    inverses + trailing-panel GEMMs instead of XLA's serial-per-row
+    batched TriangularSolve — the vmapped serving programs' fast
+    substitution path (`conflux_tpu.ops.batched_trsm`). T is (n, n) or
+    (B, n, n) (packed factors fine); `backend='pallas'` (or the module
+    backend, resolved at trace time like :func:`gemm`) routes batched
+    operands through the Pallas kernel, interpret mode off-TPU."""
+    from conflux_tpu.ops import batched_trsm
+
+    backend = _BACKEND if backend is None else backend
+    precision = _MATMUL_PRECISION if precision is None else precision
+    return batched_trsm.blocked_trsm(
+        T, B, lower=lower, unit_diagonal=unit_diagonal, dinv=dinv,
+        block_size=block_size, precision=precision, backend=backend)
+
+
 def trsm_left_lower(L: jax.Array, B: jax.Array) -> jax.Array:
     """Solve L X = B with L lower triangular (Cholesky forward solve)."""
     return lax.linalg.triangular_solve(

@@ -96,7 +96,8 @@ class PlanKey:
     v: int                # tile size
     refine: int           # classic-IR sweeps fused into the solve program
     spd: bool             # Cholesky instead of LU
-    substitution: str     # 'trsm' | 'inv' (resolved from 'auto' at create)
+    substitution: str     # 'trsm' | 'inv' | 'blocked' ('auto' resolves
+                          # at create — DESIGN §27)
     precision: Any        # trailing-GEMM precision
     backend: str          # gemm backend
     panel_algo: str       # LU panel election algo
@@ -195,6 +196,13 @@ class FactorPlan:
         # the factor lane's stacked cold-start programs, keyed by batch
         # bucket (kept apart from _solve_cache, whose keys tests assert)
         self._factor_cache: dict[tuple, Any] = {}
+        # the blocked-trsm engine's fused-probe checked programs
+        # (DESIGN §27) — their OWN memo dict, again because
+        # tests/test_serve.py asserts set(_solve_cache) == width
+        # buckets exactly; release_buckets/bucket_ready cover it like
+        # the others so the adaptive controller's grow/retire cycle
+        # can neither strand nor re-compile the family
+        self._trsm_cache: dict[tuple, Any] = {}
         # per-DEVICE warm registry (kept apart from the program caches,
         # whose key sets tests assert): one jitted program traces once
         # per shape but compiles one executable per device it runs on,
@@ -237,18 +245,23 @@ class FactorPlan:
         warm compiles.
 
         `substitution` picks the per-request engine: 'trsm' runs the
-        classic triangular substitutions; 'inv' additionally inverts the
-        triangular factors AT FACTOR TIME (O(N^3), amortized into the
-        session open) so every solve is two batched GEMVs — the
-        MXU/BLAS3-friendly layout. XLA's *batched* small-rhs
-        triangular_solve is serial per row (measured 70x slower than the
-        GEMV form at B=32, N=256 on CPU), so 'auto' resolves to 'inv'
-        for batched plans and 'trsm' for single-system ones. Explicit
-        triangular inverses trade a bounded accuracy term (growth ~
-        cond(L) cond(U) instead of cond(A)); the serve tests hold the
-        result to the one-shot oracle's residual bars, and the plan's
-        `refine` sweeps restore working accuracy when the traffic is
-        harder.
+        classic triangular substitutions; 'blocked' runs them BLOCKED
+        (diagonal-block inverses computed at factor time, O(N/bs)
+        GEMM steps per solve — `conflux_tpu.ops.batched_trsm`, DESIGN
+        §27); 'inv' inverts the FULL triangular factors at factor time
+        so every solve is two batched GEMVs. XLA's *batched* small-rhs
+        triangular_solve is serial per row (measured 70x slower than
+        the GEMV form at B=32, N=256 on CPU), and every servable plan
+        may be dispatched VMAPPED — batched plans over their own batch
+        axis, single-system plans through the factor lane's stacked
+        programs (§21) and the gang-resident stacks (§26) — so 'auto'
+        resolves to 'blocked' everywhere: triangular accuracy (error
+        growth ~ max cond of a bs-wide diagonal block) at GEMM speed.
+        'trsm' and 'inv' stay explicit opt-ins; 'inv' trades the
+        larger cond(L) cond(U) growth term for the two-GEMV solve
+        shape. The serve tests hold every engine to the one-shot
+        oracle's residual bars, and the plan's `refine` sweeps restore
+        working accuracy when the traffic is harder.
         """
         if persistent_cache:
             from conflux_tpu import cache
@@ -260,10 +273,20 @@ class FactorPlan:
                      else precision)
         backend = blas.get_backend() if backend is None else backend
         if substitution == "auto":
-            substitution = "inv" if len(shape) == 3 else "trsm"
-        if substitution not in ("trsm", "inv"):
+            # branch on how the plan will be SERVED, not on its shape
+            # alone: batched plans vmap their solve body over the batch
+            # axis, and single-system plans are served vmapped too —
+            # the factor lane's stacked programs and the gang-resident
+            # stacks (§21/§26) — so every auto plan takes the blocked
+            # engine (the vmapped-safe fast path). Callers wanting the
+            # classic serial substitutions or the full-inverse GEMV
+            # form opt in explicitly.
+            served_vmapped = len(shape) == 3 or mesh is None
+            substitution = "blocked" if served_vmapped else "trsm"
+        if substitution not in ("trsm", "inv", "blocked"):
             raise ValueError(
-                f"unknown substitution {substitution!r} (auto|trsm|inv)")
+                f"unknown substitution {substitution!r} "
+                "(auto|trsm|inv|blocked)")
         key = PlanKey(
             shape=tuple(int(s) for s in shape), dtype=dtype.name,
             factor_dtype=fdtype.name, v=int(v), refine=int(refine),
@@ -313,9 +336,14 @@ class FactorPlan:
         never put a compile stall on the serving path. `checked` asks
         about the health-guarded program variant (what an engine with
         ``check_output`` dispatches)."""
+        # checked programs of a fused-probe (blocked) plan live in
+        # their own memo dict — look there, or a controller knob move
+        # would see a warm bucket as forever-cold (or vice versa)
+        checked_cache = (self._trsm_cache if self._fused_probe
+                         else self._solve_cache)
         if width is not None:
             key = ("health", int(width)) if checked else int(width)
-            fn = self._solve_cache.get(key)
+            fn = (checked_cache if checked else self._solve_cache).get(key)
             if fn is None or not fn.warm:
                 return False
         if factor_batch is not None:
@@ -331,7 +359,7 @@ class FactorPlan:
             sb, wb = int(stack[0]), int(stack[1])
             key = (("gstack_health", sb, wb) if checked
                    else ("stacked", sb, wb))
-            fn = self._solve_cache.get(key)
+            fn = (checked_cache if checked else self._solve_cache).get(key)
             if fn is None or not fn.warm:
                 return False
         return (width is not None or factor_batch is not None
@@ -369,6 +397,16 @@ class FactorPlan:
                          and k[2] == wb]
                 for key in keys:
                     dropped += self._solve_cache.pop(key, None) is not None
+                # the blocked engine's fused-probe checked programs
+                # retire with their width bucket too — a retired bucket
+                # must not pin the family's jitted closures, and a
+                # regrow must re-trace (never find a stale wrapper)
+                tkeys = [("health", wb)]
+                tkeys += [k for k in self._trsm_cache
+                          if len(k) == 3 and k[0] == "gstack_health"
+                          and k[2] == wb]
+                for key in tkeys:
+                    dropped += self._trsm_cache.pop(key, None) is not None
             for bb in factor_batches:
                 bb = int(bb)
                 if bb == 1:
@@ -428,25 +466,37 @@ class FactorPlan:
     def _one_factor(self, A):
         """Per-system factorization in the factor dtype. Returns the
         device-resident factor pytree the solve program consumes: packed
-        factors for 'trsm' substitution, explicit triangular inverses
-        (computed here, once, in the compute dtype) for 'inv'."""
+        factors for 'trsm' substitution, packed factors + diagonal-block
+        inverses for 'blocked' (the bs-wide blocks only — O(N bs^2)
+        inversion work, `ops.batched_trsm.diag_block_inverses`), and
+        explicit FULL triangular inverses (computed here, once, in the
+        compute dtype) for 'inv'."""
         from conflux_tpu.cholesky.single import _cholesky_blocked
         from conflux_tpu.lu.single import _lu_factor_blocked
+        from conflux_tpu.ops.batched_trsm import diag_block_inverses
 
         self.trace_counts["factor"] += 1  # trace-time, not per call
         k = self.key
         Af = A.astype(jnp.dtype(k.factor_dtype))
+        cdtype = blas.compute_dtype(jnp.dtype(k.factor_dtype))
         if k.spd:
             L = _cholesky_blocked(Af, k.v, k.precision, k.backend)
+            if k.substitution == "blocked":
+                Dl = diag_block_inverses(L.astype(cdtype), lower=True)
+                return (L, Dl)
             if k.substitution != "inv":
                 return (L,)
-            cdtype = blas.compute_dtype(jnp.dtype(k.factor_dtype))
             eye = jnp.eye(self.N, dtype=cdtype)
             Li = lax.linalg.triangular_solve(
                 L.astype(cdtype), eye, left_side=True, lower=True)
             return (Li,)
         LU, perm = _lu_factor_blocked(Af, k.v, k.precision, k.backend,
                                       k.panel_algo)
+        if k.substitution == "blocked":
+            LUc = LU.astype(cdtype)
+            Dl = diag_block_inverses(LUc, lower=True, unit_diagonal=True)
+            Du = diag_block_inverses(LUc, lower=False)
+            return (LU, Dl, Du, perm)
         if k.substitution != "inv":
             return (LU, perm)
         cdtype = blas.compute_dtype(jnp.dtype(k.factor_dtype))
@@ -467,6 +517,33 @@ class FactorPlan:
         from conflux_tpu.solvers import cholesky_solve, lu_solve
 
         k = self.key
+        if k.substitution == "blocked":
+            from conflux_tpu.ops.batched_trsm import blocked_solve
+
+            # the blocked engine (DESIGN §27): forward + back
+            # substitution through the factor-resident diagonal-block
+            # inverses — every step a GEMM, so the vmapped stacked
+            # programs never touch XLA's serial batched trsm
+            if k.spd:
+                L, Dl = factors
+
+                def corr(r):
+                    Lc = L.astype(Dl.dtype)
+                    y = blocked_solve(Lc, Dl, r.astype(Dl.dtype),
+                                      lower=True)
+                    Du = jnp.swapaxes(Dl.conj(), -1, -2)
+                    return blocked_solve(Lc.conj().T, Du, y,
+                                         lower=False)
+            else:
+                LU, Dl, Du, perm = factors
+
+                def corr(r):
+                    LUc = LU.astype(Dl.dtype)
+                    y = blocked_solve(LUc, Dl,
+                                      r.astype(Dl.dtype)[perm],
+                                      lower=True)
+                    return blocked_solve(LUc, Du, y, lower=False)
+            return corr
         if k.substitution == "inv":
             hi = lax.Precision.HIGHEST
             if k.spd:
@@ -512,8 +589,12 @@ class FactorPlan:
         # (Li, Ui, perm) inv — every leaf batch-axis-first, batch-sharded
         k = self.key
         spec3, spec2 = _batch_spec(self.mesh, 3), _batch_spec(self.mesh, 2)
+        spec4 = _batch_spec(self.mesh, 4)  # (B, nb, bs, bs) dinv stacks
         if k.spd:
-            out_shardings = (spec3,)
+            out_shardings = ((spec3, spec4)
+                             if k.substitution == "blocked" else (spec3,))
+        elif k.substitution == "blocked":
+            out_shardings = (spec3, spec4, spec4, spec2)
         elif k.substitution == "inv":
             out_shardings = (spec3, spec3, spec2)
         else:
@@ -583,6 +664,23 @@ class FactorPlan:
         (the body never consumes it); wA is the stacked probe rows the
         gang keeps resident."""
         self._check_stack_bucket("_stacked_solve_health_fn", ns, nrhs)
+        if self._fused_probe:
+            from conflux_tpu.update import health_verdict_from_stats_slots
+
+            def build_fused():
+                w = self.probe_w
+                body = jax.vmap(self._blocked_probe_body)
+
+                def f(factors, A0, wA, b2):
+                    self._bump("health")  # trace-time, not per call
+                    x, xsum, wAx = body(factors, wA, b2)
+                    return x, health_verdict_from_stats_slots(
+                        w, xsum, wAx, b2)
+
+                return jax.jit(f)
+
+            return self._memo(self._trsm_cache,
+                              ("gstack_health", ns, nrhs), build_fused)
 
         def build():
             w = self.probe_w
@@ -815,6 +913,49 @@ class FactorPlan:
         return jax.jit(f, out_shardings=(_batch_spec(self.mesh, 3),
                                          None))
 
+    @property
+    def _fused_probe(self) -> bool:
+        """True when this plan's checked programs fuse the Freivalds
+        probe epilogue into the blocked back-substitution's final block
+        steps (`ops.batched_trsm.blocked_solve_probe`, DESIGN §27) —
+        blocked plans without IR sweeps (`refine` re-reads x per sweep,
+        so only the refine-free shape has a 'final' block step to fuse
+        into). Fused programs live in `_trsm_cache`; everything about
+        the bucket lifecycle (`bucket_ready`, `release_buckets`,
+        `_warm_devices`) treats the two families uniformly."""
+        return self.key.substitution == "blocked" and not self.key.refine
+
+    def _blocked_probe_body(self, factors, wA, b2):
+        """Per-system blocked solve with the probe epilogue fused into
+        the final (back-substitution) block loop: returns (x, xsum,
+        wAx) where the finite accumulator and the probe projection
+        accumulate as each x block is produced — no separate verdict
+        pass over x (`update.health_verdict_from_stats` assembles the
+        (2,) verdict from these plus two O(N) b-side dots). Traceable;
+        vmapped for batched plans and the gang's stacked programs."""
+        from conflux_tpu.ops.batched_trsm import (
+            blocked_solve,
+            blocked_solve_probe,
+        )
+
+        k = self.key
+        cdtype = blas.compute_dtype(jnp.dtype(k.dtype))
+        if k.spd:
+            L, Dl = factors
+            Lc = L.astype(Dl.dtype)
+            y = blocked_solve(Lc, Dl, b2.astype(Dl.dtype), lower=True)
+            Du = jnp.swapaxes(Dl.conj(), -1, -2)
+            x, xsum, wAx = blocked_solve_probe(
+                Lc.conj().T, Du, y, wA, lower=False, stats_dtype=cdtype)
+        else:
+            LU, Dl, Du, perm = factors
+            LUc = LU.astype(Dl.dtype)
+            y = blocked_solve(LUc, Dl, b2.astype(Dl.dtype)[perm],
+                              lower=True)
+            x, xsum, wAx = blocked_solve_probe(
+                LUc, Du, y, wA, lower=False, stats_dtype=cdtype)
+        return x.astype(cdtype), xsum, wAx
+
     def _solve_health_fn(self, nrhs: int):
         """The checked substitution program per RHS bucket — what
         `SolveSession.solve_checked` (and the engine with output guards
@@ -826,6 +967,22 @@ class FactorPlan:
             raise AssertionError(
                 f"_solve_health_fn takes power-of-two RHS buckets, got "
                 f"{nrhs} — route request widths through solve_checked")
+        if self._fused_probe:
+            from conflux_tpu.update import health_verdict_from_stats
+
+            def build():
+                w = self.probe_w
+                body = (jax.vmap(self._blocked_probe_body)
+                        if self.batched else self._blocked_probe_body)
+
+                def f(factors, A0, wA, b2):
+                    self._bump("health")  # trace-time, not per call
+                    x, xsum, wAx = body(factors, wA, b2)
+                    return x, health_verdict_from_stats(w, xsum, wAx, b2)
+
+                return self._jit_checked(f)
+
+            return self._memo(self._trsm_cache, ("health", nrhs), build)
         return self._memo(
             self._solve_cache, ("health", nrhs),
             lambda: self._jit_checked(self._checked(self._one_solve)))

@@ -259,6 +259,46 @@ def health_spot_check_slots(w, wA, x, b, Up=None, Vp=None):
                       (num / den).astype(jnp.float32)])
 
 
+def health_verdict_from_stats(w, xsum, wAx, b):
+    """Assemble the :func:`health_spot_check` verdict from IN-LOOP
+    accumulators instead of a pass over x — the blocked substitution
+    engine's fused probe epilogue (DESIGN §27): `xsum` is sum(x) (the
+    finite accumulator) and `wAx` is wA . x[:, 0], both accumulated per
+    block inside `ops.batched_trsm.blocked_solve_probe`'s final solve,
+    so the verdict here costs only the two O(N) b-side dots. Leading
+    batch axes of xsum/wAx/b (batched plans) max-reduce like the
+    unfused check. Returns the same (2,) float32
+    [finite_flag, residual] verdict; traceable, call OUTSIDE vmap."""
+    cdtype = wAx.dtype
+    finite = jnp.isfinite(jnp.sum(xsum))
+    b0 = b[..., 0].astype(cdtype)
+    wc = w.astype(cdtype)
+    num = jnp.abs(jnp.sum(wc * b0, axis=-1) - wAx)
+    den = (jnp.sqrt(jnp.sum(jnp.abs(b0) ** 2, axis=-1))
+           + jnp.finfo(cdtype).tiny)
+    return jnp.stack([finite.astype(jnp.float32),
+                      jnp.max(num / den).astype(jnp.float32)])
+
+
+def health_verdict_from_stats_slots(w, xsum, wAx, b):
+    """Per-slot fused verdict from in-loop accumulators — the stacked
+    (gang) analog of :func:`health_verdict_from_stats`, mirroring
+    :func:`health_spot_check_slots`'s (2, S) contract: xsum/wAx are
+    (S,) per-slot accumulators out of the vmapped blocked probe solve,
+    b is (S, N, w). Slot i's verdict still depends only on slot i's
+    accumulators and RHS (blast-radius isolation); idle pad slots
+    (zero RHS) evaluate finite with residual 0. Traceable."""
+    cdtype = wAx.dtype
+    finite = jnp.isfinite(xsum)
+    b0 = b[..., 0].astype(cdtype)
+    wc = w.astype(cdtype)
+    num = jnp.abs(jnp.sum(wc * b0, axis=-1) - wAx)
+    den = (jnp.sqrt(jnp.sum(jnp.abs(b0) ** 2, axis=-1))
+           + jnp.finfo(cdtype).tiny)
+    return jnp.stack([finite.astype(jnp.float32),
+                      (num / den).astype(jnp.float32)])
+
+
 def pad_update_state(Up, Vp, Y, Cinv, kb: int):
     """Zero-pad one session's Woodbury state from its own rank bucket
     k0 = Up.shape[-1] up to the gang bucket `kb` — what lets sessions

@@ -32,6 +32,7 @@ _RATIO_KEYS = (
     "speedup_vs_always_refactor", "speedup_vs_seq_async",
     "ratio_solves_vs_single_lane", "overhead_pct",
     "single_speedup_vs_refactor", "speedup_vs_naive",
+    "speedup_vs_xla_trsm",
     "transitions_won",
 )
 _GATE_KEYS = (

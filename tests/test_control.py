@@ -384,12 +384,16 @@ def test_release_buckets_grow_then_shrink_leaves_no_stale_programs():
     assert set(plan._solve_cache) == {1, 2}
     assert plan.release_buckets(widths=(4, 8)) == 0  # idempotent
     # checked programs and the probe: only the released bucket's
-    # programs go; the probe program is not a bucket and survives
+    # programs go; the probe program is not a bucket and survives.
+    # Blocked (default) plans keep their fused-probe checked programs
+    # in the dedicated _trsm_cache (DESIGN §27) — released with the
+    # width bucket all the same
     s.solve_checked(jnp.asarray(np.ones(N, np.float32)))
-    assert ("health", 1) in plan._solve_cache
+    assert ("health", 1) in plan._trsm_cache
+    assert ("health", 1) not in plan._solve_cache
     assert ("probe",) in plan._solve_cache
     plan.release_buckets(widths=(1,))
-    assert ("health", 1) not in plan._solve_cache
+    assert ("health", 1) not in plan._trsm_cache
     assert 1 not in plan._solve_cache
     assert ("probe",) in plan._solve_cache
     # factor lane: stacked buckets release; bucket 1 is plan.factor's
