@@ -113,6 +113,15 @@ def __getattr__(name):
         "SessionGang": ("conflux_tpu.gang", "SessionGang"),
         "write_slot_tree": ("conflux_tpu.batched", "write_slot_tree"),
         "grow_stack_tree": ("conflux_tpu.batched", "grow_stack_tree"),
+        # multi-host serve fabric (ISSUE 13)
+        "ServeFabric": ("conflux_tpu.fabric", "ServeFabric"),
+        "FabricPolicy": ("conflux_tpu.fabric", "FabricPolicy"),
+        "LocalHost": ("conflux_tpu.fabric", "LocalHost"),
+        "ProcessHost": ("conflux_tpu.fabric", "ProcessHost"),
+        "HostUnavailable": ("conflux_tpu.resilience", "HostUnavailable"),
+        "FleetDegraded": ("conflux_tpu.resilience", "FleetDegraded"),
+        "HostLoadEstimator": ("conflux_tpu.control", "HostLoadEstimator"),
+        "CounterWindow": ("conflux_tpu.profiler", "CounterWindow"),
     }
     if name in _lazy:
         import importlib
@@ -194,4 +203,12 @@ __all__ = [
     "SessionGang",
     "write_slot_tree",
     "grow_stack_tree",
+    "ServeFabric",
+    "FabricPolicy",
+    "LocalHost",
+    "ProcessHost",
+    "HostUnavailable",
+    "FleetDegraded",
+    "HostLoadEstimator",
+    "CounterWindow",
 ]

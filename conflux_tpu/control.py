@@ -859,3 +859,84 @@ class AdaptiveController:
             "tier": {},
             "tier_gauges": {},
         }
+
+
+class HostLoadEstimator:
+    """Per-host drain-rate EMAs for the serve fabric.
+
+    The fabric's heartbeat thread feeds each host's counter deltas
+    (``profiler.CounterWindow`` output over the ping payload) into
+    :meth:`feed`; this keeps one smoothed solves/s estimate and one
+    pending-depth gauge per host.  Two consumers:
+
+    * :meth:`retry_after` — a measured-drain-rate retry hint for
+      ``HostUnavailable``/``FleetDegraded`` (same policy as
+      ``EngineSaturated.retry_after``: backlog over the smoothed
+      drain rate, clamped to ``[floor, ceil]``).
+    * :meth:`least_loaded` — migration/fail-over target pick among
+      candidate hosts: lowest pending depth, ties broken by highest
+      drain rate, then host id (deterministic).
+    """
+
+    def __init__(self, ema: float = 0.3, floor: float = 0.05,
+                 ceil: float = 5.0):
+        self.ema = float(ema)
+        self.floor = float(floor)
+        self.ceil = float(ceil)
+        self._lock = threading.Lock()
+        self._rate: dict[str, float] = {}     # guarded-by: _lock
+        self._pending: dict[str, int] = {}    # guarded-by: _lock
+
+    def feed(self, host: str, delta: dict) -> None:
+        """Fold one heartbeat counter-delta window for ``host``.
+
+        ``delta`` is a ``CounterWindow.feed`` result over the host's
+        engine counters: ``solves`` (window increment) and ``seconds``
+        give the instantaneous rate; ``pending`` gives the depth (a
+        gauge — the fabric re-injects the RAW heartbeat value after
+        the window differences the payload).
+        """
+        secs = max(1e-9, float(delta.get("seconds", 0.0) or 0.0))
+        rate = float(delta.get("solves", 0) or 0) / secs
+        pending = int(delta.get("pending", 0) or 0)
+        with self._lock:
+            prev = self._rate.get(host)
+            if prev is None:
+                self._rate[host] = rate
+            else:
+                self._rate[host] = self.ema * rate + (1 - self.ema) * prev
+            self._pending[host] = pending
+
+    def forget(self, host: str) -> None:
+        """Drop a dead host's state so it doesn't skew future picks."""
+        with self._lock:
+            self._rate.pop(host, None)
+            self._pending.pop(host, None)
+
+    def retry_after(self, backlog: int = 1,
+                    hosts: "list[str] | None" = None) -> float:
+        """Seconds until ~``backlog`` items drain at the measured
+        aggregate rate of ``hosts`` (all known hosts when None)."""
+        with self._lock:
+            rates = [r for h, r in self._rate.items()
+                     if hosts is None or h in hosts]
+        total = sum(rates)
+        if total <= 0.0:
+            return self.ceil
+        return min(self.ceil, max(self.floor, backlog / total))
+
+    def least_loaded(self, hosts: "list[str]") -> str:
+        """The best adoption target among ``hosts``: fewest pending
+        solves, then fastest drain, then lexicographic host id."""
+        if not hosts:
+            raise ValueError("least_loaded() needs at least one host")
+        with self._lock:
+            return min(hosts, key=lambda h: (self._pending.get(h, 0),
+                                             -self._rate.get(h, 0.0), h))
+
+    def stats(self) -> dict:
+        """Per-host smoothed rates and pending depths (telemetry)."""
+        with self._lock:
+            return {h: {"drain_per_s": self._rate[h],
+                        "pending": self._pending.get(h, 0)}
+                    for h in sorted(self._rate)}

@@ -141,13 +141,46 @@ def _devkey(device):
     return None if device is None else (device.platform, device.id)
 
 
+def rendezvous(sid, nodes, key=None):
+    """Rendezvous (highest-random-weight) hashing: pick the node whose
+    (sid, node-identity) hash is highest. `key(node)` supplies the
+    stable identity each node is weighed by (default: the node itself);
+    identities must be distinct and survive restarts for placement to.
+
+    The property mod-N hashing lacks, and the reason the serve fabric
+    (DESIGN §28) and the lane placer both use this: when the node SET
+    changes, only the sids whose winning node vanished move — every
+    other sid's per-node weights are untouched, so its argmax is
+    untouched. Removing one of N nodes remaps ~1/N of the sids (the
+    dead node's own) instead of the ~(N-1)/N a `hash % N` reshuffle
+    moves; adding a node steals only the sids it now wins. Ties (a
+    ~2^-32 CRC collision) break toward the lexically-largest identity
+    so the choice stays a pure function of (sid, node set)."""
+    sb = str(sid).encode()
+    best = best_ident = None
+    best_w = -1
+    for n in nodes:
+        ident = str(n if key is None else key(n))
+        w = zlib.crc32(sb + b"@" + ident.encode())
+        if w > best_w or (w == best_w and (best_ident is None
+                                           or ident > best_ident)):
+            best, best_ident, best_w = n, ident, w
+    return best
+
+
 def place_session(sid, devices):
     """Deterministic consistent placement: map a stable session id onto
-    one of `devices` by CRC32 hash. Equal sids land on equal devices for
-    any fixed device list — across engines, and across process restarts
-    (the warm-restart path re-pins a restored fleet identically). The
-    mesh-sharded serve fleet's placement function (DESIGN §25)."""
-    return devices[zlib.crc32(str(sid).encode()) % len(devices)]
+    one of `devices` by rendezvous hashing over the device identities.
+    Equal sids land on equal devices for any fixed device list — across
+    engines, and across process restarts (the warm-restart path re-pins
+    a restored fleet identically) — and a device-list CHANGE remaps
+    only the sids whose device vanished (see :func:`rendezvous`; the
+    pre-§28 CRC32 mod-N placer reshuffled ~(N-1)/N of the fleet when a
+    lane died). The mesh-sharded serve fleet's placement function
+    (DESIGN §25)."""
+    if len(devices) == 1:
+        return devices[0]
+    return rendezvous(sid, devices, key=_devkey)
 
 
 class EngineSaturated(RuntimeError):

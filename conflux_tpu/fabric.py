@@ -1,0 +1,1540 @@
+"""Multi-host serve fabric — federated engines behind one front (DESIGN §28).
+
+One :class:`ServeFabric` federates N engine *hosts* — each a full
+serve stack (ServeEngine + its session registry) living in its own
+process — behind a single routing front:
+
+- **Routing** rides the same rendezvous hash as device placement
+  (`engine.rendezvous`): a session id maps to the live host whose
+  (sid, host-id) weight is highest, so a host-set change remaps ONLY
+  the dead host's sessions (~1/N of the fleet) instead of reshuffling
+  everyone. The owners map is authoritative AFTER placement — fail-over
+  and migration move entries explicitly; healthy sessions never move
+  just because the live set changed.
+- **Detection** is a heartbeat/lease loop with hysteresis: every
+  `heartbeat_interval` the front pings each host; a miss moves
+  alive → suspect (`suspect_after` misses) → dead (`dead_after`), and a
+  torn transport (EOF/conn-reset — the process is demonstrably gone)
+  jumps straight to dead. Per-host :class:`~conflux_tpu.resilience.
+  CircuitBreaker`s shed request traffic from flapping hosts between
+  heartbeats.
+- **Fail-over** revives a dead host's fleet on the survivors from its
+  last background checkpoint (`tier.load_fleet` with the `names=`
+  subset filter — each survivor adopts exactly the records the
+  rendezvous hash assigns it). Revived sessions solve BITWISE
+  identically (the checkpoint contract); staleness is bounded by one
+  `checkpoint_interval` of drift updates. Sessions that were never
+  checkpointed are reported lost — their requests fail with a
+  structured :class:`~conflux_tpu.resilience.HostUnavailable`, never
+  hang.
+- **Migration** hands a live session between hosts at a drain barrier:
+  the source checkpoints exactly that session (`engine.checkpoint`
+  waits out in-flight work), the target adopts the record, ownership
+  flips, the source drops its copy. A crash before the target adopts
+  leaves the session intact on the source.
+
+Request traffic raises structured errors, never hangs:
+:class:`~conflux_tpu.resilience.HostUnavailable` (dead/flapping owner,
+`retry_after` riding the fleet's measured drain rate —
+:class:`~conflux_tpu.control.HostLoadEstimator`) and
+:class:`~conflux_tpu.resilience.FleetDegraded` (admission refused
+below `min_live` live hosts).
+
+Two host flavors share one op core (:class:`_HostCore`):
+:class:`LocalHost` runs the engine in-process (deterministic tests,
+lockcheck soaks, fault drills) and :class:`ProcessHost` spawns
+``python -m conflux_tpu.fabric --worker`` wired over an authenticated
+``multiprocessing.connection`` AF_UNIX pipe (the real fabric; see
+scripts/fabric_drill.py and ``bench_engine.py --fabric``). Checkpoint
+records live on a filesystem shared by front and hosts (same box or
+shared mount) — the front reads a dead host's snapshot directly and
+points survivors at it.
+
+Fault injection (`resilience.FaultPlan`) covers the fabric control
+plane: 'heartbeat' (delay/crash — a slow or failed probe, the
+hysteresis driver), 'route' (crash/delay on the front's per-request
+host call), 'migrate' (crash/delay at the hand-off barrier) and
+'host_kill' (kill — a whole engine host dies). `scripts/soak.py
+--fabric` drives randomized kill/revive/migrate chaos against per-
+session float64 oracles.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+import secrets
+import shutil
+import subprocess
+import sys
+import threading
+import time
+import weakref
+import zlib
+from concurrent.futures import Future, ThreadPoolExecutor
+from multiprocessing.connection import Client, Listener
+from typing import Any
+
+import numpy as np
+
+from conflux_tpu import resilience, tier
+from conflux_tpu.control import HostLoadEstimator
+from conflux_tpu.profiler import CounterWindow
+from conflux_tpu.resilience import (
+    CircuitBreaker,
+    DeadlineExceeded,
+    FleetDegraded,
+    HostUnavailable,
+    InjectedFault,
+    InjectedKill,
+    MeshPlanUnsupported,
+    RestoreCorrupt,
+    RhsNonFinite,
+    SessionQuarantined,
+    SessionSpilled,
+    SolveUnhealthy,
+    bump,
+    maybe_fault,
+)
+
+__all__ = [
+    "FabricPolicy", "HostHandle", "LocalHost", "ProcessHost",
+    "ServeFabric", "fabric_stats", "latest_checkpoint", "record_name",
+    "local_fabric", "process_fabric", "worker_main",
+]
+
+# errors raised by the wire/transport layer (NOT by the remote op):
+# the front maps these to HostUnavailable + breaker bookkeeping.
+# TimeoutError and ConnectionError both subclass OSError.
+_TRANSPORT_ERRORS = (OSError, EOFError)
+
+_LATEST = "LATEST"
+
+
+# --------------------------------------------------------------------------- #
+# checkpoint record naming + generation bookkeeping
+# --------------------------------------------------------------------------- #
+
+
+def record_name(sid: Any) -> str:
+    """Deterministic, filesystem-safe record name for a session id.
+
+    Successive checkpoints of the same fleet reuse names, so a
+    snapshot directory's population tracks the live registry; the
+    CRC suffix keeps two sids that sanitize identically apart."""
+    s = str(sid)
+    safe = "".join(c if c.isalnum() or c in "-_." else "_" for c in s)
+    return f"{safe[:48]}-{zlib.crc32(s.encode()):08x}"
+
+
+def _write_latest(ckpt_dir: str, dest: str) -> None:
+    """Atomically point ckpt_dir/LATEST at `dest` (a fleet snapshot
+    subdir). Write-tmp-then-replace: a crash mid-checkpoint leaves
+    LATEST on the previous complete snapshot."""
+    tmp = os.path.join(ckpt_dir, _LATEST + ".tmp")
+    with open(tmp, "w") as f:
+        f.write(os.path.basename(dest))
+    os.replace(tmp, os.path.join(ckpt_dir, _LATEST))
+
+
+def latest_checkpoint(ckpt_dir: str) -> str | None:
+    """The host's newest COMPLETE fleet snapshot dir, or None if it
+    never finished one (LATEST is written only after save_fleet
+    returns, so the pointer never names a half-written snapshot)."""
+    p = os.path.join(ckpt_dir, _LATEST)
+    try:
+        with open(p) as f:
+            name = f.read().strip()
+    except OSError:
+        return None
+    dest = os.path.join(ckpt_dir, name)
+    return dest if os.path.isdir(dest) else None
+
+
+def checkpoint_sids(snapshot: str) -> dict[Any, str]:
+    """{sid: record name} for a fleet snapshot — the fail-over front's
+    view of WHICH sessions a dead host's checkpoint can revive."""
+    with open(os.path.join(snapshot, "fleet.json")) as f:
+        fleet = json.load(f)
+    return {e["sid"]: e["name"] for e in fleet["sessions"]
+            if e.get("sid") is not None}
+
+
+# --------------------------------------------------------------------------- #
+# policy
+# --------------------------------------------------------------------------- #
+
+
+@dataclasses.dataclass
+class FabricPolicy:
+    """Fabric-front knobs (TUNING.md "Multi-host fabric").
+
+    heartbeat_interval: seconds between heartbeat rounds.
+    heartbeat_timeout: per-ping reply budget; an overrun is a miss.
+    suspect_after / dead_after: consecutive-miss thresholds of the
+        alive → suspect → dead hysteresis (dead_after > suspect_after;
+        worst-case detection ≈ dead_after * (interval + timeout)).
+        A torn transport skips the ladder — the process is gone.
+    call_timeout: default reply budget for request-path host calls.
+    checkpoint_interval: background fleet-checkpoint period per host;
+        0 disables (fail-over then recovers only durable opens and
+        explicit checkpoints). Bounds fail-over staleness to one
+        interval of drift updates.
+    checkpoint_keep: completed snapshot generations kept per host.
+    durable_open: checkpoint the owning host synchronously after every
+        `open` — every admitted session is recoverable from birth (the
+        soak's session-count conservation oracle). Costs one fleet
+        snapshot per open; high-churn deployments turn it off and
+        lean on the background interval.
+    min_live: below this many live hosts, `open` refuses with
+        :class:`FleetDegraded` (solves on live owners still run).
+    breaker_threshold / breaker_cooldown: per-host CircuitBreaker —
+        transport failures on the REQUEST path trip it; a tripped
+        host sheds with HostUnavailable until its cooldown probe.
+    retry_floor / retry_ceil: clamp on retry_after hints
+        (:class:`~conflux_tpu.control.HostLoadEstimator`).
+    """
+
+    heartbeat_interval: float = 0.25
+    heartbeat_timeout: float = 2.0
+    suspect_after: int = 2
+    dead_after: int = 4
+    call_timeout: float = 120.0
+    checkpoint_interval: float = 0.0
+    checkpoint_keep: int = 2
+    durable_open: bool = True
+    min_live: int = 1
+    breaker_threshold: int = 3
+    breaker_cooldown: float = 5.0
+    retry_floor: float = 0.05
+    retry_ceil: float = 5.0
+
+    def __post_init__(self):
+        if self.heartbeat_interval <= 0 or self.heartbeat_timeout <= 0:
+            raise ValueError("heartbeat_interval and heartbeat_timeout "
+                             "must be > 0")
+        if not (1 <= self.suspect_after < self.dead_after):
+            raise ValueError("need 1 <= suspect_after < dead_after "
+                             f"(got {self.suspect_after}, "
+                             f"{self.dead_after})")
+        if self.min_live < 1:
+            raise ValueError("min_live must be >= 1")
+        if self.checkpoint_interval < 0 or self.checkpoint_keep < 1:
+            raise ValueError("checkpoint_interval must be >= 0 and "
+                             "checkpoint_keep >= 1")
+
+
+# --------------------------------------------------------------------------- #
+# the host op core — shared by LocalHost and the worker process
+# --------------------------------------------------------------------------- #
+
+
+class _HostCore:
+    """One engine host's op surface: a ServeEngine plus the sid →
+    session registry, with the checkpoint/adopt/migrate rails the
+    fabric's robustness story rides. `LocalHost` calls it in-process;
+    `worker_main` wraps it behind the wire loop."""
+
+    def __init__(self, host_id: str, ckpt_dir: str, engine) -> None:
+        self.host_id = str(host_id)
+        self.ckpt_dir = ckpt_dir
+        os.makedirs(ckpt_dir, exist_ok=True)
+        self.eng = engine
+        self.ckpt_keep = 2
+        self._lock = threading.Lock()
+        self._registry: dict = {}  # guarded-by: _lock — sid -> session
+        self._ckpt_seq = 0         # guarded-by: _lock
+
+    # -- telemetry ----------------------------------------------------- #
+
+    def ping(self) -> dict:
+        """Heartbeat payload: a cheap counter snapshot (the front's
+        CounterWindow diffs it into rates) + the session census."""
+        c = self.eng.counters()
+        with self._lock:
+            n = len(self._registry)
+        return {"host_id": self.host_id, "sessions": n,
+                "counters": {"pending": c["pending"],
+                             "solves": c["completed"],
+                             "requests": c["requests"],
+                             "failed": c["failed"],
+                             "shed": c["shed"]}}
+
+    def stats(self) -> dict:
+        with self._lock:
+            sids = sorted(str(s) for s in self._registry)
+            seq = self._ckpt_seq
+        return {"host_id": self.host_id, "sids": sids,
+                "checkpoints": seq, "engine": self.eng.counters()}
+
+    # -- session lifecycle --------------------------------------------- #
+
+    def open(self, sid: Any, spec: dict, A: np.ndarray,
+             policy: dict | None = None) -> Any:
+        """Factor A under the EXACT plan `spec` describes and register
+        the session. The plan rebuilds from its wire spec
+        (`serve.plan_from_spec`) so every host compiles the same
+        program family — the bitwise hand-off contract."""
+        from conflux_tpu.serve import plan_from_spec
+        from conflux_tpu.update import DriftPolicy
+
+        with self._lock:
+            if sid in self._registry:
+                raise ValueError(f"sid {sid!r} already open on host "
+                                 f"{self.host_id}")
+        plan = plan_from_spec(spec)
+        pol = DriftPolicy(**policy) if policy is not None else None
+        s = self.eng.factor(plan, A, sid=sid, policy=pol)
+        with self._lock:
+            self._registry[sid] = s
+        return sid
+
+    def _session(self, sid: Any):
+        with self._lock:
+            s = self._registry.get(sid)
+        if s is None:
+            raise KeyError(f"host {self.host_id} has no session "
+                           f"{sid!r}")
+        return s
+
+    def solve_async(self, sid: Any, b: np.ndarray) -> Future:
+        return self.eng.submit(self._session(sid), b)
+
+    def update(self, sid: Any, U: np.ndarray, V: np.ndarray,
+               replace: bool = False) -> bool:
+        self._session(sid).update(U, V, replace=replace)
+        return True
+
+    def drop(self, sid: Any) -> bool:
+        """Forget a session (the migration source's final step)."""
+        with self._lock:
+            return self._registry.pop(sid, None) is not None
+
+    # -- checkpoint / adopt / migrate rails ---------------------------- #
+
+    def checkpoint(self) -> str:
+        """Snapshot the whole registry at the engine's drain barrier
+        into a fresh generation dir, flip LATEST, prune old
+        generations. Returns the snapshot dir."""
+        with self._lock:
+            items = sorted(self._registry.items(), key=lambda kv: str(kv[0]))
+            seq = self._ckpt_seq
+            self._ckpt_seq += 1
+        dest = os.path.join(self.ckpt_dir, f"fleet-{seq:06d}")
+        self.eng.checkpoint(dest, sessions=[s for _, s in items],
+                            names=[record_name(sid) for sid, _ in items])
+        _write_latest(self.ckpt_dir, dest)
+        self._prune()
+        return dest
+
+    def _prune(self) -> None:
+        keep = self.ckpt_keep
+        gens = sorted(d for d in os.listdir(self.ckpt_dir)
+                      if d.startswith("fleet-"))
+        for d in gens[:-keep]:
+            shutil.rmtree(os.path.join(self.ckpt_dir, d),
+                          ignore_errors=True)
+
+    def adopt(self, src: str, names: list[str]) -> list:
+        """Restore a `names` subset of another host's snapshot into
+        this host's registry (fail-over / migration target half).
+        Returns the adopted sids."""
+        sessions = tier.load_fleet(src, names=names)
+        with self._lock:
+            for s in sessions:
+                self._registry[s.sid] = s
+        return [s.sid for s in sessions]
+
+    def migrate_out(self, sid: Any, dest: str) -> str:
+        """Checkpoint exactly `sid` to `dest` at the engine's drain
+        barrier (in-flight solves finish first; nothing else moves).
+        The session STAYS registered — the front drops it only after
+        the target adopts, so a crash mid-hand-off loses nothing."""
+        s = self._session(sid)
+        name = record_name(sid)
+        self.eng.checkpoint(dest, sessions=[s], names=[name])
+        return name
+
+    def wipe(self) -> None:
+        """Drop the whole registry (LocalHost.kill: a dead process's
+        un-checkpointed state is simply gone)."""
+        with self._lock:
+            self._registry.clear()
+
+    def close(self) -> bool:
+        self.eng.close()
+        return True
+
+
+# --------------------------------------------------------------------------- #
+# host handles
+# --------------------------------------------------------------------------- #
+
+
+class HostHandle:
+    """The front's view of one engine host. Implementations raise
+    transport-shaped errors (ConnectionError/TimeoutError/EOFError)
+    when the host is unreachable — the front maps those to
+    HostUnavailable + breaker/heartbeat bookkeeping, while structured
+    per-request errors (EngineSaturated, SolveUnhealthy, ...) pass
+    through untouched."""
+
+    host_id: str
+    ckpt_dir: str
+
+    def start(self) -> None:
+        raise NotImplementedError
+
+    def ping(self, timeout: float | None = None) -> dict:
+        raise NotImplementedError
+
+    def open(self, sid, spec, A, policy=None,
+             timeout: float | None = None):
+        raise NotImplementedError
+
+    def solve(self, sid, b, timeout: float | None = None):
+        raise NotImplementedError
+
+    def update(self, sid, U, V, replace: bool = False,
+               timeout: float | None = None):
+        raise NotImplementedError
+
+    def checkpoint(self, timeout: float | None = None) -> str:
+        raise NotImplementedError
+
+    def adopt(self, src, names, timeout: float | None = None) -> list:
+        raise NotImplementedError
+
+    def migrate_out(self, sid, dest,
+                    timeout: float | None = None) -> str:
+        raise NotImplementedError
+
+    def drop(self, sid, timeout: float | None = None) -> bool:
+        raise NotImplementedError
+
+    def stats(self, timeout: float | None = None) -> dict:
+        raise NotImplementedError
+
+    def close(self) -> None:
+        raise NotImplementedError
+
+    def kill(self) -> None:
+        """Abrupt host death (tests/soak/bench drills)."""
+        raise NotImplementedError
+
+
+class LocalHost(HostHandle):
+    """In-process host: the engine runs on this process's threads.
+
+    Deterministic and cheap — the unit tests, the lockcheck soaks and
+    the fault drills run the whole fabric in one process. `kill()`
+    simulates abrupt process death: the registry is gone and every
+    subsequent call raises ConnectionError (transport-shaped), so the
+    front exercises the same detection/fail-over path as a real dead
+    worker."""
+
+    def __init__(self, host_id: str, ckpt_dir: str, *,
+                 engine=None, engine_kwargs: dict | None = None):
+        self.host_id = str(host_id)
+        self.ckpt_dir = ckpt_dir
+        self._engine = engine
+        self._engine_kwargs = dict(engine_kwargs or {})
+        self._core: _HostCore | None = None
+        self._killed = threading.Event()
+
+    def start(self) -> None:
+        if self._core is not None:
+            return
+        eng = self._engine
+        if eng is None:
+            from conflux_tpu.engine import ServeEngine
+
+            eng = ServeEngine(**self._engine_kwargs)
+        self._core = _HostCore(self.host_id, self.ckpt_dir, eng)
+
+    @property
+    def core(self) -> _HostCore:
+        if self._core is None:
+            raise RuntimeError(f"host {self.host_id} not started")
+        return self._core
+
+    def _alive_core(self) -> _HostCore:
+        if self._killed.is_set():
+            raise ConnectionError(f"host {self.host_id} is dead")
+        return self.core
+
+    def ping(self, timeout: float | None = None) -> dict:
+        return self._alive_core().ping()
+
+    def _engine_op(self, op, *args):
+        """Run one engine-backed core op, mapping EngineClosed during
+        a concurrent kill() to the transport shape (a real dead worker
+        would have torn the pipe mid-call)."""
+        from conflux_tpu.engine import EngineClosed
+
+        core = self._alive_core()
+        try:
+            return op(core, *args)
+        except EngineClosed as e:
+            if self._killed.is_set():  # killed mid-flight
+                raise ConnectionError(
+                    f"host {self.host_id} died mid-call") from e
+            raise
+
+    def open(self, sid, spec, A, policy=None,
+             timeout: float | None = None):
+        return self._engine_op(
+            lambda c: c.open(sid, spec, A, policy))
+
+    def solve(self, sid, b, timeout: float | None = None):
+        from conflux_tpu.engine import EngineClosed
+
+        fut = self._alive_core().solve_async(sid, b)
+        try:
+            return fut.result(timeout)
+        except EngineClosed as e:
+            if self._killed.is_set():  # killed mid-flight
+                raise ConnectionError(
+                    f"host {self.host_id} died mid-solve") from e
+            raise
+
+    def update(self, sid, U, V, replace: bool = False,
+               timeout: float | None = None):
+        return self._alive_core().update(sid, U, V, replace)
+
+    def checkpoint(self, timeout: float | None = None) -> str:
+        return self._engine_op(lambda c: c.checkpoint())
+
+    def adopt(self, src, names, timeout: float | None = None) -> list:
+        return self._alive_core().adopt(src, names)
+
+    def migrate_out(self, sid, dest,
+                    timeout: float | None = None) -> str:
+        return self._engine_op(lambda c: c.migrate_out(sid, dest))
+
+    def drop(self, sid, timeout: float | None = None) -> bool:
+        return self._alive_core().drop(sid)
+
+    def stats(self, timeout: float | None = None) -> dict:
+        return self._alive_core().stats()
+
+    def close(self) -> None:
+        if self._core is not None and not self._killed.is_set():
+            self._core.close()
+
+    def kill(self) -> None:
+        if self._killed.is_set():
+            return
+        self._killed.set()
+        # abrupt: un-checkpointed registry state is gone; the engine's
+        # close answers whatever already reached a lane, mirroring
+        # requests that raced a real process death
+        if self._core is not None:
+            self._core.eng.close(timeout=2.0)
+            self._core.wipe()
+
+
+# --------------------------------------------------------------------------- #
+# wire codec (ProcessHost <-> worker)
+# --------------------------------------------------------------------------- #
+
+
+def _encode_exc(e: BaseException) -> dict:
+    extra: dict = {}
+    for k in ("retry_after", "evidence", "live", "total", "host",
+              "surface"):
+        v = getattr(e, k, None)
+        if v is not None:
+            extra[k] = v
+    return {"ok": False, "etype": type(e).__name__,
+            "emsg": str(e), "extra": extra}
+
+
+_WIRE_TYPES: dict[str, Any] = {
+    "EngineSaturated": lambda m, x: _mk_engine_exc(
+        "EngineSaturated", m, x.get("retry_after", 0.0)),
+    "EngineClosed": lambda m, x: _mk_engine_exc("EngineClosed", m),
+    "SessionQuarantined": lambda m, x: SessionQuarantined(
+        m, retry_after=x.get("retry_after", 0.0)),
+    "SessionSpilled": lambda m, x: SessionSpilled(
+        m, retry_after=x.get("retry_after", 0.0)),
+    "SolveUnhealthy": lambda m, x: SolveUnhealthy(
+        m, x.get("evidence") or {}),
+    "RestoreCorrupt": lambda m, x: RestoreCorrupt(m, x.get("evidence")),
+    "RhsNonFinite": lambda m, x: RhsNonFinite(m),
+    "DeadlineExceeded": lambda m, x: DeadlineExceeded(m),
+    "MeshPlanUnsupported": lambda m, x: MeshPlanUnsupported(
+        m, x.get("surface", "")),
+    "HostUnavailable": lambda m, x: HostUnavailable(
+        m, retry_after=x.get("retry_after", 0.0), host=x.get("host")),
+    "FleetDegraded": lambda m, x: FleetDegraded(
+        m, retry_after=x.get("retry_after", 0.0),
+        live=x.get("live", 0), total=x.get("total", 0)),
+    "KeyError": lambda m, x: KeyError(m),
+    "ValueError": lambda m, x: ValueError(m),
+}
+
+
+def _mk_engine_exc(name: str, msg: str, retry_after: float | None = None):
+    from conflux_tpu import engine as _eng
+
+    cls = getattr(_eng, name)
+    if retry_after is None:
+        return cls(msg)
+    return cls(msg, retry_after=retry_after)
+
+
+def _raise_wire(reply: dict) -> None:
+    et = reply.get("etype", "RuntimeError")
+    em = reply.get("emsg", "")
+    build = _WIRE_TYPES.get(et)
+    if build is not None:
+        raise build(em, reply.get("extra") or {})
+    raise RuntimeError(f"remote {et}: {em}")
+
+
+class ProcessHost(HostHandle):
+    """An engine host in its own worker process.
+
+    `start()` opens an authenticated AF_UNIX listener under the host's
+    checkpoint dir, spawns ``python -m conflux_tpu.fabric --worker``
+    (authkey via the CONFLUX_FABRIC_KEY env var — never on the command
+    line) and accepts the worker's connection. Requests are
+    id-matched: a sender lock serializes writes, a receiver thread
+    resolves reply futures, and a torn pipe fails every pending future
+    with ConnectionError — an in-flight request on a dying host gets a
+    structured error, never a hang."""
+
+    def __init__(self, host_id: str, ckpt_dir: str, *,
+                 engine_kwargs: dict | None = None,
+                 start_timeout: float = 180.0,
+                 call_timeout: float = 120.0,
+                 env: dict | None = None):
+        self.host_id = str(host_id)
+        self.ckpt_dir = ckpt_dir
+        self._engine_kwargs = dict(engine_kwargs or {})
+        self._start_timeout = float(start_timeout)
+        self._call_timeout = float(call_timeout)
+        self._env = env
+        self._proc: subprocess.Popen | None = None
+        self._conn = None
+        self._listener = None
+        self._send_lock = threading.Lock()
+        self._pending: dict[int, Future] = {}  # guarded-by: _send_lock
+        self._next_id = 0                      # guarded-by: _send_lock
+        self._dead: Exception | None = None    # guarded-by: _send_lock
+        self._recv_thread: threading.Thread | None = None
+
+    # -- lifecycle ----------------------------------------------------- #
+
+    def start(self) -> None:
+        if self._conn is not None:
+            return
+        os.makedirs(self.ckpt_dir, exist_ok=True)
+        sock = os.path.join(self.ckpt_dir, "rpc.sock")
+        if os.path.exists(sock):
+            os.unlink(sock)
+        key = secrets.token_bytes(16)
+        self._listener = Listener(sock, family="AF_UNIX", authkey=key)
+        env = dict(os.environ if self._env is None else self._env)
+        env["CONFLUX_FABRIC_KEY"] = key.hex()
+        cmd = [sys.executable, "-m", "conflux_tpu.fabric", "--worker",
+               "--host-id", self.host_id, "--connect", sock,
+               "--ckpt-dir", self.ckpt_dir,
+               "--engine-json", json.dumps(self._engine_kwargs)]
+        self._log_path = os.path.join(self.ckpt_dir, "worker.log")
+        self._log = open(self._log_path, "ab")
+        self._proc = subprocess.Popen(cmd, env=env, stdout=self._log,
+                                      stderr=subprocess.STDOUT)
+        box: list = []
+
+        def accept():
+            try:
+                box.append(self._listener.accept())
+            except Exception as e:  # noqa: BLE001 — reported below
+                box.append(e)
+
+        t = threading.Thread(target=accept, daemon=True,
+                             name=f"fabric-accept-{self.host_id}")
+        t.start()
+        t.join(self._start_timeout)
+        if not box or isinstance(box[0], Exception):
+            self._proc.kill()
+            tail = b""
+            try:
+                with open(self._log_path, "rb") as f:
+                    tail = f.read()[-2000:]
+            except OSError:
+                pass
+            raise RuntimeError(
+                f"fabric worker {self.host_id} failed to connect within "
+                f"{self._start_timeout}s: {box[0] if box else 'timeout'}"
+                f"\n--- worker log tail ---\n{tail.decode(errors='replace')}")
+        self._conn = box[0]
+        self._recv_thread = threading.Thread(
+            target=self._recv_loop, daemon=True,
+            name=f"fabric-recv-{self.host_id}")
+        self._recv_thread.start()
+
+    # futures-owner
+    def _recv_loop(self) -> None:
+        try:
+            while True:
+                msg = self._conn.recv()
+                with self._send_lock:
+                    fut = self._pending.pop(msg.get("id"), None)
+                if fut is not None:
+                    fut.set_result(msg)
+        except (EOFError, OSError) as e:
+            self._fail(ConnectionError(
+                f"host {self.host_id} connection lost: {e!r}"))
+
+    def _fail(self, exc: Exception) -> None:
+        """Mark the transport dead and fail every pending reply future
+        — no request ever hangs on a torn pipe."""
+        with self._send_lock:
+            if self._dead is None:
+                self._dead = exc
+            stranded = list(self._pending.values())
+            self._pending.clear()
+        for fut in stranded:
+            fut.set_exception(exc)
+
+    # -- request plumbing ---------------------------------------------- #
+
+    def _call(self, op: str, timeout: float | None = None, **kw):
+        fut: Future = Future()
+        with self._send_lock:
+            if self._dead is not None:
+                raise ConnectionError(
+                    f"host {self.host_id} is dead: {self._dead}")
+            if self._conn is None:
+                raise ConnectionError(
+                    f"host {self.host_id} not started")
+            mid = self._next_id
+            self._next_id += 1
+            self._pending[mid] = fut
+            try:
+                self._conn.send({"id": mid, "op": op, **kw})
+            except (OSError, ValueError) as e:
+                self._pending.pop(mid, None)
+                raise ConnectionError(
+                    f"host {self.host_id} send failed: {e!r}") from e
+        try:
+            reply = fut.result(self._call_timeout
+                               if timeout is None else timeout)
+        except TimeoutError:
+            with self._send_lock:
+                self._pending.pop(mid, None)
+            raise
+        if reply.get("ok"):
+            return reply.get("value")
+        _raise_wire(reply)
+
+    # -- op surface ---------------------------------------------------- #
+
+    def ping(self, timeout: float | None = None) -> dict:
+        return self._call("ping", timeout=timeout)
+
+    def open(self, sid, spec, A, policy=None,
+             timeout: float | None = None):
+        return self._call("open", timeout=timeout, sid=sid, spec=spec,
+                          A=np.asarray(A), policy=policy)
+
+    def solve(self, sid, b, timeout: float | None = None):
+        return self._call("solve", timeout=timeout, sid=sid,
+                          b=np.asarray(b))
+
+    def update(self, sid, U, V, replace: bool = False,
+               timeout: float | None = None):
+        return self._call("update", timeout=timeout, sid=sid,
+                          U=np.asarray(U), V=np.asarray(V),
+                          replace=replace)
+
+    def checkpoint(self, timeout: float | None = None) -> str:
+        return self._call("checkpoint", timeout=timeout)
+
+    def adopt(self, src, names, timeout: float | None = None) -> list:
+        return self._call("adopt", timeout=timeout, src=src,
+                          names=list(names))
+
+    def migrate_out(self, sid, dest,
+                    timeout: float | None = None) -> str:
+        return self._call("migrate_out", timeout=timeout, sid=sid,
+                          dest=dest)
+
+    def drop(self, sid, timeout: float | None = None) -> bool:
+        return self._call("drop", timeout=timeout, sid=sid)
+
+    def stats(self, timeout: float | None = None) -> dict:
+        return self._call("stats", timeout=timeout)
+
+    def close(self) -> None:
+        if self._proc is None:
+            return
+        try:
+            self._call("close", timeout=30.0)
+        except (ConnectionError, EOFError, OSError):
+            pass
+        self._teardown()
+
+    def kill(self) -> None:
+        """Hard-kill the worker process (drills). The torn pipe fails
+        every in-flight request with ConnectionError."""
+        if self._proc is None:
+            return
+        try:
+            with self._send_lock:
+                if self._dead is None and self._conn is not None:
+                    self._conn.send({"id": -1, "op": "kill"})
+        except (OSError, ValueError):
+            pass
+        try:
+            self._proc.wait(timeout=2.0)
+        except subprocess.TimeoutExpired:
+            self._proc.kill()
+        self._fail(ConnectionError(f"host {self.host_id} killed"))
+        self._teardown(wait=False)
+
+    def _teardown(self, wait: bool = True) -> None:
+        if self._conn is not None:
+            try:
+                self._conn.close()
+            except OSError:
+                pass
+        if self._listener is not None:
+            try:
+                self._listener.close()
+            except OSError:
+                pass
+        if self._proc is not None and wait:
+            try:
+                self._proc.wait(timeout=30.0)
+            except subprocess.TimeoutExpired:
+                self._proc.kill()
+        if getattr(self, "_log", None) is not None:
+            self._log.close()
+        self._fail(ConnectionError(f"host {self.host_id} closed"))
+
+
+# --------------------------------------------------------------------------- #
+# the worker process
+# --------------------------------------------------------------------------- #
+
+
+def _send_locked(conn, lock, payload: dict) -> None:
+    with lock:
+        conn.send(payload)
+
+
+def worker_main(argv=None) -> int:
+    """``python -m conflux_tpu.fabric --worker`` — one engine host.
+
+    Connects BACK to the front's listener (authkey from the
+    CONFLUX_FABRIC_KEY env var), builds its ServeEngine, then serves
+    ops. The recv loop stays responsive while heavy ops run: `solve`
+    rides the engine's own async submit (reply from the future's done
+    callback — coalescing is preserved), and barrier ops
+    (open/checkpoint/adopt/migrate_out/update) run on a small op pool
+    so a long checkpoint cannot starve heartbeat replies. EOF on the
+    pipe (front gone) closes the engine and exits cleanly; the 'kill'
+    op exits abruptly (drills)."""
+    import argparse
+
+    ap = argparse.ArgumentParser(prog="python -m conflux_tpu.fabric")
+    ap.add_argument("--worker", action="store_true", required=True)
+    ap.add_argument("--host-id", required=True)
+    ap.add_argument("--connect", required=True,
+                    help="front's AF_UNIX listener path")
+    ap.add_argument("--ckpt-dir", required=True)
+    ap.add_argument("--engine-json", default="{}")
+    args = ap.parse_args(argv)
+
+    key = bytes.fromhex(os.environ["CONFLUX_FABRIC_KEY"])
+    conn = Client(args.connect, family="AF_UNIX", authkey=key)
+    send_lock = threading.Lock()
+
+    from conflux_tpu.engine import ServeEngine
+
+    eng = ServeEngine(**json.loads(args.engine_json))
+    core = _HostCore(args.host_id, args.ckpt_dir, eng)
+    pool = ThreadPoolExecutor(max_workers=2,
+                              thread_name_prefix="fabric-op")
+
+    def reply_solve(fut: Future, mid: int) -> None:
+        try:
+            val = fut.result()
+        # conflint: disable=CFX-EXCEPT worker op boundary: every failure (kills included) is wired back to the front
+        except BaseException as e:
+            payload = {"id": mid, **_encode_exc(e)}
+        else:
+            payload = {"id": mid, "ok": True, "value": val}
+        try:
+            _send_locked(conn, send_lock, payload)
+        except (OSError, ValueError):
+            pass  # front is gone; EOF will land on the recv loop
+
+    def run_op(mid: int, op: str, kw: dict) -> None:
+        try:
+            if op == "ping":
+                val: Any = core.ping()
+            elif op == "open":
+                val = core.open(kw["sid"], kw["spec"], kw["A"],
+                                kw.get("policy"))
+            elif op == "update":
+                val = core.update(kw["sid"], kw["U"], kw["V"],
+                                  kw.get("replace", False))
+            elif op == "checkpoint":
+                val = core.checkpoint()
+            elif op == "adopt":
+                val = core.adopt(kw["src"], kw["names"])
+            elif op == "migrate_out":
+                val = core.migrate_out(kw["sid"], kw["dest"])
+            elif op == "drop":
+                val = core.drop(kw["sid"])
+            elif op == "stats":
+                val = core.stats()
+            else:
+                raise ValueError(f"unknown fabric op {op!r}")
+        # conflint: disable=CFX-EXCEPT worker op boundary: every failure (kills included) is wired back to the front
+        except BaseException as e:
+            payload = {"id": mid, **_encode_exc(e)}
+        else:
+            payload = {"id": mid, "ok": True, "value": val}
+        try:
+            _send_locked(conn, send_lock, payload)
+        except (OSError, ValueError):
+            pass
+
+    try:
+        while True:
+            try:
+                msg = conn.recv()
+            except (EOFError, OSError):
+                break
+            op = msg.get("op")
+            mid = msg.get("id")
+            if op == "kill":
+                os._exit(1)
+            if op == "close":
+                _send_locked(conn, send_lock,
+                             {"id": mid, "ok": True, "value": True})
+                break
+            if op == "solve":
+                try:
+                    fut = core.solve_async(msg["sid"], msg["b"])
+                # conflint: disable=CFX-EXCEPT worker op boundary: admission failures are wired back to the front
+                except BaseException as e:
+                    _send_locked(conn, send_lock,
+                                 {"id": mid, **_encode_exc(e)})
+                else:
+                    fut.add_done_callback(
+                        lambda f, mid=mid: reply_solve(f, mid))
+                continue
+            if op == "ping":
+                run_op(mid, op, msg)  # inline: must outrun the op pool
+                continue
+            pool.submit(run_op, mid, op, dict(msg))
+    finally:
+        pool.shutdown(wait=False)
+        eng.close()
+        try:
+            conn.close()
+        except OSError:
+            pass
+    return 0
+
+
+# --------------------------------------------------------------------------- #
+# the fabric front
+# --------------------------------------------------------------------------- #
+
+_FABRICS: "weakref.WeakSet[ServeFabric]" = weakref.WeakSet()
+
+
+class ServeFabric:
+    """The routing front over a fleet of engine hosts (DESIGN §28).
+
+    Construct with started-or-not :class:`HostHandle`s, call
+    :meth:`start`, then `open`/`solve`/`update` by session id. The
+    heartbeat, background-checkpoint and fail-over machinery runs on
+    two daemon threads; `close()` stops them and the hosts.
+    """
+
+    def __init__(self, hosts, *, policy: FabricPolicy | None = None,
+                 fault_plan=None, root: str | None = None):
+        handles = list(hosts)
+        if not handles:
+            raise ValueError("a fabric needs at least one host")
+        ids = [h.host_id for h in handles]
+        if len(set(ids)) != len(ids):
+            raise ValueError(f"duplicate host ids: {ids}")
+        self.policy = policy if policy is not None else FabricPolicy()
+        self._hosts: dict[str, HostHandle] = {h.host_id: h
+                                              for h in handles}
+        if root is None:
+            import tempfile
+
+            root = tempfile.mkdtemp(prefix="conflux-fabric-")
+        self.root = root
+        os.makedirs(root, exist_ok=True)
+        self._faults = fault_plan
+        self._lock = threading.Lock()
+        self._state = {h: "alive" for h in self._hosts}  # guarded-by: _lock
+        self._misses = {h: 0 for h in self._hosts}       # guarded-by: _lock
+        self._owners: dict[Any, str] = {}                # guarded-by: _lock
+        self._lost: dict[Any, str] = {}                  # guarded-by: _lock
+        self._recoveries: list[dict] = []                # guarded-by: _lock
+        self._mig_seq = 0                                # guarded-by: _lock
+        self._ckpt_rounds = 0                            # guarded-by: _lock
+        self._breakers = {h: CircuitBreaker(self.policy.breaker_threshold,
+                                            self.policy.breaker_cooldown)
+                          for h in self._hosts}
+        self._windows = {h: CounterWindow() for h in self._hosts}
+        self.load = HostLoadEstimator(floor=self.policy.retry_floor,
+                                      ceil=self.policy.retry_ceil)
+        self._stop = threading.Event()
+        self._hb_thread: threading.Thread | None = None
+        self._ckpt_thread: threading.Thread | None = None
+        self._closed = False
+        _FABRICS.add(self)
+
+    # -- lifecycle ----------------------------------------------------- #
+
+    def start(self) -> "ServeFabric":
+        for h in self._hosts.values():
+            h.start()
+            if isinstance(h, LocalHost):
+                h.core.ckpt_keep = self.policy.checkpoint_keep
+        self._hb_thread = threading.Thread(
+            target=self._hb_loop, daemon=True, name="fabric-heartbeat")
+        self._hb_thread.start()
+        if self.policy.checkpoint_interval > 0:
+            self._ckpt_thread = threading.Thread(
+                target=self._ckpt_loop, daemon=True, name="fabric-ckpt")
+            self._ckpt_thread.start()
+        return self
+
+    def close(self) -> None:
+        self._closed = True
+        self._stop.set()
+        for t in (self._hb_thread, self._ckpt_thread):
+            if t is not None:
+                t.join(timeout=10.0)
+        for h in self._hosts.values():
+            try:
+                h.close()
+            except (ConnectionError, EOFError, OSError):
+                pass
+
+    def __enter__(self) -> "ServeFabric":
+        return self.start()
+
+    def __exit__(self, *exc) -> None:
+        self.close()
+
+    # -- host census --------------------------------------------------- #
+
+    def _live(self) -> list[str]:
+        """Hosts eligible for routing/placement: alive or suspect
+        (a suspect host still answers most traffic; only DEATH moves
+        sessions — the hysteresis half of the no-reshuffle story)."""
+        with self._lock:
+            return sorted(h for h, s in self._state.items()
+                          if s != "dead")
+
+    def _alive(self) -> list[str]:
+        with self._lock:
+            return sorted(h for h, s in self._state.items()
+                          if s == "alive")
+
+    def host_state(self, host_id: str) -> str:
+        with self._lock:
+            return self._state[host_id]
+
+    def owner_of(self, sid) -> str | None:
+        with self._lock:
+            return self._owners.get(sid)
+
+    def add_host(self, handle: HostHandle) -> None:
+        """Grow the live set (soak's revive arm). New sessions HRW
+        over the enlarged set; existing owners do not move — call
+        :meth:`migrate` to rebalance deliberately."""
+        hid = handle.host_id
+        with self._lock:
+            if hid in self._hosts:
+                raise ValueError(f"host id {hid!r} already present")
+        handle.start()
+        self._breakers[hid] = CircuitBreaker(
+            self.policy.breaker_threshold, self.policy.breaker_cooldown)
+        self._windows[hid] = CounterWindow()
+        with self._lock:
+            self._hosts[hid] = handle
+            self._state[hid] = "alive"
+            self._misses[hid] = 0
+
+    # -- admission + request routing ----------------------------------- #
+
+    def _fault_plan(self):
+        return (self._faults if self._faults is not None
+                else resilience.active_faults())
+
+    def _retry_hint(self, backlog: int = 1) -> float:
+        return self.load.retry_after(backlog, self._alive() or None)
+
+    def open(self, sid, plan_or_spec, A, *, policy: dict | None = None,
+             timeout: float | None = None):
+        """Admit a session: place it on the rendezvous-chosen live
+        host, factor there, optionally checkpoint it durable
+        (`durable_open`). Refuses with :class:`FleetDegraded` below
+        `min_live` live hosts and ValueError on a duplicate sid."""
+        from conflux_tpu.engine import rendezvous
+        from conflux_tpu.serve import FactorPlan, plan_spec
+
+        spec = (plan_spec(plan_or_spec)
+                if isinstance(plan_or_spec, FactorPlan)
+                else dict(plan_or_spec))
+        with self._lock:
+            if sid in self._owners:
+                raise ValueError(f"sid {sid!r} already open on host "
+                                 f"{self._owners[sid]}")
+            self._lost.pop(sid, None)  # reopening a lost sid is legal
+            total = len(self._hosts)
+        live = self._live()
+        if len(live) < self.policy.min_live:
+            raise FleetDegraded(
+                f"{len(live)}/{total} hosts live, below min_live="
+                f"{self.policy.min_live} — admission refused",
+                retry_after=self._retry_hint(), live=len(live),
+                total=total)
+        hid = rendezvous(sid, live)
+        self._route_fault(hid)
+        host = self._hosts[hid]
+        try:
+            host.open(sid, spec, A, policy,
+                      timeout=timeout if timeout is not None
+                      else self.policy.call_timeout)
+        except _TRANSPORT_ERRORS as e:
+            self._note_request_failure(hid)
+            raise HostUnavailable(
+                f"host {hid} unreachable during open: {e}",
+                retry_after=self._retry_hint(), host=hid) from e
+        with self._lock:
+            self._owners[sid] = hid
+        if self.policy.durable_open:
+            if self._checkpoint_host(hid) is None:
+                # the host died inside the admission window: the
+                # session is NOT durable, so the admission is void —
+                # undo it and tell the caller to retry (the next open
+                # lands on a survivor). Without this, a kill racing
+                # durable_open admits a session that the very next
+                # fail-over must declare lost.
+                with self._lock:
+                    self._owners.pop(sid, None)
+                try:
+                    host.drop(sid, timeout=self.policy.call_timeout)
+                except _TRANSPORT_ERRORS:
+                    pass
+                raise HostUnavailable(
+                    f"host {hid} died before admission of {sid!r} "
+                    "became durable — retry",
+                    retry_after=self._retry_hint(), host=hid)
+        return sid
+
+    def _route_fault(self, hid: str) -> None:
+        try:
+            maybe_fault(self._fault_plan(), "route")
+        # conflint: disable=CFX-EXCEPT injected transport fault mapped to a structured HostUnavailable
+        except (InjectedFault, InjectedKill) as e:
+            self._note_request_failure(hid)
+            raise HostUnavailable(
+                f"host {hid} unreachable (injected route fault)",
+                retry_after=self._retry_hint(), host=hid) from e
+
+    def _resolve(self, sid) -> tuple[str, HostHandle]:
+        """Route a request: owner lookup + state/breaker gates.
+        Structured failures only — never a hang, never a stale pick."""
+        with self._lock:
+            lost = self._lost.get(sid)
+            hid = self._owners.get(sid)
+            st = None if hid is None else self._state[hid]
+        if lost is not None:
+            raise HostUnavailable(
+                f"session {sid!r} was lost: {lost}", retry_after=0.0)
+        if hid is None:
+            raise KeyError(f"unknown sid {sid!r} — open it first")
+        if st == "dead":
+            raise HostUnavailable(
+                f"host {hid} is dead; fail-over for {sid!r} is in "
+                "flight", retry_after=self._retry_hint(), host=hid)
+        ok, cool = self._breakers[hid].allow()
+        if not ok:
+            raise HostUnavailable(
+                f"host {hid} circuit open (repeated transport "
+                f"failures); probe in ~{cool:.2f}s",
+                retry_after=max(cool, self._retry_hint()), host=hid)
+        return hid, self._hosts[hid]
+
+    def _note_request_failure(self, hid: str) -> None:
+        br = self._breakers.get(hid)
+        if br is not None:
+            br.record_failure()
+
+    def solve(self, sid, b, timeout: float | None = None):
+        """One routed solve. Transport failure on the owning host maps
+        to :class:`HostUnavailable` with a measured-drain retry hint;
+        the host's own structured errors pass through untouched."""
+        hid, host = self._resolve(sid)
+        self._route_fault(hid)
+        try:
+            out = host.solve(sid, b,
+                             timeout=timeout if timeout is not None
+                             else self.policy.call_timeout)
+        except _TRANSPORT_ERRORS as e:
+            self._note_request_failure(hid)
+            raise HostUnavailable(
+                f"host {hid} unreachable during solve({sid!r}): {e}",
+                retry_after=self._retry_hint(), host=hid) from e
+        self._breakers[hid].record_success()
+        return out
+
+    def update(self, sid, U, V, *, replace: bool = False,
+               timeout: float | None = None):
+        hid, host = self._resolve(sid)
+        self._route_fault(hid)
+        try:
+            out = host.update(sid, U, V, replace=replace,
+                              timeout=timeout if timeout is not None
+                              else self.policy.call_timeout)
+        except _TRANSPORT_ERRORS as e:
+            self._note_request_failure(hid)
+            raise HostUnavailable(
+                f"host {hid} unreachable during update({sid!r}): {e}",
+                retry_after=self._retry_hint(), host=hid) from e
+        self._breakers[hid].record_success()
+        return out
+
+    # -- heartbeat / detection ----------------------------------------- #
+
+    def _hb_loop(self) -> None:
+        while not self._stop.wait(self.policy.heartbeat_interval):
+            try:
+                self._hb_round()
+            except Exception:  # noqa: BLE001 — the loop must survive
+                bump("fabric_hb_errors")
+
+    def _hb_round(self) -> None:
+        plan = self._fault_plan()
+        if plan is not None:
+            s = plan.fire("host_kill", kinds=("kill",))
+            if s is not None:
+                victims = self._alive()
+                if victims:
+                    try:
+                        self._hosts[victims[0]].kill()
+                    except (ConnectionError, EOFError, OSError):
+                        pass
+        for hid in sorted(self._hosts):
+            if self._closed:
+                return
+            with self._lock:
+                if self._state[hid] == "dead":
+                    continue
+            self._probe(hid, plan)
+
+    def _probe(self, hid: str, plan) -> None:
+        host = self._hosts[hid]
+        torn = False
+        try:
+            maybe_fault(plan, "heartbeat")
+            payload = host.ping(timeout=self.policy.heartbeat_timeout)
+        except (ConnectionError, EOFError, BrokenPipeError) as e:
+            torn, payload = True, None
+            del e
+        # conflint: disable=CFX-EXCEPT an injected heartbeat kill IS the miss being counted
+        except (InjectedFault, InjectedKill, OSError):
+            payload = None  # includes TimeoutError: a miss, not a tear
+        if payload is not None:
+            with self._lock:
+                self._misses[hid] = 0
+                if self._state[hid] == "suspect":
+                    self._state[hid] = "alive"
+            counters = dict(payload.get("counters") or {})
+            delta = self._windows[hid].feed(counters)
+            # pending is a gauge: re-inject the raw depth after the
+            # window differences the payload
+            delta["pending"] = counters.get("pending", 0)
+            self.load.feed(hid, delta)
+            return
+        bump("heartbeat_misses")
+        with self._lock:
+            self._misses[hid] += 1
+            m = self._misses[hid]
+            st = self._state[hid]
+        if torn or m >= self.policy.dead_after:
+            self._declare_dead(hid)
+        elif m >= self.policy.suspect_after and st == "alive":
+            with self._lock:
+                self._state[hid] = "suspect"
+            bump("hosts_suspected")
+
+    def _declare_dead(self, hid: str) -> None:
+        with self._lock:
+            if self._state[hid] == "dead":
+                return
+            self._state[hid] = "dead"
+        bump("hosts_died")
+        self.load.forget(hid)
+        self._failover(hid)
+
+    # -- fail-over ------------------------------------------------------ #
+
+    def _failover(self, hid: str) -> None:
+        """Re-home a dead host's sessions onto the survivors from its
+        last complete checkpoint. Bounded recovery: file reads + one
+        adopt RPC per target; sessions restore HOST-tier... adopted
+        eagerly here (small per-host share). Sids with no checkpoint
+        record are declared lost with a structured reason."""
+        from conflux_tpu.engine import rendezvous
+
+        t0 = time.perf_counter()
+        with self._lock:
+            owned = sorted((sid for sid, h in self._owners.items()
+                            if h == hid), key=str)
+        snap = latest_checkpoint(self._hosts[hid].ckpt_dir)
+        have = checkpoint_sids(snap) if snap is not None else {}
+        adopted: dict[Any, str] = {}
+        lost: dict[Any, str] = {}
+        for sid in owned:
+            if sid not in have:
+                lost[sid] = (f"host {hid} died before {sid!r} was "
+                             "ever checkpointed")
+        excluded: set[str] = set()
+        remaining = [sid for sid in owned if sid in have]
+        while remaining:
+            live = [h for h in self._live() if h not in excluded]
+            if not live:
+                for sid in remaining:
+                    lost[sid] = (f"host {hid} died and no live host "
+                                 f"could adopt {sid!r}")
+                break
+            groups: dict[str, list] = {}
+            for sid in remaining:
+                groups.setdefault(rendezvous(sid, live), []).append(sid)
+            remaining = []
+            for tgt, sids in sorted(groups.items()):
+                try:
+                    self._hosts[tgt].adopt(
+                        snap, [have[s] for s in sids],
+                        timeout=self.policy.call_timeout)
+                except _TRANSPORT_ERRORS:
+                    # the target is dying too: exclude it and re-home
+                    # its share on the next pass (its own heartbeat
+                    # death will run its own fail-over)
+                    self._note_request_failure(tgt)
+                    excluded.add(tgt)
+                    remaining.extend(sids)
+                else:
+                    for s in sids:
+                        adopted[s] = tgt
+        with self._lock:
+            for sid, tgt in adopted.items():
+                self._owners[sid] = tgt
+            for sid, why in lost.items():
+                self._owners.pop(sid, None)
+                self._lost[sid] = why
+            dt = time.perf_counter() - t0
+            self._recoveries.append(
+                {"host": hid, "seconds": dt, "adopted": len(adopted),
+                 "lost": len(lost),
+                 "snapshot": os.path.basename(snap) if snap else None})
+        bump("host_failovers")
+        if adopted:
+            bump("sessions_failed_over", len(adopted))
+
+    # -- migration ------------------------------------------------------ #
+
+    def migrate(self, sid, target: str | None = None) -> str:
+        """Hand a live session to another host at a drain barrier.
+
+        Order is crash-safe: (1) the source checkpoints exactly this
+        session (its engine drains in-flight work first), (2) the
+        target adopts the record, (3) ownership flips, (4) the source
+        drops its copy. A failure at or before (2) leaves the session
+        intact and owned by the source. Migrated sessions solve
+        BITWISE identically (the checkpoint contract). Returns the
+        target host id."""
+        hid, src = self._resolve(sid)
+        live = [h for h in self._alive() if h != hid]
+        if not live:
+            raise FleetDegraded(
+                f"no live migration target for {sid!r} (source {hid})",
+                retry_after=self._retry_hint(),
+                live=len(self._alive()), total=len(self._hosts))
+        if target is None:
+            target = self.load.least_loaded(live)
+        elif target == hid:
+            raise ValueError(f"migrate target equals source {hid!r}")
+        elif self.host_state(target) != "alive":
+            raise HostUnavailable(
+                f"migrate target {target} is "
+                f"{self.host_state(target)}",
+                retry_after=self._retry_hint(), host=target)
+        with self._lock:
+            seq = self._mig_seq
+            self._mig_seq += 1
+        dest = os.path.join(self.root, "migrate", f"m{seq:06d}")
+        try:
+            name = src.migrate_out(sid, dest,
+                                   timeout=self.policy.call_timeout)
+        except _TRANSPORT_ERRORS as e:
+            self._note_request_failure(hid)
+            raise HostUnavailable(
+                f"migration source {hid} unreachable: {e}",
+                retry_after=self._retry_hint(), host=hid) from e
+        # the hand-off barrier: a crash HERE (record written, target
+        # not yet adopting) leaves the session intact on the source
+        maybe_fault(self._fault_plan(), "migrate")
+        try:
+            self._hosts[target].adopt(dest, [name],
+                                      timeout=self.policy.call_timeout)
+        except _TRANSPORT_ERRORS as e:
+            self._note_request_failure(target)
+            raise HostUnavailable(
+                f"migration target {target} unreachable — {sid!r} "
+                f"stays on {hid}", retry_after=self._retry_hint(),
+                host=target) from e
+        with self._lock:
+            self._owners[sid] = target
+        try:
+            src.drop(sid, timeout=self.policy.call_timeout)
+        except _TRANSPORT_ERRORS:
+            pass  # source copy is unreachable garbage; fail-over skips
+            # moved sids because ownership already flipped
+        if self.policy.durable_open:
+            # migration is re-admission on the target: fold the moved
+            # session into the target's own fleet snapshot NOW, or a
+            # target death inside one checkpoint interval loses it
+            self._checkpoint_host(target)
+        bump("sessions_migrated")
+        return target
+
+    # -- checkpointing -------------------------------------------------- #
+
+    def _ckpt_loop(self) -> None:
+        while not self._stop.wait(self.policy.checkpoint_interval):
+            try:
+                self.checkpoint_all()
+            except Exception:  # noqa: BLE001 — the loop must survive
+                bump("fabric_ckpt_errors")
+
+    def checkpoint_all(self) -> dict[str, str | None]:
+        """One background-checkpoint round: snapshot every alive
+        host's fleet (each at its own drain barrier). Returns
+        {host_id: snapshot dir | None} (None: host unreachable —
+        its heartbeat will deal with it)."""
+        out: dict[str, str | None] = {}
+        for hid in self._alive():
+            out[hid] = self._checkpoint_host(hid)
+        with self._lock:
+            self._ckpt_rounds += 1
+        return out
+
+    def _checkpoint_host(self, hid: str) -> str | None:
+        try:
+            return self._hosts[hid].checkpoint(
+                timeout=self.policy.call_timeout)
+        except _TRANSPORT_ERRORS:
+            self._note_request_failure(hid)
+            return None
+
+    # -- observability -------------------------------------------------- #
+
+    def session_count(self) -> int:
+        with self._lock:
+            return len(self._owners)
+
+    def stats(self) -> dict:
+        """Fabric census: per-host state/misses/sessions/breaker, the
+        owners and lost totals, recovery log tail and the per-host
+        load estimates — merged into `profiler.serve_stats()['fabric']`
+        via :func:`fabric_stats`."""
+        with self._lock:
+            per_sid = {}
+            for sid, h in self._owners.items():
+                per_sid[h] = per_sid.get(h, 0) + 1
+            hosts = {hid: {"state": self._state[hid],
+                           "misses": self._misses[hid],
+                           "sessions": per_sid.get(hid, 0),
+                           "breaker": self._breakers[hid].state}
+                     for hid in sorted(self._hosts)}
+            recoveries = list(self._recoveries[-8:])
+            out = {"hosts": hosts,
+                   "sessions": len(self._owners),
+                   "lost_sessions": len(self._lost),
+                   "checkpoint_rounds": self._ckpt_rounds,
+                   "recoveries": recoveries}
+        out["recovery_s_max"] = max(
+            (r["seconds"] for r in recoveries), default=0.0)
+        out["load"] = self.load.stats()
+        return out
+
+
+def fabric_stats() -> dict:
+    """Aggregate census over every live fabric front — the 'fabric'
+    sub-dict of :func:`conflux_tpu.profiler.serve_stats`. Gauges live
+    on the fabrics (surviving `profiler.clear()`); the EVENT counters
+    (host_unavailable, heartbeat_misses, hosts_died,
+    sessions_failed_over, ...) ride `resilience.health_stats` in the
+    'health' sub-dict."""
+    fabs = [f for f in list(_FABRICS) if not f._closed]
+    out = {"fabrics": len(fabs), "hosts": 0, "hosts_alive": 0,
+           "hosts_suspect": 0, "hosts_dead": 0, "sessions": 0,
+           "lost_sessions": 0, "recovery_s_max": 0.0}
+    for f in fabs:
+        s = f.stats()
+        out["hosts"] += len(s["hosts"])
+        for row in s["hosts"].values():
+            out[f"hosts_{row['state']}"] += 1
+        out["sessions"] += s["sessions"]
+        out["lost_sessions"] += s["lost_sessions"]
+        out["recovery_s_max"] = max(out["recovery_s_max"],
+                                    s["recovery_s_max"])
+    return out
+
+
+# --------------------------------------------------------------------------- #
+# convenience constructors
+# --------------------------------------------------------------------------- #
+
+
+def local_fabric(n: int, root: str, *,
+                 engine_kwargs: dict | None = None,
+                 policy: FabricPolicy | None = None,
+                 fault_plan=None) -> ServeFabric:
+    """An n-host single-process fabric (tests, soak, lockcheck)."""
+    hosts = [LocalHost(f"h{i}", os.path.join(root, f"h{i}"),
+                       engine_kwargs=engine_kwargs) for i in range(n)]
+    return ServeFabric(hosts, policy=policy, fault_plan=fault_plan,
+                       root=root)
+
+
+def process_fabric(n: int, root: str, *,
+                   engine_kwargs: dict | None = None,
+                   policy: FabricPolicy | None = None,
+                   fault_plan=None,
+                   start_timeout: float = 180.0) -> ServeFabric:
+    """An n-host fabric with one worker process per host (the real
+    deployment shape; scripts/fabric_drill.py and the --fabric
+    bench)."""
+    hosts = [ProcessHost(f"h{i}", os.path.join(root, f"h{i}"),
+                         engine_kwargs=engine_kwargs,
+                         start_timeout=start_timeout)
+             for i in range(n)]
+    return ServeFabric(hosts, policy=policy, fault_plan=fault_plan,
+                       root=root)
+
+
+if __name__ == "__main__":
+    sys.exit(worker_main())

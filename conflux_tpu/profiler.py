@@ -326,6 +326,14 @@ def serve_stats() -> dict:
     # population/byte gauges merged across live ResidentSets (live on
     # the managers, surviving clear() like engine counters)
     out["tier"] = tier.tier_stats()
+    # the fabric sub-dict: host census + fail-over/migration gauges
+    # merged across live ServeFabric fronts (DESIGN §28); like engine
+    # counters these live on the fabrics and survive clear(). The
+    # fabric EVENT counters (host_unavailable, heartbeat_misses,
+    # hosts_died, sessions_failed_over, ...) ride the 'health' dict
+    from conflux_tpu import fabric
+
+    out["fabric"] = fabric.fabric_stats()
     return out
 
 
@@ -505,6 +513,44 @@ class StatsWindow:
         }
         self._prev = cur
         self._t_prev = now
+        return out
+
+
+class CounterWindow:
+    """Reset-aware rolling deltas over an arbitrary monotone-counter
+    dict — the cross-process sibling of :class:`StatsWindow`.
+
+    StatsWindow reads THIS process's profiler/engine globals; a serve
+    fabric front (`conflux_tpu.fabric`, DESIGN §28) cannot — each
+    engine host is its own process, and its counters arrive serialized
+    in heartbeat payloads. The front keeps one CounterWindow per host
+    and `feed()`s it each payload: numeric keys are differenced with
+    the same reset-clamp `_diff` applies (a host that restarted or
+    `clear()`ed reports its post-reset counts, never negative deltas),
+    non-numeric keys pass through untouched, and the returned dict
+    carries `seconds` (wall span of the window) so callers derive
+    rates. Thread-safe: feed() is atomic under the window's lock (the
+    heartbeat thread writes, stats readers may race it)."""
+
+    def __init__(self):
+        import threading
+
+        self._lock = threading.Lock()
+        self._prev: dict | None = None   # guarded-by: _lock
+        self._t_prev = time.perf_counter()  # guarded-by: _lock
+
+    def feed(self, counters: dict, t: float | None = None) -> dict:
+        now = time.perf_counter() if t is None else t
+        num = {k: v for k, v in counters.items()
+               if isinstance(v, (int, float)) and not isinstance(v, bool)}
+        with self._lock:
+            prev = self._prev if self._prev is not None else {}
+            dt = max(1e-9, now - self._t_prev)
+            out = _diff(num, prev)
+            out.update({k: v for k, v in counters.items() if k not in num})
+            out["seconds"] = dt
+            self._prev = num
+            self._t_prev = now
         return out
 
 

@@ -82,6 +82,47 @@ class MeshPlanUnsupported(ValueError):
         bump("mesh_plan_unsupported")
 
 
+class HostUnavailable(RuntimeError):
+    """A fabric request targeted an engine host that cannot answer —
+    its process died mid-flight, its heartbeat lease lapsed (suspect or
+    dead), its circuit breaker is open after repeated transport
+    failures, or its sessions are mid-fail-over onto survivors. The
+    request NEVER hangs: in-flight futures on a declared-dead host fail
+    with this error the moment the fabric declares it. `retry_after`
+    rides the fabric's measured signals (the PR 8 pattern): during
+    fail-over it is the measured per-session revival rate times the
+    sessions still queued, otherwise the heartbeat/breaker window that
+    must elapse before the host can be trusted again. `host` names the
+    unavailable host id. Counted in
+    ``profiler.serve_stats()['health']['host_unavailable']``."""
+
+    def __init__(self, msg: str, retry_after: float = 0.0,
+                 host: str | None = None):
+        super().__init__(msg)
+        self.retry_after = retry_after
+        self.host = host
+        bump("host_unavailable")
+
+
+class FleetDegraded(RuntimeError):
+    """Fabric admission refused: fewer than `min_live` engine hosts are
+    alive, so the fabric is running in degraded mode — existing
+    sessions on live hosts keep answering, but NEW session opens (and,
+    below quorum, all traffic) are shed until capacity recovers.
+    `retry_after` hints when the next heartbeat round could restore a
+    suspect host or finish a fail-over; `live`/`total` carry the
+    observed host census. Counted in
+    ``profiler.serve_stats()['health']['fleet_degraded']``."""
+
+    def __init__(self, msg: str, retry_after: float = 0.0,
+                 live: int = 0, total: int = 0):
+        super().__init__(msg)
+        self.retry_after = retry_after
+        self.live = live
+        self.total = total
+        bump("fleet_degraded")
+
+
 class DeadlineExceeded(TimeoutError):
     """The request's deadline passed while it was queued; its pending
     slot has been released (lazy eviction, `ServeEngine.submit`)."""
@@ -175,6 +216,18 @@ _HEALTH_KEYS = (
     "lane_revives",           # per-lane watchdog trips that respawned a lane
     "mesh_plan_unsupported",  # MeshPlanUnsupported raised (mesh plan routed
                               # at an unsharded-only serving surface)
+    # the multi-host serve fabric (DESIGN §28)
+    "host_unavailable",       # HostUnavailable raised (dead/suspect host,
+                              # open breaker, or mid-fail-over routing)
+    "fleet_degraded",         # FleetDegraded raised (admission below the
+                              # live-host quorum)
+    "heartbeat_misses",       # heartbeat probes that timed out / errored
+    "hosts_suspected",        # alive -> suspect transitions
+    "hosts_died",             # suspect/alive -> dead declarations
+    "host_failovers",         # fail-over drills run (one per dead host)
+    "sessions_failed_over",   # sessions revived on survivors from the
+                              # dead host's last checkpoint
+    "sessions_migrated",      # live drain-barrier session hand-offs
     "faults_injected",
 )
 
@@ -390,7 +443,8 @@ def breaker_for(session, policy: HealthPolicy,
 # --------------------------------------------------------------------------- #
 
 FAULT_SITES = ("staging", "dispatch", "drain", "d2h", "solve", "refresh",
-               "factor", "spill", "revive", "disk_write", "disk_read")
+               "factor", "spill", "revive", "disk_write", "disk_read",
+               "heartbeat", "route", "migrate", "host_kill")
 FAULT_KINDS = ("nan", "delay", "crash", "kill", "unhealthy")
 
 
@@ -419,6 +473,13 @@ class FaultSpec:
     prob: float = 1.0
     delay_s: float = 0.0
     count: int | None = None
+    # The fabric layer (`conflux_tpu.fabric`, DESIGN §28) adds
+    # 'heartbeat' (kinds 'delay'/'crash' — a slow or failed probe, the
+    # hysteresis driver), 'route' (kinds 'crash'/'delay' on the front's
+    # per-request host call), 'migrate' (kinds 'crash'/'delay' at the
+    # hand-off barrier: a crash before the target adopts leaves the
+    # session intact on the source) and 'host_kill' (kind 'kill': the
+    # whole engine host dies, exercising detection + fail-over).
 
     def __post_init__(self):
         if self.site not in FAULT_SITES:

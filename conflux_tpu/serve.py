@@ -108,6 +108,56 @@ _PLANS: dict[PlanKey, "FactorPlan"] = {}
 _PLANS_LOCK = threading.Lock()
 
 
+def _encode_precision(p):
+    if isinstance(p, lax.Precision):
+        return ["precision", p.name]
+    return p
+
+
+def _decode_precision(p):
+    if isinstance(p, list) and len(p) == 2 and p[0] == "precision":
+        return lax.Precision[p[1]]
+    return p
+
+
+def plan_spec(plan: "FactorPlan") -> dict:
+    """JSON-serializable identity of a plan — the persistence/wire
+    codec shared by the checkpoint fleet.json (`tier.save_fleet`), the
+    serve fabric's cross-process session open (`conflux_tpu.fabric`
+    worker 'open' op, DESIGN §28) and anything else that must rebuild
+    the EXACT plan in another process. Mesh-sharded plans are refused:
+    their session state spans devices, so neither checkpoints nor
+    fabric hosts can carry them."""
+    k = plan.key
+    if k.mesh_key is not None:
+        raise ValueError(
+            "checkpointing covers unsharded plans only (a mesh-sharded "
+            "session's state lives across devices)")
+    return {"shape": list(k.shape), "dtype": k.dtype,
+            "factor_dtype": k.factor_dtype, "v": k.v,
+            "refine": k.refine, "spd": k.spd,
+            "substitution": k.substitution,
+            "precision": _encode_precision(k.precision),
+            "backend": k.backend, "panel_algo": k.panel_algo}
+
+
+def plan_from_spec(d: dict) -> "FactorPlan":
+    """Reconstruct the EXACT PlanKey from a :func:`plan_spec` dict
+    (trace-time knobs included, not re-derived from process globals)
+    and get-or-build its plan — the restore/adopt path's half of the
+    bitwise contract: same key, same compiled program family, same
+    bits."""
+    key = PlanKey(
+        shape=tuple(int(s) for s in d["shape"]), dtype=d["dtype"],
+        factor_dtype=d["factor_dtype"], v=int(d["v"]),
+        refine=int(d["refine"]), spd=bool(d["spd"]),
+        substitution=d["substitution"],
+        precision=_decode_precision(d["precision"]),
+        backend=d["backend"], panel_algo=d["panel_algo"],
+        mesh_key=None)
+    return FactorPlan.from_key(key)
+
+
 class _CompileOnce:
     """Serialize the FIRST call of a jitted program; later calls bypass.
 
@@ -317,6 +367,15 @@ class FactorPlan:
                 plan = cls(key)
                 _PLANS[key] = plan
         return plan
+
+    def spec(self) -> dict:
+        """This plan's :func:`plan_spec` dict (JSON/wire codec)."""
+        return plan_spec(self)
+
+    @classmethod
+    def from_spec(cls, d: dict) -> "FactorPlan":
+        """Get-or-build the plan a :func:`plan_spec` dict names."""
+        return plan_from_spec(d)
 
     # ------------------------------------------------------------------ #
     # bucket lifecycle (the adaptive controller's actuation surface)

@@ -75,7 +75,6 @@ import numpy as np
 
 import jax
 import jax.numpy as jnp
-from jax import lax
 
 from conflux_tpu import io as cfio
 from conflux_tpu import profiler, resilience
@@ -1434,48 +1433,18 @@ class ResidentSet:
 # --------------------------------------------------------------------------- #
 
 
-def _encode_precision(p):
-    if isinstance(p, lax.Precision):
-        return ["precision", p.name]
-    return p
-
-
-def _decode_precision(p):
-    if isinstance(p, list) and len(p) == 2 and p[0] == "precision":
-        return lax.Precision[p[1]]
-    return p
-
-
 def _plan_fields(plan) -> dict:
-    k = plan.key
-    if k.mesh_key is not None:
-        raise ValueError(
-            "checkpointing covers unsharded plans only (a mesh-sharded "
-            "session's state lives across devices)")
-    return {"shape": list(k.shape), "dtype": k.dtype,
-            "factor_dtype": k.factor_dtype, "v": k.v,
-            "refine": k.refine, "spd": k.spd,
-            "substitution": k.substitution,
-            "precision": _encode_precision(k.precision),
-            "backend": k.backend, "panel_algo": k.panel_algo}
+    # promoted to serve.plan_spec (the fabric shares the codec); these
+    # names stay as the tier-local spelling
+    from conflux_tpu.serve import plan_spec
+
+    return plan_spec(plan)
 
 
 def _plan_from_fields(d: dict):
-    """Reconstruct the EXACT PlanKey (trace-time knobs included, not
-    re-derived from process globals) and get-or-build its plan — the
-    restore path's half of the bitwise contract: same key, same
-    compiled program family, same bits."""
-    from conflux_tpu.serve import FactorPlan, PlanKey
+    from conflux_tpu.serve import plan_from_spec
 
-    key = PlanKey(
-        shape=tuple(int(s) for s in d["shape"]), dtype=d["dtype"],
-        factor_dtype=d["factor_dtype"], v=int(d["v"]),
-        refine=int(d["refine"]), spd=bool(d["spd"]),
-        substitution=d["substitution"],
-        precision=_decode_precision(d["precision"]),
-        backend=d["backend"], panel_algo=d["panel_algo"],
-        mesh_key=None)
-    return FactorPlan.from_key(key)
+    return plan_from_spec(d)
 
 
 def _policy_fields(policy) -> dict:
@@ -1526,14 +1495,16 @@ def save_fleet(path: str, sessions, names=None) -> dict:
             nbytes = _write_record(os.path.join(path, name), leaves,
                                    meta)
         entries.append({"name": name, "dir": name,
-                        "plan": _plan_fields(s.plan), "nbytes": nbytes})
+                        "plan": _plan_fields(s.plan), "nbytes": nbytes,
+                        "sid": getattr(s, "sid", None)})
     with open(os.path.join(path, "fleet.json"), "w") as f:
         json.dump({"format": 1, "sessions": entries}, f, indent=1)
     bump("checkpoints")
     return {e["name"]: e["dir"] for e in entries}
 
 
-def load_fleet(path: str, *, residency: ResidentSet | None = None):
+def load_fleet(path: str, *, residency: ResidentSet | None = None,
+               names=None):
     """Rebuild a fleet from a :func:`save_fleet` snapshot. Plans are
     reconstructed from their exact keys; each session comes back with
     its counters, drift policy, Woodbury state and probe row, and
@@ -1549,14 +1520,28 @@ def load_fleet(path: str, *, residency: ResidentSet | None = None):
     storms coalescing through the usual lanes). Returns the sessions in
     checkpoint order. A corrupt record raises :class:`RestoreCorrupt`
     naming the session; pass over it by deleting its entry from
-    fleet.json if partial restore is wanted."""
+    fleet.json if partial restore is wanted.
+
+    `names` restores a SUBSET of the snapshot (checkpoint-order
+    preserved): the serve fabric's fail-over re-homes a dead host's
+    sessions across several survivors, each adopting only the names the
+    rendezvous hash assigns it (DESIGN §28). Unknown names raise
+    KeyError — a fail-over must never silently under-restore."""
     from conflux_tpu.serve import SolveSession
     from conflux_tpu.update import DriftPolicy
 
     with open(os.path.join(path, "fleet.json")) as f:
         fleet = json.load(f)
+    entries = fleet["sessions"]
+    if names is not None:
+        want = set(names)
+        have = {e["name"] for e in entries}
+        if not want <= have:
+            raise KeyError(f"snapshot {path} has no session(s) "
+                           f"{sorted(want - have)}")
+        entries = [e for e in entries if e["name"] in want]
     sessions = []
-    for e in fleet["sessions"]:
+    for e in entries:
         plan = _plan_from_fields(e["plan"])
         leaves, meta = _read_record(os.path.join(path, e["dir"]))
         pol = (DriftPolicy(**meta["policy"])

@@ -1,9 +1,10 @@
 """Aggregate the committed BENCH_*.json headlines into one markdown
 trajectory table.
 
-Nine benches now carry the serving stack's perf story (engine, refresh,
-cold start, resilience overhead, working set, adaptive control, fleet,
-gang, serve) and reading it means opening nine JSON files. This script
+Eleven benches now carry the serving stack's perf story (engine,
+refresh, cold start, resilience overhead, working set, adaptive
+control, fleet, gang, serve, trsm, fabric) and reading it means opening
+eleven JSON files. This script
 folds every committed headline into a single table — metric, value,
 speedup/gate column, and the git date of the last change to each file —
 so the perf trajectory is reviewable at a glance. CI runs it and uploads
@@ -30,7 +31,8 @@ import sys
 _RATIO_KEYS = (
     "speedup_vs_per_session_dispatch", "speedup_vs_sequential",
     "speedup_vs_always_refactor", "speedup_vs_seq_async",
-    "ratio_solves_vs_single_lane", "overhead_pct",
+    "ratio_solves_vs_single_lane", "ratio_solves_vs_single_host",
+    "overhead_pct",
     "single_speedup_vs_refactor", "speedup_vs_naive",
     "speedup_vs_xla_trsm",
     "transitions_won",

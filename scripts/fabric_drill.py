@@ -1,0 +1,214 @@
+"""Two-process fabric drill: kill -> detect -> fail-over -> migrate
+(ISSUE 13 / DESIGN §28).
+
+A REAL multi-process drill, not a simulated one: the driver builds a
+`process_fabric` whose hosts are separate worker processes
+(`python -m conflux_tpu.fabric --worker`), opens a mixed fleet (plain
++ drifted sessions), records every answer, then
+
+  1. healthy pass   — every session solves; answers are bitwise-stable
+                      and match an f64 oracle,
+  2. live migration — one session hands off between live workers and
+                      keeps answering bitwise,
+  3. kill drill     — SIGKILL one worker (a real process death; the
+                      handle is not told), assert requests routed at
+                      the corpse fail with structured HostUnavailable
+                      (never hang), the heartbeat declares it dead, its
+                      fleet revives on the survivor from the last
+                      checkpoint, every session still answers BITWISE,
+                      and the measured recovery time is bounded,
+  4. conservation   — the session census never changes: nothing is
+                      lost, nothing duplicated.
+
+    python scripts/fabric_drill.py DIR [--hosts 2] [--sessions 6]
+                                       [--json OUT]
+
+Exit status is the gate (CI runs this after the unit suite).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import signal
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+from conflux_tpu import fabric
+from conflux_tpu.engine import rendezvous
+from conflux_tpu.fabric import FabricPolicy
+from conflux_tpu.resilience import HostUnavailable
+from conflux_tpu.serve import FactorPlan
+
+N, V = 48, 16
+RECOVERY_BOUND_S = 60.0  # generous CI bound; report the measured value
+
+
+def _mk(seed):
+    rng = np.random.default_rng(seed)
+    return (rng.standard_normal((N, N)) / np.sqrt(N)
+            + 2.0 * np.eye(N)).astype(np.float32)
+
+
+def _rhs(seed):
+    return np.random.default_rng(1000 + seed).standard_normal(
+        (N, 2)).astype(np.float32)
+
+
+def drill(root: str, hosts: int, sessions: int) -> dict:
+    t_all = time.perf_counter()
+    bad: list[str] = []
+    pol = FabricPolicy(heartbeat_interval=0.1, heartbeat_timeout=5.0,
+                       suspect_after=2, dead_after=4)
+    plan = FactorPlan.create((N, N), "float32", v=V)
+    fab = fabric.process_fabric(hosts, root, policy=pol,
+                                engine_kwargs={"max_batch_delay": 0.0})
+    out: dict = {"hosts": hosts, "sessions": sessions}
+    # pick sids that provably spread over every host (HRW is a pure
+    # function of (sid, host ids) — probe it before opening anything)
+    ids = [f"h{i}" for i in range(hosts)]
+    by_host: dict[str, list[str]] = {h: [] for h in ids}
+    i = 0
+    while min(len(v) for v in by_host.values()) * hosts < sessions:
+        sid = f"drill-{i}"
+        by_host[rendezvous(sid, ids)].append(sid)
+        i += 1
+    sids = sorted(sum((v[:(sessions + hosts - 1) // hosts]
+                       for v in by_host.values()), []))[:sessions]
+    with fab:
+        # ---- open a mixed fleet (alternating plain / drifted) --------- #
+        mats, rhs, ref = {}, {}, {}
+        for i, sid in enumerate(sids):
+            mats[sid] = _mk(i)
+            fab.open(sid, plan, mats[sid])
+            if i % 2:
+                rng = np.random.default_rng(500 + i)
+                U = (0.01 * rng.standard_normal((N, 2))).astype(np.float32)
+                Vm = (0.01 * rng.standard_normal((N, 2))).astype(np.float32)
+                fab.update(sid, U, Vm)
+                mats[sid] = mats[sid] + U @ Vm.T
+            rhs[sid] = _rhs(i)
+            ref[sid] = np.asarray(fab.solve(sid, rhs[sid]))
+        owners0 = {sid: fab.owner_of(sid) for sid in ref}
+        if len(set(owners0.values())) < 2:
+            bad.append(f"placement degenerated: {owners0}")
+        # one full checkpoint round AFTER the drift updates: the kill
+        # drill below must revive post-update state (in production the
+        # background checkpoint_interval loop provides this bound)
+        fab.checkpoint_all()
+
+        # ---- 1. healthy pass: bitwise-stable + f64 oracle ------------- #
+        for sid in ref:
+            if not np.array_equal(np.asarray(fab.solve(sid, rhs[sid])),
+                                  ref[sid]):
+                bad.append(f"healthy resolve not bitwise: {sid}")
+            x64 = np.linalg.solve(mats[sid].astype(np.float64),
+                                  rhs[sid].astype(np.float64))
+            err = float(np.max(np.abs(ref[sid] - x64)))
+            if not err < 1e-3:
+                bad.append(f"f64 oracle divergence {err:.2e}: {sid}")
+
+        # ---- 2. live migration --------------------------------------- #
+        mig = next(iter(ref))
+        src = fab.owner_of(mig)
+        tgt = fab.migrate(mig)
+        if tgt == src:
+            bad.append(f"migration did not move {mig}: {src}")
+        if not np.array_equal(np.asarray(fab.solve(mig, rhs[mig])),
+                              ref[mig]):
+            bad.append(f"migrated session not bitwise: {mig}")
+        out["migrated"] = {"sid": mig, "from": src, "to": tgt}
+
+        # ---- 3. kill drill: a REAL process death ---------------------- #
+        victim = fab.owner_of(sids[-1])
+        doomed = sorted(s for s in ref if fab.owner_of(s) == victim)
+        os.kill(fab._hosts[victim]._proc.pid, signal.SIGKILL)
+        # a request routed at the corpse must fail STRUCTURED, fast —
+        # never hang (either HostUnavailable, or fail-over already won
+        # the race and it just answers)
+        t0 = time.perf_counter()
+        try:
+            fab.solve(doomed[0], rhs[doomed[0]], timeout=30.0)
+        except HostUnavailable as e:
+            if not e.retry_after >= 0.0:
+                bad.append(f"HostUnavailable without retry hint: {e}")
+        if time.perf_counter() - t0 > 30.0:
+            bad.append("request against dead host hung")
+        deadline = time.perf_counter() + 30.0
+        while (fab.host_state(victim) != "dead"
+               and time.perf_counter() < deadline):
+            time.sleep(0.02)
+        if fab.host_state(victim) != "dead":
+            bad.append(f"{victim} never declared dead")
+        rec = fab.stats()["recoveries"]
+        if not rec:
+            bad.append("no recovery recorded after host death")
+        else:
+            r = rec[-1]
+            out["recovery"] = r
+            if r["lost"]:
+                bad.append(f"fail-over lost {r['lost']} sessions")
+            if not r["seconds"] < RECOVERY_BOUND_S:
+                bad.append(f"recovery took {r['seconds']:.2f}s "
+                           f">= {RECOVERY_BOUND_S}s")
+        # every session — revived ones included — answers bitwise
+        for sid in ref:
+            try:
+                got = np.asarray(fab.solve(sid, rhs[sid]))
+            except Exception as e:  # noqa: BLE001 — a drill records, not raises
+                bad.append(f"post-failover solve failed: {sid}: {e!r}")
+                continue
+            if not np.array_equal(got, ref[sid]):
+                bad.append(f"post-failover solve not bitwise: {sid}")
+        out["killed"] = {"host": victim, "owned": len(doomed)}
+
+        # ---- 4. conservation ------------------------------------------ #
+        st = fab.stats()
+        if st["sessions"] != sessions:
+            bad.append(f"session census {st['sessions']} != {sessions}")
+        if st["lost_sessions"]:
+            bad.append(f"lost_sessions = {st['lost_sessions']}")
+        out["fabric_stats"] = {
+            "sessions": st["sessions"],
+            "lost_sessions": st["lost_sessions"],
+            "recovery_s_max": st["recovery_s_max"],
+            "hosts": {h: d["state"] for h, d in st["hosts"].items()},
+        }
+    out["failures"] = bad
+    out["elapsed_s"] = round(time.perf_counter() - t_all, 3)
+    return out
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("dir", help="scratch root for checkpoints/sockets")
+    ap.add_argument("--hosts", type=int, default=2)
+    ap.add_argument("--sessions", type=int, default=6)
+    ap.add_argument("--json", default=None,
+                    help="also write the summary JSON here")
+    args = ap.parse_args(argv)
+    if args.hosts < 2:
+        ap.error("--hosts must be >= 2 (someone has to survive)")
+    out = drill(args.dir, args.hosts, args.sessions)
+    if args.json:
+        with open(args.json, "w") as f:
+            json.dump(out, f, indent=2, sort_keys=True)
+    for line in out["failures"]:
+        print(f"fabric_drill: FAIL {line}")
+    if out["failures"]:
+        return 1
+    print(f"fabric_drill: OK — {args.sessions} sessions over "
+          f"{args.hosts} worker processes; migration bitwise; kill of "
+          f"{out['killed']['host']} ({out['killed']['owned']} sessions) "
+          f"recovered in {out['recovery']['seconds'] * 1e3:.0f}ms with "
+          f"0 lost; total {out['elapsed_s']:.1f}s")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1075,6 +1075,199 @@ def run_gang_trial(seed: int) -> tuple[bool, str]:
                   f"injected={sum(faults.injected.values())}")
 
 
+def run_fabric_trial(seed: int) -> tuple[bool, str]:
+    """One chaos trial of the multi-host serve fabric (ISSUE 13).
+
+    A LocalHost fabric (2-3 engine hosts, fast heartbeat, durable
+    admission) serves mixed solve / drift-update / migrate traffic
+    while the fabric fault menu fires: heartbeat crashes and delays
+    (hysteresis food), route crashes (structured HostUnavailable
+    food), migrate crashes at the hand-off barrier, and whole-host
+    kills from inside the heartbeat loop. Dead hosts are sometimes
+    replaced via `add_host` (the revive arm). Invariants: failures are
+    STRUCTURED resilience errors only; every session keeps answering
+    against its OWN f64 oracle (a fail-over or migration that leaked
+    state across hosts/sessions would miss it — zero cross-host
+    corruption); a request window during fail-over ends in recovery
+    (bounded, not permanent unavailability); and the session census is
+    conserved (open sessions + lost == admitted, with durable
+    admission making lost == 0)."""
+    import tempfile
+
+    from conflux_tpu import fabric as fabric_mod
+    from conflux_tpu import serve
+    from conflux_tpu.engine import EngineSaturated
+    from conflux_tpu.fabric import FabricPolicy, LocalHost
+    from conflux_tpu.resilience import (
+        FaultPlan,
+        FaultSpec,
+        FleetDegraded,
+        HostUnavailable,
+        InjectedFault,
+    )
+
+    rng = np.random.default_rng(seed)
+    serve.clear_plans()
+    N = int(rng.choice([24, 32]))
+    H = int(rng.integers(2, 4))
+    S = int(rng.integers(4, 8))
+    plan = serve.FactorPlan.create((N, N), jnp.float32, v=8)
+    menu = [
+        FaultSpec("heartbeat", "crash", prob=0.4,
+                  count=int(rng.integers(1, 4))),
+        FaultSpec("heartbeat", "delay", prob=0.3, delay_s=0.002,
+                  count=3),
+        FaultSpec("route", "crash", prob=0.4,
+                  count=int(rng.integers(1, 3))),
+        FaultSpec("migrate", "crash", prob=0.5, count=1),
+        FaultSpec("host_kill", "kill", prob=0.6, count=1),
+    ]
+    picks = [m for m in menu if rng.integers(2)]
+    faults = FaultPlan(picks, seed=seed)
+    killful = any(f.site == "host_kill" for f in picks)
+    label = (f"seed={seed} fabric N={N} H={H} S={S} "
+             f"faults={[(f.site, f.kind) for f in picks]}")
+    # EngineSaturated: a background checkpoint's drain barrier briefly
+    # pauses admission — structured and retryable, exactly like a
+    # fail-over window
+    ok_exc = (HostUnavailable, FleetDegraded, InjectedFault,
+              EngineSaturated)
+
+    def solve_retry(fab, sid, b, deadline_s=30.0):
+        """Route with fail-over patience: HostUnavailable during a
+        detection/fail-over window is expected — but it must END."""
+        t0 = time.time()
+        while True:
+            try:
+                return np.asarray(fab.solve(sid, b))
+            except ok_exc as e:
+                if time.time() - t0 > deadline_s:
+                    raise TimeoutError(
+                        f"recovery never completed for {sid}: {e}")
+                time.sleep(min(0.05, max(0.01,
+                                         getattr(e, "retry_after", 0.0))))
+
+    pol = FabricPolicy(heartbeat_interval=0.02, heartbeat_timeout=1.0,
+                       suspect_after=2, dead_after=3,
+                       checkpoint_interval=float(rng.choice([0.0, 0.1])))
+    answered = migrations = revived = rollbacks = 0
+    with tempfile.TemporaryDirectory() as tmp:
+        fab = fabric_mod.local_fabric(
+            H, tmp, policy=pol, fault_plan=faults,
+            engine_kwargs={"max_batch_delay": 0.0})
+        try:
+            with fab:
+                # per-sid oracle CANDIDATES: durable admission pins the
+                # pre-drift state; a post-admission update is durable
+                # only once a later checkpoint covers it, so until then
+                # a fail-over may legitimately revive the pre-drift
+                # snapshot (the documented staleness bound). The soak
+                # therefore accepts EITHER state — but nothing else: a
+                # blend or another session's answer misses both.
+                As, pre, rhs = {}, {}, {}
+                for i in range(S):
+                    sid = f"soak-{seed}-{i}"
+                    A = (rng.standard_normal((N, N)) / np.sqrt(N)
+                         + 2.0 * np.eye(N)).astype(np.float32)
+                    A64 = A.astype(np.float64)
+                    t0 = time.time()
+                    while True:  # admission retries route faults too
+                        try:
+                            fab.open(sid, plan, A)
+                            break
+                        except ok_exc as e:
+                            if time.time() - t0 > 30.0:
+                                return False, (f"{label}: admission "
+                                               f"never recovered: {e}")
+                            time.sleep(0.01)
+                    pre[sid] = A64
+                    if rng.integers(2):  # pre-traffic SMW drift
+                        k = int(rng.integers(1, 3))
+                        U = (0.01 * rng.standard_normal((N, k))
+                             ).astype(np.float32)
+                        Vm = (0.01 * rng.standard_normal((N, k))
+                              ).astype(np.float32)
+                        try:
+                            fab.update(sid, U, Vm)
+                            A64 = (A64 + U.astype(np.float64)
+                                   @ Vm.astype(np.float64).T)
+                        except ok_exc:
+                            pass  # structured refusal: no drift applied
+                    As[sid] = A64
+                    rhs[sid] = rng.standard_normal(
+                        (N, int(rng.choice([1, 2])))).astype(np.float32)
+                sids = sorted(As)
+                for _phase in range(3):
+                    for sid in sids:
+                        op = int(rng.integers(6))
+                        if op == 0:  # live migration under chaos
+                            try:
+                                fab.migrate(sid)
+                                migrations += 1
+                            except ok_exc:
+                                pass  # crash at the barrier: session
+                                # stays on the source (checked below)
+                            except ValueError:
+                                pass  # no distinct target available
+                        b = rhs[sid]
+                        try:
+                            x = solve_retry(fab, sid, b)
+                        except TimeoutError as e:
+                            return False, f"{label}: {e}"
+                        except Exception as e:  # noqa: BLE001 — leak
+                            return False, (f"{label}: UNSTRUCTURED "
+                                           f"{type(e).__name__}: {e}")
+                        want = np.linalg.solve(As[sid],
+                                               b.astype(np.float64))
+                        err = (np.linalg.norm(x - want)
+                               / max(np.linalg.norm(want), 1e-30))
+                        if not (err < 1e-3):
+                            wpre = np.linalg.solve(
+                                pre[sid], b.astype(np.float64))
+                            epre = (np.linalg.norm(x - wpre)
+                                    / max(np.linalg.norm(wpre), 1e-30))
+                            if killful and epre < 1e-3:
+                                # a fail-over revived the pre-drift
+                                # snapshot: legal staleness, and it is
+                                # now the session's authoritative state
+                                As[sid] = pre[sid]
+                                rollbacks += 1
+                            else:
+                                return False, (f"{label}: {sid} off "
+                                               f"its own oracle "
+                                               f"({err:.2e}) — cross-"
+                                               "host corruption?")
+                        answered += 1
+                    # the revive arm: replace one dead host
+                    dead = [h for h in sorted(fab._hosts)
+                            if fab.host_state(h) == "dead"]
+                    if dead and rng.integers(2):
+                        hid = f"r{revived}"
+                        fab.add_host(LocalHost(
+                            hid, os.path.join(tmp, hid),
+                            engine_kwargs={"max_batch_delay": 0.0}))
+                        revived += 1
+                st = fab.stats()
+                if st["sessions"] + st["lost_sessions"] != S:
+                    return False, (f"{label}: census not conserved "
+                                   f"({st['sessions']}+"
+                                   f"{st['lost_sessions']} != {S})")
+                if st["lost_sessions"]:
+                    return False, (f"{label}: durable admission lost "
+                                   f"{st['lost_sessions']} sessions")
+                deaths = sum(1 for h in st["hosts"].values()
+                             if h["state"] == "dead")
+                if deaths and not killful:
+                    return False, (f"{label}: {deaths} hosts died "
+                                   "without a host_kill fault")
+        finally:
+            fab.close()
+    return True, (f"{label}: ok {answered} solves, "
+                  f"{migrations} migrations, {revived} revives, "
+                  f"{rollbacks} rollbacks, "
+                  f"injected={sum(faults.injected.values())}")
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser()
     ap.add_argument("--trials", type=int, default=200)
@@ -1125,6 +1318,15 @@ def main(argv=None) -> int:
                     "cross-slot corruption), the closed exclusion "
                     "holes staying closed, and slot/membership "
                     "accounting")
+    ap.add_argument("--fabric", action="store_true",
+                    help="chaos-soak the multi-host serve fabric: "
+                    "LocalHost fleets under the fabric fault menu "
+                    "(heartbeat crash/delay, route crash, migrate "
+                    "crash at the hand-off barrier, whole-host kills) "
+                    "with kill/revive/migrate churn; asserts "
+                    "structured failures only, bounded recovery, "
+                    "per-session f64 oracle answers (zero cross-host "
+                    "corruption) and session-count conservation")
     ap.add_argument("--lockcheck", action="store_true",
                     help="run trials under the conflint runtime "
                     "lock-order harness (conflux_tpu.analysis."
@@ -1133,7 +1335,8 @@ def main(argv=None) -> int:
                     "cycle or lock-held-across-dispatch fails the soak")
     args = ap.parse_args(argv)
 
-    trial = (run_gang_trial if args.gang
+    trial = (run_fabric_trial if args.fabric
+             else run_gang_trial if args.gang
              else run_fleet_trial if args.fleet
              else run_tier_trial if args.tier
              else run_adaptive_trial if args.adaptive

@@ -83,6 +83,28 @@ def test_place_session_deterministic_across_engines():
     assert len({str(d) for d in d1.values()}) > 1
 
 
+def test_place_session_remap_only_removed_device():
+    """The rendezvous (HRW) property the multi-host fabric rides
+    (ISSUE 13): shrinking the device set remaps ONLY the sids the
+    removed device owned — every other placement is bit-identical.
+    Regression guard against mod-N style placement, where one removal
+    reshuffles nearly every sid."""
+    devs = jax.devices()
+    assert len(devs) >= 8, "conftest pins an 8-device CPU mesh"
+    sids = [f"user-{i}" for i in range(200)]
+    before = {sid: place_session(sid, devs) for sid in sids}
+    gone = devs[3]
+    survivors = [d for d in devs if d is not gone]
+    after = {sid: place_session(sid, survivors) for sid in sids}
+    moved = [sid for sid in sids if after[sid] is not before[sid]]
+    # exactly the removed device's sids moved, nothing else
+    assert moved == [sid for sid in sids if before[sid] is gone]
+    for sid in moved:
+        assert after[sid] in survivors
+    # the hash spreads: the removed device owned a nontrivial share
+    assert 0 < len(moved) < len(sids)
+
+
 def test_sid_pinned_factor_and_resubmit_route_to_same_lane():
     serve.clear_plans()
     plan = serve.FactorPlan.create((N, N), jnp.float32, v=V)
