@@ -314,17 +314,32 @@ def _mesh_key(mesh):
 # --------------------------------------------------------------------------- #
 
 
+def _pallas_factor_eligible(A, mesh, backend: str) -> bool:
+    """Whether a batched factor call routes to the batch-blocked Pallas
+    kernels (DESIGN §29): opt-in via `backend='pallas'`, single-device
+    only (the kernel grid owns the batch axis — a mesh wants the vmapped
+    body so the partitioner can shard it), f32/f64 systems (the
+    batch-grid kernel's verified dtypes; f64 is interpret-only)."""
+    return (backend == "pallas" and mesh is None
+            and A.dtype in (jnp.float32, jnp.float64))
+
+
 def lu_factor_batched(A, v: int, *, mesh=None, precision=None,
                       backend: str | None = None):
     """Pivoted LU of a (B, N, N) batch: returns (LU (B, N, N), perm (B, N))
     with A[i][perm[i]] == L_i @ U_i (the `lu_factor_blocked` contract per
-    element). With a `batch_mesh`, the batch is sharded over its devices."""
+    element). With a `batch_mesh`, the batch is sharded over its devices.
+    `backend='pallas'` (mesh-less, f32/f64) runs the batch-blocked Pallas
+    factor kernel (`ops.pallas_factor`) instead of vmapping the blocked
+    single-system body — the batch axis lives in the kernel grid."""
     A = jnp.asarray(A)
     _check_batched_square(A)
     B, N = A.shape[0], A.shape[1]
     if N % v:
         raise ValueError(f"N={N} not a multiple of tile size v={v}")
     precision, backend = _resolve(precision, backend)
+    if _pallas_factor_eligible(A, mesh, backend):
+        return blas.batched_lu_factor(A, backend="pallas")
     key = _mesh_key(mesh)
     nsh = 1 if mesh is None else mesh.devices.size
     (Ap,), Bp = _pad_batch((A,), B, nsh)
@@ -338,13 +353,17 @@ def lu_factor_batched(A, v: int, *, mesh=None, precision=None,
 def cholesky_factor_batched(A, v: int, *, mesh=None, precision=None,
                             backend: str | None = None):
     """Lower Cholesky factors of a (B, N, N) SPD batch: returns L
-    (B, N, N), strictly-upper parts zeroed."""
+    (B, N, N), strictly-upper parts zeroed. `backend='pallas'`
+    (mesh-less, f32/f64) runs the batch-blocked Pallas kernel, see
+    :func:`lu_factor_batched`."""
     A = jnp.asarray(A)
     _check_batched_square(A)
     B, N = A.shape[0], A.shape[1]
     if N % v:
         raise ValueError(f"N={N} not a multiple of tile size v={v}")
     precision, backend = _resolve(precision, backend)
+    if _pallas_factor_eligible(A, mesh, backend):
+        return blas.batched_cholesky_factor(A, backend="pallas")
     key = _mesh_key(mesh)
     nsh = 1 if mesh is None else mesh.devices.size
     (Ap,), Bp = _pad_batch((A,), B, nsh)

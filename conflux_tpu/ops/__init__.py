@@ -8,6 +8,8 @@ LU, potrf) with an XLA backend and, for the hot ops, Pallas TPU kernels.
 from conflux_tpu.ops.blas import (
     gemm,
     blocked_trsm,
+    batched_lu_factor,
+    batched_cholesky_factor,
     trsm_left_lower_unit,
     trsm_right_upper,
     panel_lu,
@@ -19,6 +21,8 @@ from conflux_tpu.ops.blas import (
 __all__ = [
     "gemm",
     "blocked_trsm",
+    "batched_lu_factor",
+    "batched_cholesky_factor",
     "trsm_left_lower_unit",
     "trsm_right_upper",
     "panel_lu",

@@ -183,6 +183,49 @@ def blocked_trsm(T: jax.Array, B: jax.Array, *, lower: bool = True,
         block_size=block_size, precision=precision, backend=backend)
 
 
+def batched_lu_factor(A: jax.Array, *, probe_w=None,
+                      backend: str | None = None):
+    """Batched pivoted LU of (B, n, n) systems (DESIGN §29): the coalesced
+    factor lane's kernel entry point. `backend='pallas'` (or the module
+    backend, resolved at trace time like :func:`gemm`) runs the
+    batch-blocked Pallas kernel with the batch axis in the grid —
+    interpret mode off-TPU; 'xla' vmaps `lax.linalg.lu`. Returns
+    `(LU, perm)`, or `(LU, perm, wA)` with the in-kernel Freivalds probe
+    row `wA = w^T A` when `probe_w` is given."""
+    backend = _BACKEND if backend is None else backend
+    if backend == "pallas":
+        from conflux_tpu.ops import pallas_factor
+
+        return pallas_factor.pallas_lu_factor_batched(A, probe_w=probe_w)
+    lu_packed, _piv, perm = jax.vmap(lax.linalg.lu)(A)
+    if probe_w is None:
+        return lu_packed, perm
+    wa = jnp.matmul(probe_w[None, None, :], A,
+                    preferred_element_type=_acc_dtype(A.dtype),
+                    precision=lax.Precision.HIGHEST)[:, 0, :]
+    return lu_packed, perm, wa
+
+
+def batched_cholesky_factor(A: jax.Array, *, probe_w=None,
+                            backend: str | None = None):
+    """Batched lower-Cholesky of (B, n, n) SPD systems (DESIGN §29).
+    Backend semantics match :func:`batched_lu_factor`. Returns `L`, or
+    `(L, wA)` when `probe_w` is given."""
+    backend = _BACKEND if backend is None else backend
+    if backend == "pallas":
+        from conflux_tpu.ops import pallas_factor
+
+        return pallas_factor.pallas_cholesky_factor_batched(
+            A, probe_w=probe_w)
+    L = lax.linalg.cholesky(A, symmetrize_input=False)
+    if probe_w is None:
+        return L
+    wa = jnp.matmul(probe_w[None, None, :], A,
+                    preferred_element_type=_acc_dtype(A.dtype),
+                    precision=lax.Precision.HIGHEST)[:, 0, :]
+    return L, wa
+
+
 def trsm_left_lower(L: jax.Array, B: jax.Array) -> jax.Array:
     """Solve L X = B with L lower triangular (Cholesky forward solve)."""
     return lax.linalg.triangular_solve(

@@ -581,21 +581,29 @@ def evaluate(verdict, limit: float) -> tuple[bool, bool, float]:
 
 
 def evaluate_slots(verdict, limit: float) -> list[tuple[bool, bool, float]]:
-    """Host-side read of a factor-lane (2, bb) verdict block — row 0 the
-    per-slot finite flags, row 1 the per-slot post-factor probe
-    residuals (`FactorPlan._factor_health_fn`). Returns one
-    (healthy, finite, residual) triple per slot so the drain thread can
-    settle the healthy sessions and isolate the sick ones individually
-    (slot verdicts are independent by construction). A NaN residual
-    (non-finite factors poison their own probe solve) compares unhealthy
-    through the same `res <= limit` predicate `evaluate` uses."""
+    """Host-side read of a per-slot (2, S) verdict block — row 0 the
+    per-slot finite flags, row 1 the per-slot probe residuals. Three
+    device-side producers emit this contract and are indistinguishable
+    here by design: the factor lane's checked program
+    (`FactorPlan._factor_health_fn` — vmapped probe solve, or the §27
+    fused stats epilogue, or the §29 Pallas factor kernel with the
+    in-kernel probe row) and the gang's stacked solve verdicts
+    (`update.health_spot_check_slots` / `health_verdict_from_stats_slots`).
+    Returns one (healthy, finite, residual) triple per slot so the
+    drain thread can settle the healthy sessions and isolate the sick
+    ones individually (slot verdicts are independent by construction).
+    A NaN residual (non-finite factors poison their own probe solve)
+    compares unhealthy through the same `res <= limit` predicate
+    `evaluate` uses; the slot sweep is vectorized — one bulk comparison,
+    not S python reads — because a 32-wide factor drain runs this on
+    every coalesced dispatch."""
     v = np.asarray(verdict)
-    out = []
-    for i in range(v.shape[-1]):
-        finite = bool(v[0, i] >= 0.5)
-        res = float(v[1, i])
-        out.append((finite and res <= limit, finite, res))
-    return out
+    finite = v[0] >= 0.5
+    res = v[1].astype(float)
+    with np.errstate(invalid="ignore"):
+        healthy = finite & (res <= limit)
+    return [(bool(healthy[i]), bool(finite[i]), float(res[i]))
+            for i in range(v.shape[-1])]
 
 
 def escalate(session, buf, policy: HealthPolicy, limit: float,

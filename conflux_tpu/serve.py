@@ -806,10 +806,140 @@ class FactorPlan:
     # stacked (cold-start) factor programs — the engine's factor lane
     # ------------------------------------------------------------------ #
 
+    @property
+    def _pallas_factor(self) -> bool:
+        """True when this plan's stacked factor programs run the factor
+        itself through the batch-blocked Pallas kernels
+        (`ops.pallas_factor`, DESIGN §29) instead of vmapping
+        `_one_factor`: opt-in via `backend='pallas'`, non-mesh plans
+        only (the kernel grid owns the batch axis), and f32/f64 with
+        `dtype == factor_dtype` (the kernel's verified dtypes; equality
+        keeps the in-kernel probe row `wA = w^T A` on the same operand
+        `probe_row` would read). Everything about the bucket lifecycle
+        and the bitwise bucket/pad-invariance contract is unchanged —
+        only the traced factor body differs."""
+        k = self.key
+        return (k.backend == "pallas" and self.mesh is None
+                and jnp.dtype(k.dtype) == jnp.dtype(k.factor_dtype)
+                and jnp.dtype(k.factor_dtype) in (jnp.float32,
+                                                  jnp.float64))
+
+    def _stacked_factor_body(self, Ast, probe: bool = False):
+        """The stacked factor computation of XLA-backend plans, shared
+        by :meth:`_stacked_factor_fn` and :meth:`_factor_health_fn`:
+        (bb,) + key.shape -> stacked factor pytree (plus the (bb, N)
+        probe rows wA when `probe`), by vmapping `_one_factor` verbatim
+        — bit continuity with every pre-§29 program. `_pallas_factor`
+        plans use the core/epilogue pair below instead. Traceable;
+        callers jit."""
+        w = self.probe_w if probe else None
+        one = self._one_factor
+        f = jax.vmap(jax.vmap(one)) if self.batched else jax.vmap(one)
+        F = f(Ast)
+        if not probe:
+            return F
+        probe_one = lambda A0: probe_row(w, A0)  # noqa: E731
+        inner_probe = (jax.vmap(jax.vmap(probe_one))
+                       if self.batched else jax.vmap(probe_one))
+        return F, inner_probe(Ast)
+
+    def _pallas_factor_core(self, Ast, probe: bool = False):
+        """EAGER half of a `_pallas_factor` plan's stacked factor:
+        flatten the stack (batched plans fold (bb, B) into one kernel
+        batch — pure metadata, `dtype == factor_dtype` is part of the
+        eligibility gate so no cast happens here) and dispatch the
+        batch-grid kernel (`blas.batched_lu_factor` /
+        `batched_cholesky_factor`) as its OWN compiled program. Returns
+        (LU, perm[, wA]) / (L[, wA]) stacks. Off-TPU the kernel runs in
+        interpret mode — a large inlined XLA graph whose per-slot bits
+        are invariant to the kernel batch only when the program boundary
+        sits exactly at the kernel wrapper: under a caller's outer jit
+        the graph fuses with its consumers differently per bucket size
+        and the factor lane's bitwise bucket-invariance contract breaks
+        (measured: low-bit LU drift between bucket 1 and 4). So this
+        half must NEVER run under a trace — the bucket programs are
+        Python closures chaining this dispatch with the jitted
+        :meth:`_pallas_factor_epilogue`."""
+        k = self.key
+        shp = Ast.shape
+        A2 = (Ast.reshape((shp[0] * shp[1],) + shp[2:])
+              if self.batched else Ast)
+        w = self.probe_w if probe else None
+        if k.spd:
+            out = blas.batched_cholesky_factor(A2, probe_w=w,
+                                               backend="pallas")
+            return out if probe else (out,)
+        return blas.batched_lu_factor(A2, probe_w=w, backend="pallas")
+
+    def _pallas_factor_epilogue(self, core, probe: bool = False):
+        """Traceable second half of a `_pallas_factor` plan's stacked
+        factor: the substitution epilogue on the kernel's stacked output
+        — per-slot diagonal-block inverses for 'blocked' (the §27
+        factor-time pass), full triangular inverses for 'inv' — plus
+        the (bb, B) unflatten for batched plans. Every epilogue op is
+        per-slot exact (vmapped triangular-solve custom calls, triangle
+        masking, reshapes), so the kernel's per-slot bitwise invariance
+        survives to the session pytrees. Callers jit (one program per
+        bucket; `trace_counts['factor']` counts its traces)."""
+        from conflux_tpu.ops.batched_trsm import diag_block_inverses
+
+        self.trace_counts["factor"] += 1  # trace-time, not per call
+        k = self.key
+        cdtype = blas.compute_dtype(jnp.dtype(k.factor_dtype))
+        if k.spd:
+            L = core[0]
+            wA = core[1] if probe else None
+            if k.substitution == "blocked":
+                dbi = jax.vmap(lambda t: diag_block_inverses(t, lower=True))
+                F = (L, dbi(L.astype(cdtype)))
+            elif k.substitution == "inv":
+                Lc = L.astype(cdtype)
+                eye = jnp.broadcast_to(jnp.eye(self.N, dtype=cdtype),
+                                       Lc.shape)
+                F = (lax.linalg.triangular_solve(
+                    Lc, eye, left_side=True, lower=True),)
+            else:
+                F = (L,)
+        else:
+            LU, perm = core[0], core[1]
+            wA = core[2] if probe else None
+            if k.substitution == "blocked":
+                LUc = LU.astype(cdtype)
+                dbi_l = jax.vmap(lambda t: diag_block_inverses(
+                    t, lower=True, unit_diagonal=True))
+                dbi_u = jax.vmap(lambda t: diag_block_inverses(
+                    t, lower=False))
+                F = (LU, dbi_l(LUc), dbi_u(LUc), perm)
+            elif k.substitution == "inv":
+                LUc = LU.astype(cdtype)
+                eye = jnp.broadcast_to(jnp.eye(self.N, dtype=cdtype),
+                                       LUc.shape)
+                Li = lax.linalg.triangular_solve(
+                    LUc, eye, left_side=True, lower=True,
+                    unit_diagonal=True)
+                Ui = lax.linalg.triangular_solve(
+                    LUc, eye, left_side=True, lower=False)
+                F = (Li, Ui, perm)
+            else:
+                F = (LU, perm)
+
+        def unflat(x):
+            if not self.batched:
+                return x
+            B = self.key.shape[0]
+            return x.reshape((x.shape[0] // B, B) + x.shape[1:])
+
+        F = tuple(unflat(x) for x in F)
+        if not probe:
+            return F
+        return F, unflat(wA)
+
     def _stacked_factor_fn(self, bb: int):
         """The factor lane's coalesced cold-start program: `bb` systems
         of this plan stack on a new leading axis — (bb,) + key.shape —
-        and factor in ONE vmapped dispatch, at power-of-two batch
+        and factor in ONE dispatch (vmapped `_one_factor`, or the
+        batch-grid Pallas kernel for `_pallas_factor` plans — see
+        :meth:`_stacked_factor_body`), at power-of-two batch
         buckets so a traffic mix of coalesced sizes compiles O(log)
         programs (pad slots carry identity matrices, well-conditioned by
         construction). Per-slot factors are BITWISE invariant to the
@@ -819,7 +949,9 @@ class FactorPlan:
         opened by `plan.factor` and one opened by a coalesced engine
         dispatch are the same bits. (The UNvmapped factor body differs
         from its vmapped form at rounding level, so routing both paths
-        through one program family is what makes the contract hold.)"""
+        through one program family is what makes the contract hold; the
+        Pallas kernel keeps it by flooring its grid batch at 2 slots —
+        `ops.pallas_factor._pad_batch_floor`.)"""
         if self.mesh is not None:
             raise AssertionError(
                 "the stacked factor program is unsharded — mesh plans "
@@ -830,9 +962,14 @@ class FactorPlan:
                 f"got {bb} — route requests through ServeEngine")
 
         def build():
-            one = self._one_factor
-            f = jax.vmap(jax.vmap(one)) if self.batched else jax.vmap(one)
-            return jax.jit(f)
+            if not self._pallas_factor:
+                return jax.jit(self._stacked_factor_body)
+            epi = jax.jit(self._pallas_factor_epilogue)
+
+            def run(Ast):
+                return epi(self._pallas_factor_core(Ast))
+
+            return run
 
         return self._memo(self._factor_cache, ("factor", bb), build)
 
@@ -851,7 +988,16 @@ class FactorPlan:
         contaminate its co-batched slots' evidence (blast-radius
         isolation at the verdict level). Per-slot reductions run OUTSIDE
         the vmaps as a handful of batched ops (the XLA-CPU fixed-op-cost
-        rule, §20)."""
+        rule, §20).
+
+        `_fused_probe` plans run the probe solve through
+        `_blocked_probe_body` — finite/projection accumulators ride the
+        back substitution's own block loop (§27), so the verdict costs
+        two O(N) dots; `_pallas_factor` plans additionally compute the
+        factor AND wA inside the batch-grid kernel
+        (`_stacked_factor_body`), making the checked coalesced factor
+        one dispatch end to end (§29). All three producers emit the
+        same (2, bb) verdict block `resilience.evaluate_slots` reads."""
         if self.mesh is not None:
             raise AssertionError(
                 "the checked stacked factor program is unsharded — mesh "
@@ -863,41 +1009,73 @@ class FactorPlan:
 
         def build():
             w = self.probe_w
-            inner_factor = (jax.vmap(jax.vmap(self._one_factor))
-                            if self.batched else jax.vmap(self._one_factor))
-            probe_one = lambda A0: probe_row(w, A0)  # noqa: E731
-            inner_probe = (jax.vmap(jax.vmap(probe_one))
-                           if self.batched else jax.vmap(probe_one))
-            solve_one = jax.vmap(self._one_solve, in_axes=(0, 0, None))
-            if self.batched:
-                solve_one = jax.vmap(solve_one, in_axes=(0, 0, None))
+            fused = self._fused_probe
+            if fused:
+                # the §27 fused probe epilogue: the probe solve's back
+                # substitution accumulates the finite/projection stats
+                # in its own block loop, so the verdict costs two O(N)
+                # dots instead of a pass over x
+                probe_body = jax.vmap(self._blocked_probe_body,
+                                      in_axes=(0, 0, None))
+                if self.batched:
+                    probe_body = jax.vmap(probe_body, in_axes=(0, 0, None))
+            else:
+                solve_one = jax.vmap(self._one_solve, in_axes=(0, 0, None))
+                if self.batched:
+                    solve_one = jax.vmap(solve_one, in_axes=(0, 0, None))
 
-            def f(Ast):
-                self._bump("factor_health")  # trace-time, not per call
-                F = inner_factor(Ast)
-                wA = inner_probe(Ast)
-                w2 = w[:, None].astype(jnp.dtype(self.key.dtype))
-                x = solve_one(F, Ast, w2)
+            def check(F, wA, Ast):
                 # per-slot verdict, batched reductions outside the vmaps:
                 # finite flag rides one summation per slot (factor NaNs
                 # propagate into x), residual is the probe projection
                 # |w.w - wA.x0| / ||w|| per system, max-reduced over the
                 # plan's own batch axis for batched plans
-                cdtype = x[..., 0].dtype
-                xs = jnp.sum(x, axis=tuple(range(1, x.ndim)))
-                finite = jnp.isfinite(xs)
-                x0 = x[..., 0].astype(cdtype)
+                w2 = w[:, None].astype(jnp.dtype(self.key.dtype))
+                if fused:
+                    _x, xsum, wAx = probe_body(F, wA, w2)
+                    cdtype = wAx.dtype
+                    fin_acc = (jnp.sum(xsum, axis=-1) if self.batched
+                               else xsum)
+                    ax = wAx
+                else:
+                    x = solve_one(F, Ast, w2)
+                    cdtype = x[..., 0].dtype
+                    fin_acc = jnp.sum(x, axis=tuple(range(1, x.ndim)))
+                    x0 = x[..., 0].astype(cdtype)
+                    ax = jnp.sum(wA.astype(cdtype) * x0, axis=-1)
+                finite = jnp.isfinite(fin_acc)
                 wc = w.astype(cdtype)
-                num = jnp.abs(jnp.sum(wc * wc)
-                              - jnp.sum(wA.astype(cdtype) * x0, axis=-1))
+                num = jnp.abs(jnp.sum(wc * wc) - ax)
                 den = (jnp.sqrt(jnp.sum(jnp.abs(wc) ** 2))
                        + jnp.finfo(cdtype).tiny)
                 res = num / den
                 if self.batched:
                     res = jnp.max(res, axis=-1)
-                verdict = jnp.stack([finite.astype(jnp.float32),
-                                     res.astype(jnp.float32)])
-                return F, wA, verdict
+                return jnp.stack([finite.astype(jnp.float32),
+                                  res.astype(jnp.float32)])
+
+            if self._pallas_factor:
+                # same core/epilogue split as _stacked_factor_fn: the
+                # kernel (which already computed wA in-grid) dispatches
+                # standalone, and ONE jitted epilogue program builds the
+                # substitution pytree + probe solve + verdict
+                def epi(Ast, core):
+                    self._bump("factor_health")  # trace-time
+                    F, wA = self._pallas_factor_epilogue(core, probe=True)
+                    return F, wA, check(F, wA, Ast)
+
+                jepi = jax.jit(epi)
+
+                def run(Ast):
+                    return jepi(Ast,
+                                self._pallas_factor_core(Ast, probe=True))
+
+                return run
+
+            def f(Ast):
+                self._bump("factor_health")  # trace-time, not per call
+                F, wA = self._stacked_factor_body(Ast, probe=True)
+                return F, wA, check(F, wA, Ast)
 
             return jax.jit(f)
 

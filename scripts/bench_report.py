@@ -1,14 +1,19 @@
 """Aggregate the committed BENCH_*.json headlines into one markdown
 trajectory table.
 
-Eleven benches now carry the serving stack's perf story (engine,
+Twelve benches now carry the serving stack's perf story (engine,
 refresh, cold start, resilience overhead, working set, adaptive
-control, fleet, gang, serve, trsm, fabric) and reading it means opening
-eleven JSON files. This script
+control, fleet, gang, serve, trsm, fabric, factor kernel) and reading
+it means opening twelve JSON files. This script
 folds every committed headline into a single table — metric, value,
-speedup/gate column, and the git date of the last change to each file —
-so the perf trajectory is reviewable at a glance. CI runs it and uploads
-BENCH_REPORT.md as an artifact.
+speedup/gate column, and a date — so the perf trajectory is reviewable
+at a glance. CI runs it and uploads BENCH_REPORT.md as an artifact.
+
+Row dates come from the record's own 'date' field (bench_engine stamps
+the run date into every JSON it writes), falling back to the file's
+git date, then mtime, for records that predate the stamp — so
+regenerating the report is a no-op unless a bench actually reran
+(no more date-column churn commits).
 
 Usage: python scripts/bench_report.py [--repo DIR] [--out BENCH_REPORT.md]
 
@@ -26,6 +31,7 @@ import json
 import os
 import subprocess
 import sys
+import time
 
 # keys (in priority order) that carry each bench's speedup/gate story
 _RATIO_KEYS = (
@@ -34,7 +40,7 @@ _RATIO_KEYS = (
     "ratio_solves_vs_single_lane", "ratio_solves_vs_single_host",
     "overhead_pct",
     "single_speedup_vs_refactor", "speedup_vs_naive",
-    "speedup_vs_xla_trsm",
+    "speedup_vs_xla_trsm", "speedup_vs_staged_factor",
     "transitions_won",
 )
 _GATE_KEYS = (
@@ -51,6 +57,19 @@ def _git_date(repo: str, path: str) -> str:
             cwd=repo, capture_output=True, text=True, timeout=30)
         return out.stdout.strip() or "-"
     except Exception:  # noqa: BLE001 — the date column is best-effort
+        return "-"
+
+
+def _file_date(repo: str, path: str) -> str:
+    """Fallback row date for records that predate the in-record 'date'
+    stamp: the file's last git-commit date, else its mtime."""
+    git = _git_date(repo, path)
+    if git != "-":
+        return git
+    try:
+        return time.strftime("%Y-%m-%d",
+                             time.localtime(os.path.getmtime(path)))
+    except OSError:
         return "-"
 
 
@@ -87,10 +106,19 @@ def build_rows(repo: str) -> list:
         name = os.path.basename(path)
         if "_smoke" in name or name.startswith("BENCH_r0"):
             continue
-        date = _git_date(repo, path)
+        fallback = None  # lazy: git/mtime lookups only when needed
         for rec in _records(path):
             if not isinstance(rec, dict) or "metric" not in rec:
                 continue
+            # row date comes from the RECORD (bench_engine stamps the
+            # run date into the JSON), so regenerating the report never
+            # churns date columns for untouched benches; records that
+            # predate the stamp fall back to git date, then mtime
+            date = rec.get("date")
+            if not date:
+                if fallback is None:
+                    fallback = _file_date(repo, path)
+                date = fallback
             rk, rv = _pick(rec, _RATIO_KEYS)
             gk, gv = _pick(rec, _GATE_KEYS)
             rows.append({
@@ -100,7 +128,7 @@ def build_rows(repo: str) -> list:
                          f" {rec.get('unit', '')}".strip(),
                 "ratio": f"{rk}={rv}" if rk != "-" else "-",
                 "gate": f"{gk}={gv}" if gk != "-" else "-",
-                "date": date,
+                "date": str(date),
             })
     return rows
 
