@@ -122,6 +122,9 @@ def __getattr__(name):
         "FleetDegraded": ("conflux_tpu.resilience", "FleetDegraded"),
         "HostLoadEstimator": ("conflux_tpu.control", "HostLoadEstimator"),
         "CounterWindow": ("conflux_tpu.profiler", "CounterWindow"),
+        "QosClass": ("conflux_tpu.qos", "QosClass"),
+        "FairShareLedger": ("conflux_tpu.qos", "FairShareLedger"),
+        "TenantThrottled": ("conflux_tpu.resilience", "TenantThrottled"),
     }
     if name in _lazy:
         import importlib
@@ -211,4 +214,7 @@ __all__ = [
     "FleetDegraded",
     "HostLoadEstimator",
     "CounterWindow",
+    "QosClass",
+    "FairShareLedger",
+    "TenantThrottled",
 ]

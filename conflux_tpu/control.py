@@ -72,10 +72,13 @@ did p50 just change".
 from __future__ import annotations
 
 import dataclasses
+import json
+import os
 import threading
 import time
 
 from conflux_tpu import profiler
+from conflux_tpu import qos as qos_mod
 from conflux_tpu.update import rank_bucket
 
 # the health counters whose window deltas count as "guard trips" — any
@@ -92,6 +95,120 @@ def _pow2_at_most(n: int) -> int:
     while p * 2 <= n:
         p *= 2
     return p
+
+
+# --------------------------------------------------------------------------- #
+# persistent operating point (autotune.py-style rule rows on disk)
+# --------------------------------------------------------------------------- #
+#
+# A restarted engine used to start at the cold constructor defaults and
+# spend the controller's first dozen windows re-climbing to wherever
+# yesterday's traffic had already settled. With
+# `AdaptiveController(persist=True)` the controller dumps its current
+# knob vector per REGIME to a small JSON beside the XLA cache dir and
+# re-seeds it at `attach` — the same discipline as `autotune.py`'s rule
+# table: strict row validation, most-recent-wins per regime, an env-var
+# override for tests, and unreadable/invalid files degrade to the cold
+# defaults (the store is advisory, never load-bearing).
+
+_OP_VERSION = 1
+
+# the knob subset a restart may safely re-seed: window/admission/QoS
+# knobs apply instantly and can never put a compile on the serving
+# path. Bucket caps (max_coalesce_width, max_factor_batch, max_stack)
+# are deliberately EXCLUDED — growing them is only ever allowed behind
+# the controller's prewarm gate, and a re-seeded cap would point at
+# programs the restarted process has not compiled yet.
+_SEED_KNOBS = ("max_batch_delay", "max_pending", "qos_contention")
+
+
+def operating_point_path() -> str:
+    """Where the operating-point rows live: beside the XLA cache dir
+    (`~/.cache/conflux_tpu/operating_point.json` by default), or
+    wherever `$CONFLUX_TPU_OPERATING_POINT` points (the test hook)."""
+    p = os.environ.get("CONFLUX_TPU_OPERATING_POINT")
+    if p:
+        return p
+    from conflux_tpu import cache
+
+    return os.path.join(os.path.dirname(cache.default_cache_dir()),
+                        "operating_point.json")
+
+
+def _validate_op_row(row) -> bool:
+    """One rule row: {'regime': str, 'knobs': dict, 'updated': str}.
+    Unknown fields reject the row (the autotune.py strictness: a
+    half-understood row is worse than a cold start)."""
+    if not isinstance(row, dict) or set(row) != {"regime", "knobs",
+                                                "updated"}:
+        return False
+    if not isinstance(row["regime"], str) or not row["regime"]:
+        return False
+    if not isinstance(row["updated"], str):
+        return False
+    k = row["knobs"]
+    if not isinstance(k, dict):
+        return False
+    for key, v in k.items():
+        if key == "qos_tier_delay":
+            if not (isinstance(v, dict)
+                    and all(t in qos_mod.TIERS for t in v)
+                    and all(isinstance(x, (int, float)) and x >= 0
+                            for x in v.values())):
+                return False
+        elif key not in _SEED_KNOBS \
+                or not isinstance(v, (int, float)) \
+                or isinstance(v, bool):
+            return False
+    return True
+
+
+def load_operating_point(regime: str, path: str | None = None) -> dict:
+    """The saved knob vector for `regime` ({} when absent/invalid —
+    callers fall back to the cold defaults)."""
+    path = operating_point_path() if path is None else path
+    try:
+        with open(path, encoding="utf-8") as f:
+            doc = json.load(f)
+    except (OSError, ValueError):
+        return {}
+    if not isinstance(doc, dict) or doc.get("version") != _OP_VERSION \
+            or not isinstance(doc.get("rows"), list):
+        return {}
+    for row in doc["rows"]:
+        if _validate_op_row(row) and row["regime"] == regime:
+            return dict(row["knobs"])
+    return {}
+
+
+def save_operating_point(regime: str, knobs: dict,
+                         path: str | None = None) -> str:
+    """Upsert `regime`'s row (read-modify-write, atomic tmp+rename so
+    a crashed writer never leaves a torn table) and return the path."""
+    path = operating_point_path() if path is None else path
+    row = {"regime": regime,
+           "knobs": {k: v for k, v in knobs.items()
+                     if k in _SEED_KNOBS + ("qos_tier_delay",)
+                     and v is not None},
+           "updated": time.strftime("%Y-%m-%dT%H:%M:%S")}
+    if not _validate_op_row(row):
+        raise ValueError(f"unsaveable knob vector {knobs!r}")
+    rows = []
+    try:
+        with open(path, encoding="utf-8") as f:
+            doc = json.load(f)
+        if isinstance(doc, dict) and doc.get("version") == _OP_VERSION:
+            rows = [r for r in doc.get("rows", ())
+                    if _validate_op_row(r) and r["regime"] != regime]
+    except (OSError, ValueError):
+        pass
+    rows.append(row)
+    os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+    tmp = f"{path}.tmp.{os.getpid()}"
+    with open(tmp, "w", encoding="utf-8") as f:
+        json.dump({"version": _OP_VERSION, "rows": rows}, f, indent=1)
+    os.replace(tmp, path)
+    return path
 
 
 @dataclasses.dataclass(frozen=True)
@@ -161,6 +278,16 @@ class AdaptiveController:
         sampling relaxes.
     min_window_samples: latency samples a window needs before its p99
         is trusted to steer the delay knob.
+    persist: opt into the on-disk operating point (see
+        :func:`operating_point_path`): `attach` re-seeds the safe knob
+        subset from the saved row for `regime`, and every
+        `persist_every`-th tick (and `close`) dumps the current vector
+        back. Default off — a `persist=False` controller touches no
+        files, exactly the pre-§30 behavior.
+    regime: the operating-point row key (defaults to a key derived
+        from the SLO and the engine's lane count at attach — restarts
+        of the same deployment shape share a row; distinct shapes
+        never cross-seed).
     """
 
     def __init__(self, *, slo_p99_ms: float = 25.0,
@@ -180,7 +307,10 @@ class AdaptiveController:
                  stack_after: int = 2,
                  unstack_after: int = 30,
                  min_window_samples: int = 8,
-                 decision_log: int = 256):
+                 decision_log: int = 256,
+                 persist: bool = False,
+                 regime: str | None = None,
+                 persist_every: int = 40):
         if slo_p99_ms <= 0 or interval <= 0:
             raise ValueError("slo_p99_ms and interval must be > 0")
         if not 0 < headroom <= 1:
@@ -243,6 +373,21 @@ class AdaptiveController:
         # debounced widen-pressure count
         self._lane_prev: dict = {}      # guarded-by: _lock
         self._lane_widen: dict = {}     # guarded-by: _lock
+        # multi-tenant QoS steering state (DESIGN §30): one per-class
+        # StatsWindow (key -> window) opened lazily once the engine
+        # reports QoS traffic, plus the debounce counters for the
+        # contention / batch-stretch knobs
+        self._qos_windows: dict = {}    # guarded-by: _lock
+        self._qos_hot = 0               # guarded-by: _lock
+        self._qos_calm = 0              # guarded-by: _lock
+        self._qos_batch_pressure = 0    # guarded-by: _lock
+        self._qos_batch_idle = 0        # guarded-by: _lock
+        # persistent operating point (DESIGN §30): the regime row this
+        # controller seeds from / dumps to, or None when persist=False
+        self.persist = bool(persist)
+        self._regime = regime           # resolved at attach when None
+        self._persist_every = max(1, int(persist_every))
+        self._reseeded: dict = {}       # guarded-by: _lock (last seed)
 
         self._stop = threading.Event()
         self._thread: threading.Thread | None = None
@@ -265,7 +410,67 @@ class AdaptiveController:
             self._engine_ref = weakref.ref(engine)
             self._window = profiler.StatsWindow(engine)
             self._strict_health = engine.health
+            if self._regime is None:
+                # same deployment shape -> same row; distinct shapes
+                # (different SLO or lane fan-out) never cross-seed
+                self._regime = (f"slo{self.slo_p99_ms:g}"
+                                f"-l{max(1, len(engine._lanes))}")
+        if self.persist:
+            self._reseed(engine)
         return self
+
+    def _reseed(self, engine) -> None:
+        """Apply the saved operating point for this regime (if any),
+        clamped to the limits envelope so a stale or hand-edited row
+        can never steer outside what the live controller would."""
+        row = load_operating_point(self._regime)
+        if not row:
+            return
+        lim = self.limits
+        seed: dict = {}
+        if "max_batch_delay" in row:
+            seed["max_batch_delay"] = min(
+                lim.max_batch_delay,
+                max(lim.min_batch_delay, float(row["max_batch_delay"])))
+        if "max_pending" in row:
+            seed["max_pending"] = min(
+                lim.max_pending,
+                max(lim.min_pending, int(row["max_pending"])))
+        if "qos_contention" in row:
+            seed["qos_contention"] = min(
+                1.0, max(0.05, float(row["qos_contention"])))
+        if "qos_tier_delay" in row:
+            seed["qos_tier_delay"] = {
+                t: min(lim.max_batch_delay, float(v))
+                for t, v in row["qos_tier_delay"].items()}
+        if not seed:
+            return
+        try:
+            engine.set_knobs(**seed)
+        except Exception:  # noqa: BLE001 — a bad row must not kill attach
+            with self._lock:
+                self._errors += 1
+            return
+        with self._lock:
+            self._reseeded = seed
+        self._record("operating_point", None, seed,
+                     f"re-seeded regime {self._regime!r} from "
+                     f"{operating_point_path()}")
+
+    def _persist_tick(self, eng, final: bool = False) -> None:
+        """Dump the current knob vector for this regime — every
+        `persist_every`-th tick and once at close."""
+        if not self.persist or self._regime is None:
+            return
+        with self._lock:
+            due = final or (self._ticks % self._persist_every == 0)
+        if not due:
+            return
+        try:
+            save_operating_point(self._regime, eng.knobs())
+        except Exception:  # noqa: BLE001 — persistence is best-effort
+            with self._lock:
+                self._errors += 1
 
     def start(self) -> None:
         """Spawn the control-loop daemon thread (idempotent)."""
@@ -284,6 +489,12 @@ class AdaptiveController:
         t = self._thread
         if t is not None and t.is_alive():
             t.join(timeout)
+        if self.persist:
+            with self._lock:
+                ref = self._engine_ref
+            eng = None if ref is None else ref()
+            if eng is not None:
+                self._persist_tick(eng, final=True)
 
     def _loop(self) -> None:
         while not self._stop.wait(self.interval):
@@ -327,6 +538,8 @@ class AdaptiveController:
         self._decide_factor_batches(eng, d, e)
         self._decide_stacking(eng, d, e)
         self._decide_health(eng, d, e)
+        self._decide_qos(eng, d, e)
+        self._persist_tick(eng)
         return d
 
     def _record(self, knob: str, old, new, reason: str) -> None:
@@ -812,6 +1025,113 @@ class AdaptiveController:
             f"{lim.staging_stride} batches (device verdict still "
             "exact; any trip restores instantly)")
 
+    # -- per-class QoS steering (DESIGN §30) ---------------------------- #
+
+    def _decide_qos(self, eng, d, e) -> None:
+        """Steer the two QoS knobs off per-class telemetry windows:
+
+        * SLO pressure: any latency-SLO class whose windowed p99 runs
+          inside `headroom` of its SLO for two consecutive ticks means
+          bulk work is crowding it out — halve `qos_contention` so the
+          fair-share ledger bites earlier; relax it back (x1.5, cap
+          0.5) after `relax_health_after` comfortable windows.
+        * Batch stretch: batch-tier traffic that still coalesces under
+          `coalesce_target` can afford to wait longer — grow the
+          `batch` tier delay override; clear it after `unstack_after`
+          batch-idle windows.
+        """
+        qc = eng.counters().get("qos")
+        if qc is None:
+            return  # no classified traffic yet: nothing to steer
+        with self._lock:
+            for key in qc.get("classes", {}):
+                if key not in self._qos_windows:
+                    # same lock shape as attach(): a per-class window
+                    # constructed under the controller lock takes the
+                    # engine lock once to snapshot
+                    self._qos_windows[key] = profiler.StatsWindow(
+                        eng, qos_class=key)
+            windows = dict(self._qos_windows)
+        hot = comfortable = False
+        batch_busy = batch_under = False
+        slo_by_key = {k: row.get("slo_ms")
+                      for k, row in qc.get("classes", {}).items()}
+        tier_by_key = {k: row.get("tier")
+                       for k, row in qc.get("classes", {}).items()}
+        for key, w in windows.items():
+            we = w.delta()["engine"]
+            slo_ms = slo_by_key.get(key)
+            if (slo_ms is not None
+                    and we["latency_samples"] >= self.min_window_samples):
+                p99 = we["latency_p99_ms"]
+                if p99 >= self.headroom * slo_ms:
+                    hot = True
+                elif p99 < 0.5 * self.headroom * slo_ms:
+                    comfortable = True
+            if tier_by_key.get(key) == "batch" and we["qos_requests"]:
+                batch_busy = True
+                if (e["coalesced_mean"]
+                        and e["coalesced_mean"] < self.coalesce_target):
+                    batch_under = True
+        knobs = eng.knobs()
+        contention = knobs.get("qos_contention", 0.5)
+        tier_delay = knobs.get("qos_tier_delay") or {}
+        with self._lock:
+            self._qos_hot = self._qos_hot + 1 if hot else 0
+            self._qos_calm = (0 if hot or not comfortable
+                              else self._qos_calm + 1)
+            self._qos_batch_pressure = (
+                self._qos_batch_pressure + 1 if batch_under else 0)
+            self._qos_batch_idle = (
+                0 if batch_busy else self._qos_batch_idle + 1)
+            hot_n, calm_n = self._qos_hot, self._qos_calm
+            bp, bi = self._qos_batch_pressure, self._qos_batch_idle
+        if hot_n >= 2 and contention > 0.1:
+            new = max(0.1, 0.5 * contention)
+            eng.set_knobs(qos_contention=new)
+            self._record(
+                "qos_contention", contention, new,
+                f"{hot_n} windows with a latency class p99 inside "
+                f"{self.headroom:g}x of its SLO — the fair-share "
+                "ledger now bites earlier")
+            with self._lock:
+                self._qos_hot = 0
+        elif calm_n >= self.relax_health_after and contention < 0.5:
+            new = min(0.5, 1.5 * contention)
+            eng.set_knobs(qos_contention=new)
+            self._record(
+                "qos_contention", contention, new,
+                f"{calm_n} comfortable windows — admission pressure "
+                "relaxed toward the default")
+            with self._lock:
+                self._qos_calm = 0
+        cur_batch = tier_delay.get("batch")
+        if bp >= self.grow_after:
+            base = (cur_batch if cur_batch is not None else min(
+                eng.max_batch_delay * qos_mod.BATCH_STRETCH,
+                qos_mod.MAX_TIER_DELAY))
+            new_delay = min(self.limits.max_batch_delay,
+                            max(base * self.delay_grow,
+                                base + self.delay_floor_step))
+            if new_delay > (cur_batch or 0.0):
+                eng.set_knobs(qos_tier_delay={"batch": new_delay})
+                self._record(
+                    "qos_tier_delay[batch]", cur_batch, new_delay,
+                    f"{bp} windows of batch-tier traffic coalescing "
+                    f"under target {self.coalesce_target:g} — batch "
+                    "classes wait longer for fuller devices")
+            with self._lock:
+                self._qos_batch_pressure = 0
+        elif cur_batch is not None and bi >= self.unstack_after:
+            eng.set_knobs(qos_tier_delay={"batch": None})
+            self._record(
+                "qos_tier_delay[batch]", cur_batch, None,
+                f"{bi} windows without batch-tier traffic — the "
+                "stretch override is retired until it earns its way "
+                "back")
+            with self._lock:
+                self._qos_batch_idle = 0
+
     # ------------------------------------------------------------------ #
     # observability
     # ------------------------------------------------------------------ #
@@ -829,6 +1149,12 @@ class AdaptiveController:
                 "relaxed_guards": self._relaxed,
                 "drain_rate": self._drain_rate,
                 "slo_p99_ms": self.slo_p99_ms,
+                "qos_windows": sorted(self._qos_windows),
+                "persist": {
+                    "enabled": self.persist,
+                    "regime": self._regime,
+                    "reseeded": dict(self._reseeded),
+                } if self.persist else {"enabled": False},
                 "last_window": dict(self._last_window),
                 "decisions_log": [
                     {"t": t, "knob": k, "old": o, "new": n, "reason": r}
@@ -886,6 +1212,10 @@ class HostLoadEstimator:
         self._lock = threading.Lock()
         self._rate: dict[str, float] = {}     # guarded-by: _lock
         self._pending: dict[str, int] = {}    # guarded-by: _lock
+        # host -> tier -> smoothed drain rate, fed from the flat
+        # qos_<tier>_solves heartbeat counters (DESIGN §30); empty for
+        # hosts that never report classified traffic
+        self._tier_rate: dict[str, dict[str, float]] = {}  # guarded-by: _lock
 
     def feed(self, host: str, delta: dict) -> None:
         """Fold one heartbeat counter-delta window for ``host``.
@@ -899,6 +1229,10 @@ class HostLoadEstimator:
         secs = max(1e-9, float(delta.get("seconds", 0.0) or 0.0))
         rate = float(delta.get("solves", 0) or 0) / secs
         pending = int(delta.get("pending", 0) or 0)
+        tiers = {k[len("qos_"):-len("_solves")]:
+                 float(v or 0) / secs
+                 for k, v in delta.items()
+                 if k.startswith("qos_") and k.endswith("_solves")}
         with self._lock:
             prev = self._rate.get(host)
             if prev is None:
@@ -906,12 +1240,19 @@ class HostLoadEstimator:
             else:
                 self._rate[host] = self.ema * rate + (1 - self.ema) * prev
             self._pending[host] = pending
+            if tiers:
+                cur = self._tier_rate.setdefault(host, {})
+                for t, r in tiers.items():
+                    p = cur.get(t)
+                    cur[t] = r if p is None else (
+                        self.ema * r + (1 - self.ema) * p)
 
     def forget(self, host: str) -> None:
         """Drop a dead host's state so it doesn't skew future picks."""
         with self._lock:
             self._rate.pop(host, None)
             self._pending.pop(host, None)
+            self._tier_rate.pop(host, None)
 
     def retry_after(self, backlog: int = 1,
                     hosts: "list[str] | None" = None) -> float:
@@ -937,6 +1278,10 @@ class HostLoadEstimator:
     def stats(self) -> dict:
         """Per-host smoothed rates and pending depths (telemetry)."""
         with self._lock:
-            return {h: {"drain_per_s": self._rate[h],
-                        "pending": self._pending.get(h, 0)}
-                    for h in sorted(self._rate)}
+            out = {h: {"drain_per_s": self._rate[h],
+                       "pending": self._pending.get(h, 0)}
+                   for h in sorted(self._rate)}
+            for h, tiers in self._tier_rate.items():
+                if h in out:
+                    out[h]["qos_drain_per_s"] = dict(sorted(tiers.items()))
+            return out

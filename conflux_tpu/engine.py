@@ -119,6 +119,7 @@ import jax
 import jax.numpy as jnp
 
 from conflux_tpu import profiler, resilience
+from conflux_tpu import qos as qos_mod
 from conflux_tpu.batched import _shard_batch, put_tree, stack_trees, \
     unstack_tree
 from conflux_tpu.gang import SessionGang
@@ -187,11 +188,19 @@ class EngineSaturated(RuntimeError):
     """submit() refused: the bounded pending set is full (shed policy).
     `retry_after` is an exponential-backoff hint in seconds — it doubles
     with every consecutive shed and resets on the next admission, so a
-    retrying client herd spreads out instead of hammering the bound."""
+    retrying client herd spreads out instead of hammering the bound.
+    `tenant`/`qos_class` carry the shed attribution when the request
+    was QoS-classified (DESIGN §30; None on unclassified traffic), so
+    a global-bound shed is auditable per class next to the fair-share
+    `TenantThrottled` sheds."""
 
-    def __init__(self, msg: str, retry_after: float = 0.0):
+    def __init__(self, msg: str, retry_after: float = 0.0,
+                 tenant: str | None = None,
+                 qos_class: str | None = None):
         super().__init__(msg)
         self.retry_after = retry_after
+        self.tenant = tenant
+        self.qos_class = qos_class
 
 
 class EngineClosed(RuntimeError):
@@ -211,6 +220,7 @@ class _Request:
     carried: bool = False  # deferred once already — never defer again
     lane: Any = None      # the DeviceLane that owns this request
     lane_slot: bool = False  # counted against the lane's pending slice
+    qos: Any = None       # QosClass (DESIGN §30) or None
 
     __hash__ = object.__hash__
 
@@ -234,6 +244,7 @@ class _FactorRequest:
     pool: bool = False    # admitted into the work-stealing factor pool
     sid: Any = None       # stable session id for the opened session
     device: Any = None    # explicit device pin for the opened session
+    qos: Any = None       # QosClass (DESIGN §30) or None
 
     __hash__ = object.__hash__
 
@@ -396,6 +407,33 @@ class DeviceLane:
         d = self.delay_override
         return self.eng.max_batch_delay if d is None else d
 
+    # hot-path
+    def _collect_delay(self, r) -> float:
+        """The request's collect delay inside this lane's window:
+        exactly `self.delay` for unclassified requests (the qos=None
+        path resolves in one attribute check), else the class's tier
+        delay (DESIGN §30 — latency rides ~0, batch pads the window
+        out). Priority-aware coalescing happens HERE, inside the one
+        existing window, not in per-class queues: the window's
+        effective deadline is the MIN over its members' class delays."""
+        if r.qos is None:
+            return self.delay
+        st = self.eng._qos
+        # racy read of the tier-override dict (a knob, like max_batch_
+        # delay itself): a concurrent set_knobs lands on the next window
+        return qos_mod.collect_delay(
+            r.qos, self.delay, st.tier_delay if st is not None else {})
+
+    # hot-path
+    def _carry_delay(self, reqs) -> float:
+        """The window to give a carried batch: the MIN of its members'
+        collect delays (== `self.delay` when none are classified)."""
+        d = self.delay
+        for r in reqs:
+            if r.qos is not None:
+                d = min(d, self._collect_delay(r))
+        return d
+
     def _tname(self, role: str) -> str:
         """Worker thread name: the pre-fleet names on a single-lane
         engine (ops tooling and tests key on them), lane-suffixed on a
@@ -500,7 +538,8 @@ class DeviceLane:
             if carry:
                 try:
                     first = self._inq.get(
-                        timeout=self._wait_bound(carry, self.delay))
+                        timeout=self._wait_bound(
+                            carry, self._carry_delay(carry)))
                 except Empty:
                     first = None  # window spent waiting on the carry
             else:
@@ -516,7 +555,11 @@ class DeviceLane:
             elif first is not _WAKE:
                 batch.append(first)
             if collect:
-                deadline = time.perf_counter() + self.delay
+                # the window's effective deadline is the MIN over its
+                # members' class collect delays (== self.delay when
+                # nothing is classified — _carry_delay is one attribute
+                # check per member on the qos=None path)
+                deadline = time.perf_counter() + self._carry_delay(batch)
                 while True:
                     batch = self._prune_expired(batch)
                     remaining = deadline - time.perf_counter()
@@ -543,6 +586,12 @@ class DeviceLane:
                     if r is _WAKE:
                         continue  # pooled work is drawn at dispatch time
                     batch.append(r)
+                    if r.qos is not None:
+                        # a latency-class arrival pulls the whole
+                        # window in; batch-class arrivals never push an
+                        # already-set deadline out
+                        deadline = min(deadline, time.perf_counter()
+                                       + self._collect_delay(r))
                     if len(batch) >= eng.max_pending:
                         break
             if batch:
@@ -1376,6 +1425,13 @@ class DeviceLane:
                     eng._factor_latencies.append(now - r.t_submit)
             eng._flat_seq += len(owned)
             eng._completed += len(owned)
+            st = eng._qos
+            if st is not None:
+                # classified cold starts settle against the same
+                # per-class rings/ledger as solves (DESIGN §30)
+                for r in owned:
+                    if r.qos is not None:
+                        st.record_settle(r.qos, now - r.t_submit)
         plan = fb.plan
         trees = unstack_tree(fb.factors, len(fb.reqs))
         for i, r in entries:
@@ -1728,6 +1784,11 @@ class ServeEngine:
         # (thread name, exc) post-mortem: write-once by the dying worker,
         # racy reads tolerate staleness by design — not lock-guarded
         self._dead: tuple | None = None
+        # multi-tenant QoS state (DESIGN §30): stays None until the
+        # first CLASSIFIED submission, so the qos=None engine carries no
+        # new state and every hot-path branch is one attribute check
+        self._qos = None                # guarded-by: _lock
+        self._qos_latency_window = int(latency_window)
 
         profiler.register_engine(self)
         for lane in self._lanes:
@@ -1755,7 +1816,8 @@ class ServeEngine:
     # ------------------------------------------------------------------ #
 
     # hot-path (admission: host work only, no device syncs)
-    def submit(self, session, b, *, deadline: float | None = None) -> Future:
+    def submit(self, session, b, *, deadline: float | None = None,
+               qos=None) -> Future:
         """Enqueue one solve against `session`; returns a Future whose
         result is a HOST (numpy) array with the shape and values
         `session.solve(b)` would have returned. A served answer crosses
@@ -1773,7 +1835,17 @@ class ServeEngine:
         backoff hint); blocks under 'block'. With a
         :class:`HealthPolicy`, a non-finite RHS raises
         :class:`RhsNonFinite` here and a quarantined session
-        :class:`SessionQuarantined`."""
+        :class:`SessionQuarantined`.
+
+        `qos=` classifies the request (DESIGN §30,
+        :class:`conflux_tpu.qos.QosClass`): the tenant joins the
+        weighted fair-share ledger — an over-share tenant on a
+        contended engine is shed with a structured
+        :class:`~conflux_tpu.resilience.TenantThrottled` — and the
+        tier picks the request's collect delay inside the lane's
+        coalescing window (latency ~0, throughput the engine window,
+        batch a stretched window). `qos=None` (the default) keeps
+        every path byte-identical to the unclassified engine."""
         # conflint: disable=CFX-LOCK benign racy fast-fail; _admit re-checks locked
         if self._closed:
             raise EngineClosed("submit() on a closed ServeEngine")
@@ -1797,9 +1869,13 @@ class ServeEngine:
             raise RhsNonFinite(
                 "rhs contains NaN/Inf — rejected at admission (a poisoned "
                 "request would corrupt every co-batched answer)")
+        if qos is not None and not isinstance(qos, qos_mod.QosClass):
+            raise TypeError(f"qos must be a conflux_tpu.qos.QosClass "
+                            f"(or None), got {type(qos).__name__}")
         now = time.perf_counter()
         req = _Request(session, b2, int(b2.shape[-1]), squeeze, Future(),
-                       now, None if deadline is None else now + deadline)
+                       now, None if deadline is None else now + deadline,
+                       qos=qos)
         # resolve the owning lane BEFORE admission (placement may move a
         # not-yet-pinned session's state — device work, so never under
         # the admission lock), so every live request is lane-attributed
@@ -1845,7 +1921,8 @@ class ServeEngine:
                     raise EngineSaturated(
                         f"{self._pending} pending requests >= max_pending="
                         f"{self.max_pending} (shed policy 'reject'; "
-                        f"{why})", retry_after=hint)
+                        f"{why})", retry_after=hint,
+                        **self._qos_shed_attr(req))
                 while self._pending >= self.max_pending \
                         and not self._closed:
                     self._not_full.wait()
@@ -1853,8 +1930,9 @@ class ServeEngine:
                     raise EngineClosed("engine closed while blocked")
             lane = getattr(req, "lane", None)
             slice_cap = self.max_lane_pending
-            if (slice_cap is not None and lane is not None
-                    and len(self._lanes) > 1):
+            take_slot = (slice_cap is not None and lane is not None
+                         and len(self._lanes) > 1)
+            if take_slot:
                 # the per-lane pending slice: one hot lane's backlog
                 # sheds ITS OWN overflow instead of filling the global
                 # bound and starving every other lane's admission
@@ -1868,12 +1946,19 @@ class ServeEngine:
                             f"lane {lane.index} holds {lane.pending} "
                             f"pending >= max_lane_pending={slice_cap} "
                             f"(per-lane slice; other lanes keep "
-                            f"admitting — {why})", retry_after=hint)
+                            f"admitting — {why})", retry_after=hint,
+                            **self._qos_shed_attr(req))
                     while lane.pending >= slice_cap \
                             and not self._closed:
                         self._not_full.wait()
                     if self._closed:
                         raise EngineClosed("engine closed while blocked")
+            # weighted fair-share admission (DESIGN §30): runs LAST so
+            # a throttle has committed nothing to roll back; the
+            # qos=None path is one attribute check
+            if req.qos is not None:
+                self._qos_admit_locked(req)
+            if take_slot:
                 req.lane_slot = True
                 lane.pending += 1
             self._consec_sheds = 0
@@ -1906,6 +1991,61 @@ class ServeEngine:
             why = (f"retry in ~{1e3 * hint:.0f}ms, backoff "
                    "hint doubles per consecutive shed")
         return hint, why
+
+    def _qos_shed_attr(self, req) -> dict:
+        """Saturation-shed attribution (DESIGN §30): {} on the
+        qos=None path (EngineSaturated raises exactly as before);
+        tenant/qos_class kwargs — plus the lazy per-class health count
+        `engine_saturated[tenant/tier]` — for a classified request, so
+        a global-bound shed is auditable next to the fair-share
+        TenantThrottled sheds."""
+        if req.qos is None:
+            return {}
+        key = req.qos.key
+        resilience.bump(f"engine_saturated[{key}]")
+        return {"tenant": req.qos.tenant, "qos_class": key}
+
+    # requires-lock: _lock
+    def _qos_admit_locked(self, req) -> None:
+        """Weighted fair-share admission for a CLASSIFIED request (the
+        qos=None path never calls this). Lazily creates the engine's
+        QoS state, interns the class (latest declaration of a
+        tenant/tier key wins), and consults the ledger: a throttle
+        raises :class:`~conflux_tpu.resilience.TenantThrottled` with a
+        `retry_after` sized from the tenant's weighted fraction of the
+        measured drain rate — by then roughly one of the tenant's OWN
+        slots should have freed. Throttling is a policy outcome, so it
+        applies under both on_full policies ('block' waits out global
+        saturation but never a fair-share violation — blocking would
+        let the over-quota tenant queue in front of everyone else)."""
+        st = self._qos
+        if st is None:
+            st = self._qos = qos_mod.EngineQosState(
+                self._qos_latency_window)
+        cls = st.intern(req.qos)
+        req.qos = cls
+        over = st.ledger.try_admit(cls, self._pending, self.max_pending)
+        if over is None:
+            st.record_admit(cls)
+            return
+        st.record_throttle(cls)
+        rate = self._drain_rate
+        frac = st.ledger.frac(cls.tenant)
+        if rate is not None and rate * frac > 0.0:
+            hint = min(1.0, max(1e-4, over / (rate * frac)))
+            why = (f"retry in ~{1e3 * hint:.0f}ms, sized from the "
+                   f"tenant's {100 * frac:.0f}% share of the measured "
+                   f"drain rate {rate:.0f}/s")
+        else:
+            hint = min(1.0, 2e-3 * max(1.0, over))
+            why = (f"retry in ~{1e3 * hint:.0f}ms, scaled by the "
+                   "tenant's over-share backlog")
+        raise resilience.TenantThrottled(
+            f"tenant {cls.tenant!r} is at/over its fair share "
+            f"({st.ledger.share(cls.tenant, self.max_pending):.0f} of "
+            f"max_pending={self.max_pending}) while the engine is "
+            f"contended ({self._pending} pending; {why})",
+            retry_after=hint, tenant=cls.tenant, qos_class=cls.key)
 
     def _note_exclusion(self, reason: str) -> None:
         """Count one stacking exclusion: a session the gang path COULD
@@ -2051,7 +2191,7 @@ class ServeEngine:
     # hot-path (admission: host work only, no device syncs)
     def submit_factor(self, plan, A, *, policy=None,
                       deadline: float | None = None,
-                      sid=None, device=None) -> Future:
+                      sid=None, device=None, qos=None) -> Future:
         """Enqueue one factorization against `plan`; returns a Future
         whose result is a device-resident
         :class:`~conflux_tpu.serve.SolveSession` — exactly what
@@ -2083,7 +2223,12 @@ class ServeEngine:
         lane has a free dispatch round takes it (work-stealing);
         `sid=` pins the opened session by consistent hash
         (`place_session` — deterministic across restarts), `device=`
-        pins it explicitly."""
+        pins it explicitly.
+
+        `qos=` classifies the cold start exactly as on :meth:`submit`
+        (DESIGN §30): the tenant's factor churn counts against the
+        same fair-share ledger as its solves, so a bulk tenant cannot
+        starve the latency class by flooding session opens instead."""
         # conflint: disable=CFX-LOCK benign racy fast-fail; _admit re-checks locked
         if self._closed:
             raise EngineClosed("submit_factor() on a closed ServeEngine")
@@ -2116,10 +2261,13 @@ class ServeEngine:
             raise RhsNonFinite(
                 "matrix contains NaN/Inf — rejected at admission (a "
                 "poisoned system would waste a coalesced factor dispatch)")
+        if qos is not None and not isinstance(qos, qos_mod.QosClass):
+            raise TypeError(f"qos must be a conflux_tpu.qos.QosClass "
+                            f"(or None), got {type(qos).__name__}")
         now = time.perf_counter()
         req = _FactorRequest(plan, A2, policy, Future(), now,
                              None if deadline is None else now + deadline,
-                             sid=sid, device=device)
+                             sid=sid, device=device, qos=qos)
         # lane resolution (multi-lane): an explicit device pins the lane,
         # a sid pins it by consistent hash, otherwise the request joins
         # the shared pool and the lanes load-balance it between them
@@ -2142,18 +2290,19 @@ class ServeEngine:
 
     def factor(self, plan, A, timeout: float | None = None, *,
                policy=None, deadline: float | None = None,
-               sid=None, device=None):
+               sid=None, device=None, qos=None):
         """Blocking convenience (the mirror of :meth:`solve`):
         ``submit_factor(plan, A).result(timeout)`` — returns the opened
         :class:`~conflux_tpu.serve.SolveSession`."""
         return self.submit_factor(plan, A, policy=policy,
                                   deadline=deadline, sid=sid,
-                                  device=device).result(timeout)
+                                  device=device, qos=qos).result(timeout)
 
     def solve(self, session, b, timeout: float | None = None,
-              deadline: float | None = None):
+              deadline: float | None = None, qos=None):
         """Blocking convenience: ``submit(session, b).result(timeout)``."""
-        return self.submit(session, b, deadline=deadline).result(timeout)
+        return self.submit(session, b, deadline=deadline,
+                           qos=qos).result(timeout)
 
     # futures-owner
     def close(self, timeout: float | None = None) -> list:
@@ -2208,6 +2357,8 @@ class ServeEngine:
                   health: HealthPolicy | None = None,
                   staging_stride: int | None = None,
                   drain_rate: float | None = None,
+                  qos_contention: float | None = None,
+                  qos_tier_delay: dict | None = None,
                   lane: int | None = None) -> dict:
         """Thread-safe knob actuation: the write half of the adaptive
         control loop (`conflux_tpu.control.AdaptiveController`), also a
@@ -2227,6 +2378,14 @@ class ServeEngine:
         (None leaves the current estimate in place). Returns the full
         knob dict after the move.
 
+        `qos_contention` moves the fair-share ledger's contention
+        fraction (the pending fraction of `max_pending` above which
+        over-share tenants throttle, DESIGN §30); `qos_tier_delay`
+        merges per-tier collect-delay overrides in seconds (keys from
+        `conflux_tpu.qos.TIERS`; a None value clears that tier's
+        override). Either knob lazily creates the engine's QoS state;
+        neither appears in the knob dict of an engine that has none.
+
         `lane=` scopes the move to ONE lane: only `max_batch_delay` may
         ride it (the per-lane coalescing window the adaptive controller
         tunes independently per device, DESIGN §25) — the write lands as
@@ -2244,7 +2403,9 @@ class ServeEngine:
                                             max_factor_batch,
                                             stack_sessions, max_stack,
                                             max_lane_pending, health,
-                                            staging_stride, drain_rate)):
+                                            staging_stride, drain_rate,
+                                            qos_contention,
+                                            qos_tier_delay)):
                 raise ValueError("lane= scopes exactly one knob: "
                                  "max_batch_delay")
             with self._lock:
@@ -2263,6 +2424,18 @@ class ServeEngine:
             raise ValueError("max_stack must be >= 1")
         if max_lane_pending is not None and max_lane_pending < 1:
             raise ValueError("max_lane_pending must be >= 1")
+        if qos_contention is not None \
+                and not 0 < qos_contention <= 1:
+            raise ValueError("qos_contention must be in (0, 1]")
+        if qos_tier_delay is not None:
+            for tier, v in qos_tier_delay.items():
+                if tier not in qos_mod.TIERS:
+                    raise ValueError(
+                        f"qos_tier_delay key {tier!r} is not one of "
+                        f"{qos_mod.TIERS}")
+                if v is not None and v < 0:
+                    raise ValueError("qos_tier_delay values must be "
+                                     ">= 0 seconds (or None to clear)")
         with self._lock:
             if max_batch_delay is not None:
                 self.max_batch_delay = float(max_batch_delay)
@@ -2293,11 +2466,24 @@ class ServeEngine:
                 self._staging_stride = int(staging_stride)
             if drain_rate is not None:
                 self._drain_rate = float(drain_rate)
+            if qos_contention is not None or qos_tier_delay is not None:
+                st = self._qos
+                if st is None:
+                    st = self._qos = qos_mod.EngineQosState(
+                        self._qos_latency_window)
+                if qos_contention is not None:
+                    st.ledger.contention = float(qos_contention)
+                if qos_tier_delay is not None:
+                    for tier, v in qos_tier_delay.items():
+                        if v is None:
+                            st.tier_delay.pop(tier, None)
+                        else:
+                            st.tier_delay[tier] = float(v)
             return self._knobs_locked()
 
     # requires-lock: _lock
     def _knobs_locked(self) -> dict:
-        return {"max_batch_delay": self.max_batch_delay,
+        out = {"max_batch_delay": self.max_batch_delay,
                 "max_pending": self.max_pending,
                 "max_coalesce_width": self.max_coalesce_width,
                 "max_factor_batch": self.max_factor_batch,
@@ -2313,6 +2499,12 @@ class ServeEngine:
                 "lane_delays": {ln.index: ln.delay_override
                                 for ln in self._lanes
                                 if ln.delay_override is not None}}
+        if self._qos is not None:
+            # present only with live QoS state, so a qos=None engine's
+            # knob dict is unchanged (knobs() equality in old tests)
+            out["qos_contention"] = self._qos.ledger.contention
+            out["qos_tier_delay"] = dict(self._qos.tier_delay)
+        return out
 
     def knobs(self) -> dict:
         """The current knob values (a consistent snapshot)."""
@@ -2676,6 +2868,13 @@ class ServeEngine:
         owned = self._take(reqs)
         with self._lock:
             self._failed += len(owned)
+            st = self._qos
+            if st is not None:
+                # per-class failure accounting + the ledger slot release
+                # (the DRR refill) — classified requests only
+                for r in owned:
+                    if r.qos is not None:
+                        st.record_fail(r.qos)
         for r in owned:
             r.future.set_exception(exc)
 
@@ -2689,6 +2888,14 @@ class ServeEngine:
                 self._latencies.append(now - r.t_submit)
             self._lat_seq += len(owned)
             self._completed += len(owned)
+            st = self._qos
+            if st is not None:
+                # per-class latency rings + completion counts + the
+                # ledger slot release (classified requests only; the
+                # qos=None path pays one attribute read)
+                for r in owned:
+                    if r.qos is not None:
+                        st.record_settle(r.qos, now - r.t_submit)
         for r, si, lo in spec:
             if r not in owned:
                 continue
@@ -2835,7 +3042,7 @@ class ServeEngine:
         a 4-times-a-second control loop sharing one core with the
         dispatch path."""
         with self._lock:
-            return {
+            out = {
                 "pending": self._pending,
                 "queue_peak": self._queue_peak,
                 "requests": self._requests,
@@ -2859,6 +3066,12 @@ class ServeEngine:
                 "factor_bucket_hits": dict(self._factor_bucket_hits),
                 "lanes": self._lane_rows_locked(),
             }
+            if self._qos is not None:
+                # present only once classified traffic (or a qos knob
+                # write) created the state — a qos=None engine's
+                # counter dict is unchanged
+                out["qos"] = self._qos.counters(self.max_pending)
+            return out
 
     # requires-lock: _lock
     def _gang_locked(self) -> dict:
@@ -2972,6 +3185,10 @@ class ServeEngine:
                 "lanes": self._lane_rows_locked(),
                 "knobs": self._knobs_locked(),
             }
+            if self._qos is not None:
+                # per-class counters + latency percentiles + SLO
+                # attainment (absent on a qos=None engine)
+                out["qos"] = self._qos.stats(self.max_pending)
         if self.residency is not None:
             # outside the engine lock: the manager takes its own
             # (engine-lock -> manager-lock never nests)
@@ -3015,6 +3232,34 @@ class ServeEngine:
         with self._lock:
             seq = self._flat_seq
             lats = list(self._factor_latencies)
+            if token is None:
+                return seq, lats
+            n = min(len(lats), max(0, seq - token))
+            return seq, lats[len(lats) - n:] if n else []
+
+    def qos_latency_samples(self) -> dict:
+        """Per-class rolling latency windows in seconds, keyed
+        'tenant/tier' ({} on a qos=None engine). The per-class twin of
+        :meth:`latency_samples`."""
+        with self._lock:
+            st = self._qos
+            if st is None:
+                return {}
+            return {k: list(d) for k, d in st.latencies.items()}
+
+    def qos_latency_window(self, key: str,
+                           token: int | None = None) -> tuple:
+        """:meth:`latency_window` for ONE QoS class's ring (`key` is
+        the 'tenant/tier' class key). A class the engine has not seen
+        (including every key on a qos=None engine) reads as (0, []),
+        so a per-class `profiler.StatsWindow` may open ahead of the
+        class's first request."""
+        with self._lock:
+            st = self._qos
+            if st is None or key not in st.latencies:
+                return 0, []
+            seq = st.lat_seq[key]
+            lats = list(st.latencies[key])
             if token is None:
                 return seq, lats
             n = min(len(lats), max(0, seq - token))

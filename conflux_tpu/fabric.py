@@ -79,6 +79,7 @@ from typing import Any
 import numpy as np
 
 from conflux_tpu import resilience, tier
+from conflux_tpu import qos as qos_mod
 from conflux_tpu.control import HostLoadEstimator
 from conflux_tpu.profiler import CounterWindow
 from conflux_tpu.resilience import (
@@ -94,6 +95,7 @@ from conflux_tpu.resilience import (
     SessionQuarantined,
     SessionSpilled,
     SolveUnhealthy,
+    TenantThrottled,
     bump,
     maybe_fault,
 )
@@ -254,12 +256,24 @@ class _HostCore:
         c = self.eng.counters()
         with self._lock:
             n = len(self._registry)
+        counters = {"pending": c["pending"],
+                    "solves": c["completed"],
+                    "requests": c["requests"],
+                    "failed": c["failed"],
+                    "shed": c["shed"]}
+        # per-tier drain counters ride as FLAT keys: CounterWindow on
+        # the front differences numeric keys only, so the front sees
+        # per-class drain rates without a payload schema change
+        qc = c.get("qos")
+        if qc is not None:
+            tiers: dict[str, int] = {}
+            for row in qc.get("classes", {}).values():
+                t = row.get("tier")
+                tiers[t] = tiers.get(t, 0) + int(row.get("completed", 0))
+            for t, done in sorted(tiers.items()):
+                counters[f"qos_{t}_solves"] = done
         return {"host_id": self.host_id, "sessions": n,
-                "counters": {"pending": c["pending"],
-                             "solves": c["completed"],
-                             "requests": c["requests"],
-                             "failed": c["failed"],
-                             "shed": c["shed"]}}
+                "counters": counters}
 
     def stats(self) -> dict:
         with self._lock:
@@ -298,8 +312,9 @@ class _HostCore:
                            f"{sid!r}")
         return s
 
-    def solve_async(self, sid: Any, b: np.ndarray) -> Future:
-        return self.eng.submit(self._session(sid), b)
+    def solve_async(self, sid: Any, b: np.ndarray,
+                    qos=None) -> Future:
+        return self.eng.submit(self._session(sid), b, qos=qos)
 
     def update(self, sid: Any, U: np.ndarray, V: np.ndarray,
                replace: bool = False) -> bool:
@@ -393,7 +408,7 @@ class HostHandle:
              timeout: float | None = None):
         raise NotImplementedError
 
-    def solve(self, sid, b, timeout: float | None = None):
+    def solve(self, sid, b, timeout: float | None = None, qos=None):
         raise NotImplementedError
 
     def update(self, sid, U, V, replace: bool = False,
@@ -487,10 +502,10 @@ class LocalHost(HostHandle):
         return self._engine_op(
             lambda c: c.open(sid, spec, A, policy))
 
-    def solve(self, sid, b, timeout: float | None = None):
+    def solve(self, sid, b, timeout: float | None = None, qos=None):
         from conflux_tpu.engine import EngineClosed
 
-        fut = self._alive_core().solve_async(sid, b)
+        fut = self._alive_core().solve_async(sid, b, qos=qos)
         try:
             return fut.result(timeout)
         except EngineClosed as e:
@@ -543,7 +558,7 @@ class LocalHost(HostHandle):
 def _encode_exc(e: BaseException) -> dict:
     extra: dict = {}
     for k in ("retry_after", "evidence", "live", "total", "host",
-              "surface"):
+              "surface", "tenant", "qos_class"):
         v = getattr(e, k, None)
         if v is not None:
             extra[k] = v
@@ -553,8 +568,12 @@ def _encode_exc(e: BaseException) -> dict:
 
 _WIRE_TYPES: dict[str, Any] = {
     "EngineSaturated": lambda m, x: _mk_engine_exc(
-        "EngineSaturated", m, x.get("retry_after", 0.0)),
+        "EngineSaturated", m, x.get("retry_after", 0.0),
+        tenant=x.get("tenant"), qos_class=x.get("qos_class")),
     "EngineClosed": lambda m, x: _mk_engine_exc("EngineClosed", m),
+    "TenantThrottled": lambda m, x: TenantThrottled(
+        m, retry_after=x.get("retry_after", 0.0),
+        tenant=x.get("tenant"), qos_class=x.get("qos_class")),
     "SessionQuarantined": lambda m, x: SessionQuarantined(
         m, retry_after=x.get("retry_after", 0.0)),
     "SessionSpilled": lambda m, x: SessionSpilled(
@@ -576,13 +595,15 @@ _WIRE_TYPES: dict[str, Any] = {
 }
 
 
-def _mk_engine_exc(name: str, msg: str, retry_after: float | None = None):
+def _mk_engine_exc(name: str, msg: str, retry_after: float | None = None,
+                   **attrs):
     from conflux_tpu import engine as _eng
 
     cls = getattr(_eng, name)
     if retry_after is None:
         return cls(msg)
-    return cls(msg, retry_after=retry_after)
+    return cls(msg, retry_after=retry_after,
+               **{k: v for k, v in attrs.items() if v is not None})
 
 
 def _raise_wire(reply: dict) -> None:
@@ -742,9 +763,10 @@ class ProcessHost(HostHandle):
         return self._call("open", timeout=timeout, sid=sid, spec=spec,
                           A=np.asarray(A), policy=policy)
 
-    def solve(self, sid, b, timeout: float | None = None):
+    def solve(self, sid, b, timeout: float | None = None, qos=None):
         return self._call("solve", timeout=timeout, sid=sid,
-                          b=np.asarray(b))
+                          b=np.asarray(b),
+                          qos=None if qos is None else qos.to_wire())
 
     def update(self, sid, U, V, replace: bool = False,
                timeout: float | None = None):
@@ -923,7 +945,11 @@ def worker_main(argv=None) -> int:
                 break
             if op == "solve":
                 try:
-                    fut = core.solve_async(msg["sid"], msg["b"])
+                    q = msg.get("qos")
+                    fut = core.solve_async(
+                        msg["sid"], msg["b"],
+                        qos=None if q is None
+                        else qos_mod.class_from_wire(q))
                 # conflint: disable=CFX-EXCEPT worker op boundary: admission failures are wired back to the front
                 except BaseException as e:
                     _send_locked(conn, send_lock,
@@ -1181,16 +1207,23 @@ class ServeFabric:
         if br is not None:
             br.record_failure()
 
-    def solve(self, sid, b, timeout: float | None = None):
+    def solve(self, sid, b, timeout: float | None = None, qos=None):
         """One routed solve. Transport failure on the owning host maps
         to :class:`HostUnavailable` with a measured-drain retry hint;
-        the host's own structured errors pass through untouched."""
+        the host's own structured errors (including per-tenant
+        ``TenantThrottled``, attrs intact) pass through untouched.
+        ``qos`` is a :class:`conflux_tpu.qos.QosClass` classifying the
+        request on the OWNING host's engine — each host runs its own
+        fair-share ledger over the tenants it actually serves."""
+        if qos is not None and not isinstance(qos, qos_mod.QosClass):
+            raise TypeError(f"qos must be a QosClass or None, got "
+                            f"{type(qos).__name__}")
         hid, host = self._resolve(sid)
         self._route_fault(hid)
         try:
             out = host.solve(sid, b,
                              timeout=timeout if timeout is not None
-                             else self.policy.call_timeout)
+                             else self.policy.call_timeout, qos=qos)
         except _TRANSPORT_ERRORS as e:
             self._note_request_failure(hid)
             raise HostUnavailable(

@@ -286,6 +286,57 @@ def engine_stats() -> dict:
     return out
 
 
+def qos_stats() -> dict:
+    """Merged multi-tenant QoS rows across live engines (DESIGN §30):
+    per-class counters summed, per-class latency percentiles and SLO
+    attainment recomputed over the engines' merged per-class rolling
+    windows, and the per-tenant ledger totals. Engines that never saw
+    classified traffic contribute nothing; with none, the dict is
+    empty shells — the `serve_stats()['qos']` surface."""
+    engines = _live_engines()
+    out: dict = {"engines": 0, "classes": {}, "tenants": {}}
+    samples: dict = {}
+    for e in engines:
+        q = e.counters().get("qos")
+        if not q:
+            continue
+        out["engines"] += 1
+        for k, row in q["classes"].items():
+            dst = out["classes"].setdefault(k, {
+                "tenant": row["tenant"], "tier": row["tier"],
+                "priority": row["priority"], "weight": row["weight"],
+                "slo_ms": row["slo_ms"], "requests": 0,
+                "completed": 0, "failed": 0, "throttled": 0})
+            for c in ("requests", "completed", "failed", "throttled"):
+                dst[c] += row[c]
+        for t, row in q["tenants"].items():
+            dst = out["tenants"].setdefault(t, {
+                "weight": row["weight"], "pending": 0, "admitted": 0,
+                "throttled": 0})
+            dst["pending"] += row["pending"]
+            dst["admitted"] += row["admitted"]
+            dst["throttled"] += row["throttled"]
+        for k, xs in e.qos_latency_samples().items():
+            samples.setdefault(k, []).extend(xs)
+    if samples:
+        from conflux_tpu.engine import _percentile
+
+        for k, xs in samples.items():
+            row = out["classes"].get(k)
+            if row is None or not xs:
+                continue
+            xs.sort()
+            row["latency_samples"] = len(xs)
+            for pct in (50, 95, 99):
+                row[f"latency_p{pct}_ms"] = 1e3 * _percentile(xs, pct)
+            slo_ms = row.get("slo_ms")
+            if slo_ms is not None:
+                within = sum(1 for x in xs if 1e3 * x <= slo_ms)
+                row["slo_attainment_pct"] = round(
+                    100.0 * within / len(xs), 2)
+    return out
+
+
 def serve_stats() -> dict:
     """Per-phase serving counters from the `serve.*` regions.
 
@@ -334,6 +385,13 @@ def serve_stats() -> dict:
     from conflux_tpu import fabric
 
     out["fabric"] = fabric.fabric_stats()
+    # the qos sub-dict: per-class/per-tenant counters, percentiles and
+    # SLO attainment merged across live engines (DESIGN §30); like
+    # engine counters these live on the engines and survive clear().
+    # The THROTTLE event counters (tenant_throttled, per-class
+    # tenant_throttled[t/tier] / engine_saturated[t/tier]) ride the
+    # 'health' dict
+    out["qos"] = qos_stats()
     return out
 
 
@@ -350,6 +408,11 @@ _ENGINE_COUNTERS = (
     "factor_batches", "factor_coalesced_requests", "factor_slots",
     "factor_pad_slots", "gang_batches", "gang_coalesced_requests",
     "gang_opportunity",
+)
+# the extra per-class counters a qos_class=-scoped StatsWindow windows
+# (sourced from counters()['qos']['classes'][key], DESIGN §30)
+_QOS_WINDOW_COUNTERS = (
+    "qos_requests", "qos_completed", "qos_failed", "qos_throttled",
 )
 # tier.tier_stats() keys that are NOT counters: per-manager population/
 # byte gauges and the latency percentiles (recomputed cumulatively)
@@ -396,12 +459,23 @@ class StatsWindow:
     `engine=None` windows the merged `serve_stats()` surface across all
     live engines; passing a specific engine windows that engine's own
     counters (what `conflux_tpu.control.AdaptiveController` consumes).
+
+    `qos_class=` ('tenant/tier', DESIGN §30) scopes the LATENCY half of
+    the window to one QoS class: samples come from the engines'
+    per-class rings (`ServeEngine.qos_latency_window` — so the
+    percentiles are the class's own tail, not the blended one) and the
+    delta grows `qos_requests`/`qos_completed`/`qos_failed`/
+    `qos_throttled` counters for the class; the engine-wide counters
+    still ride along. Any number of class windows coexist with each
+    other, with the controller's own window, and with every cumulative
+    consumer — the §24 non-destructive contract, per class.
     """
 
-    def __init__(self, engine=None):
+    def __init__(self, engine=None, qos_class: str | None = None):
         import weakref
 
         self._engine = None if engine is None else weakref.ref(engine)
+        self._qos_class = qos_class
         # per-engine latency sample-sequence tokens, weakly keyed so a
         # dead engine drops its token with itself
         self._tokens: "weakref.WeakKeyDictionary" = \
@@ -421,6 +495,8 @@ class StatsWindow:
         latency samples)."""
         engines = self._engines()
         eng = {k: 0 for k in _ENGINE_COUNTERS}
+        if self._qos_class is not None:
+            eng.update({k: 0 for k in _QOS_WINDOW_COUNTERS})
         eng["pending"] = 0
         bucket_hits: dict[int, int] = {}
         fbucket_hits: dict[int, int] = {}
@@ -439,7 +515,17 @@ class StatsWindow:
             for bb, n in s.get("factor_bucket_hits", {}).items():
                 fbucket_hits[bb] = fbucket_hits.get(bb, 0) + n
             tok, ftok = self._tokens.get(e, (None, None))
-            tok, new = e.latency_window(tok)
+            if self._qos_class is None:
+                tok, new = e.latency_window(tok)
+            else:
+                # the class's OWN ring: this window's percentiles are
+                # the class tail, not the engine-blended one
+                tok, new = e.qos_latency_window(self._qos_class, tok)
+                row = (s.get("qos") or {}).get("classes", {}).get(
+                    self._qos_class, {})
+                for c in ("requests", "completed", "failed",
+                          "throttled"):
+                    eng[f"qos_{c}"] += row.get(c, 0)
             ftok, fnew = e.factor_latency_window(ftok)
             self._tokens[e] = (tok, ftok)
             lats.extend(new)
@@ -474,7 +560,9 @@ class StatsWindow:
                     "phases": {ph: {} for ph in SERVE_PHASES},
                     "health": {}, "tier": {}}
         dt = max(1e-9, now - self._t_prev)
-        eng = _diff(cur["engine"], prev["engine"], _ENGINE_COUNTERS)
+        keys = (_ENGINE_COUNTERS if self._qos_class is None
+                else _ENGINE_COUNTERS + _QOS_WINDOW_COUNTERS)
+        eng = _diff(cur["engine"], prev["engine"], keys)
         eng["pending"] = cur["engine"]["pending"]
         # queue growth over the window: admissions minus resolutions.
         # Positive = the backlog is building (arrivals outpace drain)

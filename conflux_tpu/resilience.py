@@ -123,6 +123,32 @@ class FleetDegraded(RuntimeError):
         bump("fleet_degraded")
 
 
+class TenantThrottled(RuntimeError):
+    """Weighted fair-share admission shed this tenant's request: the
+    engine is contended and the tenant is at/over its declared share of
+    `max_pending` with no deficit credit left (DESIGN §30). The shed is
+    a POLICY outcome, not a failure — other tenants' traffic (and the
+    latency class in particular) is admitted untouched, which is the
+    point. `retry_after` is sized from the tenant's weighted fraction
+    of the engine's measured drain rate: by then roughly one of the
+    tenant's own slots should have freed. `tenant`/`qos_class` carry
+    the shed attribution (`qos_class` is the 'tenant/tier' key).
+    Counted globally in
+    ``profiler.serve_stats()['health']['tenant_throttled']`` and
+    per class under ``tenant_throttled[<tenant>/<tier>]``."""
+
+    def __init__(self, msg: str, retry_after: float = 0.0,
+                 tenant: str | None = None,
+                 qos_class: str | None = None):
+        super().__init__(msg)
+        self.retry_after = retry_after
+        self.tenant = tenant
+        self.qos_class = qos_class
+        bump("tenant_throttled")
+        if qos_class is not None:
+            bump(f"tenant_throttled[{qos_class}]")
+
+
 class DeadlineExceeded(TimeoutError):
     """The request's deadline passed while it was queued; its pending
     slot has been released (lazy eviction, `ServeEngine.submit`)."""
@@ -228,6 +254,11 @@ _HEALTH_KEYS = (
     "sessions_failed_over",   # sessions revived on survivors from the
                               # dead host's last checkpoint
     "sessions_migrated",      # live drain-barrier session hand-offs
+    # multi-tenant QoS (DESIGN §30): fair-share admission sheds. The
+    # per-class attributions ride lazy keys — tenant_throttled[t/tier]
+    # and engine_saturated[t/tier] — next to these global totals
+    "tenant_throttled",       # TenantThrottled raised (over-share tenant
+                              # shed while the engine was contended)
     "faults_injected",
 )
 

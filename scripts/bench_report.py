@@ -41,11 +41,11 @@ _RATIO_KEYS = (
     "overhead_pct",
     "single_speedup_vs_refactor", "speedup_vs_naive",
     "speedup_vs_xla_trsm", "speedup_vs_staged_factor",
-    "transitions_won",
+    "transitions_won", "noqos_blowup_x",
 )
 _GATE_KEYS = (
     "speedup_gate_x", "gate_ratio", "overhead_gate_pct",
-    "steady_slack_gate_pct", "tier_gate_x",
+    "steady_slack_gate_pct", "tier_gate_x", "blowup_gate_x",
 )
 
 

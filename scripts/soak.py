@@ -379,6 +379,160 @@ def run_serve_trial(seed: int) -> tuple[bool, str]:
                   f"evictions={h['evictions']}")
 
 
+def run_qos_trial(seed: int) -> tuple[bool, str]:
+    """One chaos trial of the serving stack with multi-tenant QoS
+    classification in the loop (ISSUE 15).
+
+    Random tenants spread across the latency/throughput/batch tiers
+    submit mixed traffic (some of it unclassified) under the serve
+    fault menu while the fair-share ledger admits and sheds.
+    Invariants: every admitted future resolves to a structured
+    outcome; successful answers match the f64 oracle regardless of
+    tenant (zero cross-tenant corruption); TenantThrottled only ever
+    surfaces at admission and carries retry_after / tenant /
+    qos_class; after close() the engine has zero pending, every
+    class's counters are coherent (requests == completed + failed),
+    and the ledger's per-tenant pending sums to zero."""
+    import jax.numpy as jnp
+
+    from conflux_tpu import resilience, serve
+    from conflux_tpu.engine import EngineSaturated, ServeEngine
+    from conflux_tpu.qos import QosClass
+    from conflux_tpu.resilience import (
+        DeadlineExceeded,
+        FaultPlan,
+        FaultSpec,
+        HealthPolicy,
+        InjectedFault,
+        RhsNonFinite,
+        SessionQuarantined,
+        SolveUnhealthy,
+        TenantThrottled,
+    )
+
+    rng = np.random.default_rng(seed)
+    serve.clear_plans()
+    N = int(rng.choice([32, 64]))
+    S = int(rng.integers(1, 4))
+    plan = serve.FactorPlan.create((N, N), jnp.float32, v=16)
+    As, sessions = [], []
+    for _ in range(S):
+        A = (rng.standard_normal((N, N)) / np.sqrt(N)
+             + 2.0 * np.eye(N)).astype(np.float32)
+        sess = plan.factor(jnp.asarray(A))
+        As.append(A.astype(np.float64))
+        sessions.append(sess)
+    tiers = ("latency", "throughput", "batch")
+    T = int(rng.integers(2, 4))
+    classes = []
+    for t in range(T):
+        tier = tiers[int(rng.integers(3))]
+        classes.append(QosClass(
+            tenant=f"t{t}", tier=tier,
+            priority=int(rng.integers(-1, 2)),
+            slo=(float(rng.choice([0.05, 0.25]))
+                 if tier == "latency" else None),
+            weight=float(rng.choice([0.25, 1.0, 4.0]))))
+    menu = [
+        FaultSpec("staging", "nan", prob=0.3,
+                  count=int(rng.integers(1, 4))),
+        FaultSpec("dispatch", "delay", prob=0.3, delay_s=0.002, count=3),
+        FaultSpec("drain", "crash", prob=0.5, count=1),
+        FaultSpec("d2h", "delay", prob=0.3, delay_s=0.002, count=3),
+        FaultSpec("d2h", "crash", prob=0.5, count=1),
+        FaultSpec("solve", "unhealthy", prob=0.4,
+                  count=int(rng.integers(1, 3))),
+        FaultSpec("refresh", "delay", prob=0.5, delay_s=0.002, count=2),
+    ]
+    picks = [m for m in menu if rng.integers(2)]
+    faults = FaultPlan(picks, seed=seed)
+    label = (f"seed={seed} qos N={N} S={S} "
+             f"classes={[c.key for c in classes]} "
+             f"faults={[(f.site, f.kind) for f in picks]}")
+    eng = ServeEngine(
+        max_batch_delay=float(rng.choice([0.0, 0.002])),
+        max_pending=int(rng.choice([8, 64])), max_coalesce_width=8,
+        health=HealthPolicy(quarantine_after=2, quarantine_cooldown=0.05),
+        fault_plan=faults, watchdog_interval=0.05)
+    resilience.install_faults(faults)
+    reqs, throttled = [], 0
+    try:
+        for i in range(32):
+            si = int(rng.integers(S))
+            # 3 in 4 submissions carry a class; the rest ride the
+            # unclassified path through the same queue
+            cls = classes[int(rng.integers(T))] if rng.integers(4) else None
+            w = int(rng.choice([1, 1, 2, 3]))
+            b = rng.standard_normal((N, w)).astype(np.float32)
+            kind = int(rng.integers(8))
+            deadline = None
+            if kind == 0:  # poisoned at the source
+                b[int(rng.integers(N)), 0] = np.nan
+            elif kind == 1:  # born expired
+                deadline = 0.0
+            try:
+                fut = eng.submit(sessions[si], b, deadline=deadline,
+                                 qos=cls)
+            except TenantThrottled as e:
+                if (e.retry_after < 0 or e.tenant is None
+                        or e.qos_class is None):
+                    return False, (f"{label}: malformed "
+                                   f"TenantThrottled {e!r}")
+                throttled += 1
+                continue
+            except (RhsNonFinite, SessionQuarantined, EngineSaturated):
+                continue  # other structured admission outcomes are fine
+            reqs.append((si, b, fut))
+        wedged = eng.close(timeout=120)
+        if wedged:
+            return False, f"{label}: close() wedged {wedged}"
+    finally:
+        resilience.install_faults(None)
+        eng.close(timeout=10)
+    ok_exc = (RhsNonFinite, DeadlineExceeded, SolveUnhealthy,
+              SessionQuarantined, InjectedFault)
+    answered = 0
+    for si, b, fut in reqs:
+        if not fut.done():
+            return False, f"{label}: close() left a future unresolved"
+        try:
+            x = np.asarray(fut.result(0))
+        except TenantThrottled:
+            return False, (f"{label}: TenantThrottled leaked past "
+                           "admission into a future")
+        except ok_exc:
+            continue
+        except Exception as e:  # noqa: BLE001 — any other leak is a bug
+            return False, (f"{label}: UNSTRUCTURED "
+                           f"{type(e).__name__}: {e}")
+        want = np.linalg.solve(As[si], b.astype(np.float64))
+        err = (np.linalg.norm(x - want)
+               / max(np.linalg.norm(want), 1e-30))
+        if not (err < 1e-3):
+            return False, f"{label}: answer off oracle ({err:.2e})"
+        answered += 1
+    stats = eng.stats()
+    if stats["pending"] != 0:
+        return False, f"{label}: {stats['pending']} pending slots leaked"
+    if stats["completed"] + stats["failed"] != stats["requests"]:
+        return False, f"{label}: counters incoherent {stats}"
+    q = eng.counters().get("qos")
+    if q is not None:
+        for key, row in q["classes"].items():
+            if row["requests"] != row["completed"] + row["failed"]:
+                return False, (f"{label}: class {key} counters "
+                               f"incoherent {row}")
+        for tname, row in q["tenants"].items():
+            if row["pending"] != 0:
+                return False, (f"{label}: ledger pending leaked for "
+                               f"tenant {tname}: {row['pending']}")
+    h = resilience.health_stats()
+    return True, (f"{label}: ok {answered}/{len(reqs)} answered, "
+                  f"throttled={throttled}, "
+                  f"injected={sum(faults.injected.values())}, "
+                  f"evictions={h['evictions']}")
+
+
 def run_adaptive_trial(seed: int) -> tuple[bool, str]:
     """One chaos trial of the serving stack WITH the adaptive
     controller in the loop (ISSUE 8).
@@ -1327,6 +1481,17 @@ def main(argv=None) -> int:
                     "structured failures only, bounded recovery, "
                     "per-session f64 oracle answers (zero cross-host "
                     "corruption) and session-count conservation")
+    ap.add_argument("--qos", action="store_true",
+                    help="chaos-soak the multi-tenant QoS layer: "
+                    "random tenants across the latency/throughput/"
+                    "batch tiers (mixed with unclassified traffic) "
+                    "under the serve fault menu while the fair-share "
+                    "ledger admits and sheds; asserts structured "
+                    "failures only, TenantThrottled only at admission "
+                    "with retry_after/tenant/qos_class attached, "
+                    "per-request f64 oracle answers (zero cross-"
+                    "tenant corruption), coherent per-class counters "
+                    "and a fully drained ledger after close()")
     ap.add_argument("--lockcheck", action="store_true",
                     help="run trials under the conflint runtime "
                     "lock-order harness (conflux_tpu.analysis."
@@ -1335,7 +1500,8 @@ def main(argv=None) -> int:
                     "cycle or lock-held-across-dispatch fails the soak")
     args = ap.parse_args(argv)
 
-    trial = (run_fabric_trial if args.fabric
+    trial = (run_qos_trial if args.qos
+             else run_fabric_trial if args.fabric
              else run_gang_trial if args.gang
              else run_fleet_trial if args.fleet
              else run_tier_trial if args.tier
