@@ -246,6 +246,32 @@ def parse_args():
     ap.add_argument("--fabric-recovery-gate", type=float, default=30.0,
                     help="max kill-to-all-sessions-answering seconds "
                     "(--fabric kill drill)")
+    ap.add_argument("--wire", action="store_true",
+                    help="measure the ISSUE 16 zero-copy fabric wire "
+                    "instead (DESIGN §31): an identical concurrent "
+                    "echo trace (B=32 N=256 width-1 f32 payloads, the "
+                    "production RHS shape) through a 1-worker-process "
+                    "fabric on the shared-memory descriptor wire "
+                    "versus the SAME fabric on the pickle wire — both "
+                    "legs pay the same process/thread plumbing, so "
+                    "the ratio isolates exactly what the wire buys: "
+                    "zero-copy payload staging plus batched control "
+                    "frames; gate >= --wire-gate requests/s ratio. "
+                    "Also gated: real solves bitwise identical across "
+                    "both wires and vs an f64 oracle, a torn-reply "
+                    "corruption drill on a 2-host shm fabric "
+                    "(structural instant-dead, bitwise fail-over, "
+                    "bounded recovery), and zero leaked /dev/shm "
+                    "segments after close. The throughput gate is "
+                    ">= --wire-gate on a multi-core box; on 1 core "
+                    "the front, both pumps and the worker process "
+                    "time-slice one core and the gate degrades to a "
+                    "clearly-wins 2x bound (the BENCH_FABRIC "
+                    "precedent for conditionally-armed parallelism "
+                    "gates); write BENCH_WIRE.json")
+    ap.add_argument("--wire-gate", type=float, default=5.0,
+                    help="min shm-wire/pickle-wire echo requests/s "
+                    "ratio (--wire, full shape, >= 4 cores)")
     ap.add_argument("--qos", action="store_true",
                     help="measure the ISSUE 15 multi-tenant QoS layer "
                     "instead (DESIGN §30): a bulk tenant floods the "
@@ -316,6 +342,7 @@ def main():
                     else "BENCH_TRSM.json" if args.trsm
                     else "BENCH_FKERNEL.json" if args.factor_kernel
                     else "BENCH_FABRIC.json" if args.fabric
+                    else "BENCH_WIRE.json" if args.wire
                     else "BENCH_QOS.json" if args.qos
                     else "BENCH_ENGINE.json")
         if args.smoke:
@@ -1024,6 +1051,305 @@ def main():
             raise SystemExit(
                 f"gate: 2-host/1-host solves ratio {r_solve:.3f} "
                 f"below {gate} ({(os.cpu_count() or 1)} cores)")
+        return
+
+    # ---------------- wire mode: zero-copy shared-memory wire ------------ #
+    # the ISSUE 16 acceptance numbers (DESIGN §31). Leg A is the
+    # request-throughput pair: an IDENTICAL pipelined echo trace
+    # ((B, N, 1) f32 payloads — the production width-1 RHS shape,
+    # every request submitted before any reply is awaited) through a
+    # 1-worker-process fabric on the shm descriptor wire versus the
+    # same fabric on the pickle wire. The echo op round-trips the
+    # payload through the transport with the engine bypassed, and the
+    # pipelined ``echo_many`` submission keeps both wires saturated,
+    # so the ratio isolates exactly what the wire buys: zero-copy
+    # ring staging + batched solve_many/reply_many control frames
+    # instead of one pickled Connection.send per request and per
+    # reply. The shm fabric's ring is sized to the burst (TUNING.md:
+    # size ring_bytes to the in-flight working set) so the leg
+    # measures the wire, not backpressure pacing. Correctness bars
+    # BEFORE any timing: echo payloads bitwise through both wires,
+    # and real solves bitwise across the two wires and against an
+    # f64 oracle. Leg B is the corruption drill on a 2-host shm
+    # fabric: the worker emits a deliberately torn reply record
+    # (footer generation zeroed — exactly what a crash mid-write
+    # leaves), which must read as a STRUCTURAL instant-dead
+    # (WireCorrupt -> host dead, pending failed now, no timeout
+    # wait), followed by bitwise fail-over inside the
+    # --fabric-recovery-gate bound. Finally: zero cfxw-* segments
+    # leaked in /dev/shm after close. Methodology per the repo
+    # discipline: interleaved adjacent legs, alternating order,
+    # median of per-rep ratios, <= 3 independent re-measures with
+    # the gate on the best; the throughput gate arms at
+    # --wire-gate on >= 4 cores and degrades to a clearly-wins 2x
+    # bound when the front, both pumps and the worker process
+    # time-slice a single core (the BENCH_FABRIC precedent).
+    if args.wire:
+        import glob
+        import tempfile
+
+        from conflux_tpu import fabric as fabric_mod
+        from conflux_tpu.engine import rendezvous
+        from conflux_tpu.fabric import FabricPolicy
+        from conflux_tpu.resilience import HostUnavailable
+        from conflux_tpu.wire import WireConfig
+
+        if args.smoke:
+            WB, WN, E, REPS = 8, 64, 96, min(args.reps, 3)
+        else:
+            WB, WN, E, REPS = 32, 256, 512, args.reps
+        plan = serve.FactorPlan.create((WB, WN, WN), jnp.float32,
+                                       v=min(args.v, WN))
+        rng = np.random.default_rng(0)
+        A = (rng.standard_normal((WB, WN, WN)) / np.sqrt(WN)
+             + 2.0 * np.eye(WN)).astype(np.float32)
+        payloads = [rng.standard_normal((WB, WN, 1)).astype(np.float32)
+                    for _ in range(8)]
+        trace = [payloads[j % 8] for j in range(E)]
+        req_bytes = payloads[0].nbytes
+        # ring record span: header + payload + footer, cache-aligned;
+        # 2x the burst's working set so the pipelined leg never idles
+        # in backpressure pacing
+        rec = 24 + -(-(req_bytes + 8) // 64) * 64
+        wcfg = WireConfig(ring_bytes=max(8 << 20, 2 * E * rec))
+
+        pol = FabricPolicy(heartbeat_interval=0.2,
+                           heartbeat_timeout=10.0,
+                           suspect_after=2, dead_after=4,
+                           checkpoint_interval=0.0)
+        scratch = tempfile.TemporaryDirectory(
+            prefix="bench_wire_", ignore_cleanup_errors=True)
+        fab_shm = fabric_mod.process_fabric(
+            1, os.path.join(scratch.name, "shm"), policy=pol,
+            wire="shm", wire_config=wcfg,
+            engine_kwargs={"max_batch_delay": args.delay_ms * 1e-3})
+        fab_pkl = fabric_mod.process_fabric(
+            1, os.path.join(scratch.name, "pkl"), policy=pol,
+            wire="pickle",
+            engine_kwargs={"max_batch_delay": args.delay_ms * 1e-3})
+
+        def median(xs):
+            xs = sorted(xs)
+            return xs[len(xs) // 2]
+
+        out: dict = {}
+        with fab_shm, fab_pkl:
+            host_shm = next(iter(fab_shm._hosts.values()))
+            host_pkl = next(iter(fab_pkl._hosts.values()))
+
+            # correctness bar BEFORE any timing: (a) echo payloads
+            # round-trip bitwise through BOTH wires (batched AND
+            # single-shot paths); (b) real solves agree bitwise
+            # across the wires and with an f64 oracle
+            echo_bitwise = sum(
+                int(np.array_equal(np.asarray(g), p))
+                for h in (host_shm, host_pkl)
+                for g, p in zip(h.echo_many(payloads, 30.0), payloads))
+            echo_bitwise += sum(
+                int(np.array_equal(np.asarray(h.echo(p, 30.0)), p))
+                for h in (host_shm, host_pkl) for p in payloads[:4])
+            for fab in (fab_shm, fab_pkl):
+                fab.open("wire-bench", plan, A)
+            n_bitwise = 0
+            RS = 8
+            for j in range(RS):
+                b = payloads[j % len(payloads)]
+                x1 = np.asarray(fab_shm.solve("wire-bench", b,
+                                              timeout=300.0))
+                x2 = np.asarray(fab_pkl.solve("wire-bench", b,
+                                              timeout=300.0))
+                n_bitwise += int(np.array_equal(x1, x2))
+                if j < 2:
+                    x64 = np.linalg.solve(A.astype(np.float64),
+                                          b.astype(np.float64))
+                    err = float(np.max(np.abs(x1 - x64)))
+                    assert err < 1e-3, \
+                        f"f64 oracle divergence {err:.2e}"
+
+            def echo_leg(host):
+                t0 = time.perf_counter()
+                host.echo_many(trace, timeout=300.0)
+                return time.perf_counter() - t0
+
+            # sequential round-trip latency: the per-request front
+            # overhead each wire charges with zero concurrency
+            def seq_us(host):
+                ts = []
+                for k in range(32):
+                    t0 = time.perf_counter()
+                    host.echo(payloads[k % 8], 30.0)
+                    ts.append(time.perf_counter() - t0)
+                return median(ts) * 1e6
+
+            # warm the RPC plumbing (and the rings' pages) on both
+            for _ in range(2):
+                echo_leg(host_shm)
+                echo_leg(host_pkl)
+            us_shm = seq_us(host_shm)
+            us_pkl = seq_us(host_pkl)
+
+            # front-side CPU charged per request during a saturated
+            # leg (process_time covers the submit thread + both
+            # pumps + the recv/decode thread — the whole front)
+            def front_cpu_us(host):
+                best = None
+                for _ in range(3):
+                    c0 = time.process_time()
+                    echo_leg(host)
+                    c = time.process_time() - c0
+                    best = c if best is None else min(best, c)
+                return best / E * 1e6
+
+            cpu_shm = front_cpu_us(host_shm)
+            cpu_pkl = front_cpu_us(host_pkl)
+
+            def measure():
+                tss, tps = [], []
+                for rep in range(REPS):
+                    legs = [(host_pkl, tps), (host_shm, tss)]
+                    if rep % 2:
+                        legs.reverse()
+                    for host, ts in legs:
+                        ts.append(echo_leg(host))
+                return (median([a / b for a, b in zip(tps, tss)]),
+                        median(tss))
+
+            gate = (args.wire_gate
+                    if (os.cpu_count() or 1) >= 4 else 2.0)
+            # re-measure against the HEADLINE gate (not the degraded
+            # one) so a noisy first estimate on a shared core still
+            # gets its best-of-3; the pass/fail bar stays `gate`
+            estimates = [measure()]
+            while (estimates[-1][0] < args.wire_gate
+                   and len(estimates) < 3):
+                estimates.append(measure())
+            r_wire, t_shm = max(estimates, key=lambda e: e[0])
+            wire_st = host_shm.ping().get("wire", {})
+
+            # ---- torn-reply drill: structural instant-dead --------- #
+            # a 2-host shm fabric so the corruption ALSO proves
+            # fail-over: sessions spread by HRW, one worker emits a
+            # torn reply record, its host must die structurally (no
+            # timeout wait) and the doomed sessions must answer again
+            # bitwise from the survivor
+            fab2 = fabric_mod.process_fabric(
+                2, os.path.join(scratch.name, "two"), policy=pol,
+                wire="shm",
+                engine_kwargs={"max_batch_delay": args.delay_ms * 1e-3})
+            drill = {}
+            with fab2:
+                ids = sorted(fab2._hosts)
+                sids, i = [], 0
+                while len({rendezvous(s, ids) for s in sids}) < 2:
+                    sids.append(f"drill-{i}")
+                    i += 1
+                for sid in sids:
+                    fab2.open(sid, plan, A)
+                dref = {sid: np.asarray(
+                    fab2.solve(sid, payloads[0], timeout=300.0))
+                    for sid in sids}
+                fab2.checkpoint_all()
+                victim = fab2.owner_of(sids[-1])
+                fab2._hosts[victim].debug_wire("torn_reply")
+                t0 = time.perf_counter()
+                # structural death: the NEXT solve to the victim's
+                # sessions must fail fast (HostUnavailable) or route
+                # to the survivor — never hang out a timeout
+                deadline = t0 + 120.0
+                post_bitwise = 0
+                for sid in sids:
+                    while True:
+                        try:
+                            got = np.asarray(
+                                fab2.solve(sid, payloads[0],
+                                           timeout=30.0))
+                            break
+                        except HostUnavailable as e:
+                            if time.perf_counter() > deadline:
+                                raise SystemExit(
+                                    f"wire drill: {sid} still "
+                                    f"unavailable 120s after the "
+                                    f"torn reply: {e}")
+                            time.sleep(
+                                min(0.05, max(0.01, e.retry_after)))
+                    post_bitwise += int(np.array_equal(got, dref[sid]))
+                drill_recovery_s = time.perf_counter() - t0
+                st2 = fab2.stats()
+                hb = resilience.health_stats()
+                drill = {
+                    "victim": victim,
+                    "recovery_s": round(drill_recovery_s, 3),
+                    "post_bitwise": f"{post_bitwise}/{len(sids)}",
+                    "lost_sessions": st2["lost_sessions"],
+                    "wire_corrupt": int(hb.get("wire_corrupt", 0)),
+                    "torn_segment": int(
+                        hb.get("wire_corrupt[torn_segment]", 0)),
+                }
+
+            out = {
+                "metric": (f"zero-copy fabric wire B={WB} N={WN} w=1 "
+                           f"f32 ({req_bytes >> 10} KiB/req, E={E} "
+                           f"pipelined echoes, shm descriptor wire "
+                           f"vs pickle wire, {os.cpu_count()} cores"
+                           + (", smoke" if args.smoke else "") + ")"),
+                "value": round(E / t_shm, 1),
+                "unit": "requests/s",
+                "speedup_vs_pickle_wire": round(r_wire, 3),
+                "ratio_estimates": [round(e[0], 3) for e in estimates],
+                "wire_gate_x": args.wire_gate,
+                "gate_ratio": gate,
+                "pickle_requests_per_s": round(E / (t_shm * r_wire), 1),
+                "roundtrip_us_shm": round(us_shm, 1),
+                "roundtrip_us_pickle": round(us_pkl, 1),
+                "front_cpu_us_per_request_shm": round(cpu_shm, 1),
+                "front_cpu_us_per_request_pickle": round(cpu_pkl, 1),
+                "ring_bytes": wcfg.ring_bytes,
+                "echo_bitwise": f"{echo_bitwise}/24",
+                "solve_bitwise_vs_pickle_wire": f"{n_bitwise}/{RS}",
+                "wire_frames": int(wire_st.get("frames", -1)),
+                "wire_staged": int(wire_st.get("staged", -1)),
+                "drill": drill,
+                "reps": REPS,
+                "baseline": "same 1-worker-process fabric on the "
+                            "pickled Connection wire, identical "
+                            "pipelined echo trace",
+            }
+        scratch.cleanup()
+        leaked = sorted(glob.glob("/dev/shm/cfxw-*"))
+        out["shm_leaks"] = len(leaked)
+        emit(out)
+        if echo_bitwise != 24:
+            raise SystemExit(
+                f"gate: echo payloads bitwise on only "
+                f"{echo_bitwise}/24 round-trips")
+        if n_bitwise != RS:
+            raise SystemExit(
+                f"gate: shm-wire solves bitwise on only "
+                f"{n_bitwise}/{RS} requests vs the pickle wire")
+        if post_bitwise != len(sids):
+            raise SystemExit(
+                f"gate: post-drill answers bitwise on only "
+                f"{drill['post_bitwise']} sessions")
+        if drill["lost_sessions"]:
+            raise SystemExit(
+                f"gate: torn-reply drill lost sessions ({drill})")
+        if drill["torn_segment"] < 1:
+            raise SystemExit(
+                "gate: torn reply was not classified as a "
+                f"torn_segment WireCorrupt ({drill})")
+        if drill_recovery_s >= args.fabric_recovery_gate:
+            raise SystemExit(
+                f"gate: torn-reply recovery {drill_recovery_s:.2f}s "
+                f">= {args.fabric_recovery_gate}s")
+        if leaked:
+            raise SystemExit(
+                f"gate: leaked /dev/shm segments after close: "
+                f"{leaked}")
+        if r_wire < gate:
+            raise SystemExit(
+                f"gate: shm/pickle echo throughput ratio "
+                f"{r_wire:.3f} below {gate} "
+                f"({(os.cpu_count() or 1)} cores)")
         return
 
     # ---------------- gang mode: device-resident stacked fleets ---------- #

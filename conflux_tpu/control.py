@@ -1216,6 +1216,10 @@ class HostLoadEstimator:
         # qos_<tier>_solves heartbeat counters (DESIGN §30); empty for
         # hosts that never report classified traffic
         self._tier_rate: dict[str, dict[str, float]] = {}  # guarded-by: _lock
+        # host -> shm wire ring occupancy in [0, 1] (DESIGN §31): the
+        # fuller of the host's two payload rings, a gauge straight off
+        # the ping payload; absent for pickle-wire hosts
+        self._wire: dict[str, float] = {}  # guarded-by: _lock
 
     def feed(self, host: str, delta: dict) -> None:
         """Fold one heartbeat counter-delta window for ``host``.
@@ -1224,7 +1228,11 @@ class HostLoadEstimator:
         engine counters: ``solves`` (window increment) and ``seconds``
         give the instantaneous rate; ``pending`` gives the depth (a
         gauge — the fabric re-injects the RAW heartbeat value after
-        the window differences the payload).
+        the window differences the payload). ``wire_used_frac`` (also
+        a re-injected gauge) reports the host's shm payload-ring
+        occupancy (DESIGN §31) — a near-full wire backpressures
+        admission before pending depth shows it, so placement reads
+        it directly.
         """
         secs = max(1e-9, float(delta.get("seconds", 0.0) or 0.0))
         rate = float(delta.get("solves", 0) or 0) / secs
@@ -1240,6 +1248,9 @@ class HostLoadEstimator:
             else:
                 self._rate[host] = self.ema * rate + (1 - self.ema) * prev
             self._pending[host] = pending
+            wire = delta.get("wire_used_frac")
+            if wire is not None:
+                self._wire[host] = min(1.0, max(0.0, float(wire)))
             if tiers:
                 cur = self._tier_rate.setdefault(host, {})
                 for t, r in tiers.items():
@@ -1253,6 +1264,7 @@ class HostLoadEstimator:
             self._rate.pop(host, None)
             self._pending.pop(host, None)
             self._tier_rate.pop(host, None)
+            self._wire.pop(host, None)
 
     def retry_after(self, backlog: int = 1,
                     hosts: "list[str] | None" = None) -> float:
@@ -1267,12 +1279,16 @@ class HostLoadEstimator:
         return min(self.ceil, max(self.floor, backlog / total))
 
     def least_loaded(self, hosts: "list[str]") -> str:
-        """The best adoption target among ``hosts``: fewest pending
-        solves, then fastest drain, then lexicographic host id."""
+        """The best adoption target among ``hosts``: hosts whose shm
+        wire is congested (ring ≥ 90% full — their admission is about
+        to shed RingFull regardless of queue depth) sort behind
+        everyone else, then fewest pending solves, then fastest drain,
+        then lexicographic host id."""
         if not hosts:
             raise ValueError("least_loaded() needs at least one host")
         with self._lock:
-            return min(hosts, key=lambda h: (self._pending.get(h, 0),
+            return min(hosts, key=lambda h: (self._wire.get(h, 0.0) >= 0.9,
+                                             self._pending.get(h, 0),
                                              -self._rate.get(h, 0.0), h))
 
     def stats(self) -> dict:
@@ -1284,4 +1300,7 @@ class HostLoadEstimator:
             for h, tiers in self._tier_rate.items():
                 if h in out:
                     out[h]["qos_drain_per_s"] = dict(sorted(tiers.items()))
+            for h, frac in self._wire.items():
+                if h in out:
+                    out[h]["wire_used_frac"] = round(frac, 4)
             return out

@@ -1846,6 +1846,15 @@ class ServeEngine:
         coalescing window (latency ~0, throughput the engine window,
         batch a stretched window). `qos=None` (the default) keeps
         every path byte-identical to the unclassified engine."""
+        return self._admit(self._prepare(session, b, deadline, qos))
+
+    # hot-path (admission prelude: validation + request construction —
+    # no locks, no device syncs)
+    def _prepare(self, session, b, deadline=None, qos=None):
+        """submit()'s lock-free prelude — fast-fail checks, RHS
+        normalization/guarding, request construction, lane resolution.
+        Shared with :meth:`submit_many` so a batched wire frame runs
+        the identical validation per item."""
         # conflint: disable=CFX-LOCK benign racy fast-fail; _admit re-checks locked
         if self._closed:
             raise EngineClosed("submit() on a closed ServeEngine")
@@ -1881,96 +1890,143 @@ class ServeEngine:
         # the admission lock), so every live request is lane-attributed
         # for the per-lane watchdog
         req.lane = self._lane_for(session)
-        return self._admit(req)
+        return req
+
+    # hot-path (batched admission: ONE lock round-trip per wire frame)
+    def submit_many(self, items) -> list:
+        """Batched :meth:`submit` for the zero-copy wire (DESIGN §31):
+        `items` is ``[(session, b, qos)]``; returns len(items) futures,
+        aligned. All admissible items are admitted under a SINGLE
+        acquisition of the admission lock — a coalesced control frame
+        pays one lock round-trip instead of one per request — and
+        routing (queue pushes) happens outside it, like submit().
+
+        Per-item failures (validation, quarantine, saturation, tenant
+        throttle) are set ON that item's future instead of raised, so
+        one bad request never takes down its frame-mates; the wire
+        encodes each future's exception back to its own caller."""
+        reqs: list = []
+        futs: list = []
+        for session, b, qos in items:
+            try:
+                req = self._prepare(session, b, qos=qos)
+            except Exception as e:
+                fut = Future()
+                fut.set_exception(e)
+                futs.append(fut)
+            else:
+                reqs.append(req)
+                futs.append(req.future)
+        admitted = []
+        with self._lock:
+            for req in reqs:
+                try:
+                    self._admit_locked(req)
+                except Exception as e:
+                    req.future.set_exception(e)
+                else:
+                    admitted.append(req)
+        for req in admitted:
+            self._route(req)
+        return futs
 
     def _admit(self, req) -> Future:
         """Shared admission control for both lanes: the bounded pending
         set (shed with a backoff hint, or block), registration in the
         `_live` resolution-ownership set, and the queue push."""
         with self._lock:
-            if self._closed:
-                raise EngineClosed("submit() on a closed ServeEngine")
-            while self._draining and not self._closed:
-                if isinstance(req, _FactorRequest):
-                    # A factor submission must SHED at the drain
-                    # barrier, never wait: a client-thread stale-drift
-                    # revival (tier._revive_refactor) legitimately
-                    # holds its session RLock while submitting here,
-                    # and checkpoint()'s save_fleet needs that same
-                    # lock — and _draining only clears after save_fleet
-                    # returns, so waiting would close the cycle and
-                    # wedge the engine forever. EngineSaturated routes
-                    # the revival onto its direct plan._factor_once
-                    # fallback (same program family, same bits).
-                    raise EngineSaturated(
-                        "factor lane paused at the checkpoint drain "
-                        "barrier (snapshot serializing) — retry "
-                        "shortly, or fall back to plan.factor",
-                        retry_after=0.05)
-                # checkpoint drain barrier: hold admission (both
-                # policies) until the snapshot completes — brief by
-                # construction, the snapshot is host-side serialization
+            self._admit_locked(req)
+        self._route(req)
+        return req.future
+
+    # requires-lock: _lock
+    def _admit_locked(self, req) -> None:
+        """The locked body of admission (also the per-item step of
+        :meth:`submit_many`'s single-lock batch). May WAIT on
+        `_not_full` (drain barrier / 'block' policy) — condition waits
+        release the lock, so frame-mates are not wedged, merely
+        ordered."""
+        if self._closed:
+            raise EngineClosed("submit() on a closed ServeEngine")
+        while self._draining and not self._closed:
+            if isinstance(req, _FactorRequest):
+                # A factor submission must SHED at the drain
+                # barrier, never wait: a client-thread stale-drift
+                # revival (tier._revive_refactor) legitimately
+                # holds its session RLock while submitting here,
+                # and checkpoint()'s save_fleet needs that same
+                # lock — and _draining only clears after save_fleet
+                # returns, so waiting would close the cycle and
+                # wedge the engine forever. EngineSaturated routes
+                # the revival onto its direct plan._factor_once
+                # fallback (same program family, same bits).
+                raise EngineSaturated(
+                    "factor lane paused at the checkpoint drain "
+                    "barrier (snapshot serializing) — retry "
+                    "shortly, or fall back to plan.factor",
+                    retry_after=0.05)
+            # checkpoint drain barrier: hold admission (both
+            # policies) until the snapshot completes — brief by
+            # construction, the snapshot is host-side serialization
+            self._not_full.wait()
+        if self._closed:
+            raise EngineClosed("engine closed while checkpointing")
+        if self._pending >= self.max_pending:
+            if self.on_full == "reject":
+                self._sheds += 1
+                self._consec_sheds += 1
+                hint, why = self._shed_hint_locked()
+                raise EngineSaturated(
+                    f"{self._pending} pending requests >= max_pending="
+                    f"{self.max_pending} (shed policy 'reject'; "
+                    f"{why})", retry_after=hint,
+                    **self._qos_shed_attr(req))
+            while self._pending >= self.max_pending \
+                    and not self._closed:
                 self._not_full.wait()
             if self._closed:
-                raise EngineClosed("engine closed while checkpointing")
-            if self._pending >= self.max_pending:
+                raise EngineClosed("engine closed while blocked")
+        lane = getattr(req, "lane", None)
+        slice_cap = self.max_lane_pending
+        take_slot = (slice_cap is not None and lane is not None
+                     and len(self._lanes) > 1)
+        if take_slot:
+            # the per-lane pending slice: one hot lane's backlog
+            # sheds ITS OWN overflow instead of filling the global
+            # bound and starving every other lane's admission
+            if lane.pending >= slice_cap:
                 if self.on_full == "reject":
                     self._sheds += 1
                     self._consec_sheds += 1
+                    lane.sheds += 1
                     hint, why = self._shed_hint_locked()
                     raise EngineSaturated(
-                        f"{self._pending} pending requests >= max_pending="
-                        f"{self.max_pending} (shed policy 'reject'; "
-                        f"{why})", retry_after=hint,
+                        f"lane {lane.index} holds {lane.pending} "
+                        f"pending >= max_lane_pending={slice_cap} "
+                        f"(per-lane slice; other lanes keep "
+                        f"admitting — {why})", retry_after=hint,
                         **self._qos_shed_attr(req))
-                while self._pending >= self.max_pending \
+                while lane.pending >= slice_cap \
                         and not self._closed:
                     self._not_full.wait()
                 if self._closed:
                     raise EngineClosed("engine closed while blocked")
-            lane = getattr(req, "lane", None)
-            slice_cap = self.max_lane_pending
-            take_slot = (slice_cap is not None and lane is not None
-                         and len(self._lanes) > 1)
-            if take_slot:
-                # the per-lane pending slice: one hot lane's backlog
-                # sheds ITS OWN overflow instead of filling the global
-                # bound and starving every other lane's admission
-                if lane.pending >= slice_cap:
-                    if self.on_full == "reject":
-                        self._sheds += 1
-                        self._consec_sheds += 1
-                        lane.sheds += 1
-                        hint, why = self._shed_hint_locked()
-                        raise EngineSaturated(
-                            f"lane {lane.index} holds {lane.pending} "
-                            f"pending >= max_lane_pending={slice_cap} "
-                            f"(per-lane slice; other lanes keep "
-                            f"admitting — {why})", retry_after=hint,
-                            **self._qos_shed_attr(req))
-                    while lane.pending >= slice_cap \
-                            and not self._closed:
-                        self._not_full.wait()
-                    if self._closed:
-                        raise EngineClosed("engine closed while blocked")
-            # weighted fair-share admission (DESIGN §30): runs LAST so
-            # a throttle has committed nothing to roll back; the
-            # qos=None path is one attribute check
-            if req.qos is not None:
-                self._qos_admit_locked(req)
-            if take_slot:
-                req.lane_slot = True
-                lane.pending += 1
-            self._consec_sheds = 0
-            self._pending += 1
-            self._requests += 1
-            if isinstance(req, _FactorRequest):
-                self._factor_requests += 1
-            self._live.add(req)
-            if self._pending > self._queue_peak:
-                self._queue_peak = self._pending
-        self._route(req)
-        return req.future
+        # weighted fair-share admission (DESIGN §30): runs LAST so
+        # a throttle has committed nothing to roll back; the
+        # qos=None path is one attribute check
+        if req.qos is not None:
+            self._qos_admit_locked(req)
+        if take_slot:
+            req.lane_slot = True
+            lane.pending += 1
+        self._consec_sheds = 0
+        self._pending += 1
+        self._requests += 1
+        if isinstance(req, _FactorRequest):
+            self._factor_requests += 1
+        self._live.add(req)
+        if self._pending > self._queue_peak:
+            self._queue_peak = self._pending
 
     # requires-lock: _lock
     def _shed_hint_locked(self) -> tuple:

@@ -73,12 +73,14 @@ import time
 import weakref
 import zlib
 from concurrent.futures import Future, ThreadPoolExecutor
+from concurrent.futures import TimeoutError as FuturesTimeout
 from multiprocessing.connection import Client, Listener
 from typing import Any
 
 import numpy as np
 
 from conflux_tpu import resilience, tier
+from conflux_tpu import wire as wire_mod
 from conflux_tpu import qos as qos_mod
 from conflux_tpu.control import HostLoadEstimator
 from conflux_tpu.profiler import CounterWindow
@@ -96,9 +98,11 @@ from conflux_tpu.resilience import (
     SessionSpilled,
     SolveUnhealthy,
     TenantThrottled,
+    WireCorrupt,
     bump,
     maybe_fault,
 )
+from conflux_tpu.wire import WireConfig
 
 __all__ = [
     "FabricPolicy", "HostHandle", "LocalHost", "ProcessHost",
@@ -625,19 +629,41 @@ class ProcessHost(HostHandle):
     id-matched: a sender lock serializes writes, a receiver thread
     resolves reply futures, and a torn pipe fails every pending future
     with ConnectionError — an in-flight request on a dying host gets a
-    structured error, never a hang."""
+    structured error, never a hang.
+
+    With ``wire="shm"`` (the default) solve payloads ride the
+    zero-copy shared-memory wire (DESIGN §31, `conflux_tpu.wire`):
+    the RHS is staged straight into a per-host request ring and only
+    a descriptor crosses the pipe, batched with its frame-mates; the
+    answer comes back through the reply ring the same way. Non-array
+    ops, oversized payloads and a worker whose reply ring backs up
+    all fall back to the pickle wire transparently; ``wire="pickle"``
+    is the escape hatch that turns the rings off entirely. A corrupt
+    ring record (:class:`~conflux_tpu.resilience.WireCorrupt` —
+    torn/stale/overrun) means the payload channel can no longer be
+    trusted: the worker is killed and every pending request fails
+    structurally, exactly like a torn pipe."""
 
     def __init__(self, host_id: str, ckpt_dir: str, *,
                  engine_kwargs: dict | None = None,
                  start_timeout: float = 180.0,
                  call_timeout: float = 120.0,
-                 env: dict | None = None):
+                 env: dict | None = None,
+                 wire: str = "shm",
+                 wire_config: WireConfig | None = None):
+        if wire not in ("shm", "pickle"):
+            raise ValueError(f"wire must be 'shm' or 'pickle', "
+                             f"got {wire!r}")
         self.host_id = str(host_id)
         self.ckpt_dir = ckpt_dir
         self._engine_kwargs = dict(engine_kwargs or {})
         self._start_timeout = float(start_timeout)
         self._call_timeout = float(call_timeout)
         self._env = env
+        self._wire_mode = wire
+        self._wire_cfg = (wire_config if wire_config is not None
+                          else WireConfig())
+        self._wire: wire_mod.WireClient | None = None
         self._proc: subprocess.Popen | None = None
         self._conn = None
         self._listener = None
@@ -664,6 +690,18 @@ class ProcessHost(HostHandle):
                "--host-id", self.host_id, "--connect", sock,
                "--ckpt-dir", self.ckpt_dir,
                "--engine-json", json.dumps(self._engine_kwargs)]
+        req_ring = rep_ring = None
+        if self._wire_mode == "shm":
+            # the FRONT creates (and always unlinks) the segments, so
+            # a SIGKILLed worker can never leak /dev/shm entries
+            rq_name, rp_name = wire_mod.segment_names(self.host_id)
+            req_ring = wire_mod.Ring.create(
+                rq_name, self._wire_cfg.ring_bytes, reclaim="local")
+            rep_ring = wire_mod.Ring.create(
+                rp_name, self._wire_cfg.ring_bytes, reclaim="shared")
+            cmd += ["--wire-json", json.dumps(
+                {"req": rq_name, "rep": rp_name,
+                 "cfg": self._wire_cfg.to_json()})]
         self._log_path = os.path.join(self.ckpt_dir, "worker.log")
         self._log = open(self._log_path, "ab")
         self._proc = subprocess.Popen(cmd, env=env, stdout=self._log,
@@ -682,6 +720,9 @@ class ProcessHost(HostHandle):
         t.join(self._start_timeout)
         if not box or isinstance(box[0], Exception):
             self._proc.kill()
+            if req_ring is not None:
+                req_ring.close()
+                rep_ring.close()
             tail = b""
             try:
                 with open(self._log_path, "rb") as f:
@@ -693,16 +734,44 @@ class ProcessHost(HostHandle):
                 f"{self._start_timeout}s: {box[0] if box else 'timeout'}"
                 f"\n--- worker log tail ---\n{tail.decode(errors='replace')}")
         self._conn = box[0]
+        if req_ring is not None:
+            self._wire = wire_mod.WireClient(
+                req_ring, rep_ring, self._wire_send,
+                host_id=self.host_id, config=self._wire_cfg,
+                on_send_error=self._wire_send_failed)
         self._recv_thread = threading.Thread(
             target=self._recv_loop, daemon=True,
             name=f"fabric-recv-{self.host_id}")
         self._recv_thread.start()
+
+    def _wire_send(self, frame: dict) -> None:
+        """Control-frame send for the wire pump — serialized with the
+        direct _call sends on the one pipe."""
+        with self._send_lock:
+            if self._dead is not None:
+                raise OSError(f"host {self.host_id} is dead")
+            self._conn.send(frame)
+
+    # futures-owner
+    def _wire_send_failed(self, items: list, exc: Exception) -> None:
+        """The wire pump's frame never left: fail exactly its mids
+        (the pipe itself is torn, so _recv_loop's _fail follows)."""
+        with self._send_lock:
+            futs = [self._pending.pop(it["id"], None) for it in items]
+        e = ConnectionError(
+            f"host {self.host_id} wire send failed: {exc!r}")
+        for fut in futs:
+            if fut is not None:
+                fut.set_exception(e)
 
     # futures-owner
     def _recv_loop(self) -> None:
         try:
             while True:
                 msg = self._conn.recv()
+                if msg.get("op") == "reply_many":
+                    self._wire_replies(msg)
+                    continue
                 with self._send_lock:
                     fut = self._pending.pop(msg.get("id"), None)
                 if fut is not None:
@@ -710,6 +779,38 @@ class ProcessHost(HostHandle):
         except (EOFError, OSError) as e:
             self._fail(ConnectionError(
                 f"host {self.host_id} connection lost: {e!r}"))
+
+    # futures-owner
+    def _wire_replies(self, msg: dict) -> None:
+        """One batched reply frame off the shm wire: decode validates
+        every ring record — a torn/stale/overrun record condemns the
+        whole payload channel (DESIGN §31 fault table)."""
+        try:
+            pairs = self._wire.decode(msg["items"])
+        except WireCorrupt as e:
+            self._wire_dead(e)
+            return
+        with self._send_lock:
+            futs = [(self._pending.pop(mid, None), reply)
+                    for mid, reply in pairs]
+        for fut, reply in futs:
+            if fut is not None:
+                fut.set_result(reply)
+
+    def _wire_dead(self, exc: WireCorrupt) -> None:
+        """A corrupt shm record ⇒ instant structural death: kill the
+        worker (its view of the rings is no longer trustworthy), fail
+        every pending request NOW (WireCorrupt is ConnectionError-
+        shaped, so the front maps it like any torn transport), and
+        let the heartbeat's torn-pipe detection drive fail-over."""
+        if self._wire is not None:
+            self._wire.fail(exc)
+        if self._proc is not None:
+            try:
+                self._proc.kill()
+            except OSError:
+                pass
+        self._fail(exc)
 
     def _fail(self, exc: Exception) -> None:
         """Mark the transport dead and fail every pending reply future
@@ -723,6 +824,33 @@ class ProcessHost(HostHandle):
             fut.set_exception(exc)
 
     # -- request plumbing ---------------------------------------------- #
+
+    def _deadline(self, timeout: float | None) -> float:
+        """ONE timeout rule for every op: an explicit per-op timeout
+        wins, else the handle's call_timeout — the pickle wire, the
+        shm wire and ping all resolve through here, so the two knobs
+        compose identically everywhere."""
+        return self._call_timeout if timeout is None else float(timeout)
+
+    def _await(self, fut: Future, mid: int, timeout: float | None):
+        """Wait out one reply future. A timeout pops the pending entry
+        (no leak) and raises the BUILTIN TimeoutError: on Python 3.10
+        ``concurrent.futures.TimeoutError`` is a distinct class that
+        is NOT an OSError, so re-raising it raw would slip past
+        _TRANSPORT_ERRORS and reach the caller unstructured instead of
+        mapping to HostUnavailable."""
+        secs = self._deadline(timeout)
+        try:
+            reply = fut.result(secs)
+        except FuturesTimeout as e:
+            with self._send_lock:
+                self._pending.pop(mid, None)
+            raise TimeoutError(
+                f"host {self.host_id} op timed out after "
+                f"{secs:g}s") from e
+        if reply.get("ok"):
+            return reply.get("value")
+        _raise_wire(reply)
 
     def _call(self, op: str, timeout: float | None = None, **kw):
         fut: Future = Future()
@@ -742,21 +870,54 @@ class ProcessHost(HostHandle):
                 self._pending.pop(mid, None)
                 raise ConnectionError(
                     f"host {self.host_id} send failed: {e!r}") from e
+        return self._await(fut, mid, timeout)
+
+    # hot-path (one ring memcpy + one outbox append per request)
+    def _call_wire(self, op: str, sid, b: np.ndarray,
+                   timeout: float | None, qos) -> Any:
+        """A payload op over the shm wire: register the mid, stage the
+        RHS into the request ring, let the pump batch the descriptor
+        out. Ring backpressure maps to HostUnavailable with the ring's
+        measured-drain retry hint — never a blocking wait."""
+        fut: Future = Future()
+        with self._send_lock:
+            if self._dead is not None:
+                raise ConnectionError(
+                    f"host {self.host_id} is dead: {self._dead}")
+            mid = self._next_id
+            self._next_id += 1
+            self._pending[mid] = fut
         try:
-            reply = fut.result(self._call_timeout
-                               if timeout is None else timeout)
-        except TimeoutError:
+            self._wire.submit(mid, sid, b, qos=qos, op=op)
+        except wire_mod.RingFull as e:
+            with self._send_lock:
+                self._pending.pop(mid, None)
+            raise HostUnavailable(
+                f"host {self.host_id} wire backpressure: {e} "
+                f"(retry in ~{e.retry_after * 1e3:.0f}ms at the "
+                f"measured drain rate)",
+                retry_after=e.retry_after, host=self.host_id) from e
+        except ConnectionError:
             with self._send_lock:
                 self._pending.pop(mid, None)
             raise
-        if reply.get("ok"):
-            return reply.get("value")
-        _raise_wire(reply)
+        return self._await(fut, mid, timeout)
 
     # -- op surface ---------------------------------------------------- #
 
     def ping(self, timeout: float | None = None) -> dict:
-        return self._call("ping", timeout=timeout)
+        out = self._call("ping", timeout=timeout)
+        w = self._wire
+        if w is not None and isinstance(out, dict):
+            # ring occupancy rides the heartbeat as a GAUGE — the
+            # front-side client sees both rings, no worker round-trip
+            st = w.stats()
+            frac = max(st["req_used"] / max(1, st["req_cap"]),
+                       st["rep_used"] / max(1, st["rep_cap"]))
+            out.setdefault("counters", {})["wire_used_frac"] = round(
+                frac, 4)
+            out["wire"] = st
+        return out
 
     def open(self, sid, spec, A, policy=None,
              timeout: float | None = None):
@@ -764,9 +925,102 @@ class ProcessHost(HostHandle):
                           A=np.asarray(A), policy=policy)
 
     def solve(self, sid, b, timeout: float | None = None, qos=None):
+        w = self._wire
+        if w is not None:
+            b2 = np.asarray(b)
+            if b2.dtype != object and w.payload_fits(b2.nbytes):
+                return self._call_wire(
+                    "solve", sid, b2, timeout,
+                    None if qos is None else qos.to_wire())
         return self._call("solve", timeout=timeout, sid=sid,
                           b=np.asarray(b),
                           qos=None if qos is None else qos.to_wire())
+
+    def echo(self, b, timeout: float | None = None):
+        """RPC-layer microbench op (``bench_engine.py --wire``): the
+        payload round-trips through whichever wire this host runs,
+        engine bypassed — isolates transport cost from solve cost."""
+        w = self._wire
+        if w is not None:
+            b2 = np.asarray(b)
+            if b2.dtype != object and w.payload_fits(b2.nbytes):
+                return self._call_wire("echo", None, b2, timeout, None)
+        return self._call("echo", timeout=timeout, b=np.asarray(b))
+
+    def echo_many(self, payloads, timeout: float | None = None):
+        """Pipelined batch echo (``bench_engine.py --wire``): submit
+        EVERY payload before awaiting any reply, so the measured cost
+        is the wire itself, not one round-trip latency per request.
+        On the shm wire the burst goes through
+        :meth:`WireClient.submit_many` — N payloads, one lock, a
+        handful of ``solve_many`` frames — honouring ring
+        backpressure with the measured-drain retry hint; on the
+        pickle wire it is one ``Connection.send`` per payload (that
+        per-request serialization IS the baseline being measured).
+        Returns the echoed arrays in submission order."""
+        arrs = [np.asarray(b) for b in payloads]
+        w = self._wire
+        pend: list[tuple[int, Future]] = []
+        if w is not None and all(
+                a.dtype != object and w.payload_fits(a.nbytes)
+                for a in arrs):
+            with self._send_lock:
+                if self._dead is not None:
+                    raise ConnectionError(
+                        f"host {self.host_id} is dead: {self._dead}")
+                for a in arrs:
+                    mid = self._next_id
+                    self._next_id += 1
+                    fut: Future = Future()
+                    self._pending[mid] = fut
+                    pend.append((mid, fut))
+            entries = [(mid, None, a, None, "echo")
+                       for (mid, _f), a in zip(pend, arrs)]
+            sent = 0
+            try:
+                while sent < len(entries):
+                    try:
+                        sent += w.submit_many(entries[sent:])
+                    except wire_mod.RingFull as e:
+                        # bounded, measured-drain pacing: the ring is
+                        # full because replies are still in flight —
+                        # they free records as they land
+                        time.sleep(min(0.05, max(1e-4, e.retry_after)))
+            except ConnectionError:
+                with self._send_lock:
+                    for mid, _f in pend[sent:]:
+                        self._pending.pop(mid, None)
+                raise
+        else:
+            with self._send_lock:
+                if self._dead is not None:
+                    raise ConnectionError(
+                        f"host {self.host_id} is dead: {self._dead}")
+                for a in arrs:
+                    mid = self._next_id
+                    self._next_id += 1
+                    fut = Future()
+                    self._pending[mid] = fut
+                    try:
+                        self._conn.send({"id": mid, "op": "echo",
+                                         "b": a})
+                    except (OSError, ValueError) as e:
+                        self._pending.pop(mid, None)
+                        raise ConnectionError(
+                            f"host {self.host_id} send failed: "
+                            f"{e!r}") from e
+                    pend.append((mid, fut))
+        return [self._await(f, m, timeout) for m, f in pend]
+
+    def debug_wire(self, mode: str) -> None:
+        """Fire-and-forget drill trigger (scripts/fabric_drill.py):
+        ask the worker to emit a deliberately corrupt wire reply. No
+        reply is waited for — the corruption's detection IS the
+        response."""
+        with self._send_lock:
+            if self._conn is not None and self._dead is None:
+                self._conn.send({"id": -2, "op": "_debug_wire",
+                                 "mode": mode})
 
     def update(self, sid, U, V, replace: bool = False,
                timeout: float | None = None):
@@ -820,6 +1074,12 @@ class ProcessHost(HostHandle):
         self._teardown(wait=False)
 
     def _teardown(self, wait: bool = True) -> None:
+        if self._wire is not None:
+            # closes the pump and UNLINKS both segments (the front
+            # created them) — /dev/shm stays clean even when the
+            # worker was SIGKILLed mid-write
+            self._wire.close()
+            self._wire = None
         if self._conn is not None:
             try:
                 self._conn.close()
@@ -871,6 +1131,8 @@ def worker_main(argv=None) -> int:
                     help="front's AF_UNIX listener path")
     ap.add_argument("--ckpt-dir", required=True)
     ap.add_argument("--engine-json", default="{}")
+    ap.add_argument("--wire-json", default=None,
+                    help="shm wire spec: segment names + WireConfig")
     args = ap.parse_args(argv)
 
     key = bytes.fromhex(os.environ["CONFLUX_FABRIC_KEY"])
@@ -883,6 +1145,43 @@ def worker_main(argv=None) -> int:
     core = _HostCore(args.host_id, args.ckpt_dir, eng)
     pool = ThreadPoolExecutor(max_workers=2,
                               thread_name_prefix="fabric-op")
+
+    wire_srv: wire_mod.WireServer | None = None
+    if args.wire_json is not None:
+        spec = json.loads(args.wire_json)
+        cfg = WireConfig.from_json(spec["cfg"])
+        # ATTACH only — the front owns creation and unlink, so a
+        # worker death (even SIGKILL) can never leak /dev/shm entries
+        req_ring = wire_mod.Ring.attach(spec["req"], reclaim="local")
+        rep_ring = wire_mod.Ring.attach(spec["rep"], reclaim="shared")
+        wire_srv = wire_mod.WireServer(
+            req_ring, rep_ring,
+            lambda frame: _send_locked(conn, send_lock, frame),
+            host_id=args.host_id, config=cfg, encode_exc=_encode_exc)
+
+    def _wire_submit_many(batch):
+        """[(sid, b_view, qos_dict)] -> aligned futures. Session and
+        qos resolution fail PER ITEM (a bad sid must not poison its
+        frame-mates); the survivors ride the engine's single-lock
+        batched admission."""
+        futs: list[Future | None] = [None] * len(batch)
+        live = []
+        for i, (sid, b, q) in enumerate(batch):
+            try:
+                s = core._session(sid)
+                qc = None if q is None else qos_mod.class_from_wire(q)
+            except Exception as e:
+                f: Future = Future()
+                f.set_exception(e)
+                futs[i] = f
+            else:
+                live.append((i, s, b, qc))
+        if live:
+            engine_futs = eng.submit_many(
+                [(s, b, qc) for _, s, b, qc in live])
+            for (i, _s, _b, _qc), f in zip(live, engine_futs):
+                futs[i] = f
+        return futs
 
     def reply_solve(fut: Future, mid: int) -> None:
         try:
@@ -917,6 +1216,8 @@ def worker_main(argv=None) -> int:
                 val = core.drop(kw["sid"])
             elif op == "stats":
                 val = core.stats()
+            elif op == "echo":
+                val = kw["b"]  # RPC microbench: transport cost only
             else:
                 raise ValueError(f"unknown fabric op {op!r}")
         # conflint: disable=CFX-EXCEPT worker op boundary: every failure (kills included) is wired back to the front
@@ -943,6 +1244,23 @@ def worker_main(argv=None) -> int:
                 _send_locked(conn, send_lock,
                              {"id": mid, "ok": True, "value": True})
                 break
+            if op == "solve_many":
+                # the zero-copy wire's batched solve frame: descriptor
+                # -> shm view -> single-lock engine admission; replies
+                # ride the reply ring via the server's pump
+                if wire_srv is not None:
+                    wire_srv.handle(msg, _wire_submit_many)
+                continue
+            if op == "_debug_wire":
+                # drill hook: emit a deliberately corrupt wire reply
+                # (fire-and-forget — detection is the response)
+                if wire_srv is not None:
+                    if msg.get("mode") == "die_mid_write":
+                        wire_srv.debug_partial_write()
+                        os._exit(1)
+                    wire_srv.debug_corrupt(msg.get("mode",
+                                                   "torn_reply"))
+                continue
             if op == "solve":
                 try:
                     q = msg.get("qos")
@@ -965,6 +1283,8 @@ def worker_main(argv=None) -> int:
     finally:
         pool.shutdown(wait=False)
         eng.close()
+        if wire_srv is not None:
+            wire_srv.close()  # detach only; the front unlinks
         try:
             conn.close()
         except OSError:
@@ -1295,9 +1615,11 @@ class ServeFabric:
                     self._state[hid] = "alive"
             counters = dict(payload.get("counters") or {})
             delta = self._windows[hid].feed(counters)
-            # pending is a gauge: re-inject the raw depth after the
-            # window differences the payload
+            # pending and wire occupancy are gauges: re-inject the raw
+            # values after the window differences the payload
             delta["pending"] = counters.get("pending", 0)
+            if "wire_used_frac" in counters:
+                delta["wire_used_frac"] = counters["wire_used_frac"]
             self.load.feed(hid, delta)
             return
         bump("heartbeat_misses")
@@ -1557,13 +1879,18 @@ def process_fabric(n: int, root: str, *,
                    engine_kwargs: dict | None = None,
                    policy: FabricPolicy | None = None,
                    fault_plan=None,
-                   start_timeout: float = 180.0) -> ServeFabric:
+                   start_timeout: float = 180.0,
+                   wire: str = "shm",
+                   wire_config: WireConfig | None = None) -> ServeFabric:
     """An n-host fabric with one worker process per host (the real
     deployment shape; scripts/fabric_drill.py and the --fabric
-    bench)."""
+    bench). ``wire`` picks the payload transport (DESIGN §31):
+    'shm' (default) stages solve payloads through per-host
+    shared-memory rings; 'pickle' is the pre-§31 escape hatch."""
     hosts = [ProcessHost(f"h{i}", os.path.join(root, f"h{i}"),
                          engine_kwargs=engine_kwargs,
-                         start_timeout=start_timeout)
+                         start_timeout=start_timeout,
+                         wire=wire, wire_config=wire_config)
              for i in range(n)]
     return ServeFabric(hosts, policy=policy, fault_plan=fault_plan,
                        root=root)

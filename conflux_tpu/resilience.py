@@ -123,6 +123,30 @@ class FleetDegraded(RuntimeError):
         bump("fleet_degraded")
 
 
+class WireCorrupt(ConnectionError):
+    """A shared-memory wire segment failed its integrity check
+    (DESIGN §31): a reply/request record's generation tag does not
+    match its descriptor (a SIGKILL mid-write left a torn record, or a
+    stale descriptor points at a recycled slot), or the descriptor
+    names bytes outside the segment (overrun). Deliberately a
+    ConnectionError subclass — the payload channel to that host can no
+    longer be trusted, so the front treats it exactly like a torn
+    pipe: the host is declared structurally dead on the spot, every
+    pending reply future fails instantly (never a hang), and fail-over
+    revives its sessions from the last checkpoint. `kind` is one of
+    'torn_segment' | 'stale_generation' | 'overrun'; `host` names the
+    host whose wire tore. Counted in
+    ``profiler.serve_stats()['health']['wire_corrupt']``."""
+
+    def __init__(self, msg: str, kind: str = "torn_segment",
+                 host: str | None = None):
+        super().__init__(msg)
+        self.kind = kind
+        self.host = host
+        bump("wire_corrupt")
+        bump(f"wire_corrupt[{kind}]")
+
+
 class TenantThrottled(RuntimeError):
     """Weighted fair-share admission shed this tenant's request: the
     engine is contended and the tenant is at/over its declared share of
@@ -254,6 +278,13 @@ _HEALTH_KEYS = (
     "sessions_failed_over",   # sessions revived on survivors from the
                               # dead host's last checkpoint
     "sessions_migrated",      # live drain-barrier session hand-offs
+    # the zero-copy shm wire (DESIGN §31)
+    "wire_corrupt",           # WireCorrupt raised (torn/stale/overrun
+                              # ring record — host declared dead)
+    "wire_ring_full",         # shm ring allocations refused (backpressure
+                              # shed with a measured-drain retry hint)
+    "wire_pickle_fallbacks",  # payloads that rode the pickle wire because
+                              # they did not fit / the ring was saturated
     # multi-tenant QoS (DESIGN §30): fair-share admission sheds. The
     # per-class attributions ride lazy keys — tenant_throttled[t/tier]
     # and engine_saturated[t/tier] — next to these global totals
@@ -475,7 +506,10 @@ def breaker_for(session, policy: HealthPolicy,
 
 FAULT_SITES = ("staging", "dispatch", "drain", "d2h", "solve", "refresh",
                "factor", "spill", "revive", "disk_write", "disk_read",
-               "heartbeat", "route", "migrate", "host_kill")
+               "heartbeat", "route", "migrate", "host_kill",
+               # the shm wire (DESIGN §31): alloc refusal + reader-side
+               # integrity trips, injected in conflux_tpu/wire.py
+               "ring_full", "torn_segment", "stale_generation")
 FAULT_KINDS = ("nan", "delay", "crash", "kill", "unhealthy")
 
 

@@ -38,6 +38,7 @@ _RATIO_KEYS = (
     "speedup_vs_per_session_dispatch", "speedup_vs_sequential",
     "speedup_vs_always_refactor", "speedup_vs_seq_async",
     "ratio_solves_vs_single_lane", "ratio_solves_vs_single_host",
+    "speedup_vs_pickle_wire",
     "overhead_pct",
     "single_speedup_vs_refactor", "speedup_vs_naive",
     "speedup_vs_xla_trsm", "speedup_vs_staged_factor",
@@ -46,6 +47,7 @@ _RATIO_KEYS = (
 _GATE_KEYS = (
     "speedup_gate_x", "gate_ratio", "overhead_gate_pct",
     "steady_slack_gate_pct", "tier_gate_x", "blowup_gate_x",
+    "wire_gate_x",
 )
 
 

@@ -18,7 +18,14 @@ A REAL multi-process drill, not a simulated one: the driver builds a
                       checkpoint, every session still answers BITWISE,
                       and the measured recovery time is bounded,
   4. conservation   — the session census never changes: nothing is
-                      lost, nothing duplicated.
+                      lost, nothing duplicated,
+  5. wire drill     — on a FRESH 3-host fabric over the shm wire
+                      (DESIGN §31): a torn reply record (writer killed
+                      mid-copy) must read as WireCorrupt -> instant
+                      structural dead, and a worker that SIGKILLs
+                      itself mid-ring-write must likewise fail over;
+                      both times every session still answers bitwise
+                      and no /dev/shm segment leaks.
 
     python scripts/fabric_drill.py DIR [--hosts 2] [--sessions 6]
                                        [--json OUT]
@@ -29,6 +36,7 @@ Exit status is the gate (CI runs this after the unit suite).
 from __future__ import annotations
 
 import argparse
+import glob
 import json
 import os
 import signal
@@ -39,7 +47,7 @@ import numpy as np
 
 sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
 
-from conflux_tpu import fabric
+from conflux_tpu import fabric, resilience
 from conflux_tpu.engine import rendezvous
 from conflux_tpu.fabric import FabricPolicy
 from conflux_tpu.resilience import HostUnavailable
@@ -179,9 +187,124 @@ def drill(root: str, hosts: int, sessions: int) -> dict:
             "recovery_s_max": st["recovery_s_max"],
             "hosts": {h: d["state"] for h, d in st["hosts"].items()},
         }
+    # ---- 5. wire drill: torn ring records => structural death --------- #
+    out["wire"] = wire_drill(os.path.join(root, "wire"), bad)
+
     out["failures"] = bad
     out["elapsed_s"] = round(time.perf_counter() - t_all, 3)
     return out
+
+
+def _answer_through_failover(fab, sid, b, bad, tag, bound=90.0):
+    """Solve ``sid`` riding out a host death: structured
+    HostUnavailable retries (honouring the hint) until the fail-over
+    wins; a hang or a >bound stall is the failure being drilled for."""
+    t0 = time.perf_counter()
+    while True:
+        try:
+            return np.asarray(fab.solve(sid, b, timeout=30.0)), \
+                time.perf_counter() - t0
+        except HostUnavailable as e:
+            if time.perf_counter() - t0 >= bound:
+                bad.append(f"{tag}: {sid} unanswered after {bound}s")
+                return None, time.perf_counter() - t0
+            time.sleep(min(0.05, max(0.01, e.retry_after)))
+
+
+def wire_drill(root: str, bad: list[str]) -> dict:
+    """Phase 5 — the shm-wire corruption drill (ISSUE 16 / DESIGN
+    §31), on its own 3-host fabric so each event has survivors:
+
+      a. ``torn_reply``    — the worker emits a reply record whose
+                             footer never landed (a writer killed
+                             mid-copy).  The front's decode must see
+                             WireCorrupt and declare the host
+                             structurally dead INSTANTLY (no timeout
+                             escalation), fail-over must revive its
+                             fleet bitwise.
+      b. ``die_mid_write`` — the worker writes a bare record header at
+                             the reply ring's head and SIGKILLs itself
+                             (os._exit), the real crash geometry.
+                             Same contract: structured death, bitwise
+                             fail-over.
+
+    Both fabrics' shared-memory segments must be unlinked on close —
+    including the rings of the two corpses."""
+    pre = set(glob.glob("/dev/shm/cfxw-*"))
+    pol = FabricPolicy(heartbeat_interval=0.1, heartbeat_timeout=5.0,
+                       suspect_after=2, dead_after=4,
+                       checkpoint_interval=0.0)
+    plan = FactorPlan.create((N, N), "float32", v=V)
+    fab = fabric.process_fabric(3, root, policy=pol,
+                                engine_kwargs={"max_batch_delay": 0.0},
+                                wire="shm")
+    info: dict = {}
+    with fab:
+        ids = [f"h{i}" for i in range(3)]
+        by_host: dict[str, list[str]] = {h: [] for h in ids}
+        i = 0
+        while min(len(v) for v in by_host.values()) < 2:
+            sid = f"wire-{i}"
+            by_host[rendezvous(sid, ids)].append(sid)
+            i += 1
+        sids = sorted(sum((v[:2] for v in by_host.values()), []))
+        mats, rhs, ref = {}, {}, {}
+        for i, sid in enumerate(sids):
+            mats[sid] = _mk(100 + i)
+            fab.open(sid, plan, mats[sid])
+            rhs[sid] = _rhs(100 + i)
+            ref[sid] = np.asarray(fab.solve(sid, rhs[sid]))
+        fab.checkpoint_all()
+
+        for mode in ("torn_reply", "die_mid_write"):
+            live = [h for h in ids if fab.host_state(h) != "dead"]
+            victim = fab.owner_of(next(
+                s for s in sids if fab.owner_of(s) in live))
+            fab._hosts[victim].debug_wire(mode)
+            probe = next(s for s in sids if fab.owner_of(s) == victim)
+            got, dt = _answer_through_failover(
+                fab, probe, rhs[probe], bad, mode)
+            if got is not None and not np.array_equal(got, ref[probe]):
+                bad.append(f"{mode}: fail-over answer not bitwise: "
+                           f"{probe}")
+            deadline = time.perf_counter() + 30.0
+            while (fab.host_state(victim) != "dead"
+                   and time.perf_counter() < deadline):
+                time.sleep(0.02)
+            if fab.host_state(victim) != "dead":
+                bad.append(f"{mode}: {victim} never declared dead")
+            for sid in sids:  # the whole fleet, revived ones included
+                got2, _ = _answer_through_failover(
+                    fab, sid, rhs[sid], bad, mode + "/sweep")
+                if got2 is not None and not np.array_equal(
+                        got2, ref[sid]):
+                    bad.append(f"{mode}: post-failover not bitwise: "
+                               f"{sid}")
+            info[mode] = {"victim": victim,
+                          "recovery_s": round(dt, 3)}
+            # re-checkpoint the revived fleet before the next event —
+            # the background checkpoint_interval loop provides this
+            # bound in production (same note as phase 3 above)
+            fab.checkpoint_all()
+
+        hb = resilience.health_stats()
+        info["wire_corrupt"] = hb.get("wire_corrupt", 0)
+        if not hb.get("wire_corrupt", 0) >= 1:
+            bad.append("wire drill never recorded a wire_corrupt "
+                       f"health event: {hb}")
+        st = fab.stats()
+        if st["sessions"] != len(sids):
+            bad.append(f"wire drill census {st['sessions']} != "
+                       f"{len(sids)}")
+        if st["lost_sessions"]:
+            bad.append(f"wire drill lost_sessions = "
+                       f"{st['lost_sessions']}")
+        info["sessions"] = st["sessions"]
+    leaked = sorted(set(glob.glob("/dev/shm/cfxw-*")) - pre)
+    if leaked:
+        bad.append(f"wire drill leaked shm segments: {leaked}")
+    info["shm_leaks"] = len(leaked)
+    return info
 
 
 def main(argv=None) -> int:
@@ -202,11 +325,15 @@ def main(argv=None) -> int:
         print(f"fabric_drill: FAIL {line}")
     if out["failures"]:
         return 1
+    w = out["wire"]
     print(f"fabric_drill: OK — {args.sessions} sessions over "
           f"{args.hosts} worker processes; migration bitwise; kill of "
           f"{out['killed']['host']} ({out['killed']['owned']} sessions) "
           f"recovered in {out['recovery']['seconds'] * 1e3:.0f}ms with "
-          f"0 lost; total {out['elapsed_s']:.1f}s")
+          f"0 lost; wire drill torn_reply "
+          f"{w['torn_reply']['recovery_s'] * 1e3:.0f}ms / die_mid_write "
+          f"{w['die_mid_write']['recovery_s'] * 1e3:.0f}ms, "
+          f"{w['shm_leaks']} shm leaks; total {out['elapsed_s']:.1f}s")
     return 0
 
 

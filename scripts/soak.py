@@ -1416,10 +1416,113 @@ def run_fabric_trial(seed: int) -> tuple[bool, str]:
                                    "without a host_kill fault")
         finally:
             fab.close()
+
+    # ---- wire hammer: the shm payload wire under its own menu --------- #
+    # (ISSUE 16 / DESIGN §31) An InProcWire — real shared segments,
+    # real generation/backpressure protocol — serving per-sid f64
+    # solves while the wire fault sites fire: ring_full (alloc
+    # refusal), torn_segment / stale_generation (reader-integrity
+    # trips). Invariants: RingFull is retryable backpressure (the wire
+    # SURVIVES it), WireCorrupt is instant structural death (pending
+    # futures fail NOW; a fresh wire is the fail-over analogue), and
+    # every answer that lands is BITWISE its sid's own f64 oracle —
+    # zero cross-request corruption through the shared segments.
+    from concurrent.futures import Future
+
+    from conflux_tpu.wire import (
+        InProcWire,
+        RingFull,
+        WireConfig,
+        WireCorrupt,
+    )
+    wrng = np.random.default_rng(seed + 7)
+    wire_menu = [
+        FaultSpec("ring_full", "crash", prob=0.5,
+                  count=int(wrng.integers(1, 3))),
+        FaultSpec("torn_segment", "crash", prob=1.0, count=1),
+        FaultSpec("stale_generation", "crash", prob=1.0, count=1),
+    ]
+    wire_picks = [m for m in wire_menu if wrng.integers(2)]
+    wire_faults = FaultPlan(wire_picks, seed=seed + 7)
+    label += f" wire={[(f.site, f.kind) for f in wire_picks]}"
+    W = int(wrng.integers(3, 6))
+    wAs = {f"w{j}": (wrng.standard_normal((N, N)) / np.sqrt(N)
+                     + 2.0 * np.eye(N))
+           for j in range(W)}
+
+    def hook(batch):
+        futs = []
+        for sid, view, _q in batch:
+            f: Future = Future()
+            try:
+                f.set_result(np.linalg.solve(
+                    wAs[sid], np.asarray(view, np.float64)))
+            # conflint: disable=CFX-EXCEPT soak hook mirrors the worker op boundary
+            except BaseException as e:
+                f.set_exception(e)
+            futs.append(f)
+        return futs
+
+    def mk():
+        return InProcWire(hook, config=WireConfig(ring_bytes=1 << 20),
+                          fault_plan=wire_faults,
+                          host_id=f"soak{seed % 10000}")
+
+    w = mk()
+    wire_answers = wire_deaths = wire_backpressure = 0
+    try:
+        for j in range(24):
+            sid = f"w{j % W}"
+            b = wrng.standard_normal((N, int(wrng.choice([1, 2]))))
+            want = np.linalg.solve(wAs[sid], b)
+            t0, fut = time.time(), None
+            while fut is None:
+                try:
+                    fut = w.solve(sid, b)
+                except RingFull as e:
+                    wire_backpressure += 1
+                    if time.time() - t0 > 10.0:
+                        return False, (f"{label}: wire backpressure "
+                                       "never cleared")
+                    time.sleep(min(0.01, max(1e-4, e.retry_after)))
+                except ConnectionError:
+                    w.close()
+                    wire_deaths += 1
+                    w = mk()
+            try:
+                x = fut.result(timeout=30.0)
+            except (WireCorrupt, ConnectionError):
+                # instant structural death with pending work — the
+                # request fails NOW (never a hang, never a silent
+                # retry into a corrupt segment); fail-over = new wire
+                w.close()
+                wire_deaths += 1
+                w = mk()
+                continue
+            except Exception as e:  # noqa: BLE001 — soak records, not raises
+                return False, (f"{label}: UNSTRUCTURED wire failure "
+                               f"{type(e).__name__}: {e}")
+            if not np.array_equal(np.asarray(x), want):
+                return False, (f"{label}: wire answer for {sid} not "
+                               "bitwise its own f64 oracle — cross-"
+                               "request corruption through the ring")
+            wire_answers += 1
+    finally:
+        w.close()
+    corrupt_picked = sum(1 for f in wire_picks
+                         if f.site in ("torn_segment",
+                                       "stale_generation"))
+    if wire_deaths < corrupt_picked:
+        return False, (f"{label}: {corrupt_picked} corrupt-site "
+                       f"faults picked but only {wire_deaths} "
+                       "structural wire deaths observed")
     return True, (f"{label}: ok {answered} solves, "
                   f"{migrations} migrations, {revived} revives, "
                   f"{rollbacks} rollbacks, "
-                  f"injected={sum(faults.injected.values())}")
+                  f"injected={sum(faults.injected.values())}; wire "
+                  f"{wire_answers} answers, {wire_deaths} deaths, "
+                  f"{wire_backpressure} backpressure retries, "
+                  f"injected={sum(wire_faults.injected.values())}")
 
 
 def main(argv=None) -> int:
@@ -1480,7 +1583,12 @@ def main(argv=None) -> int:
                     "with kill/revive/migrate churn; asserts "
                     "structured failures only, bounded recovery, "
                     "per-session f64 oracle answers (zero cross-host "
-                    "corruption) and session-count conservation")
+                    "corruption) and session-count conservation; each "
+                    "trial then hammers the shm payload wire (DESIGN "
+                    "§31) under the ring_full / torn_segment / "
+                    "stale_generation fault sites: backpressure is "
+                    "retryable, corruption is instant structural "
+                    "death, answers stay bitwise their f64 oracle")
     ap.add_argument("--qos", action="store_true",
                     help="chaos-soak the multi-tenant QoS layer: "
                     "random tenants across the latency/throughput/"

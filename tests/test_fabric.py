@@ -460,3 +460,62 @@ def test_checkpoint_generations_pruned(tmp_path):
         assert snap is not None
         assert fabric.checkpoint_sids(snap) == {
             "gen": fabric.record_name("gen")}
+
+
+# --------------------------------------------------------------------------- #
+# ProcessHost request plumbing (ISSUE 16): timeout composition + wire
+# --------------------------------------------------------------------------- #
+
+
+class _NeverReplies:
+    """A Connection stand-in that accepts sends and never answers —
+    the shape of a wedged (not dead) worker."""
+
+    def __init__(self):
+        self.sent = []
+
+    def send(self, msg):
+        self.sent.append(msg)
+
+    def close(self):
+        pass
+
+
+def test_processhost_per_op_timeout_is_transport_shaped(tmp_path):
+    """Regression (ISSUE 16): on Python 3.10
+    `concurrent.futures.TimeoutError` is NOT the builtin TimeoutError
+    (and not an OSError), so ProcessHost._call's old `except
+    TimeoutError` never caught it — the pending entry leaked and the
+    raw futures timeout escaped `_TRANSPORT_ERRORS`, reaching callers
+    unstructured. Now a slow op under a tight per-op timeout raises
+    the BUILTIN TimeoutError (OSError-shaped, so the front maps it to
+    HostUnavailable), pops its pending entry, and the per-op timeout
+    beats call_timeout."""
+    h = fabric.ProcessHost("hx", str(tmp_path / "hx"),
+                           call_timeout=30.0, wire="pickle")
+    h._conn = _NeverReplies()
+    t0 = time.perf_counter()
+    with pytest.raises(TimeoutError) as ei:
+        h._call("stats", timeout=0.15)
+    dt = time.perf_counter() - t0
+    assert isinstance(ei.value, fabric._TRANSPORT_ERRORS)
+    assert 0.1 < dt < 5.0          # the per-op timeout won, not 30s
+    assert h._pending == {}        # no pending-entry leak
+    assert h._conn.sent            # the op really went out
+
+
+def test_processhost_call_timeout_fallback(tmp_path):
+    """timeout=None composes predictably: the handle's call_timeout
+    applies, through the same single `_deadline` rule as per-op
+    timeouts."""
+    h = fabric.ProcessHost("hy", str(tmp_path / "hy"),
+                           call_timeout=0.15, wire="pickle")
+    h._conn = _NeverReplies()
+    with pytest.raises(TimeoutError):
+        h._call("stats")
+    assert h._pending == {}
+
+
+def test_processhost_rejects_unknown_wire():
+    with pytest.raises(ValueError, match="wire"):
+        fabric.ProcessHost("hz", "/tmp/unused-hz", wire="carrier-pigeon")
