@@ -1896,16 +1896,24 @@ class ServeEngine:
     def submit_many(self, items) -> list:
         """Batched :meth:`submit` for the zero-copy wire (DESIGN §31):
         `items` is ``[(session, b, qos)]``; returns len(items) futures,
-        aligned. All admissible items are admitted under a SINGLE
-        acquisition of the admission lock — a coalesced control frame
-        pays one lock round-trip instead of one per request — and
-        routing (queue pushes) happens outside it, like submit().
+        aligned. Items that can be admitted WITHOUT waiting are
+        admitted under a single acquisition of the admission lock — a
+        coalesced control frame pays one lock round-trip instead of
+        one per request — and routed (queue pushes) outside it, like
+        submit(). An item that would have to WAIT (the checkpoint
+        drain barrier, or the 'block' policy at the global/per-lane
+        pending bound) first flushes its already-admitted frame-mates
+        to their lanes, then waits alone through the ordinary
+        :meth:`_admit` path: an admitted-but-unrouted request can
+        never complete, so a condition wait that needs ITS pending
+        slot to free would deadlock the frame (and wedge the wire
+        recv thread behind it).
 
         Per-item failures (validation, quarantine, saturation, tenant
         throttle) are set ON that item's future instead of raised, so
         one bad request never takes down its frame-mates; the wire
         encodes each future's exception back to its own caller."""
-        reqs: list = []
+        reqs: deque = deque()
         futs: list = []
         for session, b, qos in items:
             try:
@@ -1917,17 +1925,32 @@ class ServeEngine:
             else:
                 reqs.append(req)
                 futs.append(req.future)
-        admitted = []
-        with self._lock:
-            for req in reqs:
+        while reqs:
+            admitted = []
+            with self._lock:
+                while reqs:
+                    req = reqs[0]
+                    try:
+                        if not self._admit_locked(req, wait=False):
+                            break  # would wait: flush admitted first
+                    except Exception as e:
+                        reqs.popleft()
+                        req.future.set_exception(e)
+                        continue
+                    reqs.popleft()
+                    admitted.append(req)
+            for req in admitted:
+                self._route(req)
+            if reqs:
+                # the head of the remainder must wait; every admitted
+                # frame-mate is routed by now (free to complete and
+                # release its slot), so the plain blocking path holds
+                # no frame state — then resume batching the tail
+                req = reqs.popleft()
                 try:
-                    self._admit_locked(req)
+                    self._admit(req)
                 except Exception as e:
                     req.future.set_exception(e)
-                else:
-                    admitted.append(req)
-        for req in admitted:
-            self._route(req)
         return futs
 
     def _admit(self, req) -> Future:
@@ -1940,12 +1963,15 @@ class ServeEngine:
         return req.future
 
     # requires-lock: _lock
-    def _admit_locked(self, req) -> None:
+    def _admit_locked(self, req, wait: bool = True) -> bool:
         """The locked body of admission (also the per-item step of
-        :meth:`submit_many`'s single-lock batch). May WAIT on
-        `_not_full` (drain barrier / 'block' policy) — condition waits
-        release the lock, so frame-mates are not wedged, merely
-        ordered."""
+        :meth:`submit_many`'s batch). May WAIT on `_not_full` (drain
+        barrier / 'block' policy); with ``wait=False`` every
+        would-wait site instead returns False with NOTHING committed,
+        so a batched caller can route its already-admitted work before
+        blocking — a wait taken while admitted-but-unrouted
+        frame-mates hold pending slots could never be satisfied by
+        them. Returns True when the request was admitted."""
         if self._closed:
             raise EngineClosed("submit() on a closed ServeEngine")
         while self._draining and not self._closed:
@@ -1965,6 +1991,8 @@ class ServeEngine:
                     "barrier (snapshot serializing) — retry "
                     "shortly, or fall back to plan.factor",
                     retry_after=0.05)
+            if not wait:
+                return False
             # checkpoint drain barrier: hold admission (both
             # policies) until the snapshot completes — brief by
             # construction, the snapshot is host-side serialization
@@ -1981,6 +2009,8 @@ class ServeEngine:
                     f"{self.max_pending} (shed policy 'reject'; "
                     f"{why})", retry_after=hint,
                     **self._qos_shed_attr(req))
+            if not wait:
+                return False
             while self._pending >= self.max_pending \
                     and not self._closed:
                 self._not_full.wait()
@@ -2006,6 +2036,8 @@ class ServeEngine:
                         f"(per-lane slice; other lanes keep "
                         f"admitting — {why})", retry_after=hint,
                         **self._qos_shed_attr(req))
+                if not wait:
+                    return False
                 while lane.pending >= slice_cap \
                         and not self._closed:
                     self._not_full.wait()
@@ -2027,6 +2059,7 @@ class ServeEngine:
         self._live.add(req)
         if self._pending > self._queue_peak:
             self._queue_peak = self._pending
+        return True
 
     # requires-lock: _lock
     def _shed_hint_locked(self) -> tuple:

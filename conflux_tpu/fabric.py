@@ -562,7 +562,7 @@ class LocalHost(HostHandle):
 def _encode_exc(e: BaseException) -> dict:
     extra: dict = {}
     for k in ("retry_after", "evidence", "live", "total", "host",
-              "surface", "tenant", "qos_class"):
+              "surface", "tenant", "qos_class", "kind"):
         v = getattr(e, k, None)
         if v is not None:
             extra[k] = v
@@ -596,6 +596,16 @@ _WIRE_TYPES: dict[str, Any] = {
         live=x.get("live", 0), total=x.get("total", 0)),
     "KeyError": lambda m, x: KeyError(m),
     "ValueError": lambda m, x: ValueError(m),
+    # a corrupt REQUEST record detected worker-side comes back as a
+    # per-item error frame: rehydrate it ConnectionError-shaped with
+    # its kind/host intact. Deliberately NOT host death (the
+    # asymmetry with reply-side corruption): the front wrote that
+    # record and its frame-mates validated fine, so the channel
+    # itself is still trusted — only reply-side corruption (decode)
+    # condemns the host, because there the front can no longer trust
+    # anything it reads out of the reply ring.
+    "WireCorrupt": lambda m, x: WireCorrupt(
+        m, kind=x.get("kind", "torn_segment"), host=x.get("host")),
 }
 
 
@@ -639,10 +649,13 @@ class ProcessHost(HostHandle):
     ops, oversized payloads and a worker whose reply ring backs up
     all fall back to the pickle wire transparently; ``wire="pickle"``
     is the escape hatch that turns the rings off entirely. A corrupt
-    ring record (:class:`~conflux_tpu.resilience.WireCorrupt` —
+    REPLY record (:class:`~conflux_tpu.resilience.WireCorrupt` —
     torn/stale/overrun) means the payload channel can no longer be
     trusted: the worker is killed and every pending request fails
-    structurally, exactly like a torn pipe."""
+    structurally, exactly like a torn pipe. A corrupt REQUEST record
+    detected worker-side fails only its own item (rehydrated
+    front-side as WireCorrupt, kind/host intact) — the asymmetry is
+    deliberate, see the `_WIRE_TYPES` entry."""
 
     def __init__(self, host_id: str, ckpt_dir: str, *,
                  engine_kwargs: dict | None = None,
@@ -814,7 +827,15 @@ class ProcessHost(HostHandle):
 
     def _fail(self, exc: Exception) -> None:
         """Mark the transport dead and fail every pending reply future
-        — no request ever hangs on a torn pipe."""
+        — no request ever hangs on a torn pipe. The shm wire client
+        (when present) is failed FIRST, outside `_send_lock` (it has
+        its own lock; never nest the two): a torn pipe means no reply
+        will ever drain the rings again, so ring-backpressure retry
+        loops and the send pump must observe the death instead of
+        pacing forever against a permanently full ring."""
+        w = self._wire
+        if w is not None:
+            w.fail(exc)
         with self._send_lock:
             if self._dead is None:
                 self._dead = exc
@@ -977,6 +998,8 @@ class ProcessHost(HostHandle):
             entries = [(mid, None, a, None, "echo")
                        for (mid, _f), a in zip(pend, arrs)]
             sent = 0
+            secs = self._deadline(timeout)
+            give_up = time.perf_counter() + secs
             try:
                 while sent < len(entries):
                     try:
@@ -984,7 +1007,24 @@ class ProcessHost(HostHandle):
                     except wire_mod.RingFull as e:
                         # bounded, measured-drain pacing: the ring is
                         # full because replies are still in flight —
-                        # they free records as they land
+                        # they free records as they land. Re-check
+                        # death each lap (a torn pipe means no reply
+                        # will EVER free a record) and bound the total
+                        # pacing by the op timeout: never spin forever
+                        with self._send_lock:
+                            dead = self._dead
+                        if dead is not None:
+                            raise ConnectionError(
+                                f"host {self.host_id} died while "
+                                f"pacing a full ring: {dead}") from dead
+                        if time.perf_counter() >= give_up:
+                            with self._send_lock:
+                                for mid, _f in pend[sent:]:
+                                    self._pending.pop(mid, None)
+                            raise TimeoutError(
+                                f"host {self.host_id} request ring "
+                                f"stayed full past the {secs:g}s op "
+                                f"timeout") from e
                         time.sleep(min(0.05, max(1e-4, e.retry_after)))
             except ConnectionError:
                 with self._send_lock:

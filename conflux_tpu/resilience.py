@@ -133,9 +133,14 @@ class WireCorrupt(ConnectionError):
     longer be trusted, so the front treats it exactly like a torn
     pipe: the host is declared structurally dead on the spot, every
     pending reply future fails instantly (never a hang), and fail-over
-    revives its sessions from the last checkpoint. `kind` is one of
-    'torn_segment' | 'stale_generation' | 'overrun'; `host` names the
-    host whose wire tore. Counted in
+    revives its sessions from the last checkpoint. That condemnation
+    applies to REPLY-side corruption (the front's decode); a corrupt
+    REQUEST record detected worker-side instead fails only its own
+    item — shipped back as a structured error the front rehydrates to
+    this type — because the front wrote that record and its
+    frame-mates validated fine, so the channel itself is still
+    trusted. `kind` is one of 'torn_segment' | 'stale_generation' |
+    'overrun'; `host` names the host whose wire tore. Counted in
     ``profiler.serve_stats()['health']['wire_corrupt']``."""
 
     def __init__(self, msg: str, kind: str = "torn_segment",

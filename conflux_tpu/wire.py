@@ -854,10 +854,15 @@ class WireServer:
 
 def segment_names(host_id: str) -> tuple[str, str]:
     """(request, reply) segment names for one host — unique per
-    start(), filesystem-visible under /dev/shm for leak audits."""
+    start(), filesystem-visible under /dev/shm for leak audits. The
+    host-id slice is capped at 10 chars so the full name stays <= 27:
+    macOS limits POSIX shm names to 31 bytes (PSHMNAMLEN) including
+    the leading '/' the stdlib prepends, and a longer host id must
+    not make Ring.create fail there — the random token, not the id,
+    carries uniqueness."""
     tok = secrets.token_hex(4)
     safe = "".join(c if c.isalnum() or c in "-_" else "_"
-                   for c in str(host_id))[:24]
+                   for c in str(host_id))[:10]
     return (f"cfxw-{safe}-{tok}-rq", f"cfxw-{safe}-{tok}-rp")
 
 

@@ -166,6 +166,35 @@ def test_engine_block_policy_backpressures():
     assert eng.stats()["shed"] == 0
 
 
+def test_engine_block_policy_submit_many_frame_no_deadlock():
+    """A submit_many frame LARGER than max_pending under 'block' never
+    self-deadlocks: an item that must wait first flushes its already-
+    admitted frame-mates to their lanes — a wait taken while unrouted
+    frame-mates held the pending slots could never be satisfied by
+    them — and every item of the frame still completes bitwise."""
+    serve.clear_plans()
+    A = _systems(1, seed=63)
+    plan = serve.FactorPlan.create((N, N), jnp.float32, v=V)
+    session = plan.factor(jnp.asarray(A[0]))
+    rng = np.random.default_rng(63)
+    items = [(session, jnp.asarray(b), None)
+             for _, b in _trace(rng, 8, widths=(1,))]
+    futs = []
+    with ServeEngine(max_batch_delay=0.0, max_pending=2,
+                     on_full="block") as eng:
+        t = threading.Thread(
+            target=lambda: futs.extend(eng.submit_many(items)))
+        t.start()
+        t.join(timeout=120)
+        assert not t.is_alive(), \
+            "batched frame wedged at the pending bound"
+        results = [np.asarray(f.result(timeout=60)) for f in futs]
+        for (s, b, _q), r in zip(items, results):
+            np.testing.assert_array_equal(r, np.asarray(s.solve(b)))
+    assert eng.stats()["completed"] == 8
+    assert eng.stats()["shed"] == 0
+
+
 def test_engine_close_drains_in_flight():
     serve.clear_plans()
     A = _systems(2, seed=67)

@@ -519,3 +519,79 @@ def test_processhost_call_timeout_fallback(tmp_path):
 def test_processhost_rejects_unknown_wire():
     with pytest.raises(ValueError, match="wire"):
         fabric.ProcessHost("hz", "/tmp/unused-hz", wire="carrier-pigeon")
+
+
+class _AlwaysFullWire:
+    """A WireClient stand-in whose request ring never drains — the
+    shape of a torn pipe with replies that will never land. Optionally
+    fails the owning host mid-pacing, like the recv thread observing
+    the pipe EOF while echo_many retries."""
+
+    def __init__(self, host=None, fail_on_call=None):
+        self._host = host
+        self._fail_on_call = fail_on_call
+        self.calls = 0
+        self.failed_with = None
+
+    def payload_fits(self, nbytes):
+        return True
+
+    def submit_many(self, entries):
+        self.calls += 1
+        if self.calls == self._fail_on_call:
+            self._host._fail(ConnectionError("pipe torn mid-pacing"))
+        raise fabric.wire_mod.RingFull("ring full", retry_after=1e-3)
+
+    def fail(self, exc):
+        self.failed_with = exc
+
+
+def test_processhost_fail_also_fails_wire_client(tmp_path):
+    """Regression (ISSUE 16 review): `_fail` (a torn pipe) must also
+    fail the shm wire client — otherwise the ring-backpressure retry
+    loops keep pacing against a ring no reply will ever drain."""
+    h = fabric.ProcessHost("hw", str(tmp_path / "hw"))
+    w = _AlwaysFullWire()
+    h._wire = w
+    h._fail(ConnectionError("torn"))
+    assert isinstance(w.failed_with, ConnectionError)
+
+
+def test_echo_many_ring_full_pacing_observes_death(tmp_path):
+    """Regression (ISSUE 16 review): echo_many's RingFull pacing loop
+    re-checks host death each lap — a pipe torn mid-burst raises a
+    structured ConnectionError instead of spinning forever, and the
+    unsent tail's pending entries are reclaimed."""
+    h = fabric.ProcessHost("hv", str(tmp_path / "hv"))
+    h._wire = _AlwaysFullWire(host=h, fail_on_call=2)
+    with pytest.raises(ConnectionError, match="pacing|torn"):
+        h.echo_many([np.ones(8, np.float32)] * 3)
+    assert h._pending == {}
+
+
+def test_echo_many_ring_full_pacing_bounded_by_op_timeout(tmp_path):
+    """A ring that stays full with the host still alive cannot pace
+    past the op timeout: echo_many raises the builtin TimeoutError
+    (transport-shaped) and reclaims the unsent pending entries."""
+    h = fabric.ProcessHost("hu", str(tmp_path / "hu"),
+                           call_timeout=0.15)
+    h._wire = _AlwaysFullWire()
+    t0 = time.perf_counter()
+    with pytest.raises(TimeoutError, match="stayed full"):
+        h.echo_many([np.ones(8, np.float32)] * 3)
+    assert time.perf_counter() - t0 < 5.0
+    assert h._pending == {}
+
+
+def test_wire_corrupt_codec_rehydrates_kind_and_host():
+    """Regression (ISSUE 16 review): a worker-side WireCorrupt (corrupt
+    REQUEST record) crosses the pickle control plane intact — the front
+    re-raises the ConnectionError-shaped type with kind/host, not a
+    generic RuntimeError."""
+    e = resilience.WireCorrupt("request record torn",
+                               kind="stale_generation", host="h9")
+    with pytest.raises(resilience.WireCorrupt) as ei:
+        fabric._raise_wire(fabric._encode_exc(e))
+    assert ei.value.kind == "stale_generation"
+    assert ei.value.host == "h9"
+    assert isinstance(ei.value, ConnectionError)

@@ -70,6 +70,18 @@ def _echo_submit_many(batch):
 # --------------------------------------------------------------------------- #
 
 
+def test_segment_names_fit_posix_name_limit():
+    """macOS caps POSIX shm names at 31 bytes (PSHMNAMLEN) INCLUDING
+    the leading '/' the stdlib prepends — a long host id must trim,
+    not make Ring.create fail, and the random token keeps two starts
+    of the same host distinct."""
+    rq, rp = wire_mod.segment_names("host-" + "x" * 60)
+    assert max(len(rq), len(rp)) <= 30
+    assert rq != rp
+    assert wire_mod.segment_names("h")[0] != \
+        wire_mod.segment_names("h")[0]
+
+
 def test_ring_roundtrip_bitwise():
     """stage -> read is bitwise for every dtype/shape the fabric
     ships, both as a copy and as a zero-copy view."""
