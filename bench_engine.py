@@ -301,6 +301,42 @@ def parse_args():
     ap.add_argument("--qos-cost-gate", type=float, default=5.0,
                     help="max %% closed-loop throughput cost of "
                     "classification on calm traffic (--qos)")
+    ap.add_argument("--mesh", action="store_true",
+                    help="measure the ISSUE 17 large-N mesh lane "
+                    "instead (DESIGN §32): the paper's own mesh-sharded "
+                    "workload served THROUGH the engine. Leg pair at "
+                    "the serving shape: multi-RHS-coalesced engine "
+                    "traffic against one mesh session versus the "
+                    "sequential bare plan.factor+solve loop (the only "
+                    "way large-N ran before the mesh lane) — gate "
+                    ">= --mesh-gate x solves/s, answers 1e-5-allclose "
+                    "per request plus bitwise-within-a-bucket on a "
+                    "held window (the engine contract for batched "
+                    "plans), zero compiles after prewarm. Then a "
+                    "mixed mesh+fleet QoS trace (mesh heavyweight "
+                    "tenant + latency-tier fleet tenant on ONE engine) "
+                    "must hold BOTH classes' SLOs, and an N >= "
+                    "--mesh-e2e-n (smoke: 512) mesh session runs end-"
+                    "to-end — engine factor, coalesced solves, tiered "
+                    "spill/revive, checkpoint/restore — every answer "
+                    "bitwise vs the bare oracle. mesh_plan_unsupported "
+                    "must stay 0 across the whole run. Writes "
+                    "BENCH_MESH.json")
+    ap.add_argument("--mesh-gate", type=float, default=2.0,
+                    help="min mesh-lane coalesced / sequential "
+                    "bare-loop solves/s ratio (--mesh, full shape)")
+    ap.add_argument("--mesh-e2e-n", type=int, default=4096,
+                    help="system size of the end-to-end large-N leg "
+                    "(--mesh; smoke shrinks to 512)")
+    ap.add_argument("--mesh-slo-ms", type=float, default=4000.0,
+                    help="mesh (throughput-tier) class SLO for the "
+                    "mixed trace (--mesh)")
+    ap.add_argument("--fleet-slo-ms", type=float, default=2000.0,
+                    help="fleet (latency-tier) class SLO for the "
+                    "mixed trace (--mesh)")
+    ap.add_argument("--mesh-attainment-gate", type=float, default=95.0,
+                    help="min %% of each class's requests inside its "
+                    "SLO on the mixed trace (--mesh)")
     ap.add_argument("--out", default=None,
                     help="JSON output path. Defaults to the mode's "
                     "BENCH_*.json; --smoke runs default to "
@@ -344,6 +380,7 @@ def main():
                     else "BENCH_FABRIC.json" if args.fabric
                     else "BENCH_WIRE.json" if args.wire
                     else "BENCH_QOS.json" if args.qos
+                    else "BENCH_MESH.json" if args.mesh
                     else "BENCH_ENGINE.json")
         if args.smoke:
             # smoke shapes are not the headline shapes: write them to a
@@ -360,6 +397,299 @@ def main():
             json.dump(out, f, indent=2)
             f.write("\n")
         print(json.dumps(out))
+
+    # ---------------- mesh mode: the large-N mesh lane ------------------- #
+    # the ISSUE 17 acceptance numbers (DESIGN §32). Three legs:
+    # (a) multi-RHS coalescing on ONE mesh session served through the
+    #     engine vs the sequential bare plan.factor+solve loop — the
+    #     only way the paper's own workload ran before the mesh lane —
+    #     interleaved adjacent legs, alternating order, median of
+    #     per-rep ratios, bitwise per request, zero compiles after
+    #     prewarm, gate >= --mesh-gate;
+    # (b) a mixed mesh+fleet QoS trace on one engine: the mesh session
+    #     as a heavyweight (flop-priced) throughput tenant alongside a
+    #     latency-tier fleet tenant — both classes must hold their
+    #     SLOs (attainment >= --mesh-attainment-gate %);
+    # (c) an N >= --mesh-e2e-n mesh session end-to-end: engine factor,
+    #     coalesced solves, tiered spill -> host -> disk -> revive,
+    #     checkpoint -> restore — every answer bitwise vs the bare
+    #     plan.factor oracle.
+    # mesh_plan_unsupported must stay 0 across the whole run: the
+    # counter is reserved for the genuine residue, and a healthy mesh
+    # trace never touches it.
+    if args.mesh:
+        from conflux_tpu import qos as qos_mod
+        from conflux_tpu.tier import ResidentSet
+
+        if args.smoke:
+            args.batch, args.N, args.v = 8, 128, 64
+            args.requests, args.reps = 32, 3
+            e2e_n = 512
+        else:
+            args.batch = max(args.batch, jax.device_count())
+            e2e_n = args.mesh_e2e_n
+        B, N, v, R = args.batch, args.N, args.v, args.requests
+        if B % jax.device_count():
+            raise SystemExit("--batch must be a multiple of the mesh "
+                             "device count")
+        widths = [int(w) for w in args.widths.split(",")]
+        mesh = batched.batch_mesh()
+        rng = np.random.default_rng(0)
+        unsupported0 = resilience.health_stats().get(
+            "mesh_plan_unsupported", 0)
+
+        def gen(b, n):
+            return (rng.standard_normal((b, n, n)) / np.sqrt(n)
+                    + 2.0 * np.eye(n)).astype(np.float32)
+
+        def median(xs):
+            xs = sorted(xs)
+            return xs[len(xs) // 2]
+
+        # ---- leg (a): coalescing vs the sequential bare loop -------- #
+        plan = serve.FactorPlan.create((B, N, N), jnp.float32, v=v,
+                                       mesh=mesh)
+        A0 = gen(B, N)
+        trace = [rng.standard_normal((B, N, widths[i % len(widths)])
+                                     ).astype(np.float32)
+                 for i in range(R)]
+        solves = B * sum(b.shape[-1] for b in trace)
+        prewarm_widths = sorted(
+            {rank_bucket(w) for w in widths}
+            | {1 << p for p in range(args.max_width.bit_length())
+               if 1 << p <= args.max_width})
+        eng = ServeEngine(max_batch_delay=args.delay_ms * 1e-3,
+                          max_pending=max(4 * R, 64),
+                          max_coalesce_width=args.max_width)
+        eng.prewarm(plan, factor_batches=(1,))  # the demoted site
+        sess = eng.factor(plan, A0)             # served, not bare
+        eng.prewarm(sess, widths=prewarm_widths)
+        bare = plan.factor(jnp.asarray(A0))     # the bare-loop oracle
+
+        def leg_seq():
+            t0 = time.perf_counter()
+            xs = []
+            for b in trace:
+                x = bare.solve(b)
+                x.block_until_ready()
+                xs.append(x)
+            return time.perf_counter() - t0, xs
+
+        def leg_eng():
+            t0 = time.perf_counter()
+            futs = [eng.submit(sess, b) for b in trace]
+            xs = [f.result(timeout=600) for f in futs]
+            return time.perf_counter() - t0, xs
+
+        leg_seq()
+        leg_eng()  # warm thread handoff + future machinery
+        traces0 = dict(plan.trace_counts)
+        t_seq_reps, t_eng_reps, ratios = [], [], []
+        x_seq = x_eng = None
+        for rep in range(args.reps):
+            if rep % 2 == 0:
+                ts, x_seq = leg_seq()
+                te, x_eng = leg_eng()
+            else:
+                te, x_eng = leg_eng()
+                ts, x_seq = leg_seq()
+            t_seq_reps.append(ts)
+            t_eng_reps.append(te)
+            ratios.append(ts / te)
+        t_seq, t_eng = median(t_seq_reps), median(t_eng_reps)
+        speedup = median(ratios)
+        assert plan.trace_counts == traces0, \
+            "mesh traffic compiled after prewarm"
+        # numerics, per the engine contract (engine.py module doc):
+        # batched plans' vmapped GEMM changes shape with the coalesced
+        # width, so CROSS-bucket answers are 1e-5-allclose and bitwise
+        # only WITHIN a bucket. The timed trace coalesces mixed widths
+        # (that is the whole point), so it verifies allclose; the
+        # bitwise contract is proved next on a held window against the
+        # bare plan solved at the SAME coalesced bucket.
+        for i, (xs_i, xe_i) in enumerate(zip(x_seq, x_eng)):
+            if not np.allclose(np.asarray(xs_i), np.asarray(xe_i),
+                               rtol=1e-5, atol=1e-6):
+                raise SystemExit(
+                    f"mesh engine answer {i} diverged from the bare "
+                    "loop beyond coalescing tolerance")
+        st_a = eng.stats()
+        eng.close()
+
+        # bitwise-within-a-bucket: hold one window open so widths
+        # 1+2+1 merge into ONE bucket-4 dispatch, then solve the same
+        # merged window on the bare plan and slice it back per request
+        beng = ServeEngine(max_batch_delay=60.0, max_pending=8,
+                           max_coalesce_width=args.max_width)
+        wnd = [rng.standard_normal((B, N, w)).astype(np.float32)
+               for w in (1, 2, 1)]
+        wfuts = [beng.submit(sess, b) for b in wnd]
+        if beng.close(timeout=600):
+            raise SystemExit("bitwise window wedged on close")
+        if beng.counters()["batches"] != 1:
+            raise SystemExit("bitwise window did not coalesce into one "
+                             "dispatch")
+        xm = np.asarray(bare.solve(jnp.asarray(
+            np.concatenate(wnd, axis=-1))))
+        n_bitwise, off = 0, 0
+        for b, f in zip(wnd, wfuts):
+            w = b.shape[-1]
+            if not np.array_equal(np.asarray(f.result(0)),
+                                  xm[..., off:off + w]):
+                raise SystemExit(
+                    "mesh coalesced answer diverged from the bare plan "
+                    "at the SAME bucket (bitwise-within-bucket "
+                    "contract)")
+            off += w
+            n_bitwise += 1
+
+        # ---- leg (b): mixed mesh+fleet QoS trace, both SLOs --------- #
+        fn = 256 if not args.smoke else 64
+        fplan = serve.FactorPlan.create((fn, fn), jnp.float32,
+                                        v=min(v, fn))
+        fsessions = [fplan.factor(jnp.asarray(gen(1, fn)[0]))
+                     for _ in range(4)]
+        mesh_cls = qos_mod.QosClass(
+            tenant="mesh", tier="throughput",
+            slo=args.mesh_slo_ms * 1e-3, weight=2.0)
+        fleet_cls = qos_mod.QosClass(
+            tenant="fleet", tier="latency",
+            slo=args.fleet_slo_ms * 1e-3)
+        eng = ServeEngine(max_batch_delay=args.delay_ms * 1e-3,
+                          max_pending=max(4 * R, 64),
+                          max_coalesce_width=args.max_width)
+        eng.prewarm(sess, widths=prewarm_widths)
+        for fs in fsessions:
+            eng.prewarm(fs, widths=(1,))
+        fb = [rng.standard_normal((fn, 1)).astype(np.float32)
+              for _ in range(4)]
+        for f in [eng.submit(fsessions[i % 4], fb[i % 4])
+                  for i in range(8)]:
+            f.result(timeout=600)  # warm the fleet path
+        futs = []
+        t0 = time.perf_counter()
+        for i in range(R):
+            futs.append(eng.submit(fsessions[i % 4], fb[i % 4],
+                                   qos=fleet_cls))
+            if i % 4 == 0:
+                futs.append(eng.submit(sess, trace[i % len(trace)],
+                                       qos=mesh_cls))
+        for f in futs:
+            f.result(timeout=600)
+        mixed_dt = time.perf_counter() - t0
+        qst = eng.stats()["qos"]
+        eng.close()
+        att = {}
+        for key, row in qst["classes"].items():
+            att[key] = row.get("slo_attainment_pct", 0.0)
+        pending_left = {t: row["pending"]
+                        for t, row in qst["tenants"].items()}
+
+        # ---- leg (c): N >= 4096 end-to-end, bitwise ----------------- #
+        ndev = 2 if jax.device_count() >= 2 else 1
+        mesh2 = jax.sharding.Mesh(
+            np.asarray(jax.devices()[:ndev], dtype=object), ("b",))
+        eplan = serve.FactorPlan.create((ndev, e2e_n, e2e_n),
+                                        jnp.float32, v=128, mesh=mesh2)
+        Ae = gen(ndev, e2e_n)
+        be = rng.standard_normal((ndev, e2e_n, 2)).astype(np.float32)
+        t0 = time.perf_counter()
+        oracle = eplan.factor(jnp.asarray(Ae))  # the bare large-N loop
+        xo = np.asarray(oracle.solve(jnp.asarray(be)))
+        t_oracle = time.perf_counter() - t0
+        import tempfile
+
+        with tempfile.TemporaryDirectory() as td:
+            rs = ResidentSet(disk_dir=os.path.join(td, "tiers"))
+            eng = ServeEngine(max_batch_delay=args.delay_ms * 1e-3,
+                              residency=rs)
+            t0 = time.perf_counter()
+            es = eng.factor(eplan, Ae)
+            xe = eng.solve(es, be, timeout=900)
+            t_served = time.perf_counter() - t0
+            if not np.array_equal(xe, xo):
+                raise SystemExit("e2e: served large-N answer diverged "
+                                 "from the bare plan.factor oracle")
+            rs.adopt(es)
+            rs.spill(es)
+            spilled_ok = es.tier == "host"
+            x1 = eng.solve(es, be, timeout=900)  # transparent revive
+            rs.spill(es)
+            rs.demote(es)
+            disk_ok = es.tier == "disk"
+            x2 = eng.solve(es, be, timeout=900)
+            if not (np.array_equal(x1, xo) and np.array_equal(x2, xo)):
+                raise SystemExit("e2e: spill/revive answer diverged")
+            ck = os.path.join(td, "ck")
+            eng.checkpoint(ck, sessions=[es], names=["big"])
+            eng.close()
+            eng = ServeEngine(max_batch_delay=args.delay_ms * 1e-3)
+            (back,) = eng.restore(ck)
+            x3 = eng.solve(back, be, timeout=900)
+            eng.close()
+            if not np.array_equal(x3, xo):
+                raise SystemExit("e2e: checkpoint/restore diverged")
+
+        unsupported = resilience.health_stats().get(
+            "mesh_plan_unsupported", 0) - unsupported0
+        gate = 1.0 if args.smoke else args.mesh_gate
+        out = {
+            "metric": (f"mesh-lane coalesced solves/s B={B} N={N} "
+                       f"v={v} R={R} widths={args.widths} f32 "
+                       f"({jax.device_count()} "
+                       f"{jax.devices()[0].platform} devices"
+                       + (", smoke" if args.smoke else "") + ")"),
+            "value": round(solves / t_eng, 2),
+            "unit": "solves/s",
+            "sequential_solves_per_s": round(solves / t_seq, 2),
+            "speedup_vs_bare_loop": round(speedup, 2),
+            "speedup_gate_x": gate,
+            "reps": args.reps,
+            "batches_dispatched": st_a["batches"],
+            "coalesced_mean_reqs_per_batch": round(
+                st_a["coalesced_mean"], 2),
+            "allclose_vs_bare_loop": f"{R}/{R}",  # SystemExit otherwise
+            "bitwise_within_bucket_window": f"{n_bitwise}/3",
+            "compiles_after_prewarm": 0,   # asserted above
+            "mesh_plan_unsupported": unsupported,
+            "mixed_qos": {
+                "trace_s": round(mixed_dt, 2),
+                "slo_attainment_pct": att,
+                "attainment_gate_pct": args.mesh_attainment_gate,
+                "mesh_slo_ms": args.mesh_slo_ms,
+                "fleet_slo_ms": args.fleet_slo_ms,
+                "pending_after_drain": pending_left,
+            },
+            "e2e": {
+                "N": e2e_n,
+                "mesh_devices": ndev,
+                "bare_factor_solve_s": round(t_oracle, 2),
+                "served_factor_solve_s": round(t_served, 2),
+                "bitwise_vs_oracle": True,       # SystemExit otherwise
+                "spill_revive_bitwise": bool(spilled_ok),
+                "disk_revive_bitwise": bool(disk_ok),
+                "checkpoint_restore_bitwise": True,
+            },
+            "baseline": "sequential bare plan.factor + solve loop "
+                        "(the pre-mesh-lane large-N path)",
+            "persistent_cache": cache.cache_dir(),
+        }
+        emit(out)
+        if unsupported:
+            raise SystemExit(
+                f"gate: mesh_plan_unsupported bumped {unsupported}x on "
+                "a healthy mesh trace (must be residue-only)")
+        if speedup < gate:
+            raise SystemExit(
+                f"gate: mesh coalescing speedup {speedup:.2f}x < "
+                f"{gate}x over the sequential bare loop")
+        bad = {k: a for k, a in att.items()
+               if a < args.mesh_attainment_gate}
+        if bad and not args.smoke:
+            raise SystemExit(
+                f"gate: mixed-trace SLO attainment below "
+                f"{args.mesh_attainment_gate}%: {bad}")
+        return
 
     # ---------------- factor-kernel mode: batched Pallas factor ---------- #
     # the ISSUE 14 acceptance numbers (DESIGN §29). One leg pair: the

@@ -117,6 +117,37 @@ def put_tree(tree, device):
     return jax.tree_util.tree_map(_put, tree)
 
 
+def shard_host_tree(tree, mesh):
+    """`jax.device_put` a pytree of HOST (numpy) leaves back onto the
+    batch mesh, leaf-wise batch-sharded — the tier/checkpoint revival
+    primitive for MESH sessions (`conflux_tpu.tier`, DESIGN §32). Every
+    session-state leaf is batch-axis-leading (2D perm rows, 3D factor
+    stacks, 4D diagonal-block-inverse stacks), so
+    ``_batch_spec(mesh, leaf.ndim)`` reshards any of them. Bitwise: a
+    host->device scatter moves bytes, never computes (asserted in
+    tests/test_mesh_lane.py). Aliased leaves transfer ONCE (the same
+    dedup contract as :func:`put_tree`); None leaves stay None;
+    `mesh=None` falls back to plain `jnp.asarray` (default-device
+    revival — the pre-mesh path, byte-identical)."""
+    seen: dict[int, object] = {}
+
+    def _put(leaf):
+        if leaf is None:
+            return None
+        got = seen.get(id(leaf))
+        if got is None:
+            if mesh is None:
+                got = jnp.asarray(leaf)
+            else:
+                a = np.asarray(leaf)
+                got = jax.device_put(a, _batch_spec(mesh, a.ndim))
+            seen[id(leaf)] = got
+        return got
+
+    return jax.tree_util.tree_map(_put, tree,
+                                  is_leaf=lambda x: x is None)
+
+
 def stack_trees(trees):
     """Stack identical-structure pytrees along a new leading axis.
 

@@ -221,6 +221,7 @@ class _Request:
     lane: Any = None      # the DeviceLane that owns this request
     lane_slot: bool = False  # counted against the lane's pending slice
     qos: Any = None       # QosClass (DESIGN §30) or None
+    cost: float = 1.0     # ledger admission weight (qos.request_cost)
 
     __hash__ = object.__hash__
 
@@ -245,6 +246,7 @@ class _FactorRequest:
     sid: Any = None       # stable session id for the opened session
     device: Any = None    # explicit device pin for the opened session
     qos: Any = None       # QosClass (DESIGN §30) or None
+    cost: float = 1.0     # ledger admission weight (qos.request_cost)
 
     __hash__ = object.__hash__
 
@@ -263,6 +265,8 @@ class _FactorBatch:
     verdict: Any          # (2, bucket) device verdict (checked) or None
     A: Any                # the staged (bucket,)+shape device A stack
     solo: bool = False    # a solo re-dispatch: no second retry
+    mesh: bool = False    # a mesh-lane factor: ONE request, no stacking
+                          # (factors/A are the sharded batch itself)
 
 
 @dataclasses.dataclass
@@ -905,10 +909,14 @@ class DeviceLane:
         deferred: list = []
         for plan in order:
             greqs = groups[id(plan)]
-            chunks = [greqs[i:i + eng.max_factor_batch]
-                      for i in range(0, len(greqs), eng.max_factor_batch)]
+            # mesh plans never slot-stack (the genuine gang/stacking
+            # residue — their batch axis IS the parallelism): each
+            # request dispatches as its own sharded (B, N, N) factor
+            cap = 1 if plan.mesh is not None else eng.max_factor_batch
+            chunks = [greqs[i:i + cap]
+                      for i in range(0, len(greqs), cap)]
             last = chunks[-1]
-            if (may_defer and len(last) <= eng.max_factor_batch // 2
+            if (may_defer and len(last) <= cap // 2
                     and not any(r.carried for r in last)):
                 for r in last:
                     r.carried = True
@@ -994,8 +1002,20 @@ class DeviceLane:
         reqs = self._admit_stage_factor(reqs)
         if not reqs:
             return None
+        mesh = plan.mesh is not None
+
+        def stage(rs):
+            if mesh:
+                # the mesh lane: ONE request IS the whole (B, N, N)
+                # batch — no slot stacking (the batch axis is the
+                # parallelism), so the 'stack' is the request's own
+                # matrix batch, dispatched batch-sharded below
+                # conflint: disable=CFX-HOSTSYNC A is the caller's host array (submit_factor stages host-side); no device value reaches here
+                return np.asarray(rs[0].A)
+            return self._stage_factor(plan, rs)
+
         try:
-            buf = self._stage_factor(plan, reqs)
+            buf = stage(reqs)
             if (eng.health is not None and eng.health.check_rhs
                     and eng._tick_staging()
                     and not resilience.rhs_finite(buf)):
@@ -1007,12 +1027,20 @@ class DeviceLane:
                 reqs = self._isolate_poisoned_A(reqs)
                 if not reqs:
                     return None
-                buf = self._stage_factor(plan, reqs)
+                buf = stage(reqs)
             checked = (eng.health is not None
                        and eng.health.check_output)
-            Ad = self._to_device(buf)
+            if mesh:
+                (Ad,) = _shard_batch((jnp.asarray(buf),), plan.mesh)
+            else:
+                Ad = self._to_device(buf)
             with profiler.region("serve.factor"):
-                if checked:
+                if mesh and checked:
+                    F, wA, verdict = plan._mesh_factor_health_fn()(Ad)
+                elif mesh:
+                    F = plan._factor_fn(Ad)
+                    wA = verdict = None
+                elif checked:
                     F, wA, verdict = plan._factor_health_fn(
                         buf.shape[0])(Ad)
                 else:
@@ -1021,18 +1049,19 @@ class DeviceLane:
         except Exception as e:  # noqa: BLE001 — engine must survive
             self._redispatch_factor_survivors(reqs, e, solo)
             return None
+        bb = 1 if mesh else buf.shape[0]
         with eng._lock:
             eng._factor_batches += 1
             eng._factor_coalesced += len(reqs)
-            eng._factor_slots += buf.shape[0]
-            eng._factor_pad += buf.shape[0] - len(reqs)
-            bb = buf.shape[0]
+            eng._factor_slots += bb
+            eng._factor_pad += bb - len(reqs)
             eng._factor_bucket_hits[bb] = \
                 eng._factor_bucket_hits.get(bb, 0) + 1
             eng._active_plans[id(plan)] = weakref.ref(plan)
             self.factor_batches += 1
             self.factor_coalesced += len(reqs)
-        return _FactorBatch(plan, reqs, F, wA, verdict, Ad, solo)
+        return _FactorBatch(plan, reqs, F, wA, verdict, Ad, solo,
+                            mesh=mesh)
 
     # futures-owner
     def _redispatch_factor_survivors(self, reqs, exc,
@@ -1431,22 +1460,31 @@ class DeviceLane:
                 # per-class rings/ledger as solves (DESIGN §30)
                 for r in owned:
                     if r.qos is not None:
-                        st.record_settle(r.qos, now - r.t_submit)
+                        st.record_settle(r.qos, now - r.t_submit,
+                                         r.cost)
         plan = fb.plan
-        trees = unstack_tree(fb.factors, len(fb.reqs))
+        if fb.mesh:
+            # the mesh lane: the dispatched pytree IS the session state
+            # (no slot axis to slice), and the session stays UNPINNED —
+            # its state is batch-sharded across the plan's mesh, not
+            # resident on this lane's device (DESIGN §32)
+            trees = [fb.factors]
+        else:
+            trees = unstack_tree(fb.factors, len(fb.reqs))
         for i, r in entries:
             if r not in owned:
                 continue
-            A_i = fb.A[i]
+            A_i = fb.A if fb.mesh else fb.A[i]
             session = SolveSession(plan, trees[i],
                                    A_i if plan.key.refine else None,
                                    A_i, r.policy,
-                                   device=self.device, sid=r.sid)
+                                   device=None if fb.mesh
+                                   else self.device, sid=r.sid)
             if fb.wA is not None:
                 # the probe row wA = w^T A0 came out of the checked
                 # factor dispatch — the session opens with its half of
                 # the Freivalds check already resident
-                session._probe = fb.wA[i]
+                session._probe = fb.wA if fb.mesh else fb.wA[i]
             r.future.set_result(session)
 
     # futures-owner
@@ -1885,6 +1923,11 @@ class ServeEngine:
         req = _Request(session, b2, int(b2.shape[-1]), squeeze, Future(),
                        now, None if deadline is None else now + deadline,
                        qos=qos)
+        if qos is not None:
+            # byte/flop-aware ledger weight (DESIGN §32): a large-N
+            # mesh solve occupies the slots it actually displaces
+            req.cost = qos_mod.request_cost(session.plan.key.shape,
+                                            width=req.width)
         # resolve the owning lane BEFORE admission (placement may move a
         # not-yet-pinned session's state — device work, so never under
         # the admission lock), so every live request is lane-attributed
@@ -2113,7 +2156,8 @@ class ServeEngine:
                 self._qos_latency_window)
         cls = st.intern(req.qos)
         req.qos = cls
-        over = st.ledger.try_admit(cls, self._pending, self.max_pending)
+        over = st.ledger.try_admit(cls, self._pending, self.max_pending,
+                                   req.cost)
         if over is None:
             st.record_admit(cls)
             return
@@ -2185,7 +2229,10 @@ class ServeEngine:
         session with no sid gets one assigned (stable for its lifetime;
         give sessions stable sids for restart-deterministic placement).
         Mesh-sharded sessions are never pinned — their state spans the
-        whole mesh — and ride lane 0. Sessions on a device no lane
+        whole mesh — and ride the first live lane (the DESIGN §25
+        placeholder made real: the lane contributes its dispatcher/
+        drain threads, admission and coalescing; the mesh contributes
+        the devices). Sessions on a device no lane
         serves (or a dead lane) are served by the first live lane:
         dispatch follows the committed factors, so answers are
         unaffected, only the thread that runs them."""
@@ -2193,6 +2240,9 @@ class ServeEngine:
         if len(lanes) == 1:
             return lanes[0]
         if session.plan.mesh is not None:
+            for ln in lanes:
+                if not ln.dead:
+                    return ln
             return lanes[0]
         dev = session.device
         if dev is None:
@@ -2302,10 +2352,15 @@ class ServeEngine:
         fused per-slot post-factor finite/probe-residual verdict —
         a sick slot re-dispatches solo and fails alone with structured
         evidence (:class:`SolveUnhealthy`), its co-batched neighbours
-        untouched. Mesh-sharded plans are rejected with the structured
-        :class:`~conflux_tpu.resilience.MeshPlanUnsupported` (a
-        ValueError subclass): their factor program is batch-sharded
-        already — catch it and call ``plan.factor`` directly.
+        untouched. Mesh-sharded plans ride the MESH LANE (DESIGN §32):
+        the request dispatches as its own batch-sharded (B, N, N)
+        factor — no slot stacking, the batch axis is the parallelism —
+        through the first live lane's dispatcher, with the same
+        admission, deadline, staging-guard and per-batch health
+        machinery; the opened session is unpinned (its state spans the
+        mesh). Only `device=` naming a device outside the plan's mesh
+        still raises :class:`~conflux_tpu.resilience.MeshPlanUnsupported`
+        (sharded state cannot migrate off its mesh).
 
         On a multi-lane engine the cold start LOAD-BALANCES: with no
         `sid`/`device` the request joins the shared pool and whichever
@@ -2328,12 +2383,12 @@ class ServeEngine:
             raise TypeError(f"submit_factor takes a FactorPlan, got "
                             f"{type(plan).__name__} (submit() serves "
                             "sessions)")
-        if plan.mesh is not None:
+        if plan.mesh is not None and device is not None \
+                and not any(device == d for d in plan.mesh.devices.flat):
             raise MeshPlanUnsupported(
-                "the factor lane serves unsharded plans only (the stacked "
-                "cold-start program has no mesh variant) — factor "
-                "mesh-sharded plans through plan.factor directly",
-                surface="factor_lane")
+                "device= names a device outside this plan's mesh — a "
+                "mesh-sharded session's state cannot migrate off its "
+                "mesh", surface="factor_lane")
         # conflint: disable=CFX-HOSTSYNC host request ingestion, not a device readback
         A2 = np.asarray(A)
         if tuple(A2.shape) != plan.key.shape:
@@ -2357,6 +2412,23 @@ class ServeEngine:
         req = _FactorRequest(plan, A2, policy, Future(), now,
                              None if deadline is None else now + deadline,
                              sid=sid, device=device, qos=qos)
+        if qos is not None:
+            # byte/flop-aware ledger weight: the O(N^3) cold start
+            # counts for the slots it displaces (qos.request_cost)
+            req.cost = qos_mod.request_cost(plan.key.shape, factor=True)
+        if plan.mesh is not None:
+            # the mesh lane: the opened session stays UNPINNED (its
+            # state spans the mesh — an in-mesh device= was a placement
+            # no-op) and the request rides the first live lane's
+            # dispatcher, like _lane_for routes mesh solves
+            req.device = None
+            for ln in self._lanes:
+                if not ln.dead:
+                    req.lane = ln
+                    break
+            else:
+                req.lane = self._lanes[0]
+            return self._admit(req)
         # lane resolution (multi-lane): an explicit device pins the lane,
         # a sid pins it by consistent hash, otherwise the request joins
         # the shared pool and the lanes load-balance it between them
@@ -2786,13 +2858,32 @@ class ServeEngine:
         checked = self.health is not None and self.health.check_output
         kind = "solve_health" if checked else "solve"
         shape = ((plan.B, plan.N, wb) if plan.batched else (plan.N, wb))
+        if plan.mesh is not None:
+            # mesh lane: the sharded executable is keyed on the plan's
+            # device SET, not one lane device (dispatch rides the first
+            # live lane, see `_lane_for`) — one warm covers every lane,
+            # and a per-lane `put_tree` would gather the sharded factors
+            # onto a single device. devkey None = the mesh itself.
+            if plan.device_warm(kind, wb, None):
+                return
+            b2 = jnp.zeros(shape, jnp.dtype(plan.key.dtype))
+            (b2,) = _shard_batch((b2,), plan.mesh)
+            with session._lock:
+                session._ensure_resident()
+                F, A, A0 = session._factors, session._A, session._A0
+                probe = session._probe_row() if checked else None
+            if checked:
+                x, _ = plan._solve_health_fn(wb)(F, A0, probe, b2)
+                x.block_until_ready()
+            else:
+                plan._solve_fn(wb)(F, A, b2).block_until_ready()
+            plan.mark_device_warm(kind, wb, None)
+            return
         for lane in self._lanes:
             dk = _devkey(lane.device)
             if plan.device_warm(kind, wb, dk):
                 continue
             b2 = jnp.zeros(shape, jnp.dtype(plan.key.dtype))
-            if plan.mesh is not None:
-                (b2,) = _shard_batch((b2,), plan.mesh)
             with session._lock:
                 session._ensure_resident()
                 F, A, A0 = session._factors, session._A, session._A0
@@ -2897,12 +2988,28 @@ class ServeEngine:
                                       dk)
 
     def _prewarm_factor(self, plan, bb: int) -> None:
-        if plan.mesh is not None:
-            raise MeshPlanUnsupported(
-                "the factor lane serves unsharded plans only — factor "
-                "mesh-sharded plans through plan.factor directly",
-                surface="prewarm")
         checked = self.health is not None and self.health.check_output
+        if plan.mesh is not None:
+            # mesh lane: the (B, N, N) batch IS the dispatch (no slot
+            # stacking, `_dispatch_factors` caps mesh chunks at 1), so
+            # every requested bucket warms the same bucket-1 sharded
+            # program — `_factor_fn` plain, `_mesh_factor_health_fn`
+            # checked. One warm per mesh (devkey None), identity batch
+            # filler as below.
+            kind = "factor_health" if checked else "factor"
+            if plan.device_warm(kind, 1, None):
+                return
+            buf = np.empty(plan.key.shape, np.dtype(plan.key.dtype))
+            buf[:] = np.eye(plan.N, dtype=buf.dtype)
+            (Ad,) = _shard_batch((jnp.asarray(buf),), plan.mesh)
+            if checked:
+                F, _wA, v = plan._mesh_factor_health_fn()(Ad)
+                v.block_until_ready()
+            else:
+                F = plan._factor_fn(Ad)
+            jax.block_until_ready(F)
+            plan.mark_device_warm(kind, 1, None)
+            return
         kind = "factor_health" if checked else "factor"
         # identity stacks: well-conditioned in every mode (LU, Cholesky,
         # trsm/blocked/inv substitution — an identity's diagonal-block
@@ -2963,7 +3070,7 @@ class ServeEngine:
                 # (the DRR refill) — classified requests only
                 for r in owned:
                     if r.qos is not None:
-                        st.record_fail(r.qos)
+                        st.record_fail(r.qos, r.cost)
         for r in owned:
             r.future.set_exception(exc)
 
@@ -2984,7 +3091,8 @@ class ServeEngine:
                 # qos=None path pays one attribute read)
                 for r in owned:
                     if r.qos is not None:
-                        st.record_settle(r.qos, now - r.t_submit)
+                        st.record_settle(r.qos, now - r.t_submit,
+                                         r.cost)
         for r, si, lo in spec:
             if r not in owned:
                 continue

@@ -69,6 +69,35 @@ BATCH_STRETCH = 4.0
 # same ceiling the adaptive controller's envelope uses
 MAX_TIER_DELAY = 0.032
 
+# the canonical fleet request the ledger prices against: one solve
+# (factor) of the (32, 256, 256) batched plan the serve docs/benches
+# are written around. A request's admission cost is its flop volume
+# over this reference, clamped at >= 1.0 so lightweight traffic keeps
+# the historical one-slot accounting exactly.
+REF_SOLVE_UNITS = 32 * 256 * 256
+REF_FACTOR_UNITS = 32 * 256 ** 3
+
+
+def request_cost(shape, width=None, factor=False) -> float:
+    """Byte/flop-aware admission cost of one request, in units of the
+    canonical fleet request (clamped >= 1.0).
+
+    `shape` is the plan's key shape — (B, N, N) batched/mesh or (N, N)
+    single; `width` the request's RHS width (solves); `factor=True`
+    prices the O(N^3) cold start instead of the O(N^2 w) substitution.
+    This is what makes a large-N mesh session a HEAVYWEIGHT tenant in
+    the :class:`FairShareLedger` (DESIGN §32): one N=4096 mesh solve
+    occupies the slots its arithmetic actually displaces, so a flood of
+    them sheds at the tenant's share line while lightweight interactive
+    traffic keeps admitting — instead of both classes queueing as if
+    every request were equal."""
+    B = shape[0] if len(shape) == 3 else 1
+    N = shape[-1]
+    if factor:
+        return max(1.0, B * float(N) ** 3 / REF_FACTOR_UNITS)
+    w = 1 if width is None else max(1, int(width))
+    return max(1.0, B * float(N) ** 2 * w / REF_SOLVE_UNITS)
+
 
 @dataclasses.dataclass(frozen=True)
 class QosClass:
@@ -223,38 +252,43 @@ class FairShareLedger:
         return w / total if total > 0 else 1.0
 
     def try_admit(self, cls: QosClass, engine_pending: int,
-                  max_pending: int) -> "float | None":
+                  max_pending: int, cost: float = 1.0) -> "float | None":
         """Admit (count the slot, return None) or throttle (return the
-        tenant's over-share backlog for the retry hint)."""
+        tenant's over-share backlog for the retry hint). `cost` is the
+        request's admission weight in slots (:func:`request_cost`) —
+        the default 1.0 keeps the historical one-request-one-slot
+        accounting bitwise."""
         self.note(cls)
         t = cls.tenant
         mine = self._pending.get(t, 0)
         share = self.share(t, max_pending)
         if engine_pending < self.contention * max_pending \
                 or mine < share:
-            self._pending[t] = mine + 1
+            self._pending[t] = mine + cost
             self._admitted[t] = self._admitted.get(t, 0) + 1
             return None
         # contended and at/over share: priority-0 may spend credit
-        if cls.priority <= 0 and self._deficit.get(t, 0.0) >= 1.0:
-            self._deficit[t] -= 1.0
-            self._pending[t] = mine + 1
+        if cls.priority <= 0 and self._deficit.get(t, 0.0) >= cost:
+            self._deficit[t] -= cost
+            self._pending[t] = mine + cost
             self._admitted[t] = self._admitted.get(t, 0) + 1
             return None
         self._throttled[t] = self._throttled.get(t, 0) + 1
-        return mine - share + 1.0
+        return mine - share + cost
 
-    def release(self, cls: QosClass) -> None:
-        """One of the tenant's requests resolved: free its slot and
-        distribute the freed quantum by weight (the DRR refill)."""
+    def release(self, cls: QosClass, cost: float = 1.0) -> None:
+        """One of the tenant's requests resolved: free its slot(s) and
+        distribute the freed quantum by weight (the DRR refill — a
+        heavyweight settle frees `cost` slots, so it refills `cost`
+        quanta)."""
         t = cls.tenant
-        self._pending[t] = max(0, self._pending.get(t, 0) - 1)
+        self._pending[t] = max(0.0, self._pending.get(t, 0) - cost)
         total = sum(self._weight.values())
         if total <= 0:
             return
         for tt, w in self._weight.items():
             cap = self.deficit_cap * max(1.0, w / total * 64)
-            d = self._deficit.get(tt, 0.0) + w / total
+            d = self._deficit.get(tt, 0.0) + cost * w / total
             self._deficit[tt] = min(cap, d)
 
     def stats(self, max_pending: int) -> dict:
@@ -262,7 +296,7 @@ class FairShareLedger:
         admission bound)."""
         return {t: {"weight": self._weight.get(t, 0.0),
                     "share": round(self.share(t, max_pending), 1),
-                    "pending": self._pending.get(t, 0),
+                    "pending": round(self._pending.get(t, 0), 1),
                     "deficit": round(self._deficit.get(t, 0.0), 2),
                     "admitted": self._admitted.get(t, 0),
                     "throttled": self._throttled.get(t, 0)}
@@ -304,16 +338,17 @@ class EngineQosState:
     def record_throttle(self, cls: QosClass) -> None:
         self.throttled[cls.key] = self.throttled.get(cls.key, 0) + 1
 
-    def record_settle(self, cls: QosClass, latency_s: float) -> None:
+    def record_settle(self, cls: QosClass, latency_s: float,
+                      cost: float = 1.0) -> None:
         k = cls.key
         self.completed[k] = self.completed.get(k, 0) + 1
         self.latencies[k].append(latency_s)
         self.lat_seq[k] += 1
-        self.ledger.release(cls)
+        self.ledger.release(cls, cost)
 
-    def record_fail(self, cls: QosClass) -> None:
+    def record_fail(self, cls: QosClass, cost: float = 1.0) -> None:
         self.failed[cls.key] = self.failed.get(cls.key, 0) + 1
-        self.ledger.release(cls)
+        self.ledger.release(cls, cost)
 
     def counters(self, max_pending: int) -> dict:
         """The sort-free counter rows for `engine.counters()['qos']`."""

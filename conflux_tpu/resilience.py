@@ -65,16 +65,22 @@ class RhsNonFinite(ValueError):
 
 
 class MeshPlanUnsupported(ValueError):
-    """A mesh-sharded (batch-sharded) plan hit a serving surface that
-    only speaks unsharded program families — the engine's coalesced
-    factor lane, per-lane device placement, tier adoption. Structured
-    (a ValueError subclass, so legacy string-matching callers keep
-    working) so callers can route mesh plans programmatically: catch
-    this and fall back to ``plan.factor`` / the batch-sharded programs,
-    which serve mesh plans directly. Every raise is counted in
-    ``profiler.serve_stats()['health']['mesh_plan_unsupported']``.
-    `surface` names the rejecting surface (e.g. 'factor_lane',
-    'prewarm', 'tier')."""
+    """A mesh-sharded (batch-sharded) plan hit one of the GENUINE
+    residue surfaces — operations whose semantics contradict sharded
+    state, not missing plumbing (DESIGN §32). The serve stack itself
+    (factor lane, coalescing, tiering, checkpoint, QoS, fabric) serves
+    mesh plans directly; what remains is migration: pinning sharded
+    state onto one device (``device=`` naming a device OUTSIDE the
+    plan's mesh, ``to_device``) and restoring a sharded checkpoint on
+    a host that lacks the mesh's devices (cross-host migration).
+    Structured (a ValueError subclass, so legacy string-matching
+    callers keep working) so callers can route programmatically: the
+    fix is a topology fix — drop the pin or restore on a matching
+    host — not a fallback code path. Every raise is counted in
+    ``profiler.serve_stats()['health']['mesh_plan_unsupported']``
+    (zero on a healthy mesh trace, asserted by ``bench_engine
+    --mesh``). `surface` names the rejecting surface (e.g.
+    'factor_lane', 'factor', 'to_device', 'plan_codec')."""
 
     def __init__(self, msg: str, surface: str = ""):
         super().__init__(msg)

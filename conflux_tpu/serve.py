@@ -125,20 +125,52 @@ def plan_spec(plan: "FactorPlan") -> dict:
     codec shared by the checkpoint fleet.json (`tier.save_fleet`), the
     serve fabric's cross-process session open (`conflux_tpu.fabric`
     worker 'open' op, DESIGN §28) and anything else that must rebuild
-    the EXACT plan in another process. Mesh-sharded plans are refused:
-    their session state spans devices, so neither checkpoints nor
-    fabric hosts can carry them."""
+    the EXACT plan in another process. Mesh-sharded plans carry their
+    mesh identity (device ids + axis names + device-grid shape) in a
+    ``"mesh"`` sub-dict; :func:`plan_from_spec` rebuilds the mesh on a
+    process holding the SAME local devices (cross-host restore of
+    sharded state stays unsupported — DESIGN §32)."""
     k = plan.key
+    d = {"shape": list(k.shape), "dtype": k.dtype,
+         "factor_dtype": k.factor_dtype, "v": k.v,
+         "refine": k.refine, "spd": k.spd,
+         "substitution": k.substitution,
+         "precision": _encode_precision(k.precision),
+         "backend": k.backend, "panel_algo": k.panel_algo}
     if k.mesh_key is not None:
-        raise ValueError(
-            "checkpointing covers unsharded plans only (a mesh-sharded "
-            "session's state lives across devices)")
-    return {"shape": list(k.shape), "dtype": k.dtype,
-            "factor_dtype": k.factor_dtype, "v": k.v,
-            "refine": k.refine, "spd": k.spd,
-            "substitution": k.substitution,
-            "precision": _encode_precision(k.precision),
-            "backend": k.backend, "panel_algo": k.panel_algo}
+        mesh = plan.mesh
+        d["mesh"] = {
+            "device_ids": [int(dev.id) for dev in mesh.devices.flat],
+            "axis_names": [str(a) for a in mesh.axis_names],
+            "device_shape": [int(s) for s in mesh.devices.shape]}
+    return d
+
+
+def mesh_from_spec(m: dict):
+    """Rebuild a batch mesh from its :func:`plan_spec` wire identity —
+    the mesh half of the checkpoint/fabric codec. The rebuilt mesh
+    registers under the SAME `mesh_cache_key` as the original (the key
+    is (device ids, axis names)), so a restored plan lands on the
+    identical PlanKey and compiled-program family. A process that does
+    not hold every named device id cannot host the sharded state —
+    that is the genuine cross-host-migration residue, surfaced as
+    :class:`~conflux_tpu.resilience.MeshPlanUnsupported`."""
+    import numpy as np
+
+    ids = [int(i) for i in m["device_ids"]]
+    local = {dev.id: dev for dev in jax.devices()}
+    missing = [i for i in ids if i not in local]
+    if missing:
+        from conflux_tpu.resilience import MeshPlanUnsupported
+
+        raise MeshPlanUnsupported(
+            f"mesh plan names device ids {missing} this process does "
+            "not hold — sharded session state cannot migrate across "
+            "hosts (restore on a host with the same device topology)",
+            surface="plan_codec")
+    devs = np.array([local[i] for i in ids], dtype=object)
+    devs = devs.reshape(tuple(int(s) for s in m["device_shape"]))
+    return jax.sharding.Mesh(devs, tuple(str(a) for a in m["axis_names"]))
 
 
 def plan_from_spec(d: dict) -> "FactorPlan":
@@ -146,7 +178,13 @@ def plan_from_spec(d: dict) -> "FactorPlan":
     (trace-time knobs included, not re-derived from process globals)
     and get-or-build its plan — the restore/adopt path's half of the
     bitwise contract: same key, same compiled program family, same
-    bits."""
+    bits. Mesh plans rebuild their mesh from the spec's ``"mesh"``
+    sub-dict (:func:`mesh_from_spec`) — same devices, same axis names,
+    same out_shardings."""
+    mesh_key = None
+    m = d.get("mesh")
+    if m is not None:
+        mesh_key = mesh_cache_key(mesh_from_spec(m))
     key = PlanKey(
         shape=tuple(int(s) for s in d["shape"]), dtype=d["dtype"],
         factor_dtype=d["factor_dtype"], v=int(d["v"]),
@@ -154,7 +192,7 @@ def plan_from_spec(d: dict) -> "FactorPlan":
         substitution=d["substitution"],
         precision=_decode_precision(d["precision"]),
         backend=d["backend"], panel_algo=d["panel_algo"],
-        mesh_key=None)
+        mesh_key=mesh_key)
     return FactorPlan.from_key(key)
 
 
@@ -1081,6 +1119,87 @@ class FactorPlan:
 
         return self._memo(self._factor_cache, ("factor_health", bb), build)
 
+    def _mesh_factor_health_fn(self):
+        """The mesh lane's checked cold-start program: factor ONE
+        (B, N, N) batch through the batch-sharded factor body AND
+        produce the session's health evidence in the SAME sharded
+        dispatch — A -> (factors, wA, verdict (2, 1)).
+
+        The factor body is the same vmapped `_one_factor` that
+        `_factor_fn` jits (a mesh `plan.factor` rides `_factor_fn`
+        through `_factor_once`), so the engine's checked mesh factor
+        and the bare one carry the same bits. wA[i] = w^T A_i is the
+        per-system Freivalds probe row ((B, N), batch-sharded) — the
+        session opens with its probe device-resident, like the stacked
+        lane. The verdict reduces over the plan's OWN batch axis (one
+        mesh session is one tenant: max residual, any non-finite slot
+        poisons it) into the (2, 1) block `resilience.evaluate_slots`
+        reads, so the engine's drain path treats a mesh factor as a
+        one-slot batch."""
+        if self.mesh is None:
+            raise AssertionError(
+                "_mesh_factor_health_fn is the mesh lane's checked "
+                "factor program — unsharded plans ride "
+                "_factor_health_fn")
+
+        def build():
+            w = self.probe_w
+            fused = self._fused_probe
+            if fused:
+                probe_body = jax.vmap(self._blocked_probe_body,
+                                      in_axes=(0, 0, None))
+            else:
+                solve_one = jax.vmap(self._one_solve, in_axes=(0, 0, None))
+            k = self.key
+            spec3 = _batch_spec(self.mesh, 3)
+            spec2 = _batch_spec(self.mesh, 2)
+            spec4 = _batch_spec(self.mesh, 4)
+            if k.spd:
+                fac_shard = ((spec3, spec4) if k.substitution == "blocked"
+                             else (spec3,))
+            elif k.substitution == "blocked":
+                fac_shard = (spec3, spec4, spec4, spec2)
+            elif k.substitution == "inv":
+                fac_shard = (spec3, spec3, spec2)
+            else:
+                fac_shard = (spec3, spec2)
+
+            def check(F, wA, A):
+                w2 = w[:, None].astype(jnp.dtype(k.dtype))
+                if fused:
+                    _x, xsum, wAx = probe_body(F, wA, w2)
+                    cdtype = wAx.dtype
+                    fin_acc = jnp.sum(xsum)
+                    ax = wAx
+                else:
+                    x = solve_one(F, A, w2)
+                    cdtype = x[..., 0].dtype
+                    fin_acc = jnp.sum(x)
+                    x0 = x[..., 0].astype(cdtype)
+                    ax = jnp.sum(wA.astype(cdtype) * x0, axis=-1)
+                finite = jnp.isfinite(fin_acc)
+                wc = w.astype(cdtype)
+                num = jnp.abs(jnp.sum(wc * wc) - ax)
+                den = (jnp.sqrt(jnp.sum(jnp.abs(wc) ** 2))
+                       + jnp.finfo(cdtype).tiny)
+                res = jnp.max(num / den)
+                return jnp.stack([finite.astype(jnp.float32),
+                                  res.astype(jnp.float32)])[:, None]
+
+            body = jax.vmap(self._one_factor)
+            probe = jax.vmap(lambda A0: probe_row(w, A0))
+
+            def f(A):
+                self._bump("factor_health")  # trace-time, not per call
+                F = body(A)
+                wA = probe(A)
+                return F, wA, check(F, wA, A)
+
+            return jax.jit(f, out_shardings=(fac_shard, spec2, None))
+
+        return self._memo(self._factor_cache, ("factor_health_mesh",),
+                          build)
+
     def _factor_once(self, A):
         """Factor ONE system (or one (B, N, N) batch for batched plans)
         through the bucket-1 slot of the stacked factor program —
@@ -1387,17 +1506,21 @@ class FactorPlan:
         default device (byte-identical to the pre-fleet behavior).
         `sid` is an optional STABLE session id; the engine's consistent-
         hash placement (`engine.place_session`) maps equal sids to equal
-        devices across engine restarts. Mesh plans refuse `device=`:
-        their state is already sharded across the whole mesh.
+        devices across engine restarts. For mesh plans a `device` INSIDE
+        the plan's mesh is a placement no-op (the state is batch-sharded
+        across the whole mesh already — the session stays unpinned);
+        a device outside the mesh is refused, since sharded state
+        cannot migrate off its mesh.
         """
         if device is not None and self.mesh is not None:
-            from conflux_tpu.resilience import MeshPlanUnsupported
+            if not any(device == d for d in self.mesh.devices.flat):
+                from conflux_tpu.resilience import MeshPlanUnsupported
 
-            raise MeshPlanUnsupported(
-                "device= pins a session to ONE device, but a "
-                "mesh-sharded plan's state is batch-sharded across the "
-                "whole mesh already — factor mesh plans without a "
-                "device pin", surface="factor")
+                raise MeshPlanUnsupported(
+                    "device= names a device outside this plan's mesh — "
+                    "a mesh-sharded session's state cannot migrate off "
+                    "its mesh", surface="factor")
+            device = None  # in-mesh pin: state already spans the mesh
         A = jnp.asarray(A)
         self._check_A(A)
         if self.mesh is not None:
@@ -1584,17 +1707,22 @@ class SolveSession:
         (`batched.put_tree` preserves the `_A is _A0` alias, so the
         byte accounting stays deduplicated); `device=None` or an
         already-there session is a no-op. Runs under the session RLock
-        — a concurrent solve never observes half-moved state. Mesh
-        plans refuse: their state is sharded across the whole mesh."""
+        — a concurrent solve never observes half-moved state. For mesh
+        plans a device INSIDE the mesh is a no-op (the state already
+        spans the mesh — the session stays unpinned); a device outside
+        the mesh is refused, the genuine cross-device-migration
+        residue (DESIGN §32)."""
         if device is None:
             return self
         if self.plan.mesh is not None:
+            if any(device == d for d in self.plan.mesh.devices.flat):
+                return self
             from conflux_tpu.resilience import MeshPlanUnsupported
 
             raise MeshPlanUnsupported(
                 "a mesh-sharded session's state is batch-sharded "
-                "across the whole mesh — it cannot move to one device",
-                surface="to_device")
+                "across the whole mesh — it cannot move off its mesh "
+                "to one device", surface="to_device")
         with self._lock:
             self._ensure_resident()
             moved = put_tree(

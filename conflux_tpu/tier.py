@@ -404,6 +404,25 @@ def _host_nbytes(leaves: dict) -> int:
     return sum(int(np.asarray(a).nbytes) for a in leaves.values())
 
 
+def _leaves_to_device(session, leaves: dict) -> dict:
+    """Host leaves -> device arrays on the session's placement. Mesh
+    sessions re-scatter batch-sharded onto their plan's mesh
+    (`batched.shard_host_tree` — every state leaf is batch-axis-leading,
+    DESIGN §32); pinned sessions land on their device; unpinned ones on
+    the default device (the pre-fleet path, byte-identical). A
+    host->device transfer moves bytes, never computes — bitwise on
+    every branch."""
+    mesh = session.plan.mesh
+    if mesh is not None:
+        from conflux_tpu.batched import shard_host_tree
+
+        return shard_host_tree(leaves, mesh)
+    target = getattr(session, "device", None)
+    if target is None:
+        return {k: jnp.asarray(v) for k, v in leaves.items()}
+    return {k: jax.device_put(v, target) for k, v in leaves.items()}
+
+
 # --------------------------------------------------------------------------- #
 # ResidentSet — the tier manager
 # --------------------------------------------------------------------------- #
@@ -540,14 +559,12 @@ class ResidentSet:
         """Bring sessions under management (resident ones count against
         the caps immediately and may be evicted; already-spilled ones —
         the lazy checkpoint-restore path — register in their current
-        tier). Mesh-sharded plans are rejected: their state is sharded
-        device buffers the host tiers cannot round-trip. Chainable."""
+        tier). Mesh-sharded sessions tier like any other: spill gathers
+        the sharded leaves to one CRC'd host record (`jax.device_get`
+        assembles across the mesh), revival re-scatters them
+        batch-sharded (`batched.shard_host_tree`) — bitwise both ways
+        (DESIGN §32). Chainable."""
         for s in sessions:
-            if s.plan.mesh is not None:
-                raise resilience.MeshPlanUnsupported(
-                    "ResidentSet manages unsharded plans only — a "
-                    "mesh-sharded session's state lives across devices",
-                    surface="tier")
             if s._residency is not None and s._residency is not self:
                 raise ValueError("session is already managed by a "
                                  "different ResidentSet")
@@ -1111,17 +1128,11 @@ class ResidentSet:
                     _implant(session, leaves, meta)
                     bump("revives_h2d")
                 else:
-                    # restores land on the session's PINNED device (the
-                    # mesh-sharded fleet's placement); unpinned sessions
-                    # keep the default-device path byte-for-byte
-                    target = getattr(session, "device", None)
-                    if target is None:
-                        dev = {k: jnp.asarray(v)
-                               for k, v in leaves.items()}
-                    else:
-                        dev = {k: jax.device_put(v, target)
-                               for k, v in leaves.items()}
-                    _implant(session, dev, meta)
+                    # restores land on the session's placement: pinned
+                    # device, plan mesh (batch-sharded re-scatter), or
+                    # the default device — byte-for-byte on each branch
+                    _implant(session, _leaves_to_device(session, leaves),
+                             meta)
                     bump("revives_h2d")
                 if from_disk:
                     bump("revives_disk")
@@ -1191,8 +1202,13 @@ class ResidentSet:
             session._probe = fresh._probe
         else:
             target = getattr(session, "device", None)
-            Ad = (jnp.asarray(A1) if target is None
-                  else jax.device_put(A1, target))
+            if plan.mesh is not None:
+                from conflux_tpu.batched import _shard_batch
+
+                (Ad,) = _shard_batch((jnp.asarray(A1),), plan.mesh)
+            else:
+                Ad = (jnp.asarray(A1) if target is None
+                      else jax.device_put(A1, target))
             with profiler.region("serve.refactor"):
                 session._factors = plan._factor_once(Ad)
             session._A0 = Ad
@@ -1268,7 +1284,11 @@ class ResidentSet:
                 rec = s._spill
                 if rec is None:
                     continue
-                if rec.tier != "host" or rec.meta["upd"] is not None:
+                if (rec.tier != "host" or rec.meta["upd"] is not None
+                        or s.plan.mesh is not None):
+                    # mesh sessions fault in individually: numpy-
+                    # stacking adds a leading axis that would break the
+                    # batch-axis-leading shard rule (DESIGN §32)
                     rest.append(s)
                     continue
                 key = (id(s.plan), rec.meta["n_factors"],
@@ -1567,8 +1587,7 @@ def load_fleet(path: str, *, residency: ResidentSet | None = None,
         for s in sessions:
             with s._lock:
                 rec = s._spill
-                dev = {k: jnp.asarray(v) for k, v in rec.leaves.items()}
-                _implant(s, dev, rec.meta)
+                _implant(s, _leaves_to_device(s, rec.leaves), rec.meta)
                 s._spill = None
             bump("revives_h2d")
     bump("restores")

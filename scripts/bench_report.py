@@ -38,7 +38,7 @@ _RATIO_KEYS = (
     "speedup_vs_per_session_dispatch", "speedup_vs_sequential",
     "speedup_vs_always_refactor", "speedup_vs_seq_async",
     "ratio_solves_vs_single_lane", "ratio_solves_vs_single_host",
-    "speedup_vs_pickle_wire",
+    "speedup_vs_pickle_wire", "speedup_vs_bare_loop",
     "overhead_pct",
     "single_speedup_vs_refactor", "speedup_vs_naive",
     "speedup_vs_xla_trsm", "speedup_vs_staged_factor",

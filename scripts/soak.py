@@ -840,6 +840,184 @@ def run_tier_trial(seed: int) -> tuple[bool, str]:
                       f"corrupt={st['corrupt_sessions']}")
 
 
+def run_mesh_trial(seed: int) -> tuple[bool, str]:
+    """One chaos trial of the large-N mesh lane (ISSUE 17).
+
+    A small fleet of MESH-SHARDED sessions (one (B, N, N) batched plan
+    over the full device mesh, factors resident as sharded pytrees) is
+    served through a tiered engine while the serve fault menu (staging
+    NaN, dispatch/d2h delays, forced-unhealthy verdicts) AND the tier
+    fault sites (spill/revive/disk_write/disk_read crashes and delays)
+    fire, with explicit spill/demote churn between requests so revives
+    must reshard the factors. Invariants: every future resolves with an
+    answer or a STRUCTURED resilience error; clean answers match each
+    batch element's own f64 oracle (a resharding bug on revive would
+    scramble elements across devices and miss it); the session count is
+    conserved across tiers; the engine closes un-wedged with zero
+    pending; and `mesh_plan_unsupported` stays at ZERO — nothing in a
+    healthy mesh trace, faults included, is allowed to hit a residue
+    surface (DESIGN §32)."""
+    import tempfile
+
+    import jax
+    import jax.numpy as jnp
+
+    from conflux_tpu import batched, resilience, serve, tier
+    from conflux_tpu.engine import EngineSaturated, ServeEngine
+    from conflux_tpu.resilience import (
+        DeadlineExceeded,
+        FaultPlan,
+        FaultSpec,
+        HealthPolicy,
+        InjectedFault,
+        RestoreCorrupt,
+        RhsNonFinite,
+        SessionQuarantined,
+        SessionSpilled,
+        SolveUnhealthy,
+    )
+
+    rng = np.random.default_rng(seed)
+    serve.clear_plans()
+    B = jax.device_count()
+    N = int(rng.choice([24, 32]))
+    F = int(rng.integers(1, 3))  # mesh sessions are heavyweight tenants
+    mesh = batched.batch_mesh()
+    plan = serve.FactorPlan.create((B, N, N), jnp.float32, v=8, mesh=mesh)
+    As, fleet = [], []
+    for _ in range(F):
+        A = (rng.standard_normal((B, N, N)) / np.sqrt(N)
+             + 2.0 * np.eye(N)).astype(np.float32)
+        fleet.append(plan.factor(jnp.asarray(A)))
+        As.append(A.astype(np.float64))
+    menu = [
+        FaultSpec("staging", "nan", prob=0.3,
+                  count=int(rng.integers(1, 3))),
+        FaultSpec("dispatch", "delay", prob=0.3, delay_s=0.002, count=3),
+        FaultSpec("d2h", "delay", prob=0.3, delay_s=0.002, count=2),
+        FaultSpec("solve", "unhealthy", prob=0.4,
+                  count=int(rng.integers(1, 3))),
+        FaultSpec("spill", "crash", prob=0.3, count=1),
+        FaultSpec("spill", "delay", prob=0.3, delay_s=0.001, count=2),
+        FaultSpec("revive", "crash", prob=0.3, count=1),
+        FaultSpec("revive", "delay", prob=0.3, delay_s=0.001, count=2),
+        FaultSpec("disk_write", "crash", prob=0.3, count=1),
+        FaultSpec("disk_read", "crash", prob=0.3, count=1),
+    ]
+    picks = [m for m in menu if rng.integers(2)]
+    faults = FaultPlan(picks, seed=seed)
+    label = (f"seed={seed} mesh B={B} N={N} F={F} "
+             f"faults={[(f.site, f.kind) for f in picks]}")
+    ok_exc = (RhsNonFinite, DeadlineExceeded, SolveUnhealthy,
+              SessionQuarantined, InjectedFault, SessionSpilled,
+              RestoreCorrupt)
+    h0 = resilience.health_stats().get("mesh_plan_unsupported", 0)
+    with tempfile.TemporaryDirectory() as tmp:
+        rs = tier.ResidentSet(
+            max_sessions=1, host_max_sessions=max(2, F),
+            disk_dir=tmp, max_concurrent_revives=2, fault_plan=faults)
+        eng = ServeEngine(
+            max_batch_delay=float(rng.choice([0.0, 0.002])),
+            max_pending=64, max_coalesce_width=4,
+            health=HealthPolicy(quarantine_after=3,
+                                quarantine_cooldown=0.05),
+            residency=rs, revive_wait=5.0,
+            fault_plan=faults, watchdog_interval=0.05)
+        resilience.install_faults(faults)
+        rs.adopt(*fleet)
+        reqs = []
+        try:
+            for i in range(16):
+                si = int(rng.integers(F))
+                w = int(rng.choice([1, 1, 2]))
+                b = rng.standard_normal((B, N, w)).astype(np.float32)
+                if w == 1 and rng.integers(2):
+                    b = b[..., 0]  # vector RHS shape is legal too
+                kind = int(rng.integers(8))
+                deadline = None
+                if kind == 0:  # poisoned: admission guard food
+                    b.reshape(-1)[int(rng.integers(b.size))] = np.nan
+                elif kind == 1:  # born expired: lazy-eviction food
+                    deadline = 0.0
+                if rng.integers(3) == 0:
+                    # tier churn mid-traffic: the revive must put the
+                    # factors BACK as a sharded pytree, not a gather
+                    victim = fleet[int(rng.integers(F))]
+                    try:
+                        if rng.integers(2):
+                            rs.spill(victim)
+                        else:
+                            rs.demote(victim)
+                    except ok_exc:
+                        pass
+                if kind >= 2 and rng.integers(4) == 0:
+                    # direct client-thread touch: transparent revival.
+                    # Clean requests only — session.solve has no
+                    # admission guard, so a poisoned RHS would come
+                    # back NaN by construction, not by bug.
+                    try:
+                        x = np.asarray(fleet[si].solve(b))
+                        reqs.append((si, b, None, x))
+                    except ok_exc:
+                        continue
+                    continue
+                try:
+                    fut = eng.submit(fleet[si], b, deadline=deadline)
+                except (RhsNonFinite, SessionQuarantined,
+                        EngineSaturated, SessionSpilled,
+                        RestoreCorrupt):
+                    continue
+                reqs.append((si, b, fut, None))
+            wedged = eng.close(timeout=120)
+            if wedged:
+                return False, f"{label}: close() wedged {wedged}"
+        finally:
+            resilience.install_faults(None)
+            eng.close(timeout=10)
+        answered = 0
+        for si, b, fut, x in reqs:
+            if fut is not None:
+                if not fut.done():
+                    return False, (f"{label}: close() left a future "
+                                   "unresolved")
+                try:
+                    x = np.asarray(fut.result(0))
+                except ok_exc:
+                    continue
+                except Exception as e:  # noqa: BLE001 — a leak is a bug
+                    return False, (f"{label}: UNSTRUCTURED "
+                                   f"{type(e).__name__}: {e}")
+            b64 = b.astype(np.float64)
+            want = np.stack([np.linalg.solve(As[si][j], b64[j])
+                             for j in range(B)])
+            err = (np.linalg.norm(x - want)
+                   / max(np.linalg.norm(want), 1e-30))
+            if not (err < 1e-3):
+                return False, (f"{label}: answer off its own oracle "
+                               f"({err:.2e}) — torn reshard or "
+                               "cross-batch corruption")
+            answered += 1
+        stats = eng.stats()
+        if stats["pending"] != 0:
+            return False, f"{label}: {stats['pending']} slots leaked"
+        st = rs.stats()
+        conserved = (st["resident_sessions"] + st["host_sessions"]
+                     + st["disk_sessions"] + st["corrupt_sessions"])
+        if conserved != F or st["managed_sessions"] != F:
+            return False, (f"{label}: session count not conserved "
+                           f"({conserved}/{F}: {st})")
+        h1 = resilience.health_stats().get("mesh_plan_unsupported", 0)
+        if h1 != h0:
+            return False, (f"{label}: mesh_plan_unsupported bumped "
+                           f"{h1 - h0}x on a healthy mesh trace — a "
+                           "demoted site regressed to raising")
+        th = tier.tier_stats()
+        return True, (f"{label}: ok {answered}/{len(reqs)} answered, "
+                      f"injected={sum(faults.injected.values())}, "
+                      f"spills={th['spills_host']}+{th['spills_disk']}d, "
+                      f"revives={th['revives_h2d']}h, unsupported=0")
+
+
 def run_fleet_trial(seed: int) -> tuple[bool, str]:
     """One chaos trial of the MESH-SHARDED serve fleet (ISSUE 9):
     mixed solve + cold-start traffic over a lanes='auto' engine (one
@@ -1589,6 +1767,16 @@ def main(argv=None) -> int:
                     "stale_generation fault sites: backpressure is "
                     "retryable, corruption is instant structural "
                     "death, answers stay bitwise their f64 oracle")
+    ap.add_argument("--mesh", action="store_true",
+                    help="chaos-soak the large-N mesh lane: a fleet of "
+                    "mesh-sharded (B, N, N) sessions served through a "
+                    "tiered engine under the serve fault menu PLUS the "
+                    "spill/revive/disk fault sites, with explicit "
+                    "spill/demote churn so revives must reshard; "
+                    "asserts structured failures only, per-batch-"
+                    "element f64 oracle answers (a torn reshard "
+                    "scrambles elements), session-count conservation "
+                    "and mesh_plan_unsupported == 0")
     ap.add_argument("--qos", action="store_true",
                     help="chaos-soak the multi-tenant QoS layer: "
                     "random tenants across the latency/throughput/"
@@ -1608,7 +1796,8 @@ def main(argv=None) -> int:
                     "cycle or lock-held-across-dispatch fails the soak")
     args = ap.parse_args(argv)
 
-    trial = (run_qos_trial if args.qos
+    trial = (run_mesh_trial if args.mesh
+             else run_qos_trial if args.qos
              else run_fabric_trial if args.fabric
              else run_gang_trial if args.gang
              else run_fleet_trial if args.fleet

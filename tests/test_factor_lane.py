@@ -388,8 +388,10 @@ def test_factor_lane_rejects_bad_inputs():
                                     mesh=batched.batch_mesh())
     session = plan.factor(jnp.asarray(_systems(1, seed=67)[0]))
     with ServeEngine(max_batch_delay=0.0) as eng:
-        with pytest.raises(ValueError, match="unsharded"):
-            eng.submit_factor(mplan, np.zeros((8, N, N), np.float32))
+        # mesh plans are ADMITTED now (DESIGN §32) — the bad-input
+        # rejection left on the mesh path is a shape mismatch
+        with pytest.raises(ValueError, match="shape"):
+            eng.submit_factor(mplan, np.zeros((N, N), np.float32))
         with pytest.raises(ValueError, match="shape"):
             eng.submit_factor(plan, np.zeros((N, N + 1), np.float32))
         with pytest.raises(TypeError, match="FactorPlan"):

@@ -31,7 +31,7 @@ import pytest
 import jax
 import jax.numpy as jnp
 
-from conflux_tpu import batched, profiler, resilience, serve
+from conflux_tpu import profiler, resilience, serve
 from conflux_tpu.engine import (
     EngineClosed,
     ServeEngine,
@@ -374,30 +374,41 @@ def test_controller_tunes_lane_delay_independently():
 
 
 # --------------------------------------------------------------------- #
-# structured mesh rejection
+# structured mesh rejection: the genuine residue only (DESIGN §32)
 # --------------------------------------------------------------------- #
 
 
-def test_mesh_plan_unsupported_is_structured_and_counted():
+def test_mesh_plan_unsupported_is_residue_only():
+    """The factor lane now SERVES mesh plans; `MeshPlanUnsupported` is
+    reserved for the genuine residue — migrating sharded state off its
+    mesh. A 4-device mesh leaves devices 4..7 as provable outsiders."""
     serve.clear_plans()
+    mesh4 = jax.sharding.Mesh(
+        np.asarray(jax.devices()[:4], dtype=object), ("b",))
     mplan = serve.FactorPlan.create((8, N, N), jnp.float32, v=V,
-                                    mesh=batched.batch_mesh())
+                                    mesh=mesh4)
+    A = np.zeros((8, N, N), np.float32) + np.eye(N, dtype=np.float32)
+    outside = jax.devices()[7]
     h0 = resilience.health_stats().get("mesh_plan_unsupported", 0)
     with ServeEngine(max_batch_delay=0.0) as eng:
+        # the demoted site: submit_factor serves the mesh plan
+        s = eng.factor(mplan, A)
+        assert s.plan is mplan and s.plan.mesh is not None
+        # residue: an explicit pin OUTSIDE the plan's mesh
         with pytest.raises(MeshPlanUnsupported) as ei:
-            eng.submit_factor(mplan, np.zeros((8, N, N), np.float32))
+            eng.submit_factor(mplan, A, device=outside)
         assert isinstance(ei.value, ValueError)  # legacy callers OK
         assert ei.value.surface == "factor_lane"
-        # callers can now ROUTE instead of string-matching
-        try:
-            eng.submit_factor(mplan, np.zeros((8, N, N), np.float32))
-        except MeshPlanUnsupported:
-            s = mplan.factor(jnp.zeros((8, N, N), jnp.float32)
-                             + jnp.eye(N, dtype=jnp.float32))
-        assert s.plan is mplan
-    with pytest.raises(MeshPlanUnsupported):
-        mplan.factor(np.zeros((8, N, N), np.float32),
-                     device=jax.devices()[0])
+        # an IN-mesh pin is a placement no-op, not an error
+        assert eng.factor(mplan, A, device=jax.devices()[0]).plan \
+            is mplan
+    with pytest.raises(MeshPlanUnsupported) as ei:
+        mplan.factor(A, device=outside)
+    assert ei.value.surface == "factor"
+    with pytest.raises(MeshPlanUnsupported) as ei:
+        s.to_device(outside)
+    assert ei.value.surface == "to_device"
+    assert s.to_device(jax.devices()[1]) is s  # in-mesh: no-op
     h1 = resilience.health_stats()["mesh_plan_unsupported"]
     assert h1 >= h0 + 3
     assert "mesh_plan_unsupported" in profiler.serve_stats()["health"]

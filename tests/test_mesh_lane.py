@@ -1,0 +1,376 @@
+"""Large-N mesh lane tests: the ISSUE 17 contracts (DESIGN §32).
+
+- A mesh-sharded plan is SERVED by the engine — admission, deadlines,
+  health guards, coalescing — and every answer is bitwise what the bare
+  `plan.factor` + `session.solve` loop returns.
+- Multi-RHS coalescing: same-session solves inside one
+  `max_batch_delay` window merge along the RHS axis into ONE sharded
+  dispatch at a power-of-two width bucket; each request's slice is
+  bitwise its solo answer.
+- Layout-agnostic tiering: spill gathers the sharded factors into the
+  CRC'd host record, revive re-scatters them onto the mesh
+  (`batched.shard_host_tree`) — bitwise both ways, sharding restored.
+- checkpoint()/restore() round-trips a mesh session bitwise (the
+  PlanKey mesh identity rides the fleet codec, test_tier.py).
+- Deadlines evict mesh requests mid-window; a poisoned RHS fails alone
+  while co-batched mesh neighbours stay bitwise; NaN at admission is
+  rejected before it can waste a sharded dispatch.
+- Zero-compile steady state: after `prewarm` (factor bucket 1 +
+  the width buckets), mesh traffic retraces nothing.
+- QoS: mesh requests are heavyweight tenants — their ledger share is
+  flop-aware (`qos.request_cost`), and a mixed mesh+fleet trace runs
+  both classes on one engine.
+- `mesh_plan_unsupported` stays 0 across every serving path here: the
+  counter is reserved for the genuine residue (test_fleet.py).
+"""
+
+import time
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from conflux_tpu import batched, profiler, qos, resilience, serve, tier
+from conflux_tpu.engine import ServeEngine
+from conflux_tpu.resilience import (
+    DeadlineExceeded,
+    FaultPlan,
+    FaultSpec,
+    HealthPolicy,
+    RhsNonFinite,
+)
+from conflux_tpu.tier import ResidentSet
+
+B, N, V = 8, 32, 16
+
+
+def _mesh_plan(**kw):
+    return serve.FactorPlan.create((B, N, N), jnp.float32, v=V,
+                                   mesh=batched.batch_mesh(), **kw)
+
+
+def _systems(seed=0):
+    rng = np.random.default_rng(seed)
+    A = (rng.standard_normal((B, N, N)) / np.sqrt(N)
+         + 2.0 * np.eye(N)).astype(np.float32)
+    return A
+
+
+def _rhs(seed=0, w=None):
+    rng = np.random.default_rng(1000 + seed)
+    shape = (B, N) if w is None else (B, N, w)
+    return rng.standard_normal(shape).astype(np.float32)
+
+
+def _unsupported_delta(h0):
+    return (resilience.health_stats().get("mesh_plan_unsupported", 0)
+            - h0.get("mesh_plan_unsupported", 0))
+
+
+# --------------------------------------------------------------------- #
+# engine serves the mesh: bitwise vs the bare plan.factor oracle
+# --------------------------------------------------------------------- #
+
+
+@pytest.mark.parametrize("checked", [False, True])
+def test_mesh_submit_bitwise_vs_bare_plan(checked):
+    serve.clear_plans()
+    plan = _mesh_plan()
+    A, b = _systems(1), _rhs(1)
+    # the bare large-N loop the serve stack used to force callers into
+    oracle = plan.factor(jnp.asarray(A))
+    x0 = np.asarray(oracle.solve(jnp.asarray(b)))
+    h0 = resilience.health_stats()
+    kw = {"health": HealthPolicy()} if checked else {}
+    with ServeEngine(max_batch_delay=0.0, **kw) as eng:
+        sess = eng.factor(plan, A)
+        assert sess.plan is plan and sess.plan.mesh is not None
+        assert sess.device is None  # unpinned: state spans the mesh
+        np.testing.assert_array_equal(eng.solve(sess, b), x0)
+        c = eng.counters()
+        assert c["factor_requests"] == 1
+        assert c["factor_bucket_hits"] == {1: 1}  # ONE sharded dispatch
+    assert _unsupported_delta(h0) == 0
+
+
+def test_mesh_factors_stay_sharded_through_engine():
+    serve.clear_plans()
+    plan = _mesh_plan()
+    with ServeEngine(max_batch_delay=0.0) as eng:
+        sess = eng.factor(plan, _systems(2))
+        f0 = jax.tree_util.tree_leaves(sess._factors)[0]
+        assert len(f0.sharding.device_set) == len(
+            list(plan.mesh.devices.flat))
+
+
+# --------------------------------------------------------------------- #
+# multi-RHS coalescing: one sharded dispatch, bitwise per request
+# --------------------------------------------------------------------- #
+
+
+def test_mesh_rhs_coalesced_dispatch_bitwise_per_request():
+    serve.clear_plans()
+    plan = _mesh_plan()
+    A = _systems(3)
+    sess = plan.factor(jnp.asarray(A))
+    bs = [_rhs(30), _rhs(31, 2), _rhs(32)]
+    # batched plans are bitwise WITHIN a coalesced bucket (engine.py
+    # module doc): the oracle is the bare session solving the SAME
+    # merged window (widths 1+2+1 -> the bucket-4 dispatch), sliced
+    # back per request — not the per-width solo programs, whose GEMM
+    # shape differs
+    cols = [b[..., None] if b.ndim == 2 else b for b in bs]
+    merged = np.asarray(
+        sess.solve(jnp.asarray(np.concatenate(cols, axis=-1))))
+    direct = []
+    off = 0
+    for b, c in zip(bs, cols):
+        w = c.shape[-1]
+        d = merged[..., off:off + w]
+        direct.append(d[..., 0] if b.ndim == 2 else d)
+        off += w
+    solo = [np.asarray(sess.solve(jnp.asarray(b))) for b in bs]
+    h0 = resilience.health_stats()
+    eng = ServeEngine(max_batch_delay=60.0, max_coalesce_width=8)
+    futs = [eng.submit(sess, b) for b in bs]  # one window
+    assert eng.close(timeout=120) == []
+    for f, d, s in zip(futs, direct, solo):
+        x = np.asarray(f.result(0))
+        np.testing.assert_array_equal(x, d)
+        # and the cross-bucket contract vs the solo programs: allclose
+        np.testing.assert_allclose(x, s, rtol=1e-5, atol=1e-6)
+    c = eng.counters()
+    assert c["batches"] == 1, "the window must merge into ONE dispatch"
+    assert c["coalesced_requests"] == 3
+    assert c["bucket_hits"] == {4: 1}  # widths 1+2+1 -> bucket 4
+    assert _unsupported_delta(h0) == 0
+
+
+# --------------------------------------------------------------------- #
+# tiered spill / revive: layout-agnostic, bitwise
+# --------------------------------------------------------------------- #
+
+
+def test_mesh_spill_revive_bitwise_and_resharded(tmp_path):
+    serve.clear_plans()
+    plan = _mesh_plan()
+    A, b = _systems(4), _rhs(4, 2)
+    sess = plan.factor(jnp.asarray(A))
+    x0 = np.asarray(sess.solve(jnp.asarray(b)))
+    rs = ResidentSet(disk_dir=str(tmp_path))
+    rs.adopt(sess)  # the demoted tier.adopt site now serves mesh
+    assert rs.spill(sess) == 1
+    assert sess.tier == "host" and sess._factors is None
+    assert sess.nbytes == 0 and sess._spill.nbytes > 0
+    np.testing.assert_array_equal(x0, np.asarray(
+        sess.solve(jnp.asarray(b))))  # transparent fault-in
+    assert sess.tier == "device"
+    f0 = jax.tree_util.tree_leaves(sess._factors)[0]
+    assert len(f0.sharding.device_set) == 8, \
+        "revive must re-scatter onto the mesh, not one device"
+    # the disk tier: gather -> CRC'd record -> shard-aware h2d
+    rs.spill(sess)
+    assert rs.demote(sess) == 1 and sess.tier == "disk"
+    np.testing.assert_array_equal(x0, np.asarray(
+        sess.solve(jnp.asarray(b))))
+
+
+def test_mesh_spill_revive_through_engine_traffic():
+    serve.clear_plans()
+    plan = _mesh_plan()
+    A, b = _systems(5), _rhs(5)
+    rs = ResidentSet()
+    with ServeEngine(max_batch_delay=0.0, residency=rs) as eng:
+        sess = eng.factor(plan, A)
+        rs.adopt(sess)
+        x0 = eng.solve(sess, b)
+        rs.spill(sess)
+        assert sess.tier == "host"
+        np.testing.assert_array_equal(eng.solve(sess, b), x0)
+        assert sess.tier == "device"
+    st = tier.tier_stats()
+    assert st["revives_h2d"] > 0
+
+
+# --------------------------------------------------------------------- #
+# checkpoint / restore: sharded factors, bitwise
+# --------------------------------------------------------------------- #
+
+
+def test_mesh_checkpoint_restore_bitwise(tmp_path):
+    serve.clear_plans()
+    plan = _mesh_plan()
+    A, b = _systems(6), _rhs(6)
+    d = str(tmp_path / "ckpt")
+    with ServeEngine(max_batch_delay=0.0) as eng:
+        sess = eng.factor(plan, A)
+        x0 = eng.solve(sess, b)
+        solves = sess.solves
+        eng.checkpoint(d, sessions=[sess], names=["m0"])
+    serve.clear_plans()  # a cold process: the plan rebuilds from disk
+    with ServeEngine(max_batch_delay=0.0) as eng:
+        (back,) = eng.restore(d)
+        assert back.plan.mesh is not None
+        assert back.solves == solves  # counters rode the codec
+        np.testing.assert_array_equal(eng.solve(back, b), x0)
+        f0 = jax.tree_util.tree_leaves(back._factors)[0]
+        assert len(f0.sharding.device_set) == 8
+
+
+def test_mesh_lazy_restore_faults_in_on_first_touch(tmp_path):
+    serve.clear_plans()
+    plan = _mesh_plan()
+    A, b = _systems(7), _rhs(7)
+    sess = plan.factor(jnp.asarray(A))
+    x0 = np.asarray(sess.solve(jnp.asarray(b)))
+    d = str(tmp_path / "fleet")
+    tier.save_fleet(d, [sess], names=["z"])
+    serve.clear_plans()
+    rs = ResidentSet()
+    (back,) = tier.load_fleet(d, residency=rs)
+    assert back.tier == "host"  # scalable warm restart: lazy
+    np.testing.assert_array_equal(x0, np.asarray(
+        back.solve(jnp.asarray(b))))
+    assert back.tier == "device"
+
+
+# --------------------------------------------------------------------- #
+# deadlines + poisoned RHS on the mesh path
+# --------------------------------------------------------------------- #
+
+
+def test_mesh_deadline_evicts_mid_window():
+    serve.clear_plans()
+    plan = _mesh_plan()
+    sess = plan.factor(jnp.asarray(_systems(8)))
+    h0 = resilience.health_stats()
+    eng = ServeEngine(max_batch_delay=60.0)
+    t0 = time.perf_counter()
+    fut = eng.submit(sess, _rhs(8), deadline=0.1)
+    with pytest.raises(DeadlineExceeded, match="slot released"):
+        fut.result(30)
+    assert time.perf_counter() - t0 < 30
+    assert eng.stats()["pending"] == 0
+    assert eng.close(timeout=60) == []
+    h1 = resilience.health_stats()
+    assert h1["evictions"] - h0.get("evictions", 0) == 1
+
+
+def test_mesh_poisoned_rhs_rejected_at_admission():
+    serve.clear_plans()
+    plan = _mesh_plan()
+    sess = plan.factor(jnp.asarray(_systems(9)))
+    bad = _rhs(9)
+    bad[3, 5] = np.nan
+    with ServeEngine(max_batch_delay=0.0,
+                     health=HealthPolicy()) as eng:
+        with pytest.raises(RhsNonFinite):
+            eng.submit(sess, bad)
+        good = _rhs(10)
+        np.testing.assert_array_equal(
+            eng.solve(sess, good),
+            np.asarray(sess.solve(jnp.asarray(good))))
+
+
+def test_mesh_staging_poison_isolated_survivors_bitwise():
+    """A request poisoned AFTER admission (seeded staging fault) fails
+    its own future; the co-batched mesh requests in the SAME sharded
+    window get bitwise the answers they would have gotten alone."""
+    serve.clear_plans()
+    plan = _mesh_plan()
+    sess = plan.factor(jnp.asarray(_systems(11)))
+    bs = [_rhs(40, 2), _rhs(41), _rhs(42)]
+    direct = [np.asarray(sess.solve(jnp.asarray(b))) for b in bs]
+    faults = FaultPlan([FaultSpec("staging", "nan", count=1)])
+    h0 = resilience.health_stats()
+    eng = ServeEngine(max_batch_delay=60.0, health=HealthPolicy(),
+                      fault_plan=faults)
+    futs = [eng.submit(sess, b) for b in bs]
+    assert eng.close(timeout=120) == []
+    with pytest.raises(RhsNonFinite, match="staging"):
+        futs[0].result(0)
+    for f, d in zip(futs[1:], direct[1:]):
+        np.testing.assert_array_equal(np.asarray(f.result(0)), d)
+    h1 = resilience.health_stats()
+    assert h1["staging_isolations"] - h0.get("staging_isolations",
+                                             0) == 1
+
+
+# --------------------------------------------------------------------- #
+# prewarm: zero-compile steady state on the mesh lane
+# --------------------------------------------------------------------- #
+
+
+@pytest.mark.parametrize("checked", [False, True])
+def test_mesh_zero_compile_steady_state_after_prewarm(checked):
+    serve.clear_plans()
+    plan = _mesh_plan()
+    A = _systems(12)
+    kw = {"health": HealthPolicy()} if checked else {}
+    with ServeEngine(max_batch_delay=0.01, max_coalesce_width=8,
+                     **kw) as eng:
+        eng.prewarm(plan, factor_batches=(1,))  # the demoted site
+        sess = eng.factor(plan, A)
+        eng.prewarm(sess, widths=(1, 2, 4))
+        tc0 = dict(plan.trace_counts)
+        eng.solve(sess, _rhs(50))
+        futs = [eng.submit(sess, _rhs(51 + i)) for i in range(4)]
+        for f in futs:
+            f.result(60)
+        eng.solve(sess, _rhs(55, 2))
+        assert eng.factor(plan, A).plan is plan  # steady-state refit
+        assert dict(plan.trace_counts) == tc0, \
+            "mesh steady-state traffic must retrace NOTHING"
+
+
+# --------------------------------------------------------------------- #
+# QoS: mesh sessions are heavyweight tenants; mixed mesh+fleet trace
+# --------------------------------------------------------------------- #
+
+
+def test_mesh_request_cost_is_flop_aware():
+    # the canonical fleet request (32 systems of N=256, width 1) is the
+    # 1.0 reference; costs scale linearly in B*N^2*w (solve), B*N^3
+    # (factor), and clamp at 1.0 so fleet traffic is unchanged
+    assert qos.request_cost((256, 256), width=1) == 1.0
+    assert qos.request_cost((8, 1024, 1024), width=4) == 16.0
+    assert qos.request_cost((8, 1024, 1024), factor=True) == 16.0
+    assert qos.request_cost((32, 256, 256), width=1) == 1.0
+    led = qos.FairShareLedger()
+    big = qos.QosClass(tenant="mesh")
+    led.try_admit(big, 0, 64, cost=16.0)
+    assert led._pending["mesh"] == 16.0
+    led.release(big, cost=16.0)
+    assert led._pending["mesh"] == 0.0
+
+
+def test_mixed_mesh_and_fleet_trace_on_one_engine():
+    serve.clear_plans()
+    mplan = _mesh_plan()
+    fplan = serve.FactorPlan.create((N, N), jnp.float32, v=V)
+    rng = np.random.default_rng(60)
+    Af = (rng.standard_normal((N, N)) / np.sqrt(N)
+          + 2.0 * np.eye(N)).astype(np.float32)
+    bf = rng.standard_normal((N,)).astype(np.float32)
+    Am, bm = _systems(61), _rhs(61)
+    mesh_cls = qos.QosClass(tenant="mesh", tier="throughput")
+    fleet_cls = qos.QosClass(tenant="fleet", tier="latency")
+    h0 = resilience.health_stats()
+    with ServeEngine(max_batch_delay=0.005) as eng:
+        ms = eng.factor(mplan, Am, qos=mesh_cls)
+        fs = eng.factor(fplan, Af, qos=fleet_cls)
+        xm = np.asarray(ms.solve(jnp.asarray(bm)))
+        xf = np.asarray(fs.solve(jnp.asarray(bf)))
+        for _ in range(3):
+            fm = eng.submit(ms, bm, qos=mesh_cls)
+            ff = eng.submit(fs, bf, qos=fleet_cls)
+            np.testing.assert_array_equal(np.asarray(fm.result(60)), xm)
+            np.testing.assert_array_equal(np.asarray(ff.result(60)), xf)
+        st = eng.stats()["qos"]
+        assert {"mesh/throughput", "fleet/latency"} <= set(
+            st["classes"])
+        for row in st["tenants"].values():
+            assert row["pending"] == 0  # every cost unit released
+    assert _unsupported_delta(h0) == 0

@@ -448,19 +448,40 @@ def test_engine_checkpoint_drain_barrier_and_lazy_restore(tmp_path):
         eng.close()
 
 
-def test_checkpoint_rejects_mesh_plans(tmp_path):
+def test_mesh_plan_key_roundtrips_fleet_codec(tmp_path):
+    """ISSUE 17 satellite: a mesh plan's identity — device ids, axis
+    names, device-array shape — rides the fleet.json codec, and the
+    restored plan rebuilds its mesh (hence its out_shardings, which key
+    on `mesh_cache_key`) EXACTLY. The restored session answers bitwise
+    and its factors land sharded back across the mesh."""
     from conflux_tpu.batched import batch_mesh
 
+    serve.clear_plans()
     mesh = batch_mesh()
     plan = serve.FactorPlan.create((8, N, N), jnp.float32, v=V,
                                    mesh=mesh)
+    key0 = plan.key
     rng = np.random.default_rng(17)
     A = np.stack([_mk(rng) for _ in range(8)])
     s = plan.factor(jnp.asarray(A))
-    with pytest.raises(ValueError, match="unsharded"):
-        tier.save_fleet(str(tmp_path / "ck"), [s])
-    with pytest.raises(ValueError, match="unsharded"):
-        ResidentSet().adopt(s)
+    b = rng.standard_normal((8, N)).astype(np.float32)
+    x0 = np.asarray(s.solve(jnp.asarray(b)))
+    tier.save_fleet(str(tmp_path / "ck"), [s], names=["m"])
+    serve.clear_plans()  # a cold process: the codec must carry it all
+    (back,) = tier.load_fleet(str(tmp_path / "ck"))
+    assert back.plan.key == key0
+    assert back.plan.key.mesh_key == key0.mesh_key
+    m2 = back.plan.mesh
+    assert [d.id for d in m2.devices.flat] \
+        == [d.id for d in mesh.devices.flat]
+    assert m2.axis_names == mesh.axis_names
+    assert m2.devices.shape == mesh.devices.shape
+    np.testing.assert_array_equal(
+        x0, np.asarray(back.solve(jnp.asarray(b))))
+    f0 = jax.tree_util.tree_leaves(back._factors)[0]
+    assert len(f0.sharding.device_set) == 8
+    # the registry aliases: an equal key resolves to the live plan
+    assert serve.FactorPlan.from_key(back.plan.key) is back.plan
 
 
 # --------------------------------------------------------------------- #
