@@ -145,6 +145,18 @@ def parse_args():
     ap.add_argument("--tier-gate", type=float, default=2.0,
                     help="min solves/s speedup vs the always-refactor "
                     "baseline (--tier, full shape)")
+    ap.add_argument("--precision", action="store_true",
+                    help="measure the ISSUE 18 precision-ladder win "
+                    "instead (DESIGN §33): an 'auto' (bf16+IR, verdict-"
+                    "checked) fleet vs the all-f32 fleet under one "
+                    "fixed device-byte budget sized between the two "
+                    "footprints — the f32 leg LRU-thrashes spill/"
+                    "revive, the auto leg stays resident; gate >= "
+                    "--precision-gate solves/s at equal residual-"
+                    "verdict policy, write BENCH_PRECISION.json")
+    ap.add_argument("--precision-gate", type=float, default=1.5,
+                    help="min solves/s speedup of the 'auto' leg vs "
+                    "the all-f32 leg (--precision, full shape)")
     ap.add_argument("--resilience", action="store_true",
                     help="measure the HealthPolicy guard overhead on the "
                     "clean path instead: interleaved guarded vs unguarded "
@@ -372,6 +384,7 @@ def main():
         args.out = ("BENCH_RESILIENCE.json" if args.resilience
                     else "BENCH_COLDSTART.json" if args.factor
                     else "BENCH_WORKINGSET.json" if args.tier
+                    else "BENCH_PRECISION.json" if args.precision
                     else "BENCH_ADAPTIVE.json" if args.adaptive
                     else "BENCH_FLEET.json" if args.fleet
                     else "BENCH_GANG.json" if args.gang
@@ -2810,6 +2823,217 @@ def main():
             raise SystemExit(
                 "gate: the fair-share ledger never throttled the "
                 "flooding bulk tenant")
+        return
+
+    # ---------------- precision mode: mixed-precision capacity gate ------ #
+    # the ISSUE 18 acceptance number (DESIGN §33): a mixed-precision
+    # trace (`precision="auto"` — sessions opened on the bf16+IR rung,
+    # every answer carrying the fused §20 Freivalds verdict, the
+    # escalation ladder armed) must beat the all-f32 leg by
+    # >= --precision-gate solves/s at EQUAL residual-verdict policy.
+    # On CPU a bf16 dispatch is NOT compute-faster than f32 (XLA
+    # emulates bf16 arithmetic through f32 upcasts — measured ~1.3x
+    # SLOWER per solve at N=256), so the win this gate measures is the
+    # one the tier actually buys on any topology: CAPACITY. bf16
+    # factors are half the bytes, so under one fixed device-byte
+    # budget — a ResidentSet per leg, both sized midway between the
+    # two fleets' measured footprints — the auto fleet stays fully
+    # resident while the f32 fleet LRU-thrashes a spill + h2d revival
+    # on (nearly) every touch of the cyclic trace. Zero compiles after
+    # `prewarm(..., precisions=("auto",))`, zero escalations on the
+    # healthy fleet, the byte high-water bounded at the budget for
+    # BOTH legs, and the default `precision=None` path answering
+    # bitwise-identically to the pre-§33 native program are all gated.
+    if args.precision:
+        from conflux_tpu import tier
+        from conflux_tpu.tier import ResidentSet
+
+        if args.smoke:
+            args.N, args.v = 128, 64
+            args.fleet_size = 8
+            args.requests, args.reps = 64, 3
+        N, v, F = args.N, args.v, args.fleet_size
+        R = max(args.requests, 2 * F)
+        plan = serve.FactorPlan.create((N, N), jnp.float32, v=v)
+        rng = np.random.default_rng(0)
+        Amats = [(rng.standard_normal((N, N)) / np.sqrt(N)
+                  + 2.0 * np.eye(N)).astype(np.float32)
+                 for _ in range(F)]
+        b = rng.standard_normal((N, 1)).astype(np.float32)
+        policy = HealthPolicy()
+        # the engine's own policy resolution: one plan-dtype limit for
+        # every leg — "equal residual-verdict policy" is literal here
+        limit = policy.resolved_residual_limit(np.dtype(np.float32), N)
+
+        # the default-path subtest: `precision=None` must ride the
+        # native program family and answer the same bits every time
+        native = plan.factor(jnp.asarray(Amats[0]))
+        x_pre = np.asarray(native.solve(b))
+        bitwise_default = (
+            native.served_tier is None
+            and np.array_equal(x_pre, np.asarray(native.solve(b)))
+            and np.array_equal(
+                x_pre, np.asarray(native.solve(b, precision=None))))
+        del native
+
+        fleets = {
+            "auto": [plan.factor(jnp.asarray(A), precision="auto")
+                     for A in Amats],
+            "f32": [plan.factor(jnp.asarray(A), precision="f32")
+                    for A in Amats],
+        }
+        eng = ServeEngine(max_batch_delay=args.delay_ms / 1e3,
+                          health=policy)
+        try:
+            # "auto" warms the WHOLE ladder's checked programs — every
+            # rung an escalation can land on, which includes the
+            # explicit-f32 leg's own program family (plan-level cache:
+            # one warm covers every session of the plan)
+            eng.prewarm(fleets["auto"][0], widths=(1,),
+                        precisions=("auto",))
+        finally:
+            eng.close()
+
+        # warm pass: per-session probe rows + the bitwise reference
+        x_want = {}
+        for leg, prec in (("auto", "auto"), ("f32", "f32")):
+            xs = []
+            for s in fleets[leg]:
+                x, _vd = s.solve_checked(b, precision=prec)
+                xs.append(np.asarray(x))
+            x_want[leg] = xs
+        per_auto = fleets["auto"][0].nbytes
+        per_f32 = fleets["f32"][0].nbytes
+        if per_auto >= per_f32:
+            raise SystemExit(
+                f"bf16-tier session ({per_auto}B) is not smaller than "
+                f"the f32 session ({per_f32}B) — the capacity premise "
+                "collapsed")
+        budget = F * (per_auto + per_f32) // 2
+        rsets = {leg: ResidentSet(max_bytes=budget, evict_batch=2)
+                 for leg in fleets}
+        for leg, fl in fleets.items():
+            rsets[leg].adopt(*fl)  # enforces the cap immediately
+
+        counters = {leg: {"spills": 0, "revives": 0, "unhealthy": 0}
+                    for leg in fleets}
+
+        def run_leg(leg, prec):
+            fl, c = fleets[leg], counters[leg]
+            h0 = tier.tier_stats()
+            t0 = time.perf_counter()
+            for i in range(R):
+                s = fl[i % F]  # cyclic: LRU's worst case when over cap
+                x, verdict = s.solve_checked(b, precision=prec)
+                ok, _f, _r = resilience.evaluate(verdict, limit)
+                if not ok:
+                    c["unhealthy"] += 1
+                    x = resilience.escalate_precision(
+                        s, b, prec, policy, limit)
+            jax.block_until_ready(x)
+            dt = time.perf_counter() - t0
+            h1 = tier.tier_stats()
+            c["spills"] += h1["spills_host"] - h0["spills_host"]
+            c["revives"] += h1["revives_h2d"] - h0["revives_h2d"]
+            return dt
+
+        run_leg("auto", "auto")  # settle post-adoption residency
+        run_leg("f32", "f32")
+        for c in counters.values():
+            c.update(spills=0, revives=0, unhealthy=0)
+        traces0 = dict(plan.trace_counts)
+        t_auto_reps, t_f32_reps, ratios = [], [], []
+        for rep in range(args.reps):  # interleaved + alternating order
+            if rep % 2 == 0:
+                tf = run_leg("f32", "f32")
+                ta = run_leg("auto", "auto")
+            else:
+                ta = run_leg("auto", "auto")
+                tf = run_leg("f32", "f32")
+            t_auto_reps.append(ta)
+            t_f32_reps.append(tf)
+            ratios.append(tf / ta)
+
+        def median(xs):
+            xs = sorted(xs)
+            return xs[len(xs) // 2]
+
+        t_auto, t_f32 = median(t_auto_reps), median(t_f32_reps)
+        speedup = median(ratios)
+        assert plan.trace_counts == traces0, \
+            "tier traffic compiled after prewarm — a ladder rung leaked"
+        # the measured regime went through spill/revive: every answer
+        # must still be the warm pass's bits
+        n_bad = sum(
+            not np.array_equal(
+                np.asarray(fleets[leg][i].solve_checked(
+                    b, precision=prec)[0]), x_want[leg][i])
+            for leg, prec in (("auto", "auto"), ("f32", "f32"))
+            for i in range(F))
+        if n_bad:
+            raise SystemExit(f"{n_bad}/{2 * F} tiered sessions diverged "
+                             "from their warm-pass answers (bitwise)")
+        esc = sum(s.precision_escalations
+                  for fl in fleets.values() for s in fl)
+        for leg in fleets:
+            hw = rsets[leg].stats()["device_bytes_high_water"]
+            if hw > budget:
+                raise SystemExit(
+                    f"{leg} leg device-byte high-water {hw} exceeded "
+                    f"the budget {budget} — the tier bound leaked")
+        gate = 1.0 if args.smoke else args.precision_gate
+        out = {
+            "metric": (f"precision-ladder solves/s N={N} v={v} "
+                       f"fleet={F} R={R} auto(bf16+IR) vs all-f32 "
+                       f"under a {budget}B device budget "
+                       f"({jax.device_count()} "
+                       f"{jax.devices()[0].platform} devices"
+                       + (", smoke" if args.smoke else "") + ")"),
+            "value": round(R / t_auto, 2),
+            "unit": "solves/s",
+            "all_f32_solves_per_s": round(R / t_f32, 2),
+            "speedup_vs_all_f32": round(speedup, 2),
+            "speedup_gate_x": gate,
+            "reps": args.reps,
+            "session_nbytes": {"auto": per_auto, "f32": per_f32},
+            "fleet_bytes": {"auto": per_auto * F, "f32": per_f32 * F},
+            "device_bytes_budget": budget,
+            "spills_host": {leg: counters[leg]["spills"]
+                            for leg in fleets},
+            "revives_h2d": {leg: counters[leg]["revives"]
+                            for leg in fleets},
+            "unhealthy_verdicts": {leg: counters[leg]["unhealthy"]
+                                   for leg in fleets},
+            "precision_escalations": esc,
+            "residual_limit": limit,
+            "bitwise_default_path": bool(bitwise_default),
+            "bitwise_after_spill_revive": f"{2 * F - n_bad}/{2 * F}",
+            "compiles_after_warmup": 0,  # asserted above
+            "mechanism": ("capacity, not FLOPs: CPU XLA emulates bf16 "
+                          "through f32 (a bf16 solve dispatches "
+                          "SLOWER), so the gate measures the half-byte "
+                          "factor footprint keeping the auto fleet "
+                          "resident while the f32 fleet pays a spill + "
+                          "h2d revival per touch under the same byte "
+                          "budget"),
+            "baseline": ("all-f32 fleet, identical cyclic trace, "
+                         "identical HealthPolicy verdict evaluation, "
+                         "same per-leg ResidentSet budget"),
+            "persistent_cache": cache.cache_dir(),
+        }
+        emit(out)
+        if not bitwise_default:
+            raise SystemExit(
+                "gate: the default precision=None path is no longer "
+                "bitwise-deterministic on the native program")
+        if esc:
+            raise SystemExit(
+                f"gate: {esc} precision escalations on the healthy "
+                "fleet — the bf16+IR rung failed verdicts it must pass")
+        if speedup < gate:
+            raise SystemExit(
+                f"gate: auto-precision speedup {speedup:.2f}x < {gate}x "
+                "over the all-f32 leg")
         return
 
     # ---------------- tier mode: working-set residency gate -------------- #

@@ -131,6 +131,7 @@ from conflux_tpu.resilience import (
     SessionQuarantined,
     SolveUnhealthy,
 )
+from conflux_tpu import serve
 from conflux_tpu.serve import FactorPlan, SolveSession
 from conflux_tpu.update import rank_bucket
 
@@ -222,6 +223,7 @@ class _Request:
     lane_slot: bool = False  # counted against the lane's pending slice
     qos: Any = None       # QosClass (DESIGN §30) or None
     cost: float = 1.0     # ledger admission weight (qos.request_cost)
+    precision: Any = None  # per-request served tier / 'auto' (DESIGN §33)
 
     __hash__ = object.__hash__
 
@@ -247,6 +249,7 @@ class _FactorRequest:
     device: Any = None    # explicit device pin for the opened session
     qos: Any = None       # QosClass (DESIGN §30) or None
     cost: float = 1.0     # ledger admission weight (qos.request_cost)
+    precision: Any = None  # served tier the session opens at (DESIGN §33)
 
     __hash__ = object.__hash__
 
@@ -267,6 +270,7 @@ class _FactorBatch:
     solo: bool = False    # a solo re-dispatch: no second retry
     mesh: bool = False    # a mesh-lane factor: ONE request, no stacking
                           # (factors/A are the sharded batch itself)
+    tier: Any = None      # served tier the batch factored at (§33)
 
 
 @dataclasses.dataclass
@@ -305,12 +309,13 @@ def _normalize_rhs(session, b):
             raise ValueError(
                 f"rhs {b.shape}, session needs {want} (+ rhs axis)")
         return b, False
+    rows = plan.M  # == N for square kinds; QR solves take an M-row rhs
     if b.ndim == 1:
-        if b.shape[0] != plan.N:
-            raise ValueError(f"rhs {b.shape}, session needs ({plan.N},)")
+        if b.shape[0] != rows:
+            raise ValueError(f"rhs {b.shape}, session needs ({rows},)")
         return b[:, None], True
-    if b.ndim != 2 or b.shape[0] != plan.N:
-        raise ValueError(f"rhs {b.shape}, session needs ({plan.N}, k)")
+    if b.ndim != 2 or b.shape[0] != rows:
+        raise ValueError(f"rhs {b.shape}, session needs ({rows}, k)")
     return b, False
 
 
@@ -640,26 +645,37 @@ class DeviceLane:
         if freqs:
             deferred += self._dispatch_factors(freqs, may_defer)
             batch = [r for r in batch if not isinstance(r, _FactorRequest)]
-        groups: dict[int, list[_Request]] = {}
+        groups: dict[tuple, list[_Request]] = {}
         order = []
         for r in batch:
-            key = id(r.session)
+            # a coalesced chunk shares ONE session.solve call, so the
+            # group key carries the request's precision route (§33):
+            # same-session requests at different tiers dispatch apart
+            key = (id(r.session), r.precision)
             if key not in groups:
                 groups[key] = []
-                order.append(r.session)
+                order.append((r.session, r.precision))
             groups[key].append(r)
         stackable: dict[int, list] = {}
         plan_order = []
         opportunity: dict[int, int] = {}
-        for session in order:
-            reqs = groups[id(session)]
+        for session, precision in order:
+            reqs = groups[(id(session), precision)]
             plan = session.plan
-            if eng.stack_sessions and not plan.batched:
+            # racy read by design (like _revive_for): the served tier
+            # is written once at construction
+            tiered = (precision is not None
+                      or session._served_tier is not None)
+            if eng.stack_sessions and not plan.batched \
+                    and plan.key.kind != "qr" and not tiered:
                 # gang eligibility (DESIGN §26): single-system plans
                 # only — a non-batched plan is never mesh-sharded, and
                 # drifted (`_upd`) / checked sessions now STACK (the
                 # stacked Woodbury + per-slot-verdict programs closed
-                # the old exclusion holes)
+                # the old exclusion holes). kind='qr' plans and
+                # tier-routed requests are COUNTED exclusions (§33):
+                # the gang stacks carry neither the (M, N) factor
+                # shapes nor per-tier program families.
                 pk = id(plan)
                 if pk not in stackable:
                     stackable[pk] = []
@@ -668,7 +684,9 @@ class DeviceLane:
                 continue
             if eng.stack_sessions:
                 eng._note_exclusion(
-                    "mesh" if plan.mesh is not None else "batched")
+                    "kind" if plan.key.kind == "qr"
+                    else "precision" if tiered
+                    else "mesh" if plan.mesh is not None else "batched")
             elif not plan.batched:
                 # stacking disabled: count the opportunity the window
                 # left on the table (the controller's enable signal)
@@ -820,15 +838,20 @@ class DeviceLane:
         rs.fault_in(session, timeout=timeout)
 
     # hot-path
-    def _solve_session(self, session, buf):
+    def _solve_session(self, session, buf, precision=None):
         """One dispatch through the session, checked when the policy
         says so. Holds the session lock so a drain-thread escalation
-        (factor swap) is atomic against this dispatcher."""
+        (factor swap) is atomic against this dispatcher. 'auto'
+        precision requests ALWAYS dispatch checked — the fused §20
+        verdict is the ladder's escalation signal, with or without an
+        engine HealthPolicy (§33)."""
         eng = self.eng
         with session._lock:
-            if eng.health is not None and eng.health.check_output:
-                return session.solve_checked(buf)
-            return session.solve(buf), None
+            if (precision == "auto"
+                    or (eng.health is not None
+                        and eng.health.check_output)):
+                return session.solve_checked(buf, precision=precision)
+            return session.solve(buf, precision=precision), None
 
     # hot-path, futures-owner
     def _run_chunk(self, session, reqs, solo: bool = False) -> None:
@@ -854,7 +877,8 @@ class DeviceLane:
                     return
                 buf, spec = self._stage(reqs)
             self._revive_for(session, reqs)
-            x, verdict = self._solve_session(session, buf)
+            x, verdict = self._solve_session(session, buf,
+                                             reqs[0].precision)
         except Exception as e:  # noqa: BLE001 — engine must survive
             self._redispatch_survivors(reqs, e, solo)
             return
@@ -898,17 +922,20 @@ class DeviceLane:
         next window once instead of wasting a whole bucket on a
         sliver — the solve lane's carry-over discipline)."""
         eng = self.eng
-        groups: dict[int, list] = {}
+        # per-(plan, tier) coalescing: a served tier selects a distinct
+        # compiled factor family, so mixed-tier requests cannot share a
+        # stacked dispatch (§33)
+        groups: dict[tuple, list] = {}
         order = []
         for r in reqs:
-            key = id(r.plan)
+            key = (id(r.plan), r.precision)
             if key not in groups:
                 groups[key] = []
-                order.append(r.plan)
+                order.append((r.plan, key))
             groups[key].append(r)
         deferred: list = []
-        for plan in order:
-            greqs = groups[id(plan)]
+        for plan, key in order:
+            greqs = groups[key]
             # mesh plans never slot-stack (the genuine gang/stacking
             # residue — their batch axis IS the parallelism): each
             # request dispatches as its own sharded (B, N, N) factor
@@ -979,7 +1006,10 @@ class DeviceLane:
         for i, r in enumerate(reqs):
             buf[i] = r.A
         if bb != len(reqs):
-            buf[len(reqs):] = np.eye(plan.N, dtype=buf.dtype)
+            # eye(M, N) for rectangular (QR) plans: full column rank by
+            # construction, so pad slots stay factorable
+            buf[len(reqs):] = np.eye(*plan.key.shape[-2:],
+                                     dtype=buf.dtype)
         return buf
 
     # hot-path
@@ -1028,7 +1058,8 @@ class DeviceLane:
                 if not reqs:
                     return None
                 buf = stage(reqs)
-            checked = (eng.health is not None
+            tier = reqs[0].precision
+            checked = (tier is None and eng.health is not None
                        and eng.health.check_output)
             if mesh:
                 (Ad,) = _shard_batch((jnp.asarray(buf),), plan.mesh)
@@ -1039,6 +1070,15 @@ class DeviceLane:
                     F, wA, verdict = plan._mesh_factor_health_fn()(Ad)
                 elif mesh:
                     F = plan._factor_fn(Ad)
+                    wA = verdict = None
+                elif tier is not None:
+                    # tier cold starts ride the unchecked tier factor
+                    # family: the opened session's first checked solve
+                    # carries the ladder's verdict (§33), so a fused
+                    # post-factor probe here would be a second compile
+                    # per tier for no added coverage
+                    F = plan._tier_stacked_factor_fn(
+                        tier, buf.shape[0])(Ad)
                     wA = verdict = None
                 elif checked:
                     F, wA, verdict = plan._factor_health_fn(
@@ -1061,7 +1101,7 @@ class DeviceLane:
             self.factor_batches += 1
             self.factor_coalesced += len(reqs)
         return _FactorBatch(plan, reqs, F, wA, verdict, Ad, solo,
-                            mesh=mesh)
+                            mesh=mesh, tier=tier)
 
     # futures-owner
     def _redispatch_factor_survivors(self, reqs, exc,
@@ -1475,16 +1515,24 @@ class DeviceLane:
             if r not in owned:
                 continue
             A_i = fb.A if fb.mesh else fb.A[i]
-            session = SolveSession(plan, trees[i],
-                                   A_i if plan.key.refine else None,
+            # tier-opened sessions keep A resident even without refine:
+            # tier solves always consume A0 (the ladder refines against
+            # the full-precision base, §33)
+            keep_A = A_i if (plan.key.refine or fb.tier is not None) \
+                else None
+            session = SolveSession(plan, trees[i], keep_A,
                                    A_i, r.policy,
                                    device=None if fb.mesh
-                                   else self.device, sid=r.sid)
+                                   else self.device, sid=r.sid,
+                                   served_tier=fb.tier)
             if fb.wA is not None:
                 # the probe row wA = w^T A0 came out of the checked
                 # factor dispatch — the session opens with its half of
-                # the Freivalds check already resident
-                session._probe = fb.wA if fb.mesh else fb.wA[i]
+                # the Freivalds check already resident (a tuple of
+                # stacks for QR plans: slice each part)
+                session._probe = fb.wA if fb.mesh else (
+                    tuple(p[i] for p in fb.wA)
+                    if isinstance(fb.wA, tuple) else fb.wA[i])
             r.future.set_result(session)
 
     # futures-owner
@@ -1513,7 +1561,7 @@ class DeviceLane:
                     and not self._isolate_poisoned([r])):
                 return
             self._revive_for(session, [r])
-            x, verdict = self._solve_session(session, buf)
+            x, verdict = self._solve_session(session, buf, r.precision)
             if verdict is not None:
                 limit = eng._limit(session)
                 healthy, finite, res = resilience.evaluate(verdict, limit)
@@ -1549,16 +1597,26 @@ class DeviceLane:
     def _escalate_settle(self, session, spec, buf, finite, res) -> None:
         """Run the ladder for one request's staged buffer; settle on
         recovery, fail with the structured evidence (and count toward
-        quarantine) otherwise."""
+        quarantine) otherwise. Tier-routed requests climb the precision
+        ladder FIRST (`resilience.escalate_precision` — cheap higher-
+        tier re-solves before any refactor), then fall through to the
+        native rungs."""
         eng = self.eng
         reqs = [r for r, _si, _lo in spec]
         br = session._breaker
+        evidence0 = {"rung": "dispatch", "finite": finite,
+                     "residual": res}
         try:
-            xh = resilience.escalate(
-                session, buf, eng.health, eng._limit(session),
-                evidence0={"rung": "dispatch", "finite": finite,
-                           "residual": res},
-                faults=eng._faults)
+            if reqs[0].precision is not None:
+                xh = resilience.escalate_precision(
+                    session, buf, reqs[0].precision, eng.health,
+                    eng._limit(session), evidence0=evidence0,
+                    faults=eng._faults)
+            else:
+                xh = resilience.escalate(
+                    session, buf, eng.health, eng._limit(session),
+                    evidence0=evidence0,
+                    faults=eng._faults)
         except Exception as e:  # noqa: BLE001 — SolveUnhealthy et al.
             if br is not None:
                 br.record_failure()
@@ -1795,7 +1853,8 @@ class ServeEngine:
         # merely absent (they only move if a regression reopens them)
         self._stack_exclusions: dict = {  # guarded-by: _lock
             k: 0 for k in ("upd_pending", "checked", "mesh", "batched",
-                           "singleton", "stack_cap", "error")}
+                           "singleton", "stack_cap", "error",
+                           "kind", "precision")}
         # recently-served sessions/plans, weakly held — the adaptive
         # controller's prewarm targets (active_targets())
         self._active_sessions: dict = {}  # guarded-by: _lock
@@ -1855,7 +1914,7 @@ class ServeEngine:
 
     # hot-path (admission: host work only, no device syncs)
     def submit(self, session, b, *, deadline: float | None = None,
-               qos=None) -> Future:
+               qos=None, precision=None) -> Future:
         """Enqueue one solve against `session`; returns a Future whose
         result is a HOST (numpy) array with the shape and values
         `session.solve(b)` would have returned. A served answer crosses
@@ -1883,12 +1942,24 @@ class ServeEngine:
         tier picks the request's collect delay inside the lane's
         coalescing window (latency ~0, throughput the engine window,
         batch a stretched window). `qos=None` (the default) keeps
-        every path byte-identical to the unclassified engine."""
-        return self._admit(self._prepare(session, b, deadline, qos))
+        every path byte-identical to the unclassified engine.
+
+        `precision=` routes THIS request through a served tier's
+        program family (DESIGN §33): a tier name
+        (`serve.PRECISION_TIERS`) dispatches that tier, 'auto' starts
+        on the session's sticky rung and ALWAYS carries the fused §20
+        verdict (the ladder's escalation signal — even on an unguarded
+        engine), None keeps the session's own serving config (bitwise
+        pre-§33 for native sessions). Tier requests are a counted gang
+        exclusion, never an error; mesh-sharded plans refuse them at
+        admission."""
+        return self._admit(self._prepare(session, b, deadline, qos,
+                                         precision))
 
     # hot-path (admission prelude: validation + request construction —
     # no locks, no device syncs)
-    def _prepare(self, session, b, deadline=None, qos=None):
+    def _prepare(self, session, b, deadline=None, qos=None,
+                 precision=None):
         """submit()'s lock-free prelude — fast-fail checks, RHS
         normalization/guarding, request construction, lane resolution.
         Shared with :meth:`submit_many` so a batched wire frame runs
@@ -1919,10 +1990,16 @@ class ServeEngine:
         if qos is not None and not isinstance(qos, qos_mod.QosClass):
             raise TypeError(f"qos must be a conflux_tpu.qos.QosClass "
                             f"(or None), got {type(qos).__name__}")
+        precision = serve.check_precision_request(precision)
+        if precision is not None and session.plan.mesh is not None:
+            raise MeshPlanUnsupported(
+                "mesh-sharded plans serve their native precision only — "
+                "per-request precision= does not compose with the mesh "
+                "lane (DESIGN §33)", surface="submit")
         now = time.perf_counter()
         req = _Request(session, b2, int(b2.shape[-1]), squeeze, Future(),
                        now, None if deadline is None else now + deadline,
-                       qos=qos)
+                       qos=qos, precision=precision)
         if qos is not None:
             # byte/flop-aware ledger weight (DESIGN §32): a large-N
             # mesh solve occupies the slots it actually displaces
@@ -2330,7 +2407,8 @@ class ServeEngine:
     # hot-path (admission: host work only, no device syncs)
     def submit_factor(self, plan, A, *, policy=None,
                       deadline: float | None = None,
-                      sid=None, device=None, qos=None) -> Future:
+                      sid=None, device=None, qos=None,
+                      precision=None) -> Future:
         """Enqueue one factorization against `plan`; returns a Future
         whose result is a device-resident
         :class:`~conflux_tpu.serve.SolveSession` — exactly what
@@ -2408,10 +2486,22 @@ class ServeEngine:
         if qos is not None and not isinstance(qos, qos_mod.QosClass):
             raise TypeError(f"qos must be a conflux_tpu.qos.QosClass "
                             f"(or None), got {type(qos).__name__}")
+        precision = serve.check_precision_request(precision)
+        if precision == "auto":
+            # a cold start has no verdict history yet: "auto" opens on
+            # the ladder's cheapest rung (§33) and the session's first
+            # checked solve drives any escalation
+            precision = serve.PRECISION_TIERS[0]
+        if precision is not None and plan.mesh is not None:
+            raise MeshPlanUnsupported(
+                "precision= tiers are not served for mesh-sharded "
+                "plans (the ladder's program families are per-device)",
+                surface="factor_lane")
         now = time.perf_counter()
         req = _FactorRequest(plan, A2, policy, Future(), now,
                              None if deadline is None else now + deadline,
-                             sid=sid, device=device, qos=qos)
+                             sid=sid, device=device, qos=qos,
+                             precision=precision)
         if qos is not None:
             # byte/flop-aware ledger weight: the O(N^3) cold start
             # counts for the slots it displaces (qos.request_cost)
@@ -2460,10 +2550,10 @@ class ServeEngine:
                                   device=device, qos=qos).result(timeout)
 
     def solve(self, session, b, timeout: float | None = None,
-              deadline: float | None = None, qos=None):
+              deadline: float | None = None, qos=None, precision=None):
         """Blocking convenience: ``submit(session, b).result(timeout)``."""
         return self.submit(session, b, deadline=deadline,
-                           qos=qos).result(timeout)
+                           qos=qos, precision=precision).result(timeout)
 
     # futures-owner
     def close(self, timeout: float | None = None) -> list:
@@ -2805,7 +2895,7 @@ class ServeEngine:
     # ------------------------------------------------------------------ #
 
     def prewarm(self, target, widths=(1,), stacks=(), factor_batches=(),
-                update_ranks=(), wait: bool = True):
+                update_ranks=(), precisions=(), wait: bool = True):
         """Compile the declared traffic's programs before it lands.
 
         `target` is a SolveSession (solve-lane warming) or a FactorPlan
@@ -2822,9 +2912,27 @@ class ServeEngine:
         program steady-state traffic will actually ride observes zero
         compiles (asserted via `plan.trace_counts` in tests and
         bench_engine). `wait=False` compiles on a background thread (the
-        engine-start pattern) and returns the Thread."""
+        engine-start pattern) and returns the Thread.
+
+        `precisions` warms the §33 per-request tier program families
+        next to the native ones: each named tier's solve programs (per
+        width bucket, against a session target) and its coalesced
+        factor programs (per factor bucket). `"auto"` warms the WHOLE
+        ladder — an auto request may escalate to any rung, and every
+        rung's checked program must be resident for the steady state to
+        stay compile-free."""
         plan = target if isinstance(target, FactorPlan) else target.plan
         session = None if isinstance(target, FactorPlan) else target
+        tiers: list = []
+        auto = False
+        for p in precisions:
+            p2 = serve.check_precision_request(p)
+            if p2 == "auto":
+                auto = True
+                tiers += [t for t in serve.PRECISION_TIERS
+                          if t not in tiers]
+            elif p2 is not None and p2 not in tiers:
+                tiers.append(p2)
 
         def run():
             with profiler.region("engine.prewarm"):
@@ -2833,11 +2941,16 @@ class ServeEngine:
                         session._ensure_resident()
                     for wb in sorted({rank_bucket(w) for w in widths}):
                         self._prewarm_width(session, wb)
+                        for t in tiers:
+                            self._prewarm_tier_width(session, t, wb,
+                                                     auto)
                         for s in stacks:
                             self._prewarm_stack(session, rank_bucket(s),
                                                 wb, update_ranks)
                 for fbk in sorted({rank_bucket(n) for n in factor_batches}):
                     self._prewarm_factor(plan, fbk)
+                    for t in tiers:
+                        self._prewarm_tier_factor(plan, t, fbk)
 
         if wait:
             run()
@@ -2857,7 +2970,7 @@ class ServeEngine:
         plan = session.plan
         checked = self.health is not None and self.health.check_output
         kind = "solve_health" if checked else "solve"
-        shape = ((plan.B, plan.N, wb) if plan.batched else (plan.N, wb))
+        shape = ((plan.B, plan.N, wb) if plan.batched else (plan.M, wb))
         if plan.mesh is not None:
             # mesh lane: the sharded executable is keyed on the plan's
             # device SET, not one lane device (dispatch rides the first
@@ -3000,7 +3113,7 @@ class ServeEngine:
             if plan.device_warm(kind, 1, None):
                 return
             buf = np.empty(plan.key.shape, np.dtype(plan.key.dtype))
-            buf[:] = np.eye(plan.N, dtype=buf.dtype)
+            buf[:] = np.eye(*plan.key.shape[-2:], dtype=buf.dtype)
             (Ad,) = _shard_batch((jnp.asarray(buf),), plan.mesh)
             if checked:
                 F, _wA, v = plan._mesh_factor_health_fn()(Ad)
@@ -3016,7 +3129,7 @@ class ServeEngine:
         # inverses are identities too) — the same filler the pad slots
         # use
         buf = np.empty((bb,) + plan.key.shape, np.dtype(plan.key.dtype))
-        buf[:] = np.eye(plan.N, dtype=buf.dtype)
+        buf[:] = np.eye(*plan.key.shape[-2:], dtype=buf.dtype)
         for lane in self._lanes:
             dk = _devkey(lane.device)
             if plan.device_warm(kind, bb, dk):
@@ -3040,6 +3153,70 @@ class ServeEngine:
             if wA is not None:
                 jax.block_until_ready([wA[i] for i in range(bb)])
             plan.mark_device_warm(kind, bb, dk)
+
+    def _prewarm_tier_width(self, session, tier: str, wb: int,
+                            auto: bool = False) -> None:
+        """Warm one served tier's solve program for one RHS bucket on
+        every lane device (§33). 'auto' traffic always dispatches the
+        CHECKED tier variant — the fused verdict is the ladder's
+        escalation signal — so auto warming compiles `tier_health`
+        even on an unguarded engine. Warming a cross-tier bucket also
+        populates the session's derived `_tier_factors` cache (and the
+        bucket-1 `tier_factor` program it rides)."""
+        plan = session.plan
+        if plan.mesh is not None:
+            return  # tiers are validated away at submit for mesh plans
+        checked = auto or (self.health is not None
+                           and self.health.check_output)
+        kind = "tier_health" if checked else "tier"
+        shape = ((plan.B, plan.N, wb) if plan.batched
+                 else (plan.M, wb))
+        for lane in self._lanes:
+            dk = _devkey(lane.device)
+            if plan.device_warm(kind, (tier, wb), dk):
+                continue
+            b2 = jnp.zeros(shape, jnp.dtype(plan.key.dtype))
+            with session._lock:
+                session._ensure_resident()
+                F = (session._factors
+                     if tier == session._served_tier
+                     else session._tier_factor(tier))
+                A0 = session._A0
+                probe = session._probe_row() if checked else None
+            if lane.device is not None:
+                F = put_tree(F, lane.device)
+                A0 = put_tree(A0, lane.device)
+                probe = put_tree(probe, lane.device)
+            if checked:
+                x, _ = plan._tier_solve_health_fn(tier, wb)(
+                    F, A0, probe, b2)
+                x.block_until_ready()
+            else:
+                plan._tier_solve_fn(tier, wb)(
+                    F, A0, b2).block_until_ready()
+            plan.mark_device_warm(kind, (tier, wb), dk)
+
+    def _prewarm_tier_factor(self, plan, tier: str, bb: int) -> None:
+        """Warm one served tier's coalesced factor bucket on every lane
+        device — plus the drain-side slot slice-outs, mirroring the
+        native `_prewarm_factor`. Tier factor batches dispatch
+        UNCHECKED (§33: the opened session's first checked solve
+        carries the ladder's verdict), so there is no health variant to
+        warm here."""
+        if plan.mesh is not None:
+            return
+        buf = np.empty((bb,) + plan.key.shape, np.dtype(plan.key.dtype))
+        buf[:] = np.eye(*plan.key.shape[-2:], dtype=buf.dtype)
+        for lane in self._lanes:
+            dk = _devkey(lane.device)
+            if plan.device_warm("tier_factor", (tier, bb), dk):
+                continue
+            Ad = lane._to_device(buf)
+            F = plan._tier_stacked_factor_fn(tier, bb)(Ad)
+            slots = unstack_tree(F, bb)
+            jax.block_until_ready(slots)
+            jax.block_until_ready([Ad[i] for i in range(bb)])
+            plan.mark_device_warm("tier_factor", (tier, bb), dk)
 
     # ------------------------------------------------------------------ #
     # resolution ownership + failure bookkeeping
@@ -3106,7 +3283,12 @@ class ServeEngine:
         return self._plan_limit(session.plan)
 
     def _plan_limit(self, plan) -> float:
-        return self.health.resolved_residual_limit(
+        # 'auto' precision requests carry a verdict even on an
+        # unguarded engine (the ladder's escalation signal, §33) — the
+        # default HealthPolicy supplies the residual limit then
+        policy = self.health if self.health is not None \
+            else resilience.HealthPolicy()
+        return policy.resolved_residual_limit(
             np.dtype(plan.key.dtype), plan.N)
 
     # ------------------------------------------------------------------ #
@@ -3382,6 +3564,18 @@ class ServeEngine:
                 "lanes": self._lane_rows_locked(),
                 "knobs": self._knobs_locked(),
             }
+            psc = pfb = 0
+            for ref in self._active_sessions.values():
+                s = ref()
+                if s is not None:
+                    # conflint: disable=CFX-LOCK benign racy reads of monotonic ints (ops counter roll-up)
+                    psc += s.precision_escalations
+                    pfb += s.precision_fallbacks
+            # ladder traffic roll-up over the engine's recently-served
+            # sessions (§33): rung climbs + drifted-session tier
+            # fallbacks. Global twins live in serve_stats()['health'].
+            out["precision_escalations"] = psc
+            out["precision_fallbacks"] = pfb
             if self._qos is not None:
                 # per-class counters + latency percentiles + SLO
                 # attainment (absent on a qos=None engine)

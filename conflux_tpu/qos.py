@@ -82,21 +82,25 @@ def request_cost(shape, width=None, factor=False) -> float:
     """Byte/flop-aware admission cost of one request, in units of the
     canonical fleet request (clamped >= 1.0).
 
-    `shape` is the plan's key shape — (B, N, N) batched/mesh or (N, N)
-    single; `width` the request's RHS width (solves); `factor=True`
-    prices the O(N^3) cold start instead of the O(N^2 w) substitution.
-    This is what makes a large-N mesh session a HEAVYWEIGHT tenant in
-    the :class:`FairShareLedger` (DESIGN §32): one N=4096 mesh solve
+    `shape` is the plan's key shape — (B, M, N) batched/mesh or (M, N)
+    single, with M == N for the square kinds and M > N for tall QR
+    least-squares plans (DESIGN §33); `width` the request's RHS width
+    (solves); `factor=True` prices the O(M N^2) cold start instead of
+    the O(M N w) substitution — both reduce exactly to the former
+    N^3 / N^2 w pricing when the plan is square. This is what makes a
+    large-N mesh session a HEAVYWEIGHT tenant in the
+    :class:`FairShareLedger` (DESIGN §32): one N=4096 mesh solve
     occupies the slots its arithmetic actually displaces, so a flood of
     them sheds at the tenant's share line while lightweight interactive
     traffic keeps admitting — instead of both classes queueing as if
     every request were equal."""
     B = shape[0] if len(shape) == 3 else 1
+    M = shape[-2]
     N = shape[-1]
     if factor:
-        return max(1.0, B * float(N) ** 3 / REF_FACTOR_UNITS)
+        return max(1.0, B * float(M) * float(N) ** 2 / REF_FACTOR_UNITS)
     w = 1 if width is None else max(1, int(width))
-    return max(1.0, B * float(N) ** 2 * w / REF_SOLVE_UNITS)
+    return max(1.0, B * float(M) * float(N) * w / REF_SOLVE_UNITS)
 
 
 @dataclasses.dataclass(frozen=True)

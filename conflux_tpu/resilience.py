@@ -698,8 +698,17 @@ def escalate(session, buf, policy: HealthPolicy, limit: float,
     refined away). Runs under the session's lock so a concurrent
     dispatcher never observes half-swapped factors. Blocking is fine:
     this is the failure path.
+
+    `evidence0` seeds the per-rung evidence chain: one dict (the
+    failed dispatch) or a list of dicts (a precision ladder that
+    already climbed, :func:`escalate_precision`).
     """
-    rungs: list[dict] = [] if evidence0 is None else [dict(evidence0)]
+    if evidence0 is None:
+        rungs: list[dict] = []
+    elif isinstance(evidence0, dict):
+        rungs = [dict(evidence0)]
+    else:
+        rungs = [dict(r) for r in evidence0]
 
     def check(verdict, rung):
         ok, finite, res = evaluate(verdict, limit)
@@ -738,3 +747,52 @@ def escalate(session, buf, policy: HealthPolicy, limit: float,
         + "; ".join(f"{r.get('rung', 'dispatch')}: finite={r['finite']} "
                     f"res={r['residual']:.3e}" for r in rungs)
         + f" (limit {limit:.3e})", evidence)
+
+
+def escalate_precision(session, buf, precision, policy, limit,
+                       evidence0: dict | None = None,
+                       faults: FaultPlan | None = None):
+    """The precision ladder's escalation rungs (DESIGN §33): fight for
+    one staged chunk whose TIER-routed answer failed the §20 verdict by
+    re-solving checked at each HIGHER served tier first — cheap rungs
+    (a derived factor set + one substitution per tier, no refactor) —
+    and only when the ladder tops out falling through to the native
+    :func:`escalate` rungs (refactor + refine), carrying the
+    accumulated per-rung evidence.
+
+    'auto' requests additionally RATCHET the session's sticky rung
+    (`SolveSession._auto_rung`), so a session that needed f32 once
+    starts there on its next auto request instead of re-failing bf16.
+    Explicit-tier requests climb without moving the rung (the caller
+    asked for that tier; the ladder is the rescue, not the new
+    default). `policy` may be None (an unguarded engine serving 'auto'
+    traffic) — the native rungs then run under the default
+    :class:`HealthPolicy`."""
+    from conflux_tpu import serve
+
+    rungs: list[dict] = [] if evidence0 is None else [dict(evidence0)]
+    x = None
+    with session._lock:
+        tier = session._resolve_tier(precision)
+        while tier is not None:
+            nxt = serve.next_precision_tier(tier)
+            if nxt is None:
+                break
+            bump("precision_escalations")
+            session.precision_escalations += 1
+            if precision == "auto":
+                rung = serve.PRECISION_TIERS.index(nxt)
+                if rung > session._auto_rung:
+                    session._auto_rung = rung
+            x, verdict = session.solve_checked(buf, precision=nxt)
+            ok, finite, res = evaluate(verdict, limit)
+            if data_fault(faults, "solve", "unhealthy") is not None:
+                ok = False
+            rungs.append({"rung": f"precision:{nxt}", "finite": finite,
+                          "residual": res})
+            if ok:
+                return np.asarray(x)
+            tier = nxt
+    return escalate(session, buf,
+                    policy if policy is not None else HealthPolicy(),
+                    limit, evidence0=rungs, faults=faults)

@@ -90,12 +90,15 @@ class PlanKey:
     and one compiled solve program.
     """
 
-    shape: tuple          # (B, N, N) batched or (N, N) single
+    shape: tuple          # (B, N, N) batched, (N, N) single, or (M, N)
+                          # tall-skinny (kind='qr' least squares, M >= N)
     dtype: str            # storage dtype of A
     factor_dtype: str     # dtype the factorization runs in (HPL-MxP knob)
     v: int                # tile size
     refine: int           # classic-IR sweeps fused into the solve program
-    spd: bool             # Cholesky instead of LU
+    kind: str             # factorization family: 'lu' | 'chol' | 'qr'
+                          # (DESIGN §33 — replaces the old spd boolean;
+                          # 'qr' serves min||Ax-b|| least-squares)
     substitution: str     # 'trsm' | 'inv' | 'blocked' ('auto' resolves
                           # at create — DESIGN §27)
     precision: Any        # trailing-GEMM precision
@@ -103,21 +106,85 @@ class PlanKey:
     panel_algo: str       # LU panel election algo
     mesh_key: Any         # batch-mesh identity (None = default device)
 
+    @property
+    def spd(self) -> bool:
+        """Back-compat read of the pre-§33 boolean: True iff the plan
+        factors by Cholesky. Writers must use `kind` — the codec and
+        the cache key speak `kind` only."""
+        return self.kind == "chol"
+
+
+PLAN_KINDS = ("lu", "chol", "qr")
+
+# the per-request precision ladder (DESIGN §33): each served tier names
+# a factor dtype + the IR sweeps its solve programs fuse. 'bf16_ir'
+# factors in bfloat16 (half the resident factor bytes of f32) and ALWAYS
+# refines at least once; 'f64' degrades to f32 storage when x64 is off
+# (jax canonicalizes the dtype — same programs, documented in TUNING).
+# Requests say precision='auto' to start on the cheapest rung and let
+# the §20 Freivalds verdict drive escalation up this tuple.
+PRECISION_TIERS = ("bf16_ir", "f32", "f64")
+
+
+def check_precision_request(precision):
+    """Validate a per-request ``precision=`` value (submit/solve
+    surface): None (the plan's native path, bitwise pre-§33 behavior),
+    a served tier name, or 'auto'. Returns the value; raises
+    ValueError naming the offending value otherwise."""
+    if precision is None or precision == "auto" \
+            or precision in PRECISION_TIERS:
+        return precision
+    raise ValueError(
+        f"unknown precision {precision!r} — expected None, 'auto', or "
+        f"one of {PRECISION_TIERS}")
+
+
+def next_precision_tier(tier: str):
+    """The next rung up the ladder, or None at the top (escalation
+    then falls through to the native `resilience.escalate` rungs)."""
+    i = PRECISION_TIERS.index(tier)
+    return PRECISION_TIERS[i + 1] if i + 1 < len(PRECISION_TIERS) \
+        else None
+
 
 _PLANS: dict[PlanKey, "FactorPlan"] = {}
 _PLANS_LOCK = threading.Lock()
 
 
 def _encode_precision(p):
+    """JSON-encode a PlanKey's trailing-GEMM precision. Only the enum
+    (tagged), None, and plain strings are representable — anything else
+    (a tuple of precisions, a config object, a jnp dtype) would pass
+    through json.dump into the fleet codec and poison every later
+    restore, so it is refused HERE with the offending value named,
+    while the checkpoint is still writable."""
     if isinstance(p, lax.Precision):
         return ["precision", p.name]
-    return p
+    if p is None or isinstance(p, str):
+        return p
+    raise ValueError(
+        f"plan precision {p!r} (type {type(p).__name__}) is not "
+        "codec-representable — use None, a string, or lax.Precision")
 
 
 def _decode_precision(p):
-    if isinstance(p, list) and len(p) == 2 and p[0] == "precision":
-        return lax.Precision[p[1]]
-    return p
+    """Inverse of :func:`_encode_precision`. Malformed payloads (a
+    mistagged list, a number, a dict — anything no encoder produced)
+    raise ValueError with the offending value instead of flowing into
+    a PlanKey that would never match its originating plan."""
+    if isinstance(p, list):
+        if len(p) == 2 and p[0] == "precision" \
+                and isinstance(p[1], str) \
+                and p[1] in lax.Precision.__members__:
+            return lax.Precision[p[1]]
+        raise ValueError(
+            f"malformed precision payload {p!r} — expected "
+            "['precision', <enum name>]")
+    if p is None or isinstance(p, str):
+        return p
+    raise ValueError(
+        f"malformed precision payload {p!r} (type {type(p).__name__}) "
+        "— expected None, a string, or a tagged enum pair")
 
 
 def plan_spec(plan: "FactorPlan") -> dict:
@@ -133,7 +200,7 @@ def plan_spec(plan: "FactorPlan") -> dict:
     k = plan.key
     d = {"shape": list(k.shape), "dtype": k.dtype,
          "factor_dtype": k.factor_dtype, "v": k.v,
-         "refine": k.refine, "spd": k.spd,
+         "refine": k.refine, "kind": k.kind,
          "substitution": k.substitution,
          "precision": _encode_precision(k.precision),
          "backend": k.backend, "panel_algo": k.panel_algo}
@@ -185,10 +252,21 @@ def plan_from_spec(d: dict) -> "FactorPlan":
     m = d.get("mesh")
     if m is not None:
         mesh_key = mesh_cache_key(mesh_from_spec(m))
+    # migration shim (§33): pre-kind checkpoints spelled the
+    # factorization family as a bare 'spd' boolean — decode it so every
+    # PR-16-era durable fleet.json stays restorable bitwise
+    if "kind" in d:
+        kind = str(d["kind"])
+        if kind not in PLAN_KINDS:
+            raise ValueError(
+                f"plan spec names unknown kind {kind!r} — expected one "
+                f"of {PLAN_KINDS}")
+    else:
+        kind = "chol" if bool(d["spd"]) else "lu"
     key = PlanKey(
         shape=tuple(int(s) for s in d["shape"]), dtype=d["dtype"],
         factor_dtype=d["factor_dtype"], v=int(d["v"]),
-        refine=int(d["refine"]), spd=bool(d["spd"]),
+        refine=int(d["refine"]), kind=kind,
         substitution=d["substitution"],
         precision=_decode_precision(d["precision"]),
         backend=d["backend"], panel_algo=d["panel_algo"],
@@ -252,14 +330,43 @@ class FactorPlan:
         self.batched = len(shape) == 3
         self.B = shape[0] if self.batched else None
         self.N = shape[-1]
-        if shape[-1] != shape[-2]:
-            raise ValueError(f"plan needs square systems, got {shape}")
-        if self.N % key.v:
+        # M is the RHS row count (== N for the square kinds; > N for a
+        # tall-skinny 'qr' least-squares plan) — _rhs/_stage size by it
+        self.M = shape[-2]
+        if key.kind not in PLAN_KINDS:
             raise ValueError(
-                f"N={self.N} not a multiple of v={key.v}; pre-pad with an "
-                "identity extension (cf. solvers.solve)")
+                f"unknown plan kind {key.kind!r} — expected one of "
+                f"{PLAN_KINDS}")
+        if key.kind == "qr":
+            if self.batched:
+                raise ValueError(
+                    "kind='qr' serves single tall-skinny systems — a "
+                    f"batched plan shape {shape} has no least-squares "
+                    "semantics here (open one session per system; the "
+                    "engine's factor lane coalesces them)")
+            if self.M < self.N:
+                raise ValueError(
+                    f"kind='qr' needs M >= N (min||Ax-b|| over a "
+                    f"tall-skinny A), got {shape}")
+            if key.substitution != "trsm":
+                raise ValueError(
+                    "kind='qr' substitutes through R only "
+                    "(substitution='trsm'); 'blocked'/'inv' are the "
+                    "square kinds' engines")
+        else:
+            if shape[-1] != shape[-2]:
+                raise ValueError(
+                    f"plan needs square systems, got {shape}")
+            if self.N % key.v:
+                raise ValueError(
+                    f"N={self.N} not a multiple of v={key.v}; pre-pad "
+                    "with an identity extension (cf. solvers.solve)")
         self.mesh = (lookup_mesh(key.mesh_key)
                      if key.mesh_key is not None else None)
+        if key.kind == "qr" and key.mesh_key is not None:
+            raise ValueError(
+                "kind='qr' plans are unsharded (a single tall system "
+                "has no batch axis to mesh-shard)")
         if self.mesh is not None and not self.batched:
             raise ValueError(
                 "a mesh only applies to batched (B, N, N) plans — a single "
@@ -319,18 +426,24 @@ class FactorPlan:
 
     @classmethod
     def create(cls, shape, dtype, *, v: int = 256, factor_dtype=None,
-               refine: int = 0, spd: bool = False, mesh=None,
+               refine: int = 0, kind: str | None = None,
+               spd: bool = False, mesh=None,
                substitution: str = "auto", precision=None,
                backend: str | None = None,
                persistent_cache: bool = True) -> "FactorPlan":
         """Get-or-build the plan for a traffic shape.
 
-        shape is (B, N, N) for a batched plan or (N, N) for a
-        single-system plan; `dtype` is the request dtype. `factor_dtype`,
-        `refine`, `spd` follow `solvers.solve`; `mesh` (a `batch_mesh`)
-        shards batched plans across devices. `persistent_cache=True`
-        also switches on the on-disk XLA cache so cold processes reuse
-        warm compiles.
+        shape is (B, N, N) for a batched plan, (N, N) for a
+        single-system plan, or (M, N) with M > N for a tall-skinny
+        least-squares plan (`kind='qr'`); `dtype` is the request dtype.
+        `kind` picks the factorization family: 'lu' (default), 'chol'
+        (SPD A), or 'qr' (min||Ax-b|| via the blocked CholeskyQR2
+        recipe, `conflux_tpu.qr` — sessions answer the least-squares
+        solution x of each rhs). `spd=True` is the pre-§33 spelling of
+        kind='chol' and stays accepted. `factor_dtype`, `refine` follow
+        `solvers.solve`; `mesh` (a `batch_mesh`) shards batched plans
+        across devices. `persistent_cache=True` also switches on the
+        on-disk XLA cache so cold processes reuse warm compiles.
 
         `substitution` picks the per-request engine: 'trsm' runs the
         classic triangular substitutions; 'blocked' runs them BLOCKED
@@ -360,6 +473,17 @@ class FactorPlan:
         precision = (blas.matmul_precision() if precision is None
                      else precision)
         backend = blas.get_backend() if backend is None else backend
+        if kind is None:
+            kind = "chol" if spd else "lu"
+        elif spd and kind != "chol":
+            raise ValueError(
+                f"kind={kind!r} contradicts spd=True (the legacy "
+                "spelling of kind='chol') — pass one or the other")
+        if kind == "qr" and substitution == "auto":
+            # QR substitutes through R alone (one triangular solve on
+            # the Q^H-projected rhs) — the blocked/inv engines are the
+            # square kinds' machinery
+            substitution = "trsm"
         if substitution == "auto":
             # branch on how the plan will be SERVED, not on its shape
             # alone: batched plans vmap their solve body over the batch
@@ -378,7 +502,7 @@ class FactorPlan:
         key = PlanKey(
             shape=tuple(int(s) for s in shape), dtype=dtype.name,
             factor_dtype=fdtype.name, v=int(v), refine=int(refine),
-            spd=bool(spd), substitution=substitution,
+            kind=kind, substitution=substitution,
             precision=precision, backend=backend,
             panel_algo=blas.get_panel_algo(),
             mesh_key=None if mesh is None else mesh_cache_key(mesh))
@@ -422,7 +546,8 @@ class FactorPlan:
     def bucket_ready(self, *, width: int | None = None,
                      factor_batch: int | None = None,
                      stack=None,
-                     checked: bool = False) -> bool:
+                     checked: bool = False,
+                     precision: str | None = None) -> bool:
         """True when the named bucket's program is built AND warm (first
         call completed — traced, cached, dispatch-only from here on).
 
@@ -432,12 +557,39 @@ class FactorPlan:
         actuating the knob once this reports True, so a knob move can
         never put a compile stall on the serving path. `checked` asks
         about the health-guarded program variant (what an engine with
-        ``check_output`` dispatches)."""
+        ``check_output`` dispatches). `precision` asks about a served
+        tier's program family instead of the native one: with `width`,
+        the per-tier solve program (`("tier", tier, wb)` /
+        `("tier_health", tier, wb)`); with `factor_batch`, the per-tier
+        stacked factor program (`("tier_factor", tier, bb)`)."""
         # checked programs of a fused-probe (blocked) plan live in
         # their own memo dict — look there, or a controller knob move
         # would see a warm bucket as forever-cold (or vice versa)
         checked_cache = (self._trsm_cache if self._fused_probe
                          else self._solve_cache)
+        if precision is not None:
+            tier = check_precision_request(precision)
+            if tier is None or tier == "auto":
+                raise ValueError(
+                    "bucket_ready(precision=) names a concrete tier "
+                    f"from {PRECISION_TIERS}, not {precision!r}")
+            if width is not None:
+                key = (("tier_health", tier, int(width)) if checked
+                       else ("tier", tier, int(width)))
+                fn = self._solve_cache.get(key)
+                if fn is None or not fn.warm:
+                    return False
+            if factor_batch is not None:
+                fn = self._factor_cache.get(
+                    ("tier_factor", tier, int(factor_batch)))
+                if fn is None or not fn.warm:
+                    return False
+            if stack is not None:
+                raise ValueError(
+                    "gang-stacked buckets have no per-tier program "
+                    "family (tier requests are a counted gang "
+                    "exclusion, DESIGN §33)")
+            return width is not None or factor_batch is not None
         if width is not None:
             key = ("health", int(width)) if checked else int(width)
             fn = (checked_cache if checked else self._solve_cache).get(key)
@@ -490,7 +642,8 @@ class FactorPlan:
                 keys = [wb, ("health", wb), ("refine", wb)]
                 keys += [k for k in self._solve_cache
                          if isinstance(k, tuple) and len(k) == 3
-                         and k[0] in ("stacked", "gstack_health")
+                         and k[0] in ("stacked", "gstack_health",
+                                      "tier", "tier_health")
                          and k[2] == wb]
                 for key in keys:
                     dropped += self._solve_cache.pop(key, None) is not None
@@ -512,7 +665,11 @@ class FactorPlan:
                         "path itself (FactorPlan._factor_once) — it is "
                         "not a retirable coalescing bucket")
                 fbs.add(bb)
-                for key in (("factor", bb), ("factor_health", bb)):
+                keys = [("factor", bb), ("factor_health", bb)]
+                keys += [k for k in self._factor_cache
+                         if isinstance(k, tuple) and len(k) == 3
+                         and k[0] == "tier_factor" and k[2] == bb]
+                for key in keys:
                     dropped += (self._factor_cache.pop(key, None)
                                 is not None)
             # a released bucket is COLD again on every device: drop its
@@ -527,16 +684,21 @@ class FactorPlan:
                         and isinstance(k[1], tuple) and k[1][1] in wbs)
                     or (k[0] == "stacked_usolve"
                         and isinstance(k[1], tuple) and k[1][2] in wbs)
+                    or (k[0] in ("tier", "tier_health")
+                        and isinstance(k[1], tuple) and k[1][1] in wbs)
+                    or (k[0] == "tier_factor"
+                        and isinstance(k[1], tuple) and k[1][1] in fbs)
                     or (k[0] in ("factor", "factor_health")
                         and k[1] in fbs))}
         return dropped
 
     @staticmethod
     def _warm_key(kind: str, bucket, devkey) -> tuple:
-        # composite buckets ((stack, width), (stack, rank, width)) pass
-        # through as tuples; int() on them was a latent crash
-        b = (tuple(int(x) for x in bucket) if isinstance(bucket, tuple)
-             else int(bucket))
+        # composite buckets ((stack, width), (stack, rank, width),
+        # (tier, width)) pass through as tuples; int() on them was a
+        # latent crash, and tier names are strings — pass those through
+        b = (tuple((x if isinstance(x, str) else int(x)) for x in bucket)
+             if isinstance(bucket, tuple) else int(bucket))
         return (kind, b, devkey)
 
     def device_warm(self, kind: str, bucket, devkey) -> bool:
@@ -560,22 +722,31 @@ class FactorPlan:
     # program builders
     # ------------------------------------------------------------------ #
 
-    def _one_factor(self, A):
-        """Per-system factorization in the factor dtype. Returns the
+    def _one_factor(self, A, fdtype=None):
+        """Per-system factorization in the factor dtype (`fdtype`
+        overrides the key's — the per-request precision ladder's served
+        tiers factor the SAME base at their own dtype, §33). Returns the
         device-resident factor pytree the solve program consumes: packed
         factors for 'trsm' substitution, packed factors + diagonal-block
         inverses for 'blocked' (the bs-wide blocks only — O(N bs^2)
-        inversion work, `ops.batched_trsm.diag_block_inverses`), and
-        explicit FULL triangular inverses (computed here, once, in the
-        compute dtype) for 'inv'."""
+        inversion work, `ops.batched_trsm.diag_block_inverses`), explicit
+        FULL triangular inverses (computed here, once, in the compute
+        dtype) for 'inv', and the thin (Q, R) pair for kind='qr'
+        (blocked CholeskyQR2, `qr.single.qr_factor_blocked`)."""
         from conflux_tpu.cholesky.single import _cholesky_blocked
         from conflux_tpu.lu.single import _lu_factor_blocked
         from conflux_tpu.ops.batched_trsm import diag_block_inverses
 
         self.trace_counts["factor"] += 1  # trace-time, not per call
         k = self.key
-        Af = A.astype(jnp.dtype(k.factor_dtype))
-        cdtype = blas.compute_dtype(jnp.dtype(k.factor_dtype))
+        fd = jnp.dtype(k.factor_dtype if fdtype is None else fdtype)
+        Af = A.astype(fd)
+        cdtype = blas.compute_dtype(fd)
+        if k.kind == "qr":
+            from conflux_tpu.qr.single import qr_factor_blocked
+
+            Q, R = qr_factor_blocked(Af, v=min(k.v, self.N))
+            return (Q, R)
         if k.spd:
             L = _cholesky_blocked(Af, k.v, k.precision, k.backend)
             if k.substitution == "blocked":
@@ -596,7 +767,6 @@ class FactorPlan:
             return (LU, Dl, Du, perm)
         if k.substitution != "inv":
             return (LU, perm)
-        cdtype = blas.compute_dtype(jnp.dtype(k.factor_dtype))
         LUc = LU.astype(cdtype)
         eye = jnp.eye(self.N, dtype=cdtype)
         Li = lax.linalg.triangular_solve(
@@ -614,6 +784,22 @@ class FactorPlan:
         from conflux_tpu.solvers import cholesky_solve, lu_solve
 
         k = self.key
+        if k.kind == "qr":
+            # least-squares normal-equations-free substitution: project
+            # the (M, k) residual/rhs onto range(A) through Q^H, then
+            # one triangular solve through R — (M, k) -> (N, k). The IR
+            # sweep in _one_solve reuses this corr verbatim (the corr of
+            # the LS residual IS the LS correction).
+            Q, R = factors
+            hi = lax.Precision.HIGHEST
+
+            def qr_corr(r):
+                y = jnp.matmul(Q.conj().T, r.astype(Q.dtype),
+                               precision=hi)
+                return lax.linalg.triangular_solve(
+                    R, y, left_side=True, lower=False)
+
+            return qr_corr
         if k.substitution == "blocked":
             from conflux_tpu.ops.batched_trsm import blocked_solve
 
@@ -661,15 +847,19 @@ class FactorPlan:
             return lambda r: cholesky_solve(factors[0], r)
         return lambda r: lu_solve(factors[0], factors[1], r)
 
-    def _one_solve(self, factors, A, b2):
-        """Per-system substitution + the plan's IR sweeps. `A` is only
-        consumed when refine > 0 (the residual matvec)."""
+    def _one_solve(self, factors, A, b2, sweeps=None):
+        """Per-system substitution + the plan's IR sweeps (`sweeps`
+        overrides the key's — the served tiers fuse their own count,
+        §33). `A` is only consumed when the sweep count > 0 (the
+        residual matvec — for kind='qr' the (M, k) residual's corr IS
+        the least-squares correction, so the same loop refines
+        min||Ax-b||)."""
         self.trace_counts["solve"] += 1  # trace-time, not per call
         k = self.key
         corr = self._base_corr(factors)
         cdtype = blas.compute_dtype(jnp.dtype(k.dtype))
         x = corr(b2).astype(cdtype)
-        for _ in range(k.refine):
+        for _ in range(k.refine if sweeps is None else sweeps):
             r = (b2.astype(cdtype)
                  - jnp.matmul(A.astype(cdtype), x,
                               precision=lax.Precision.HIGHEST))
@@ -858,6 +1048,7 @@ class FactorPlan:
         only the traced factor body differs."""
         k = self.key
         return (k.backend == "pallas" and self.mesh is None
+                and k.kind != "qr"  # no batch-grid QR kernel (§29)
                 and jnp.dtype(k.dtype) == jnp.dtype(k.factor_dtype)
                 and jnp.dtype(k.factor_dtype) in (jnp.float32,
                                                   jnp.float64))
@@ -876,7 +1067,14 @@ class FactorPlan:
         F = f(Ast)
         if not probe:
             return F
-        probe_one = lambda A0: probe_row(w, A0)  # noqa: E731
+        if self.key.kind == "qr":
+            # the least-squares probe pair (u, uA) per slot — vmap of a
+            # tuple-returning body yields a tuple of stacks (§33)
+            from conflux_tpu.update import probe_lstsq
+
+            probe_one = lambda A0: probe_lstsq(w, A0)  # noqa: E731
+        else:
+            probe_one = lambda A0: probe_row(w, A0)  # noqa: E731
         inner_probe = (jax.vmap(jax.vmap(probe_one))
                        if self.batched else jax.vmap(probe_one))
         return F, inner_probe(Ast)
@@ -1048,6 +1246,37 @@ class FactorPlan:
         def build():
             w = self.probe_w
             fused = self._fused_probe
+            if self.key.kind == "qr":
+                # least-squares verdict: u_i ∈ range(A_i) by
+                # construction (`update.probe_lstsq`), so the minimizer
+                # of ||A_i x − u_i|| reproduces u_i exactly and the
+                # per-slot projected residual |u·u − uA·x| vanishes at
+                # the solution — same tripwire scale as the square
+                # lane's |w·w − wA·x| (u is normalized to ||u|| = √M).
+                # qr plans are single-system, unfused, XLA-backend.
+                solve_u = jax.vmap(self._one_solve)
+
+                def check_qr(F, wA, Ast):
+                    u_st, uA_st = wA
+                    x = solve_u(F, Ast, u_st[..., None])
+                    cdtype = x[..., 0].dtype
+                    finite = jnp.isfinite(
+                        jnp.sum(x, axis=tuple(range(1, x.ndim))))
+                    x0 = x[..., 0].astype(cdtype)
+                    uc = u_st.astype(cdtype)
+                    ax = jnp.sum(uA_st.astype(cdtype) * x0, axis=-1)
+                    num = jnp.abs(jnp.sum(uc * uc, axis=-1) - ax)
+                    den = (jnp.sqrt(jnp.sum(jnp.abs(uc) ** 2, axis=-1))
+                           + jnp.finfo(cdtype).tiny)
+                    return jnp.stack([finite.astype(jnp.float32),
+                                      (num / den).astype(jnp.float32)])
+
+                def f_qr(Ast):
+                    self._bump("factor_health")  # trace-time
+                    F, wA = self._stacked_factor_body(Ast, probe=True)
+                    return F, wA, check_qr(F, wA, Ast)
+
+                return jax.jit(f_qr)
             if fused:
                 # the §27 fused probe epilogue: the probe solve's back
                 # substitution accumulates the finite/projection stats
@@ -1232,11 +1461,19 @@ class FactorPlan:
         """Jitted wA = w^T A0 program — the once-per-base half of the
         Freivalds-style residual check (`update.probe_row`); sessions
         cache its output next to the factors and invalidate on
-        refactor."""
+        refactor. kind='qr' plans cache the LEAST-SQUARES probe pair
+        (u, uA) = `update.probe_lstsq` instead (u in range(A0), so the
+        LS residual's orthogonality makes the same projected check
+        work — §33)."""
         w = self.probe_w
 
         def build():
-            one = lambda A0: probe_row(w, A0)  # noqa: E731
+            if self.key.kind == "qr":
+                from conflux_tpu.update import probe_lstsq
+
+                one = lambda A0: probe_lstsq(w, A0)  # noqa: E731
+            else:
+                one = lambda A0: probe_row(w, A0)  # noqa: E731
             f = jax.vmap(one) if self.batched else one
             if self.mesh is None:
                 return jax.jit(f)
@@ -1254,11 +1491,19 @@ class FactorPlan:
         batched reductions, and the clean path pays no extra dispatch
         (the verdict rides the same program as the answer)."""
         w = self.probe_w
+        qr = self.key.kind == "qr"
         body = jax.vmap(inner) if self.batched else inner
 
         def f(factors, A0, wA, b2):
             self._bump("health")  # trace-time, not per call
             x = body(factors, A0, b2)
+            if qr:
+                # the session's probe is the (u, uA) pair: u ∈ range(A0)
+                # is orthogonal to the least-squares residual, so the
+                # SAME projected check u·b − uA·x vanishes at min||Ax−b||
+                # (§33) — health_spot_check consumes it verbatim
+                u, uA = wA
+                return x, health_spot_check(u, uA, x, b2)
             return x, health_spot_check(w, wA, x, b2)
 
         return f
@@ -1382,16 +1627,133 @@ class FactorPlan:
     def _refine_fn(self, nrhs: int):
         def build():
             w = self.probe_w
+            qr = self.key.kind == "qr"
             one = self._one_refine
             body = jax.vmap(one) if self.batched else one
 
             def f(factors, A0, wA, x, b2):
                 x2 = body(factors, A0, x, b2)
+                if qr:
+                    u, uA = wA
+                    return x2, health_spot_check(u, uA, x2, b2)
                 return x2, health_spot_check(w, wA, x2, b2)
 
             return self._jit_checked(f)
 
         return self._memo(self._solve_cache, ("refine", nrhs), build)
+
+    # ------------------------------------------------------------------ #
+    # served precision tiers — the per-request ladder (DESIGN §33)
+    # ------------------------------------------------------------------ #
+
+    def _tier_spec(self, tier: str):
+        """(factor dtype, fused IR sweep count) for a served tier.
+        'bf16_ir' factors in bfloat16 — half the resident factor bytes —
+        and always fuses at least one refinement sweep (the IR half of
+        the name; the residual matvec runs against the f32 base, so one
+        sweep recovers working-precision accuracy for well-conditioned
+        systems, §15). 'f32'/'f64' factor at that storage dtype with the
+        plan's own sweep count; 'f64' canonicalizes to f32 when x64 is
+        off (same programs, documented in TUNING)."""
+        if tier not in PRECISION_TIERS:
+            raise ValueError(
+                f"unknown served tier {tier!r} — one of {PRECISION_TIERS}")
+        if tier == "bf16_ir":
+            return jnp.dtype(jnp.bfloat16), max(int(self.key.refine), 1)
+        if tier == "f32":
+            return jnp.dtype(jnp.float32), int(self.key.refine)
+        return (jnp.dtype(jax.dtypes.canonicalize_dtype(jnp.float64)),
+                int(self.key.refine))
+
+    def _check_tier(self, what: str, tier: str) -> None:
+        if tier not in PRECISION_TIERS:
+            raise ValueError(
+                f"{what} takes a served tier from {PRECISION_TIERS}, "
+                f"got {tier!r}")
+        if self.mesh is not None:
+            raise AssertionError(
+                "mesh-sharded plans serve their native precision only — "
+                "per-request tiers are validated away at submit "
+                "(engine._prepare)")
+
+    def _tier_stacked_factor_fn(self, tier: str, bb: int):
+        """The served tiers' coalesced factor program: `bb` systems
+        factor at the TIER's dtype in one dispatch — the
+        `("tier_factor", tier, bb)` family next to the native
+        `("factor", bb)` one, same power-of-two buckets, same per-slot
+        bitwise bucket/pad-invariance (vmapped `_one_factor` with the
+        dtype override; always the XLA body — the §29 Pallas kernels
+        carry no bf16 grid, and tier traffic is routed, not default)."""
+        self._check_tier("_tier_stacked_factor_fn", tier)
+        if bb & (bb - 1) or bb < 1:
+            raise AssertionError(
+                f"_tier_stacked_factor_fn takes power-of-two batch "
+                f"buckets, got {bb} — route requests through ServeEngine")
+
+        def build():
+            fd, _ = self._tier_spec(tier)
+            one = lambda A: self._one_factor(A, fdtype=fd)  # noqa: E731
+            f = jax.vmap(jax.vmap(one)) if self.batched else jax.vmap(one)
+            return jax.jit(f)
+
+        return self._memo(self._factor_cache, ("tier_factor", tier, bb),
+                          build)
+
+    def _tier_factor_once(self, tier: str, A):
+        """Factor ONE system at a served tier through the bucket-1 slot
+        of the tier's stacked program — `factor(precision=...)`, the
+        cross-tier derived cache (`SolveSession._tier_factor`), and the
+        tier-aware revive path all route here, mirroring
+        :meth:`_factor_once`'s one-program-family contract."""
+        F = self._tier_stacked_factor_fn(tier, 1)(A[None])
+        return unstack_tree(F, 1)[0]
+
+    def _tier_solve_fn(self, tier: str, nrhs: int):
+        """The served tiers' substitution program per RHS bucket: the
+        tier's factors + the tier's fused sweep count against the f32
+        base — the `("tier", tier, nrhs)` family in `_solve_cache`,
+        warmed/retired through the same `bucket_ready`/`release_buckets`
+        lifecycle as the native width buckets. Signature
+        (factors, A0, b2) -> x; A0 is always consumed (bf16_ir fuses at
+        least one residual sweep)."""
+        self._check_tier("_tier_solve_fn", tier)
+        if nrhs & (nrhs - 1) or nrhs < 1:
+            raise AssertionError(
+                f"_tier_solve_fn takes power-of-two RHS buckets, got "
+                f"{nrhs} — route request widths through SolveSession.solve")
+        _, sweeps = self._tier_spec(tier)
+
+        def build():
+            def one(factors, A0, b2):
+                return self._one_solve(factors, A0, b2, sweeps=sweeps)
+
+            f = jax.vmap(one) if self.batched else one
+            return jax.jit(f)
+
+        return self._memo(self._solve_cache, ("tier", tier, nrhs), build)
+
+    def _tier_solve_health_fn(self, tier: str, nrhs: int):
+        """Checked tier substitution per RHS bucket — what 'auto'
+        requests dispatch (the verdict IS the ladder's escalation
+        signal) and what explicit-tier requests ride under engine
+        output guards. Always the unfused `_checked` shape — the §27
+        fused-probe epilogue belongs to the native blocked family; the
+        tiers keep one program shape across substitution modes."""
+        self._check_tier("_tier_solve_health_fn", tier)
+        if nrhs & (nrhs - 1) or nrhs < 1:
+            raise AssertionError(
+                f"_tier_solve_health_fn takes power-of-two RHS buckets, "
+                f"got {nrhs} — route widths through solve_checked")
+        _, sweeps = self._tier_spec(tier)
+
+        def build():
+            def one(factors, A0, b2):
+                return self._one_solve(factors, A0, b2, sweeps=sweeps)
+
+            return jax.jit(self._checked(one))
+
+        return self._memo(self._solve_cache, ("tier_health", tier, nrhs),
+                          build)
 
     # ------------------------------------------------------------------ #
     # incremental (Woodbury) update programs — compiled once per bucket
@@ -1489,7 +1851,8 @@ class FactorPlan:
                              f"{self.key.dtype}")
 
     def factor(self, A, *, policy: DriftPolicy | None = None,
-               device=None, sid=None) -> "SolveSession":
+               device=None, sid=None,
+               precision: str | None = None) -> "SolveSession":
         """Run the factor program on A and open a device-resident session.
 
         The returned session holds the factors (and A itself — the
@@ -1511,7 +1874,23 @@ class FactorPlan:
         across the whole mesh already — the session stays unpinned);
         a device outside the mesh is refused, since sharded state
         cannot migrate off its mesh.
+
+        `precision` opens the session AT a served tier (DESIGN §33):
+        the factors are built at that tier's dtype directly — a
+        bf16-tier session never pays the f32 factorization — and
+        subsequent solves default to the tier's program family.
+        'auto' opens on the cheapest rung (bf16+IR) with the session's
+        sticky escalation rung at 0. None (the default) is the native
+        path, bitwise-identical to pre-§33 behavior. Mesh-sharded
+        plans serve native precision only.
         """
+        tier0 = check_precision_request(precision)
+        if tier0 is not None and self.mesh is not None:
+            raise ValueError(
+                "mesh-sharded plans serve their native precision only — "
+                "precision= does not compose with mesh plans (§33)")
+        if tier0 == "auto":
+            tier0 = PRECISION_TIERS[0]
         if device is not None and self.mesh is not None:
             if not any(device == d for d in self.mesh.devices.flat):
                 from conflux_tpu.resilience import MeshPlanUnsupported
@@ -1528,10 +1907,13 @@ class FactorPlan:
         elif device is not None:
             A = jax.device_put(A, device)
         with profiler.region("serve.factor"):
-            factors = self._factor_once(A)
-        keep_A = A if self.key.refine else None
+            factors = (self._factor_once(A) if tier0 is None
+                       else self._tier_factor_once(tier0, A))
+        # tier sessions always retain the base — their solve programs
+        # fuse residual sweeps against A0 (bf16_ir at minimum one)
+        keep_A = A if (self.key.refine or tier0 is not None) else None
         return SolveSession(self, factors, keep_A, A, policy,
-                            device=device, sid=sid)
+                            device=device, sid=sid, served_tier=tier0)
 
     def solve(self, A, b):
         """One-shot convenience: factor + solve in one call (a fresh
@@ -1559,7 +1941,8 @@ class SolveSession:
 
     def __init__(self, plan: FactorPlan, factors, A, A_base=None,
                  policy: DriftPolicy | None = None, *,
-                 device=None, sid=None):
+                 device=None, sid=None, served_tier=None,
+                 auto_rung: int = 0):
         self.plan = plan
         # fleet placement (DESIGN §25): the device this session's state
         # lives on (None = default device — the pre-fleet behavior,
@@ -1597,6 +1980,20 @@ class SolveSession:
         # check — computed lazily on the first checked solve, dropped
         # whenever a refactor replaces the base
         self._probe = None         # guarded-by: _lock
+        # served precision tier (DESIGN §33): `_served_tier` names the
+        # tier the resident `_factors` were built at (None = the plan's
+        # native factor dtype — bitwise the pre-ladder behavior);
+        # `_auto_rung` is the sticky 'auto' ladder position (escalations
+        # ratchet it up, so a session that needed f32 once starts there
+        # next time); `_tier_factors` is the DERIVED per-tier factor
+        # cache for cross-tier requests — rebuildable from `_A0`, so it
+        # is excluded from nbytes, spill records and checkpoints, and
+        # cleared on every base swap / device move / spill
+        self._served_tier = served_tier  # guarded-by: _lock
+        self._auto_rung = int(auto_rung)  # guarded-by: _lock
+        self._tier_factors: dict = {}  # guarded-by: _lock
+        self.precision_escalations = 0  # guarded-by: _lock
+        self.precision_fallbacks = 0  # guarded-by: _lock
         self.factorizations = 1    # guarded-by: _lock
         self.solves = 0            # guarded-by: _lock
         self.updates = 0           # guarded-by: _lock
@@ -1634,6 +2031,20 @@ class SolveSession:
         'inv' plans."""
         with self._lock:
             return self._factors
+
+    @property
+    def served_tier(self):
+        """The served precision tier the resident factors carry (None =
+        the plan's native factor dtype)."""
+        with self._lock:
+            return self._served_tier
+
+    @property
+    def auto_rung(self) -> int:
+        """The sticky 'auto' ladder position (index into
+        `PRECISION_TIERS`) — escalations ratchet it up."""
+        with self._lock:
+            return self._auto_rung
 
     @property
     def update_rank(self) -> int:
@@ -1689,8 +2100,11 @@ class SolveSession:
         this."""
         with self._lock:
             seen: dict[int, int] = {}
-            leaves = list(self._factors or ())
-            leaves += [self._A, self._A0, self._probe]
+            # tree_leaves: the probe is a (u, uA) TUPLE for kind='qr'
+            # plans; `_tier_factors` is derived state (rebuildable from
+            # _A0) and deliberately unaccounted
+            leaves = jax.tree_util.tree_leaves(
+                (self._factors, self._A, self._A0, self._probe))
             if self._upd is not None:
                 leaves += [self._upd[k] for k in
                            ("Up", "Vp", "Y", "Cinv")]
@@ -1736,6 +2150,7 @@ class SolveSession:
             self._A = moved["A"]
             self._A0 = moved["A0"]
             self._probe = moved["probe"]
+            self._tier_factors = {}  # derived state stays device-local
             if self._upd is not None:
                 self._upd = {**self._upd, **moved["upd"]}
             self.device = device
@@ -1762,23 +2177,75 @@ class SolveSession:
                     f"rhs {b.shape}, session needs {want} (+ rhs axis)")
             return b, False
         if b.ndim == 1:
-            if b.shape[0] != plan.N:
-                raise ValueError(f"rhs {b.shape}, session needs ({plan.N},)")
+            if b.shape[0] != plan.M:
+                raise ValueError(f"rhs {b.shape}, session needs ({plan.M},)")
             return b[:, None], True
-        if b.ndim != 2 or b.shape[0] != plan.N:
-            raise ValueError(f"rhs {b.shape}, session needs ({plan.N}, k)")
+        if b.ndim != 2 or b.shape[0] != plan.M:
+            raise ValueError(f"rhs {b.shape}, session needs ({plan.M}, k)")
         return b, False
 
-    def solve(self, b):  # hot-path
+    # requires-lock: _lock
+    def _resolve_tier(self, precision):
+        """Resolve a per-request ``precision=`` to a served tier (or
+        None = the native program family). None defers to the tier the
+        session was OPENED at (`_served_tier` — so a bf16-tier session's
+        plain solves ride its own factors); 'auto' reads the sticky
+        ladder rung. Drifted sessions (`_upd` set) fall back to their
+        resident (Woodbury-corrected) path for CROSS-tier requests —
+        a derived-tier factor set carries no drift state, so routing
+        there would answer against the un-drifted base; the fallback is
+        counted (`precision_fallbacks`), never an error."""
+        tier = check_precision_request(precision)
+        if tier is None:
+            return self._served_tier
+        if tier == "auto":
+            tier = PRECISION_TIERS[
+                min(self._auto_rung, len(PRECISION_TIERS) - 1)]
+        if self._upd is not None and tier != self._served_tier:
+            self.precision_fallbacks += 1
+            return self._served_tier
+        return tier
+
+    # requires-lock: _lock
+    def _tier_factor(self, tier):
+        """The derived per-tier factor cache: factors of `_A0` at a
+        tier OTHER than the session's served one, built lazily through
+        the plan's tier factor family and dropped on any base swap."""
+        F = self._tier_factors.get(tier)
+        if F is None:
+            F = self.plan._tier_factor_once(tier, self._A0)
+            self._tier_factors[tier] = F
+        return F
+
+    # requires-lock: _lock
+    def _factor_base(self, A):
+        """(Re)build the session's RESIDENT factors from base `A` at
+        the session's serving configuration — the native program family
+        for untier'd sessions, the served tier's for tier'd ones. Every
+        refactor path routes here so a bf16-tier session never silently
+        reverts to f32 factors."""
+        if self._served_tier is None:
+            return self.plan._factor_once(A)
+        return self.plan._tier_factor_once(self._served_tier, A)
+
+    def solve(self, b, *, precision=None):  # hot-path
         """Solve against the resident factors: O(N^2) substitution plus
         the plan's `refine` sweeps (plus the Woodbury correction when the
         session carries an un-refactored drift). b is (N,)/(N, k) for
-        single plans, (B, N)/(B, N, k) for batched ones; x comes back in
-        b's shape. RHS widths are padded up to power-of-two buckets and
-        sliced back, so a width mix compiles O(log) programs. The
-        dispatch rides the session lock (uncontended RLock, ~100ns) so
-        a concurrent drift update or escalation refactor can never show
-        this solve half-swapped factors."""
+        single plans, (B, N)/(B, N, k) for batched ones ((M,)/(M, k)
+        for kind='qr' least-squares plans — x comes back with N rows);
+        otherwise x comes back in b's shape. RHS widths are padded up to
+        power-of-two buckets and sliced back, so a width mix compiles
+        O(log) programs. The dispatch rides the session lock
+        (uncontended RLock, ~100ns) so a concurrent drift update or
+        escalation refactor can never show this solve half-swapped
+        factors.
+
+        `precision` routes THIS request through a served tier's program
+        family (§33): None keeps the session's own serving config
+        (bitwise pre-§33 for native sessions), a tier name dispatches
+        that tier (factors derived lazily when it isn't the session's
+        own), 'auto' starts at the session's sticky rung."""
         plan = self.plan
         b2, squeeze = self._rhs(b)
         nrhs = b2.shape[-1]
@@ -1790,15 +2257,20 @@ class SolveSession:
             (b2,) = _shard_batch((b2,), plan.mesh)
         with self._lock:
             self._ensure_resident()
+            tier = self._resolve_tier(precision)
             with profiler.region("serve.solve"):
-                if self._upd is None:
-                    x = plan._solve_fn(nb)(self._factors, self._A, b2)
-                else:
+                if self._upd is not None:
                     u = self._upd
                     sweeps = plan.key.refine + self.policy.refine
                     x = plan._update_solve_fn(u["kb"], nb, sweeps)(
                         self._factors, self._A0, u["Up"], u["Vp"],
                         u["Y"], u["Cinv"], b2)
+                elif tier is None:
+                    x = plan._solve_fn(nb)(self._factors, self._A, b2)
+                else:
+                    F = (self._factors if tier == self._served_tier
+                         else self._tier_factor(tier))
+                    x = plan._tier_solve_fn(tier, nb)(F, self._A0, b2)
             self.solves += 1
         if nb != nrhs:
             x = x[..., :nrhs]
@@ -1832,7 +2304,7 @@ class SolveSession:
                 self._probe = self.plan._probe_fn()(self._A0)
             return self._probe
 
-    def solve_checked(self, b):  # hot-path
+    def solve_checked(self, b, *, precision=None):  # hot-path
         """`solve` plus the fused finite/projected-residual health
         verdict, in the SAME dispatched program. Returns (x, verdict)
         with verdict a (2,) float32 device array
@@ -1844,18 +2316,24 @@ class SolveSession:
         b2, nb, nrhs, squeeze = self._rhs_bucketed(b)
         with self._lock:
             self._ensure_resident()
+            tier = self._resolve_tier(precision)
             wA = self._probe_row()
             with profiler.region("serve.solve"):
-                if self._upd is None:
-                    x, verdict = plan._solve_health_fn(nb)(
-                        self._factors, self._A0, wA, b2)
-                else:
+                if self._upd is not None:
                     u = self._upd
                     sweeps = plan.key.refine + self.policy.refine
                     x, verdict = plan._update_solve_health_fn(
                         u["kb"], nb, sweeps)(
                         self._factors, self._A0, u["Up"], u["Vp"],
                         u["Y"], u["Cinv"], wA, b2)
+                elif tier is None:
+                    x, verdict = plan._solve_health_fn(nb)(
+                        self._factors, self._A0, wA, b2)
+                else:
+                    F = (self._factors if tier == self._served_tier
+                         else self._tier_factor(tier))
+                    x, verdict = plan._tier_solve_health_fn(tier, nb)(
+                        F, self._A0, wA, b2)
             self.solves += 1
         if nb != nrhs:
             x = x[..., :nrhs]
@@ -1912,7 +2390,11 @@ class SolveSession:
 
                 resilience.maybe_fault(None, "refresh")
                 self._factors = None  # release before the factor dispatch
-                self._factors = self.plan._factor_once(self._A0)
+                self._factors = self._factor_base(self._A0)
+                # possibly-corrupt derived factors die with the rung-1
+                # rebuild — they'd be rebuilt from the same A0, but a
+                # transient-corruption escalation must not trust them
+                self._tier_factors = {}
             self.factorizations += 1
             self.refactors += 1
             self._gang_ver += 1  # the gang slot is stale; lazy re-sync
@@ -1950,6 +2432,12 @@ class SolveSession:
         `session.update(U, V).solve(b)`).
         """
         plan = self.plan
+        if plan.key.kind == "qr":
+            raise ValueError(
+                "incremental (Woodbury) drift updates apply to square "
+                "plans — a kind='qr' least-squares session re-factors "
+                "on base change (the SMW identity corrects A^-1, not "
+                "the pseudoinverse; DESIGN §33)")
         dtype = jnp.dtype(plan.key.dtype)
         U = jnp.asarray(U, dtype)
         V = jnp.asarray(V, dtype)
@@ -2031,11 +2519,12 @@ class SolveSession:
                 self._A0, Up, Vp)
             self._A0 = A_new
             self._probe = None  # wA was against the superseded base
+            self._tier_factors = {}  # derived from the superseded base
             self._owns_base = True
             if self._A is not None:
                 self._A = A_new
             self._factors = None  # release before the factor dispatch
-            self._factors = plan._factor_once(A_new)
+            self._factors = self._factor_base(A_new)
             self.factorizations += 1
             self.refactors += 1
             self._gang_ver += 1  # the gang slot is stale; lazy re-sync

@@ -238,8 +238,16 @@ def _extract_state(session) -> tuple[dict, dict]:
     for i, f in enumerate(session._factors):
         leaves[f"f{i}"] = f
     leaves["A0"] = session._A0
+    probe_parts = 0
     if session._probe is not None:
-        leaves["probe"] = session._probe
+        if isinstance(session._probe, tuple):
+            # QR least-squares probe: the (u, uA) pair (DESIGN §33) —
+            # one leaf per part, shapes differ (M vs N)
+            probe_parts = len(session._probe)
+            for i, p in enumerate(session._probe):
+                leaves[f"probe{i}"] = p
+        else:
+            leaves["probe"] = session._probe
     upd = session._upd
     if upd is not None:
         for k in ("Up", "Vp", "Y", "Cinv"):
@@ -248,10 +256,13 @@ def _extract_state(session) -> tuple[dict, dict]:
         "n_factors": len(session._factors),
         "keep_A": session._A is not None,
         "has_probe": session._probe is not None,
+        "probe_parts": probe_parts,
         "upd": (None if upd is None
                 else {"k": int(upd["k"]), "kb": int(upd["kb"])}),
         "owns_base": bool(session._owns_base),
         "last_cond": session.last_cond,
+        "precision": session._served_tier,
+        "auto_rung": int(session._auto_rung),
         "counters": {"factorizations": session.factorizations,
                      "solves": session.solves,
                      "updates": session.updates,
@@ -271,7 +282,14 @@ def _implant(session, leaves: dict, meta: dict,
                              for i in range(meta["n_factors"]))
     session._A0 = leaves["A0"]
     session._A = session._A0 if meta["keep_A"] else None
-    session._probe = leaves.get("probe")
+    pp = int(meta.get("probe_parts", 0) or 0)
+    session._probe = (tuple(leaves[f"probe{i}"] for i in range(pp))
+                      if pp else leaves.get("probe"))
+    # served-tier identity survives spill/restore (.get: pre-§33
+    # records carry neither key and restore as native sessions)
+    session._served_tier = meta.get("precision")
+    session._auto_rung = int(meta.get("auto_rung", 0) or 0)
+    session._tier_factors = {}  # derived cross-tier cache: rebuilt lazily
     u = meta["upd"]
     session._upd = (None if u is None else
                     {"k": u["k"], "kb": u["kb"],
@@ -726,6 +744,7 @@ class ResidentSet:
                 s._A0 = None
                 s._probe = None
                 s._upd = None
+                s._tier_factors = {}  # derived: dropped, not spilled
                 g = s._gang
                 if g is not None:
                     # eviction frees the gang slot (DESIGN §26) —
@@ -1183,12 +1202,17 @@ class ResidentSet:
             A1 = A0
         eng = self.engine
         fresh = None
+        tier = meta.get("precision")
         target = getattr(session, "device", None)
         # the lane path honors a pinned session's placement only when
         # the engine actually serves that device; otherwise the direct
-        # path below factors in place (state stays on its device)
+        # path below factors in place (state stays on its device).
+        # Tier-opened sessions skip the lane and re-factor directly at
+        # their served tier — the coalesced lane would rebuild them
+        # native (a silent precision change across a revive)
         servable = target is None or target in getattr(eng, "devices", ())
-        if eng is not None and not eng._is_worker_thread() and servable:
+        if (eng is not None and tier is None
+                and not eng._is_worker_thread() and servable):
             from conflux_tpu.engine import EngineClosed, EngineSaturated
 
             try:
@@ -1210,12 +1234,18 @@ class ResidentSet:
                 Ad = (jnp.asarray(A1) if target is None
                       else jax.device_put(A1, target))
             with profiler.region("serve.refactor"):
-                session._factors = plan._factor_once(Ad)
+                session._factors = (
+                    plan._factor_once(Ad) if tier is None
+                    else plan._tier_factor_once(tier, Ad))
             session._A0 = Ad
             session._probe = None
-        session._A = session._A0 if meta["keep_A"] else None
+        session._A = (session._A0
+                      if (meta["keep_A"] or tier is not None) else None)
         session._upd = None
         session._owns_base = True
+        session._served_tier = tier
+        session._auto_rung = int(meta.get("auto_rung", 0) or 0)
+        session._tier_factors = {}
         session.factorizations += 1
         session.refactors += 1
 
@@ -1292,7 +1322,10 @@ class ResidentSet:
                     rest.append(s)
                     continue
                 key = (id(s.plan), rec.meta["n_factors"],
-                       rec.meta["has_probe"], rec.meta["keep_A"],
+                       rec.meta["has_probe"],
+                       rec.meta.get("probe_parts", 0),
+                       rec.meta.get("precision"),
+                       rec.meta["keep_A"],
                        _session_devkey(s))
                 groups.setdefault(key, []).append(s)
         n = 0

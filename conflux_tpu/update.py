@@ -166,6 +166,31 @@ def probe_row(w, A0):
     return jnp.matmul(w.astype(cdtype), A0.astype(cdtype), precision=_HI)
 
 
+def probe_lstsq(w, A0):
+    """(u, uA) — the least-squares analog of :func:`probe_row`, paid
+    once per base matrix of a QR-backed session (`serve` kind='qr').
+
+    A square session's Freivalds check projects the residual b - A x
+    through a fixed Rademacher w. For min||Ax - b|| that residual is
+    NOT small — it is the orthogonal complement of b — so the probe
+    must live in range(A0) instead: u = A0 w (normalized to the
+    Rademacher scale ||u|| = sqrt(M)) and uA = u^T A0. At the true LS
+    solution the residual is orthogonal to range(A0), so
+    u . (b - A0 x) = u . b - uA . x vanishes, and
+    :func:`health_spot_check` works VERBATIM with (u, uA) in the
+    (w, wA) slots — same formula, same (2,) verdict, same escalation
+    plumbing. Systemic garbage (corrupt R, a non-orthogonal Q) shows
+    up as an O(1) relative error in uA . x. Traceable; per-system."""
+    cdtype = blas.compute_dtype(A0.dtype)
+    u = jnp.matmul(A0.astype(cdtype), w.astype(cdtype), precision=_HI)
+    m = A0.shape[-2]
+    scale = jnp.sqrt(jnp.asarray(float(m), cdtype))
+    u = u * (scale / (jnp.sqrt(jnp.sum(jnp.abs(u) ** 2))
+                      + jnp.finfo(cdtype).tiny))
+    uA = jnp.matmul(u, A0.astype(cdtype), precision=_HI)
+    return u, uA
+
+
 def health_spot_check(w, wA, x, b, Up=None, Vp=None):
     """Fused finite/projected-residual health verdict for one solve —
     the resilience layer's output guard (`conflux_tpu.resilience`),

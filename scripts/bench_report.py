@@ -42,6 +42,7 @@ _RATIO_KEYS = (
     "overhead_pct",
     "single_speedup_vs_refactor", "speedup_vs_naive",
     "speedup_vs_xla_trsm", "speedup_vs_staged_factor",
+    "speedup_vs_all_f32",
     "transitions_won", "noqos_blowup_x",
 )
 _GATE_KEYS = (

@@ -379,6 +379,154 @@ def run_serve_trial(seed: int) -> tuple[bool, str]:
                   f"evictions={h['evictions']}")
 
 
+def run_precision_trial(seed: int) -> tuple[bool, str]:
+    """One chaos trial of the §33 precision ladder under injected
+    faults (ISSUE 18).
+
+    A mixed fleet (native, bf16+IR-opened, f32-opened sessions, some
+    SMW-drifted) serves random per-request tiers — None, 'auto',
+    'bf16_ir', 'f32', 'f64' — through a guarded engine while the serve
+    fault menu fires. Invariants: every admitted future resolves;
+    failures are STRUCTURED resilience errors only; successful answers
+    land within their served tier's tolerance of the f64 numpy oracle
+    (bf16+IR is loose, every other rung is f32-tight); the ladder's
+    escalation/fallback books stay coherent — the engine's rolled-up
+    counters equal the per-session sums, and a fleet that saw no
+    'auto'/'solve unhealthy' pressure saw no escalations."""
+    import jax.numpy as jnp
+
+    from conflux_tpu import resilience, serve
+    from conflux_tpu.engine import EngineSaturated, ServeEngine
+    from conflux_tpu.resilience import (
+        DeadlineExceeded,
+        FaultPlan,
+        FaultSpec,
+        HealthPolicy,
+        InjectedFault,
+        RhsNonFinite,
+        SessionQuarantined,
+        SolveUnhealthy,
+    )
+
+    rng = np.random.default_rng(seed)
+    serve.clear_plans()
+    N = int(rng.choice([32, 64]))
+    S = int(rng.integers(2, 5))
+    plan = serve.FactorPlan.create((N, N), jnp.float32, v=16)
+    opens = [None, "auto", "f32"]
+    As, sessions, drifted = [], [], []
+    for si in range(S):
+        A = (rng.standard_normal((N, N)) / np.sqrt(N)
+             + 2.0 * np.eye(N)).astype(np.float32)
+        sess = plan.factor(jnp.asarray(A), precision=opens[si % 3])
+        drift = bool(rng.integers(2))
+        if drift:  # pre-traffic SMW drift: cross-tier requests on a
+            # drifted session must FALL BACK to the resident path,
+            # counted, never silently answer stale-tier bits
+            k = int(rng.integers(1, 4))
+            U = (0.01 * rng.standard_normal((N, k))).astype(np.float32)
+            Vm = (0.01 * rng.standard_normal((N, k))).astype(np.float32)
+            sess.update(U, Vm)
+            A = A + U @ Vm.T
+        As.append(A.astype(np.float64))
+        sessions.append(sess)
+        drifted.append(drift)
+    menu = [
+        FaultSpec("staging", "nan", prob=0.3,
+                  count=int(rng.integers(1, 4))),
+        FaultSpec("dispatch", "delay", prob=0.3, delay_s=0.002, count=3),
+        FaultSpec("drain", "crash", prob=0.5, count=1),
+        FaultSpec("d2h", "delay", prob=0.3, delay_s=0.002, count=3),
+        FaultSpec("d2h", "crash", prob=0.5, count=1),
+        FaultSpec("solve", "unhealthy", prob=0.4,
+                  count=int(rng.integers(1, 3))),
+    ]
+    picks = [m for m in menu if rng.integers(2)]
+    faults = FaultPlan(picks, seed=seed)
+    label = (f"seed={seed} precision N={N} S={S} "
+             f"faults={[(f.site, f.kind) for f in picks]}")
+    tiers = [None, "auto", "auto", "bf16_ir", "f32", "f64"]
+    eng = ServeEngine(
+        max_batch_delay=float(rng.choice([0.0, 0.002])),
+        max_pending=64, max_coalesce_width=8,
+        health=HealthPolicy(quarantine_after=3, quarantine_cooldown=0.05),
+        fault_plan=faults, watchdog_interval=0.05)
+    reqs = []
+    try:
+        for i in range(24):
+            si = int(rng.integers(S))
+            prec = tiers[int(rng.integers(len(tiers)))]
+            w = int(rng.choice([1, 1, 2, 3]))
+            b = rng.standard_normal((N, w)).astype(np.float32)
+            if int(rng.integers(8)) == 0:  # admission-guard food
+                b[int(rng.integers(N)), 0] = np.nan
+            try:
+                fut = eng.submit(sessions[si], b, precision=prec)
+            except (RhsNonFinite, SessionQuarantined, EngineSaturated):
+                continue
+            reqs.append((si, prec, b, fut))
+        wedged = eng.close(timeout=120)
+        if wedged:
+            return False, f"{label}: close() wedged {wedged}"
+    finally:
+        eng.close(timeout=10)
+    ok_exc = (RhsNonFinite, DeadlineExceeded, SolveUnhealthy,
+              SessionQuarantined, InjectedFault)
+    answered = 0
+    for si, prec, b, fut in reqs:
+        if not fut.done():
+            return False, f"{label}: close() left a future unresolved"
+        try:
+            x = np.asarray(fut.result(0))
+        except ok_exc:
+            continue
+        except Exception as e:  # noqa: BLE001 — any other leak is a bug
+            return False, (f"{label}: UNSTRUCTURED "
+                           f"{type(e).__name__}: {e}")
+        want = np.linalg.solve(As[si], b.astype(np.float64))
+        err = (np.linalg.norm(x - want)
+               / max(np.linalg.norm(want), 1e-30))
+        # the tolerance keys on the rung that could have SERVED the
+        # answer: 'auto'/'bf16_ir' requests may ride bf16 factors;
+        # precision=None on a bf16-OPENED session serves that
+        # session's own bf16+IR factors (its native bits); and a
+        # cross-tier request on a DRIFTED bf16 session falls back to
+        # the resident bf16+Woodbury path (counted, §33). Everything
+        # else — including clean cross-tier requests, whose derived
+        # factors rebuild from the full-precision _A0 — is f32-tight.
+        st = sessions[si].served_tier
+        loose = (prec in ("auto", "bf16_ir")
+                 or (st == "bf16_ir" and (prec is None or drifted[si])))
+        bound = 2e-2 if loose else 1e-3
+        if not (err < bound):
+            return False, (f"{label}: {prec} answer off oracle "
+                           f"({err:.2e} > {bound:.0e}, served "
+                           f"tier {st})")
+        answered += 1
+    stats = eng.stats()
+    if stats["pending"] != 0:
+        return False, f"{label}: {stats['pending']} pending slots leaked"
+    if stats["completed"] + stats["failed"] != stats["requests"]:
+        return False, f"{label}: counters incoherent {stats}"
+    # the ladder's books: the engine's rolled-up counters are exactly
+    # the per-session sums (nothing double-counted, nothing dropped)
+    esc = sum(s.precision_escalations for s in sessions)
+    fb = sum(s.precision_fallbacks for s in sessions)
+    if stats["precision_escalations"] != esc:
+        return False, (f"{label}: escalation roll-up "
+                       f"{stats['precision_escalations']} != "
+                       f"session sum {esc}")
+    if stats["precision_fallbacks"] != fb:
+        return False, (f"{label}: fallback roll-up "
+                       f"{stats['precision_fallbacks']} != "
+                       f"session sum {fb}")
+    h = resilience.health_stats()
+    return True, (f"{label}: ok {answered}/{len(reqs)} answered, "
+                  f"injected={sum(faults.injected.values())}, "
+                  f"escalations={esc}, fallbacks={fb}, "
+                  f"redispatches={h['survivor_redispatches']}")
+
+
 def run_qos_trial(seed: int) -> tuple[bool, str]:
     """One chaos trial of the serving stack with multi-tenant QoS
     classification in the loop (ISSUE 15).
@@ -1777,6 +1925,15 @@ def main(argv=None) -> int:
                     "element f64 oracle answers (a torn reshard "
                     "scrambles elements), session-count conservation "
                     "and mesh_plan_unsupported == 0")
+    ap.add_argument("--precision", action="store_true",
+                    help="chaos-soak the §33 precision ladder: a mixed "
+                    "native/bf16+IR/f32 fleet (some members drifted) "
+                    "serving random per-request tiers (None, 'auto', "
+                    "'bf16_ir', 'f32', 'f64') under the serve fault "
+                    "menu; asserts structured failures only, per-tier "
+                    "f64 oracle tolerances, and coherent escalation/"
+                    "fallback counters (engine roll-up == per-session "
+                    "sums)")
     ap.add_argument("--qos", action="store_true",
                     help="chaos-soak the multi-tenant QoS layer: "
                     "random tenants across the latency/throughput/"
@@ -1797,6 +1954,7 @@ def main(argv=None) -> int:
     args = ap.parse_args(argv)
 
     trial = (run_mesh_trial if args.mesh
+             else run_precision_trial if args.precision
              else run_qos_trial if args.qos
              else run_fabric_trial if args.fabric
              else run_gang_trial if args.gang

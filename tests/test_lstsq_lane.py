@@ -1,0 +1,196 @@
+"""QR-backed least-squares sessions through the serve path (§33).
+
+`FactorPlan.create(kind='qr')` opens tall-skinny min||Ax-b|| sessions
+whose (Q, R) factor pytree rides the pytree-generic machinery: engine
+coalescing (solve + factor lanes), tier spill/revive, checkpoint/
+restore — all BITWISE against the direct session path — and gang
+exclusion accounting (a QR plan that cannot gang is a COUNTED
+exclusion, never an error). Residue counters stay zero on healthy
+traces.
+"""
+
+import os
+
+import numpy as np
+import pytest
+
+from conflux_tpu import qos, serve, tier
+from conflux_tpu.engine import ServeEngine
+
+M, N = 512, 256
+
+
+def _lstsq_system(m=M, n=N, seed=0):
+    rng = np.random.default_rng(seed)
+    A = rng.standard_normal((m, n)).astype(np.float32)
+    b = rng.standard_normal(m).astype(np.float32)
+    return A, b
+
+
+def _lstsq_oracle(A, b):
+    return np.linalg.lstsq(A.astype(np.float64), b.astype(np.float64),
+                           rcond=None)[0]
+
+
+# --------------------------------------------------------------------------- #
+# the session surface
+# --------------------------------------------------------------------------- #
+
+
+def test_qr_session_solves_least_squares():
+    A, b = _lstsq_system()
+    plan = serve.FactorPlan.create((M, N), np.float32, kind="qr")
+    assert plan.key.kind == "qr" and plan.M == M and plan.N == N
+    s = plan.factor(A)
+    x = np.asarray(s.solve(b))
+    assert x.shape == (N,)
+    assert np.abs(x.astype(np.float64) - _lstsq_oracle(A, b)).max() < 1e-4
+    # multi-rhs
+    B2 = np.random.default_rng(1).standard_normal((M, 3)) \
+        .astype(np.float32)
+    X = np.asarray(s.solve(B2))
+    assert X.shape == (N, 3)
+    for j in range(3):
+        assert np.abs(X[:, j].astype(np.float64)
+                      - _lstsq_oracle(A, B2[:, j])).max() < 1e-4
+    # an N-row rhs is the wrong surface for an (M, N) plan
+    with pytest.raises(ValueError, match=str(M)):
+        s.solve(b[:N])
+
+
+def test_qr_checked_verdict_trips_on_corruption():
+    A, b = _lstsq_system(seed=2)
+    plan = serve.FactorPlan.create((M, N), np.float32, kind="qr")
+    s = plan.factor(A)
+    x, verdict = s.solve_checked(b)
+    v = np.asarray(verdict)
+    assert v[0] == 1.0 and v[1] < 1e-4  # finite, tiny projected residual
+    # the §20-analog guard: u in range(A) is orthogonal to the
+    # least-squares residual, so u.b - (u^T A) x vanishes at the
+    # optimum — poisoned factors must trip it
+    with s._lock:
+        s._factors = tuple(f * np.nan for f in s._factors)
+    _x, bad = s.solve_checked(b)
+    assert np.asarray(bad)[0] == 0.0
+
+
+def test_qr_sessions_reject_woodbury_updates():
+    A, _b = _lstsq_system(seed=3)
+    plan = serve.FactorPlan.create((M, N), np.float32, kind="qr")
+    s = plan.factor(A)
+    u = np.zeros((M, 1), np.float32)
+    v = np.zeros((N, 1), np.float32)
+    with pytest.raises(ValueError, match="qr"):
+        s.update(u, v)
+
+
+def test_qr_rejects_batched_and_square_validation():
+    with pytest.raises(ValueError):
+        serve.FactorPlan.create((4, M, N), np.float32, kind="qr")
+    with pytest.raises(ValueError):
+        serve.FactorPlan.create((N, M), np.float32, kind="qr")  # M < N
+
+
+def test_request_cost_prices_by_rows():
+    sq = qos.request_cost((N, N), width=1)
+    tall = qos.request_cost((M, N), width=1)
+    assert tall >= sq  # O(M N w) vs O(N^2 w), M = 2N here
+    # factor pricing: O(M N^2) reduces exactly to N^3 when square
+    assert qos.request_cost((N, N), factor=True) == \
+        max(1.0, float(N) ** 3 / qos.REF_FACTOR_UNITS)
+    assert qos.request_cost((M, N), factor=True) == \
+        max(1.0, M * float(N) ** 2 / qos.REF_FACTOR_UNITS)
+
+
+# --------------------------------------------------------------------------- #
+# engine lanes: coalescing bitwise, exclusions counted, residue zero
+# --------------------------------------------------------------------------- #
+
+
+def test_lstsq_rides_engine_coalescing_bitwise():
+    plan = serve.FactorPlan.create((M, N), np.float32, kind="qr")
+    systems = [_lstsq_system(seed=10 + i) for i in range(4)]
+    eng = ServeEngine(max_batch_delay=0.02)
+    try:
+        # factor lane: coalesced cold starts open QR sessions
+        futs = [eng.submit_factor(plan, A) for A, _ in systems]
+        sessions = [f.result(timeout=120) for f in futs]
+        # solve lane: coalesced requests answer bitwise what the
+        # direct session path answers
+        direct = [np.asarray(s.solve(b))
+                  for s, (_, b) in zip(sessions, systems)]
+        futs = [eng.submit(s, b)
+                for s, (_, b) in zip(sessions, systems)]
+        served = [np.asarray(f.result(timeout=120)) for f in futs]
+        for d, v, (A, b) in zip(direct, served, systems):
+            assert np.array_equal(d, v)
+            assert np.abs(v.astype(np.float64)
+                          - _lstsq_oracle(A, b)).max() < 1e-4
+        st = eng.stats()
+        assert st["failed"] == 0
+        assert st["factor_coalesced_requests"] == 4
+    finally:
+        eng.close()
+
+
+def test_qr_gang_exclusion_counted_not_error():
+    plan = serve.FactorPlan.create((M, N), np.float32, kind="qr")
+    systems = [_lstsq_system(seed=20 + i) for i in range(3)]
+    eng = ServeEngine(max_batch_delay=0.02, stack_sessions=True)
+    try:
+        sessions = [plan.factor(A) for A, _ in systems]
+        futs = [eng.submit(s, b)
+                for s, (_, b) in zip(sessions, systems)]
+        for f, s, (A, b) in zip(futs, sessions, systems):
+            x = np.asarray(f.result(timeout=120))
+            assert np.array_equal(x, np.asarray(s.solve(b)))
+        st = eng.stats()
+        # the (M, N) factor shapes cannot gang-stack: a counted
+        # exclusion per session, never a failure
+        assert st["stack_exclusions"]["kind"] >= 3
+        assert st["failed"] == 0
+        assert st["gang_batches"] == 0
+    finally:
+        eng.close()
+
+
+# --------------------------------------------------------------------------- #
+# tiering + checkpoint: bitwise round trips
+# --------------------------------------------------------------------------- #
+
+
+def test_lstsq_spill_revive_bitwise():
+    A, b = _lstsq_system(seed=30)
+    plan = serve.FactorPlan.create((M, N), np.float32, kind="qr")
+    s = plan.factor(A)
+    x0, v0 = s.solve_checked(b)
+    x0, v0 = np.asarray(x0), np.asarray(v0)
+    rs = tier.ResidentSet(max_sessions=4).adopt(s)
+    assert rs.spill(s) == 1
+    x1, v1 = s.solve_checked(b)
+    assert np.array_equal(x0, np.asarray(x1))
+    assert np.array_equal(v0, np.asarray(v1))  # (u, uA) probe survived
+    # coalesced revival: same-plan QR records stack through ONE h2d
+    others = [plan.factor(_lstsq_system(seed=31 + i)[0])
+              for i in range(2)]
+    base = [np.asarray(o.solve(b)) for o in others]
+    rs.adopt(*others)
+    rs.spill(*others)
+    assert rs.revive_many(others) == 2
+    for o, x in zip(others, base):
+        assert np.array_equal(x, np.asarray(o.solve(b)))
+
+
+def test_lstsq_checkpoint_restore_bitwise(tmp_path):
+    A, b = _lstsq_system(seed=40)
+    plan = serve.FactorPlan.create((M, N), np.float32, kind="qr")
+    s = plan.factor(A)
+    x0, v0 = s.solve_checked(b)
+    x0, v0 = np.asarray(x0), np.asarray(v0)
+    path = os.path.join(tmp_path, "fleet")
+    tier.save_fleet(path, [s], names=["lstsq"])
+    (r,) = tier.load_fleet(path)
+    assert r.plan is plan  # exact key -> same cached plan
+    x1, v1 = r.solve_checked(b)
+    assert np.array_equal(x0, np.asarray(x1))
+    assert np.array_equal(v0, np.asarray(v1))
