@@ -125,6 +125,10 @@ def __getattr__(name):
         "QosClass": ("conflux_tpu.qos", "QosClass"),
         "FairShareLedger": ("conflux_tpu.qos", "FairShareLedger"),
         "TenantThrottled": ("conflux_tpu.resilience", "TenantThrottled"),
+        # elastic fabric (ISSUE 19)
+        "FabricAutoscaler": ("conflux_tpu.control", "FabricAutoscaler"),
+        "AutoscalePolicy": ("conflux_tpu.control", "AutoscalePolicy"),
+        "rendezvous_ranked": ("conflux_tpu.engine", "rendezvous_ranked"),
     }
     if name in _lazy:
         import importlib
@@ -217,4 +221,7 @@ __all__ = [
     "QosClass",
     "FairShareLedger",
     "TenantThrottled",
+    "FabricAutoscaler",
+    "AutoscalePolicy",
+    "rendezvous_ranked",
 ]

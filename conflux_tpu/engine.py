@@ -170,6 +170,30 @@ def rendezvous(sid, nodes, key=None):
     return best
 
 
+def rendezvous_ranked(sid, nodes, k=None, key=None):
+    """Rendezvous hashing, ranked: the full preference ORDER of `nodes`
+    for `sid`, highest weight first (same weights and tie-break as
+    :func:`rendezvous`, so `rendezvous_ranked(sid, ns)[0] ==
+    rendezvous(sid, ns)`). `k` truncates to the top-k.
+
+    The serve fabric's K-replica placement (DESIGN §34) is built on
+    this: rank 0 is the primary, ranks 1..K-1 hold replica records, and
+    the no-reshuffle property extends down the list — removing a node
+    promotes each sid's next-ranked survivor without disturbing the
+    relative order of any other pair, so fail-over re-points to the
+    same standby every front would compute independently."""
+    sb = str(sid).encode()
+    ranked = sorted(
+        nodes,
+        key=lambda n: (
+            zlib.crc32(sb + b"@" + str(n if key is None else key(n)).encode()),
+            str(n if key is None else key(n)),
+        ),
+        reverse=True,
+    )
+    return ranked if k is None else ranked[:k]
+
+
 def place_session(sid, devices):
     """Deterministic consistent placement: map a stable session id onto
     one of `devices` by rendezvous hashing over the device identities.

@@ -32,6 +32,18 @@ process — behind a single routing front:
   waits out in-flight work), the target adopts the record, ownership
   flips, the source drops its copy. A crash before the target adopts
   leaves the session intact on the source.
+- **Elastic membership** (DESIGN §34): `add_host` joins at runtime
+  (adopt-on-arrival — nobody reshuffles), `remove_host` leaves via a
+  ``draining`` state + per-sid migration storm (a crash mid-drain
+  leaves undrained sessions on the still-live source), retired ids
+  never resurrect, and `rebalance` drains induced skew at a bounded
+  rate. With ``FabricPolicy.replicas`` ≥ 2, each checkpointed
+  session's record is also pushed to the next K-1 hosts on its
+  rendezvous-RANKED list, and fail-over becomes re-point-to-standby:
+  the standby adopts from its LOCAL replica store, no cross-host
+  snapshot read, with restore-from-snapshot demoted to the fallback.
+  `conflux_tpu.control.FabricAutoscaler` drives grow/shrink/rebalance
+  decisions behind a ``HostProvider`` callback.
 
 Request traffic raises structured errors, never hangs:
 :class:`~conflux_tpu.resilience.HostUnavailable` (dead/flapping owner,
@@ -158,6 +170,21 @@ def latest_checkpoint(ckpt_dir: str) -> str | None:
     return dest if os.path.isdir(dest) else None
 
 
+def _snapshot_gen(snap: str | None) -> int:
+    """The fleet-NNNNNN sequence number of a snapshot dir — the
+    K-replica coherence token (DESIGN §34): a standby's replica is
+    trusted at fail-over only when its pushed generation is ≥ the
+    corpse's latest snapshot generation, i.e. re-pointing never rolls
+    a session back further than the snapshot restore would. -1 when
+    the host never completed a snapshot (any replica then wins)."""
+    if snap is None:
+        return -1
+    try:
+        return int(os.path.basename(snap).split("-", 1)[1])
+    except (IndexError, ValueError):
+        return -1
+
+
 def checkpoint_sids(snapshot: str) -> dict[Any, str]:
     """{sid: record name} for a fleet snapshot — the fail-over front's
     view of WHICH sessions a dead host's checkpoint can revive."""
@@ -200,6 +227,14 @@ class FabricPolicy:
         host sheds with HostUnavailable until its cooldown probe.
     retry_floor / retry_ceil: clamp on retry_after hints
         (:class:`~conflux_tpu.control.HostLoadEstimator`).
+    replicas: K-replica placement (DESIGN §34). 1 (default) is the
+        pre-§34 fabric: fail-over restores from the dead host's own
+        snapshot. K ≥ 2 pushes each checkpointed session's record to
+        the next K-1 hosts on its rendezvous-RANKED candidate list
+        (`engine.rendezvous_ranked`), so fail-over re-points to a
+        standby that adopts from its LOCAL replica record — no
+        cross-host snapshot read; restore-from-snapshot demotes to
+        the fallback for sids whose live standbys are stale or gone.
     """
 
     heartbeat_interval: float = 0.25
@@ -215,8 +250,11 @@ class FabricPolicy:
     breaker_cooldown: float = 5.0
     retry_floor: float = 0.05
     retry_ceil: float = 5.0
+    replicas: int = 1
 
     def __post_init__(self):
+        if self.replicas < 1:
+            raise ValueError("replicas must be >= 1")
         if self.heartbeat_interval <= 0 or self.heartbeat_timeout <= 0:
             raise ValueError("heartbeat_interval and heartbeat_timeout "
                              "must be > 0")
@@ -251,6 +289,21 @@ class _HostCore:
         self._lock = threading.Lock()
         self._registry: dict = {}  # guarded-by: _lock — sid -> session
         self._ckpt_seq = 0         # guarded-by: _lock
+        # standby records this host holds for OTHER hosts' sessions
+        # (DESIGN §34 K-replica placement): name -> generation. Seeded
+        # from disk so a restarted worker still answers adopt_replica
+        # for records a previous incarnation accepted.
+        self._replicas: dict[str, int] = {}  # guarded-by: _lock
+        rep_root = os.path.join(ckpt_dir, "replicas")
+        if os.path.isdir(rep_root):
+            for name in os.listdir(rep_root):
+                try:
+                    with open(os.path.join(rep_root, name,
+                                           "fleet.json")) as f:
+                        self._replicas[name] = int(
+                            json.load(f).get("gen", 0))
+                except (OSError, ValueError, KeyError):
+                    continue  # half-written leftover; replaced on push
 
     # -- telemetry ----------------------------------------------------- #
 
@@ -260,6 +313,7 @@ class _HostCore:
         c = self.eng.counters()
         with self._lock:
             n = len(self._registry)
+            nrep = len(self._replicas)
         counters = {"pending": c["pending"],
                     "solves": c["completed"],
                     "requests": c["requests"],
@@ -277,7 +331,7 @@ class _HostCore:
             for t, done in sorted(tiers.items()):
                 counters[f"qos_{t}_solves"] = done
         return {"host_id": self.host_id, "sessions": n,
-                "counters": counters}
+                "replicas": nrep, "counters": counters}
 
     def stats(self) -> dict:
         with self._lock:
@@ -375,11 +429,102 @@ class _HostCore:
         self.eng.checkpoint(dest, sessions=[s], names=[name])
         return name
 
+    # -- K-replica standby store (DESIGN §34) -------------------------- #
+
+    def replicate(self, src: str, names: list[str], gen: int) -> list:
+        """Accept standby copies of another host's checkpoint records.
+
+        For each `name`, copy its record dir out of the snapshot `src`
+        into this host's local `replicas/<name>/` store as a
+        one-session fleet (loadable by `tier.load_fleet` without
+        touching `src` again — the whole point: fail-over re-points
+        here with zero cross-host reads). The swap is
+        copy-aside-then-rename, so a crash mid-push leaves either the
+        previous complete replica or a `.tmp` leftover the seeding
+        scan skips — never a half record. Generations are monotone:
+        a stale push (gen older than what this host already holds) is
+        skipped, not applied, so out-of-order rounds cannot roll a
+        standby backward. Returns the names actually (re)written."""
+        with open(os.path.join(src, "fleet.json")) as f:
+            entries = {e["name"]: e for e in json.load(f)["sessions"]}
+        rep_root = os.path.join(self.ckpt_dir, "replicas")
+        os.makedirs(rep_root, exist_ok=True)
+        done: list[str] = []
+        for name in names:
+            e = entries.get(name)
+            if e is None:
+                raise KeyError(f"snapshot {src} has no record {name!r}")
+            with self._lock:
+                if self._replicas.get(name, -1) >= gen:
+                    continue
+            tmp = os.path.join(rep_root, f"{name}.tmp")
+            shutil.rmtree(tmp, ignore_errors=True)
+            os.makedirs(tmp)
+            shutil.copytree(os.path.join(src, e["dir"]),
+                            os.path.join(tmp, e["dir"]))
+            with open(os.path.join(tmp, "fleet.json"), "w") as f:
+                json.dump({"format": 1, "gen": int(gen),
+                           "sessions": [e]}, f)
+            final = os.path.join(rep_root, name)
+            old = final + ".old"
+            shutil.rmtree(old, ignore_errors=True)
+            if os.path.isdir(final):
+                os.rename(final, old)
+            os.rename(tmp, final)
+            shutil.rmtree(old, ignore_errors=True)
+            with self._lock:
+                self._replicas[name] = int(gen)
+            done.append(name)
+        return done
+
+    def adopt_replica(self, items: list) -> dict:
+        """Fail-over re-point (DESIGN §34): restore sessions from this
+        host's LOCAL replica store — no cross-host snapshot read.
+        `items` is [[sid, name], ...]; each present replica loads via
+        the same `tier.load_fleet` rail as snapshot adoption (bitwise
+        contract intact) and registers. Missing/corrupt replicas are
+        reported, not raised — the front falls back to the snapshot
+        path for exactly those sids."""
+        rep_root = os.path.join(self.ckpt_dir, "replicas")
+        adopted: list = []
+        missing: list = []
+        for sid, name in items:
+            path = os.path.join(rep_root, name)
+            try:
+                sessions = tier.load_fleet(path, names=[name])
+            except (OSError, KeyError, ValueError, RestoreCorrupt):
+                missing.append(sid)
+                continue
+            with self._lock:
+                for s in sessions:
+                    self._registry[s.sid] = s
+                gen = self._replicas.pop(name, 0)
+            shutil.rmtree(path, ignore_errors=True)
+            adopted.append([sid, gen])
+        return {"adopted": adopted, "missing": missing}
+
+    def drop_replica(self, names: list[str]) -> int:
+        """Retire standby records this host no longer ranks for
+        (placement moved, session closed, or the primary itself now
+        lives here). Best-effort hygiene — a leftover replica is
+        harmless (generation-gated) but wastes disk."""
+        rep_root = os.path.join(self.ckpt_dir, "replicas")
+        n = 0
+        for name in names:
+            with self._lock:
+                had = self._replicas.pop(name, None)
+            shutil.rmtree(os.path.join(rep_root, name),
+                          ignore_errors=True)
+            if had is not None:
+                n += 1
+        return n
+
     def wipe(self) -> None:
         """Drop the whole registry (LocalHost.kill: a dead process's
         un-checkpointed state is simply gone)."""
         with self._lock:
             self._registry.clear()
+            self._replicas.clear()
 
     def close(self) -> bool:
         self.eng.close()
@@ -427,6 +572,18 @@ class HostHandle:
 
     def migrate_out(self, sid, dest,
                     timeout: float | None = None) -> str:
+        raise NotImplementedError
+
+    def replicate(self, src, names, gen,
+                  timeout: float | None = None) -> list:
+        raise NotImplementedError
+
+    def adopt_replica(self, items,
+                      timeout: float | None = None) -> dict:
+        raise NotImplementedError
+
+    def drop_replica(self, names,
+                     timeout: float | None = None) -> int:
         raise NotImplementedError
 
     def drop(self, sid, timeout: float | None = None) -> bool:
@@ -531,6 +688,18 @@ class LocalHost(HostHandle):
     def migrate_out(self, sid, dest,
                     timeout: float | None = None) -> str:
         return self._engine_op(lambda c: c.migrate_out(sid, dest))
+
+    def replicate(self, src, names, gen,
+                  timeout: float | None = None) -> list:
+        return self._alive_core().replicate(src, names, gen)
+
+    def adopt_replica(self, items,
+                      timeout: float | None = None) -> dict:
+        return self._engine_op(lambda c: c.adopt_replica(items))
+
+    def drop_replica(self, names,
+                     timeout: float | None = None) -> int:
+        return self._alive_core().drop_replica(names)
 
     def drop(self, sid, timeout: float | None = None) -> bool:
         return self._alive_core().drop(sid)
@@ -1080,6 +1249,21 @@ class ProcessHost(HostHandle):
         return self._call("migrate_out", timeout=timeout, sid=sid,
                           dest=dest)
 
+    def replicate(self, src, names, gen,
+                  timeout: float | None = None) -> list:
+        return self._call("replicate", timeout=timeout, src=src,
+                          names=list(names), gen=int(gen))
+
+    def adopt_replica(self, items,
+                      timeout: float | None = None) -> dict:
+        return self._call("adopt_replica", timeout=timeout,
+                          items=[[s, n] for s, n in items])
+
+    def drop_replica(self, names,
+                     timeout: float | None = None) -> int:
+        return self._call("drop_replica", timeout=timeout,
+                          names=list(names))
+
     def drop(self, sid, timeout: float | None = None) -> bool:
         return self._call("drop", timeout=timeout, sid=sid)
 
@@ -1252,6 +1436,12 @@ def worker_main(argv=None) -> int:
                 val = core.adopt(kw["src"], kw["names"])
             elif op == "migrate_out":
                 val = core.migrate_out(kw["sid"], kw["dest"])
+            elif op == "replicate":
+                val = core.replicate(kw["src"], kw["names"], kw["gen"])
+            elif op == "adopt_replica":
+                val = core.adopt_replica(kw["items"])
+            elif op == "drop_replica":
+                val = core.drop_replica(kw["names"])
             elif op == "drop":
                 val = core.drop(kw["sid"])
             elif op == "stats":
@@ -1374,6 +1564,24 @@ class ServeFabric:
         self._recoveries: list[dict] = []                # guarded-by: _lock
         self._mig_seq = 0                                # guarded-by: _lock
         self._ckpt_rounds = 0                            # guarded-by: _lock
+        self._closed_sids = 0                            # guarded-by: _lock
+        self._admitted_sids = 0                          # guarded-by: _lock
+        # elastic membership (DESIGN §34). _reserved: ids an in-flight
+        # add_host claimed in its first critical section (the TOCTOU
+        # fix — a racing duplicate add fails BEFORE starting a second
+        # worker). _retired: ids that died or were removed; they never
+        # resurrect — a returning/zombie process must come back under
+        # a fresh identity or stale routing state could alias it.
+        # _failing: hosts whose fail-over is in flight (remove_host of
+        # a corpse waits this out instead of yanking the handle the
+        # fail-over is still reading).
+        self._reserved: set[str] = set()                 # guarded-by: _lock
+        self._retired: set[str] = set()                  # guarded-by: _lock
+        self._failing: set[str] = set()                  # guarded-by: _lock
+        # sid -> {standby host id: replica generation} (K-replica
+        # placement; generations are the primary's fleet-NNNNNN seq,
+        # the coherence token fail-over's re-point gate checks)
+        self._replicas: dict[Any, dict[str, int]] = {}   # guarded-by: _lock
         self._breakers = {h: CircuitBreaker(self.policy.breaker_threshold,
                                             self.policy.breaker_cooldown)
                           for h in self._hosts}
@@ -1423,12 +1631,15 @@ class ServeFabric:
     # -- host census --------------------------------------------------- #
 
     def _live(self) -> list[str]:
-        """Hosts eligible for routing/placement: alive or suspect
-        (a suspect host still answers most traffic; only DEATH moves
-        sessions — the hysteresis half of the no-reshuffle story)."""
+        """Hosts eligible for NEW placement and fail-over adoption:
+        alive or suspect (a suspect host still answers most traffic;
+        only DEATH moves sessions — the hysteresis half of the
+        no-reshuffle story). Draining hosts (scale-in in progress,
+        DESIGN §34) are excluded — they keep serving the sessions
+        they still own but take nothing new."""
         with self._lock:
             return sorted(h for h, s in self._state.items()
-                          if s != "dead")
+                          if s not in ("dead", "draining"))
 
     def _alive(self) -> list[str]:
         with self._lock:
@@ -1443,22 +1654,187 @@ class ServeFabric:
         with self._lock:
             return self._owners.get(sid)
 
+    def owner_census(self) -> dict[str, int]:
+        """{host id: owned-session count} — the autoscaler's memory
+        axis and the rebalancer's skew input."""
+        with self._lock:
+            per: dict[str, int] = {}
+            for _sid, h in self._owners.items():
+                per[h] = per.get(h, 0) + 1
+            return per
+
+    def taken_ids(self) -> set[str]:
+        """Every host id that would be refused by :meth:`add_host` —
+        present, reserved by an in-flight add, or permanently retired.
+        The autoscaler mints fresh ids against this set."""
+        with self._lock:
+            return set(self._hosts) | self._reserved | self._retired
+
     def add_host(self, handle: HostHandle) -> None:
-        """Grow the live set (soak's revive arm). New sessions HRW
-        over the enlarged set; existing owners do not move — call
-        :meth:`migrate` to rebalance deliberately."""
+        """Grow the live set at runtime (scale-out, DESIGN §34). New
+        sessions HRW over the enlarged set; existing owners do not
+        move — scale-out is adopt-on-arrival, with :meth:`rebalance`
+        draining induced skew deliberately.
+
+        The id is RESERVED in the first critical section, so two
+        concurrent add_host calls with the same id race on the
+        reservation, not on `handle.start()` — exactly one starts a
+        worker, the loser fails before owning any resource (the old
+        check-then-insert TOCTOU leaked a started handle). Retired
+        ids (died or removed) are refused permanently: a dead host's
+        identity never resurrects."""
         hid = handle.host_id
         with self._lock:
-            if hid in self._hosts:
+            if hid in self._hosts or hid in self._reserved:
                 raise ValueError(f"host id {hid!r} already present")
-        handle.start()
-        self._breakers[hid] = CircuitBreaker(
-            self.policy.breaker_threshold, self.policy.breaker_cooldown)
-        self._windows[hid] = CounterWindow()
+            if hid in self._retired:
+                raise ValueError(
+                    f"host id {hid!r} is retired (it died or was "
+                    "removed) — dead ids never resurrect; rejoin "
+                    "under a fresh id")
+            self._reserved.add(hid)
+        try:
+            handle.start()
+            self._breakers[hid] = CircuitBreaker(
+                self.policy.breaker_threshold,
+                self.policy.breaker_cooldown)
+            self._windows[hid] = CounterWindow()
+        except BaseException:
+            with self._lock:
+                self._reserved.discard(hid)
+            raise
+        if isinstance(handle, LocalHost):
+            handle.core.ckpt_keep = self.policy.checkpoint_keep
         with self._lock:
+            self._reserved.discard(hid)
             self._hosts[hid] = handle
             self._state[hid] = "alive"
             self._misses[hid] = 0
+        bump("fabric_hosts_added")
+
+    def remove_host(self, host_id: str, *, drain: bool = True) -> list:
+        """Leave the fleet at runtime (scale-in, DESIGN §34).
+
+        A live host first moves to the ``draining`` state — it keeps
+        serving the sessions it owns but is excluded from new
+        placement, fail-over adoption and migration targets — then a
+        drain-barrier migration storm rides the §28 :meth:`migrate`
+        path once per owned sid (HRW remaps only the departing host's
+        sessions; nobody else reshuffles). Only when the host owns
+        nothing is it retired: handle closed, id permanently refused
+        by :meth:`add_host`. A crash (of this caller or a migration
+        target) mid-drain leaves every undrained session owned by the
+        still-live source, which returns to ``alive`` — scale-in is
+        abandoned, not half-applied — and the partial storm raises
+        :class:`HostUnavailable` with a retry hint.
+
+        Removing an already-dead host is pure bookkeeping: it waits
+        out any in-flight fail-over reading the corpse's snapshot,
+        then retires the entry. Returns the sids migrated off."""
+        with self._lock:
+            if host_id not in self._hosts:
+                raise KeyError(f"unknown host {host_id!r}")
+            st = self._state[host_id]
+            if st == "draining":
+                raise ValueError(f"host {host_id!r} is already "
+                                 "draining")
+        if st == "dead":
+            # fail-over (heartbeat thread) may still be reading the
+            # corpse's checkpoint via self._hosts[hid] — wait it out
+            deadline = time.monotonic() + self.policy.call_timeout
+            while time.monotonic() < deadline:
+                with self._lock:
+                    busy = host_id in self._failing
+                if not busy:
+                    break
+                time.sleep(0.01)
+            self._retire(host_id, close=False)
+            return []
+        if len(self._live()) - 1 < self.policy.min_live:
+            raise FleetDegraded(
+                f"removing {host_id} would leave "
+                f"{len(self._live()) - 1} live hosts, below min_live="
+                f"{self.policy.min_live}",
+                retry_after=self._retry_hint(),
+                live=len(self._live()), total=len(self._hosts))
+        with self._lock:
+            self._state[host_id] = "draining"
+        moved: list = []
+        if drain:
+            with self._lock:
+                owned = sorted((s for s, h in self._owners.items()
+                                if h == host_id), key=str)
+            for sid in owned:
+                try:
+                    self.migrate(sid)
+                # conflint: disable=CFX-EXCEPT an injected kill fails ONE drain migration; the storm's partial-result accounting below abandons the scale-in and the monitor owns the death
+                except (HostUnavailable, FleetDegraded, InjectedFault,
+                        InjectedKill):
+                    continue  # undrained: stays on the live source
+                moved.append(sid)
+        with self._lock:
+            undrained = sorted((s for s, h in self._owners.items()
+                                if h == host_id), key=str)
+            died = self._state.get(host_id) == "dead"
+        if undrained and not died:
+            # put the host back in service; the caller retries
+            with self._lock:
+                if self._state.get(host_id) == "draining":
+                    self._state[host_id] = "alive"
+            if moved:
+                bump("fabric_drain_migrations", len(moved))
+            raise HostUnavailable(
+                f"drain of {host_id} incomplete: {len(undrained)} "
+                f"session(s) still owned (moved {len(moved)}) — host "
+                "stays in service; retry",
+                retry_after=self._retry_hint(len(undrained)),
+                host=host_id)
+        if died:
+            # the host died mid-drain: its heartbeat fail-over has
+            # (or will) re-home the rest; retire bookkeeping only
+            self._retire(host_id, close=False)
+        else:
+            self._retire(host_id, close=True)
+        if moved:
+            bump("fabric_drain_migrations", len(moved))
+        bump("fabric_hosts_removed")
+        return moved
+
+    def _retire(self, hid: str, *, close: bool) -> None:
+        """Purge a host's entry and permanently retire its id."""
+        with self._lock:
+            handle = self._hosts.pop(hid, None)
+            self._state.pop(hid, None)
+            self._misses.pop(hid, None)
+            self._retired.add(hid)
+            for reps in self._replicas.values():
+                reps.pop(hid, None)
+        self._breakers.pop(hid, None)
+        self._windows.pop(hid, None)
+        self.load.forget(hid)
+        if close and handle is not None:
+            try:
+                handle.close()
+            except (ConnectionError, EOFError, OSError):
+                pass
+
+    def _pick_target(self, exclude: "set[str] | tuple" = (), *,
+                     require_wire_headroom: bool = False) -> str | None:
+        """THE migration-target picker — migrate, the drain storm and
+        background rebalancing all route through here so placement
+        policy lives in one place. Alive hosts only (suspect and
+        draining hosts take nothing new), wire-congestion aware:
+        hosts whose shm ring is ≥ 90% full are avoided, and with
+        `require_wire_headroom` (the rebalancer — a HOT-host fix must
+        not aim at a host about to shed RingFull) they are refused
+        outright. Returns None when no candidate qualifies."""
+        cands = [h for h in self._alive() if h not in exclude]
+        if not cands:
+            return None
+        clear = [h for h in cands if self.load.wire_frac(h) < 0.9]
+        if require_wire_headroom:
+            return self.load.least_loaded(clear) if clear else None
+        return self.load.least_loaded(clear or cands)
 
     # -- admission + request routing ----------------------------------- #
 
@@ -1485,7 +1861,11 @@ class ServeFabric:
             if sid in self._owners:
                 raise ValueError(f"sid {sid!r} already open on host "
                                  f"{self._owners[sid]}")
-            self._lost.pop(sid, None)  # reopening a lost sid is legal
+            # reopening a lost sid is legal: the loss accounting is
+            # resolved so the census identity admitted == open + lost
+            # + closed stays EXACT across re-admission
+            if self._lost.pop(sid, None) is not None:
+                self._admitted_sids -= 1
             total = len(self._hosts)
         live = self._live()
         if len(live) < self.policy.min_live:
@@ -1509,7 +1889,13 @@ class ServeFabric:
         with self._lock:
             self._owners[sid] = hid
         if self.policy.durable_open:
-            if self._checkpoint_host(hid) is None:
+            snap = self._checkpoint_host(hid)
+            if snap is not None:
+                # K-replica placement (DESIGN §34): the admission
+                # snapshot's records land on the next K-1 ranked
+                # hosts, so this session is re-pointable from birth
+                self._push_replicas(hid, snap)
+            else:
                 # the host died inside the admission window: the
                 # session is NOT durable, so the admission is void —
                 # undo it and tell the caller to retry (the next open
@@ -1526,6 +1912,8 @@ class ServeFabric:
                     f"host {hid} died before admission of {sid!r} "
                     "became durable — retry",
                     retry_after=self._retry_hint(), host=hid)
+        with self._lock:
+            self._admitted_sids += 1
         return sid
 
     def _route_fault(self, hid: str) -> None:
@@ -1554,7 +1942,12 @@ class ServeFabric:
             raise HostUnavailable(
                 f"host {hid} is dead; fail-over for {sid!r} is in "
                 "flight", retry_after=self._retry_hint(), host=hid)
-        ok, cool = self._breakers[hid].allow()
+        br = self._breakers.get(hid)
+        if br is None:  # retired mid-request
+            raise HostUnavailable(
+                f"host {hid} left the fleet; routing for {sid!r} is "
+                "settling", retry_after=self._retry_hint(), host=hid)
+        ok, cool = br.allow()
         if not ok:
             raise HostUnavailable(
                 f"host {hid} circuit open (repeated transport "
@@ -1589,7 +1982,9 @@ class ServeFabric:
             raise HostUnavailable(
                 f"host {hid} unreachable during solve({sid!r}): {e}",
                 retry_after=self._retry_hint(), host=hid) from e
-        self._breakers[hid].record_success()
+        br = self._breakers.get(hid)
+        if br is not None:
+            br.record_success()
         return out
 
     def update(self, sid, U, V, *, replace: bool = False,
@@ -1605,8 +2000,45 @@ class ServeFabric:
             raise HostUnavailable(
                 f"host {hid} unreachable during update({sid!r}): {e}",
                 retry_after=self._retry_hint(), host=hid) from e
-        self._breakers[hid].record_success()
+        br = self._breakers.get(hid)
+        if br is not None:
+            br.record_success()
         return out
+
+    def close_session(self, sid, timeout: float | None = None) -> bool:
+        """Deliberately retire a session fleet-wide: drop the owner's
+        live copy, the ownership entry and every standby replica. The
+        load-recede half of elasticity (DESIGN §34) — admitted work
+        must be able to END for utilization to fall and the
+        autoscaler's scale-in lane to ever fire. The sid becomes
+        reusable; the census conserves as
+        admitted == open + lost + closed (`stats()['closed_sessions']`,
+        the soak's conservation oracle)."""
+        hid, host = self._resolve(sid)
+        try:
+            host.drop(sid, timeout=timeout if timeout is not None
+                      else self.policy.call_timeout)
+        except _TRANSPORT_ERRORS as e:
+            self._note_request_failure(hid)
+            raise HostUnavailable(
+                f"host {hid} unreachable during close({sid!r}): {e}",
+                retry_after=self._retry_hint(), host=hid) from e
+        with self._lock:
+            self._owners.pop(sid, None)
+            reps = self._replicas.pop(sid, None) or {}
+            self._closed_sids += 1
+        name = record_name(sid)
+        for h in sorted(reps):
+            handle = self._hosts.get(h)
+            if handle is None:
+                continue
+            try:
+                handle.drop_replica([name],
+                                    timeout=self.policy.call_timeout)
+            except _TRANSPORT_ERRORS:
+                pass  # hygiene only; the generation gate covers it
+        bump("fabric_sessions_closed")
+        return True
 
     # -- heartbeat / detection ----------------------------------------- #
 
@@ -1632,12 +2064,16 @@ class ServeFabric:
             if self._closed:
                 return
             with self._lock:
-                if self._state[hid] == "dead":
+                # .get: a concurrent remove_host may retire entries
+                # mid-round — a vanished host simply isn't probed
+                if self._state.get(hid, "dead") == "dead":
                     continue
             self._probe(hid, plan)
 
     def _probe(self, hid: str, plan) -> None:
-        host = self._hosts[hid]
+        host = self._hosts.get(hid)
+        if host is None:
+            return  # retired mid-round
         torn = False
         try:
             maybe_fault(plan, "heartbeat")
@@ -1650,11 +2086,16 @@ class ServeFabric:
             payload = None  # includes TimeoutError: a miss, not a tear
         if payload is not None:
             with self._lock:
+                if hid not in self._state:
+                    return  # retired mid-probe
                 self._misses[hid] = 0
                 if self._state[hid] == "suspect":
                     self._state[hid] = "alive"
+            win = self._windows.get(hid)
+            if win is None:
+                return
             counters = dict(payload.get("counters") or {})
-            delta = self._windows[hid].feed(counters)
+            delta = win.feed(counters)
             # pending and wire occupancy are gauges: re-inject the raw
             # values after the window differences the payload
             delta["pending"] = counters.get("pending", 0)
@@ -1664,6 +2105,8 @@ class ServeFabric:
             return
         bump("heartbeat_misses")
         with self._lock:
+            if hid not in self._state:
+                return  # retired mid-probe
             self._misses[hid] += 1
             m = self._misses[hid]
             st = self._state[hid]
@@ -1676,37 +2119,95 @@ class ServeFabric:
 
     def _declare_dead(self, hid: str) -> None:
         with self._lock:
-            if self._state[hid] == "dead":
+            if self._state.get(hid, "dead") == "dead":
                 return
             self._state[hid] = "dead"
+            # claimed under the SAME lock acquisition that flips the
+            # state: remove_host of a corpse waits out _failing, so
+            # there must be no window where the state reads dead but
+            # the claim isn't visible yet
+            self._failing.add(hid)
         bump("hosts_died")
         self.load.forget(hid)
-        self._failover(hid)
+        try:
+            self._failover(hid)
+        finally:
+            with self._lock:
+                self._failing.discard(hid)
 
     # -- fail-over ------------------------------------------------------ #
 
     def _failover(self, hid: str) -> None:
-        """Re-home a dead host's sessions onto the survivors from its
-        last complete checkpoint. Bounded recovery: file reads + one
-        adopt RPC per target; sessions restore HOST-tier... adopted
-        eagerly here (small per-host share). Sids with no checkpoint
-        record are declared lost with a structured reason."""
+        """Re-home a dead host's sessions onto the survivors.
+
+        Two rails, re-point first (DESIGN §34): a sid whose live
+        standby holds a replica record at a generation ≥ the corpse's
+        latest snapshot generation is RE-POINTED — the standby adopts
+        from its own local replica store, zero cross-host reads (with
+        K ≥ 2 this is the whole fleet's fast path). Everything else
+        falls back to the §28 snapshot restore: read the corpse's
+        last complete checkpoint, group by rendezvous, one adopt RPC
+        per target. The generation gate is the coherence rule — a
+        standby whose push failed last round is STALE relative to the
+        durable snapshot and re-pointing to it would roll the session
+        back further than the documented one-interval bound, so it is
+        refused, not trusted. Sids with no record anywhere are
+        declared lost with a structured reason."""
         from conflux_tpu.engine import rendezvous
 
         t0 = time.perf_counter()
         with self._lock:
             owned = sorted((sid for sid, h in self._owners.items()
                             if h == hid), key=str)
-        snap = latest_checkpoint(self._hosts[hid].ckpt_dir)
+            reps = {sid: dict(self._replicas.get(sid, {}))
+                    for sid in owned}
+        handle = self._hosts.get(hid)
+        snap = (latest_checkpoint(handle.ckpt_dir)
+                if handle is not None else None)
+        snap_gen = _snapshot_gen(snap)
         have = checkpoint_sids(snap) if snap is not None else {}
         adopted: dict[Any, str] = {}
+        repointed: dict[Any, str] = {}
         lost: dict[Any, str] = {}
+
+        # rail 1: re-point to live standbys holding coherent replicas
+        live_set = set(self._live())
+        groups_rp: dict[str, list] = {}
         for sid in owned:
-            if sid not in have:
+            cands = sorted(
+                ((g, h) for h, g in reps[sid].items()
+                 if h in live_set and g >= snap_gen),
+                reverse=True)
+            if cands:
+                groups_rp.setdefault(cands[0][1], []).append(sid)
+        for tgt, sids in sorted(groups_rp.items()):
+            try:
+                out = self._hosts[tgt].adopt_replica(
+                    [[s, record_name(s)] for s in sids],
+                    timeout=self.policy.call_timeout)
+            except _TRANSPORT_ERRORS:
+                # standby dying too — its sids ride the snapshot rail
+                self._note_request_failure(tgt)
+                continue
+            for s, _gen in out.get("adopted", []):
+                repointed[s] = tgt
+                adopted[s] = tgt
+        if repointed:
+            with self._lock:
+                for s, tgt in repointed.items():
+                    self._replicas.get(s, {}).pop(tgt, None)
+            bump("fabric_replica_repoints", len(repointed))
+
+        # rail 2: §28 snapshot restore for everything not re-pointed
+        for sid in owned:
+            if sid not in adopted and sid not in have:
                 lost[sid] = (f"host {hid} died before {sid!r} was "
                              "ever checkpointed")
         excluded: set[str] = set()
-        remaining = [sid for sid in owned if sid in have]
+        remaining = [sid for sid in owned
+                     if sid in have and sid not in adopted]
+        if remaining:
+            bump("fabric_snapshot_restores", len(remaining))
         while remaining:
             live = [h for h in self._live() if h not in excluded]
             if not live:
@@ -1738,15 +2239,27 @@ class ServeFabric:
                 self._owners[sid] = tgt
             for sid, why in lost.items():
                 self._owners.pop(sid, None)
+                self._replicas.pop(sid, None)
                 self._lost[sid] = why
             dt = time.perf_counter() - t0
             self._recoveries.append(
                 {"host": hid, "seconds": dt, "adopted": len(adopted),
-                 "lost": len(lost),
+                 "repointed": len(repointed), "lost": len(lost),
                  "snapshot": os.path.basename(snap) if snap else None})
         bump("host_failovers")
         if adopted:
             bump("sessions_failed_over", len(adopted))
+        if adopted and self.policy.durable_open:
+            # re-adoption is re-admission: fold the moved sessions
+            # into each adopter's own fleet snapshot NOW (and re-seed
+            # their standbys) — otherwise an adopter death inside one
+            # checkpoint interval would lose the very sessions this
+            # fail-over just saved. After the recovery record: the
+            # measured recovery time is the adopt, not the re-arm.
+            for tgt in sorted(set(adopted.values())):
+                snap2 = self._checkpoint_host(tgt)
+                if snap2 is not None:
+                    self._push_replicas(tgt, snap2)
 
     # -- migration ------------------------------------------------------ #
 
@@ -1761,14 +2274,14 @@ class ServeFabric:
         BITWISE identically (the checkpoint contract). Returns the
         target host id."""
         hid, src = self._resolve(sid)
-        live = [h for h in self._alive() if h != hid]
-        if not live:
-            raise FleetDegraded(
-                f"no live migration target for {sid!r} (source {hid})",
-                retry_after=self._retry_hint(),
-                live=len(self._alive()), total=len(self._hosts))
         if target is None:
-            target = self.load.least_loaded(live)
+            target = self._pick_target(exclude={hid})
+            if target is None:
+                raise FleetDegraded(
+                    f"no live migration target for {sid!r} "
+                    f"(source {hid})",
+                    retry_after=self._retry_hint(),
+                    live=len(self._alive()), total=len(self._hosts))
         elif target == hid:
             raise ValueError(f"migrate target equals source {hid!r}")
         elif self.host_state(target) != "alive":
@@ -1811,9 +2324,59 @@ class ServeFabric:
             # migration is re-admission on the target: fold the moved
             # session into the target's own fleet snapshot NOW, or a
             # target death inside one checkpoint interval loses it
-            self._checkpoint_host(target)
+            snap = self._checkpoint_host(target)
+            if snap is not None:
+                # re-seed the moved session's standbys for the new
+                # primary (and retire standbys the new ranking drops)
+                self._push_replicas(target, snap)
         bump("sessions_migrated")
         return target
+
+    def rebalance(self, *, max_moves: int = 2, ratio: float = 2.0,
+                  floor: int = 4) -> list:
+        """One bounded background-rebalancing pass (DESIGN §34).
+
+        Skew detector + corrective storm: find the hottest alive host
+        by owned-session count; when it carries more than `ratio` ×
+        the alive-host mean (and at least `floor` sessions — tiny
+        fleets are never 'skewed'), live-migrate up to `max_moves` of
+        its sessions through :meth:`_pick_target` with the wire-
+        headroom requirement (a hot-host fix must not aim at a ≥90%
+        full wire). Everything else preserves the no-reshuffle
+        contract: only the hot host's sids move, at a bounded rate,
+        each over the §28 crash-safe migrate barrier. Returns the
+        sids moved. The :class:`~conflux_tpu.control.FabricAutoscaler`
+        calls this every tick; it is also a public one-shot knob."""
+        with self._lock:
+            per: dict[str, list] = {}
+            for sid, h in self._owners.items():
+                per.setdefault(h, []).append(sid)
+        alive = self._alive()
+        if len(alive) < 2:
+            return []
+        counts = {h: len(per.get(h, [])) for h in alive}
+        hot = max(alive, key=lambda h: (counts[h], h))
+        mean = sum(counts.values()) / len(alive)
+        if counts[hot] < floor or counts[hot] <= ratio * max(mean, 1e-9):
+            return []
+        moves = min(int(max_moves),
+                    max(1, counts[hot] - int(round(mean))))
+        moved: list = []
+        for sid in sorted(per[hot], key=str)[:moves]:
+            tgt = self._pick_target(exclude={hot},
+                                    require_wire_headroom=True)
+            if tgt is None:
+                break  # nobody has headroom: try again next tick
+            try:
+                self.migrate(sid, target=tgt)
+            # conflint: disable=CFX-EXCEPT an injected kill ends THIS rebalance tick (best-effort background bleed); the monitor owns the death
+            except (HostUnavailable, FleetDegraded, ValueError,
+                    KeyError, InjectedFault, InjectedKill):
+                break
+            moved.append(sid)
+        if moved:
+            bump("fabric_rebalance_migrations", len(moved))
+        return moved
 
     # -- checkpointing -------------------------------------------------- #
 
@@ -1831,10 +2394,89 @@ class ServeFabric:
         its heartbeat will deal with it)."""
         out: dict[str, str | None] = {}
         for hid in self._alive():
-            out[hid] = self._checkpoint_host(hid)
+            snap = self._checkpoint_host(hid)
+            out[hid] = snap
+            if snap is not None:
+                self._push_replicas(hid, snap)
         with self._lock:
             self._ckpt_rounds += 1
         return out
+
+    def _push_replicas(self, hid: str, snap: str) -> None:
+        """Seed/refresh standby replicas off one host snapshot
+        (DESIGN §34 K-replica placement, no-op at K=1).
+
+        For every sid the host owns, the next K-1 hosts on its
+        rendezvous-RANKED candidate list (owner excluded) receive a
+        local copy of its record, batched one `replicate` RPC per
+        standby, all tagged with the snapshot's generation — the
+        coherence token `_failover`'s re-point gate checks. Standbys
+        the new ranking drops (membership changed, session migrated)
+        get a best-effort `drop_replica`. Push failures are counted,
+        never fatal: the session stays durable via the primary
+        snapshot, and the stale standby is exactly what the
+        generation gate exists to refuse."""
+        if self.policy.replicas <= 1:
+            return
+        from conflux_tpu.engine import rendezvous_ranked
+
+        gen = _snapshot_gen(snap)
+        with self._lock:
+            owned = sorted((s for s, h in self._owners.items()
+                            if h == hid), key=str)
+        if not owned:
+            return
+        try:
+            have = checkpoint_sids(snap)
+        except (OSError, ValueError, KeyError):
+            return
+        cands = [h for h in self._live() if h != hid]
+        groups: dict[str, list] = {}
+        stale: dict[str, list] = {}
+        for sid in owned:
+            name = have.get(sid)
+            if name is None:
+                continue
+            standbys = rendezvous_ranked(
+                sid, cands, k=self.policy.replicas - 1)
+            with self._lock:
+                cur = self._replicas.setdefault(sid, {})
+                drop = [h for h in cur if h not in standbys]
+                for h in drop:
+                    cur.pop(h, None)
+            for h in drop:
+                stale.setdefault(h, []).append(name)
+            for tgt in standbys:
+                groups.setdefault(tgt, []).append((sid, name))
+        for tgt, pairs in sorted(groups.items()):
+            handle = self._hosts.get(tgt)
+            if handle is None:
+                continue
+            try:
+                maybe_fault(self._fault_plan(), "replicate")
+                handle.replicate(snap, [n for _, n in pairs], gen,
+                                 timeout=self.policy.call_timeout)
+            except _TRANSPORT_ERRORS:
+                self._note_request_failure(tgt)
+                bump("fabric_replica_push_failures", len(pairs))
+                continue
+            # conflint: disable=CFX-EXCEPT injected replicate fault: the standby simply stays a generation stale
+            except (InjectedFault, InjectedKill):
+                bump("fabric_replica_push_failures", len(pairs))
+                continue
+            with self._lock:
+                for sid, _n in pairs:
+                    self._replicas.setdefault(sid, {})[tgt] = gen
+            bump("fabric_replica_pushes", len(pairs))
+        for tgt, names in sorted(stale.items()):
+            handle = self._hosts.get(tgt)
+            if handle is None:
+                continue
+            try:
+                handle.drop_replica(names,
+                                    timeout=self.policy.call_timeout)
+            except _TRANSPORT_ERRORS:
+                pass  # hygiene only; the generation gate covers it
 
     def _checkpoint_host(self, hid: str) -> str | None:
         try:
@@ -1867,7 +2509,12 @@ class ServeFabric:
             recoveries = list(self._recoveries[-8:])
             out = {"hosts": hosts,
                    "sessions": len(self._owners),
+                   "admitted_sessions": self._admitted_sids,
                    "lost_sessions": len(self._lost),
+                   "closed_sessions": self._closed_sids,
+                   "retired_hosts": len(self._retired),
+                   "replicated_sessions": sum(
+                       1 for m in self._replicas.values() if m),
                    "checkpoint_rounds": self._ckpt_rounds,
                    "recoveries": recoveries}
         out["recovery_s_max"] = max(
@@ -1885,8 +2532,8 @@ def fabric_stats() -> dict:
     'health' sub-dict."""
     fabs = [f for f in list(_FABRICS) if not f._closed]
     out = {"fabrics": len(fabs), "hosts": 0, "hosts_alive": 0,
-           "hosts_suspect": 0, "hosts_dead": 0, "sessions": 0,
-           "lost_sessions": 0, "recovery_s_max": 0.0}
+           "hosts_suspect": 0, "hosts_dead": 0, "hosts_draining": 0,
+           "sessions": 0, "lost_sessions": 0, "recovery_s_max": 0.0}
     for f in fabs:
         s = f.stats()
         out["hosts"] += len(s["hosts"])

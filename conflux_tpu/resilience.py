@@ -520,7 +520,14 @@ FAULT_SITES = ("staging", "dispatch", "drain", "d2h", "solve", "refresh",
                "heartbeat", "route", "migrate", "host_kill",
                # the shm wire (DESIGN §31): alloc refusal + reader-side
                # integrity trips, injected in conflux_tpu/wire.py
-               "ring_full", "torn_segment", "stale_generation")
+               "ring_full", "torn_segment", "stale_generation",
+               # the elastic fabric (DESIGN §34): 'replicate' fires on the
+               # front's per-standby replica push (kinds 'crash'/'delay' —
+               # a failed push leaves the standby one generation stale,
+               # which the gen-coherence rule then refuses at fail-over;
+               # the drain storm itself is exercised via 'migrate', whose
+               # barrier remove_host rides unchanged).
+               "replicate")
 FAULT_KINDS = ("nan", "delay", "crash", "kill", "unhealthy")
 
 

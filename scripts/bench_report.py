@@ -40,7 +40,8 @@ _RATIO_KEYS = (
     "ratio_solves_vs_single_lane", "ratio_solves_vs_single_host",
     "speedup_vs_pickle_wire", "speedup_vs_bare_loop",
     "overhead_pct",
-    "single_speedup_vs_refactor", "speedup_vs_naive",
+    "single_speedup_vs_refactor", "speedup_vs_refactor_recovery",
+    "speedup_vs_naive",
     "speedup_vs_xla_trsm", "speedup_vs_staged_factor",
     "speedup_vs_all_f32",
     "transitions_won", "noqos_blowup_x",

@@ -25,7 +25,14 @@ A REAL multi-process drill, not a simulated one: the driver builds a
                       structural dead, and a worker that SIGKILLs
                       itself mid-ring-write must likewise fail over;
                       both times every session still answers bitwise
-                      and no /dev/shm segment leaks.
+                      and no /dev/shm segment leaks,
+  6. replica drill  — on a FRESH 3-process fabric with K=2 replica
+                      placement (DESIGN §34): SIGKILL a replicated
+                      worker and assert fail-over RE-POINTS — every
+                      recovered session adopts from its standby's
+                      LOCAL replica record (repointed == adopted >= 1,
+                      ZERO snapshot restores), nothing is lost, and
+                      the revived sessions answer bitwise.
 
     python scripts/fabric_drill.py DIR [--hosts 2] [--sessions 6]
                                        [--json OUT]
@@ -198,6 +205,9 @@ def drill(root: str, hosts: int, sessions: int) -> dict:
     # ---- 5. wire drill: torn ring records => structural death --------- #
     out["wire"] = wire_drill(os.path.join(root, "wire"), bad)
 
+    # ---- 6. replica drill: SIGKILL a K=2 host => re-point fail-over --- #
+    out["replica"] = replica_drill(os.path.join(root, "replica"), bad)
+
     out["failures"] = bad
     out["elapsed_s"] = round(time.perf_counter() - t_all, 3)
     return out
@@ -315,6 +325,96 @@ def wire_drill(root: str, bad: list[str]) -> dict:
     return info
 
 
+def replica_drill(root: str, bad: list[str]) -> dict:
+    """Phase 6 — the K=2 instant fail-over drill (ISSUE 19 / DESIGN
+    §34) on a REAL 3-process fabric: durable admission pushes every
+    session's checkpoint record to its rendezvous-ranked standby, so
+    when a worker is SIGKILLed the fail-over must RE-POINT — each
+    recovered session adopted from a LOCAL replica record on a
+    survivor, no cross-host snapshot read, zero snapshot restores —
+    and every revived session must answer bitwise."""
+    pol = FabricPolicy(heartbeat_interval=0.1, heartbeat_timeout=5.0,
+                       suspect_after=2, dead_after=4, replicas=2)
+    plan = FactorPlan.create((N, N), "float32", v=V)
+    fab = fabric.process_fabric(3, root, policy=pol,
+                                engine_kwargs={"max_batch_delay": 0.0})
+    info: dict = {}
+    with fab:
+        ids = [f"h{i}" for i in range(3)]
+        by_host: dict[str, list[str]] = {h: [] for h in ids}
+        i = 0
+        while min(len(v) for v in by_host.values()) < 2:
+            sid = f"rep-{i}"
+            by_host[rendezvous(sid, ids)].append(sid)
+            i += 1
+        sids = sorted(sum((v[:2] for v in by_host.values()), []))
+        mats, rhs, ref = {}, {}, {}
+        for i, sid in enumerate(sids):
+            mats[sid] = _mk(200 + i)
+            fab.open(sid, plan, mats[sid])
+            rhs[sid] = _rhs(200 + i)
+            ref[sid] = np.asarray(fab.solve(sid, rhs[sid]))
+        if fab.stats()["replicated_sessions"] != len(sids):
+            bad.append("replica drill: not every session replicated "
+                       f"({fab.stats()['replicated_sessions']} of "
+                       f"{len(sids)})")
+
+        restores0 = resilience.health_stats().get(
+            "fabric_snapshot_restores", 0)
+        victim = fab.owner_of(sids[0])
+        doomed = sorted(s for s in sids if fab.owner_of(s) == victim)
+        os.kill(fab._hosts[victim]._proc.pid, signal.SIGKILL)
+        deadline = time.perf_counter() + 30.0
+        while (fab.host_state(victim) != "dead"
+               and time.perf_counter() < deadline):
+            time.sleep(0.02)
+        if fab.host_state(victim) != "dead":
+            bad.append(f"replica drill: {victim} never declared dead")
+        deadline = time.perf_counter() + RECOVERY_BOUND_S
+        rec = [r for r in fab.stats()["recoveries"]
+               if r["host"] == victim]
+        while not rec and time.perf_counter() < deadline:
+            time.sleep(0.05)
+            rec = [r for r in fab.stats()["recoveries"]
+                   if r["host"] == victim]
+        if not rec:
+            bad.append("replica drill: no recovery recorded")
+        else:
+            r = rec[-1]
+            info["recovery"] = r
+            if r["lost"]:
+                bad.append(f"replica drill lost {r['lost']} sessions")
+            if not (r["repointed"] == r["adopted"] == len(doomed)
+                    and r["repointed"] >= 1):
+                bad.append("replica drill: fail-over was not a pure "
+                           f"re-point ({r['repointed']} repointed / "
+                           f"{r['adopted']} adopted / "
+                           f"{len(doomed)} owned)")
+        restores = resilience.health_stats().get(
+            "fabric_snapshot_restores", 0) - restores0
+        info["snapshot_restores"] = restores
+        if restores:
+            bad.append(f"replica drill fell back to {restores} "
+                       "snapshot restore(s) — re-point should not "
+                       "touch the corpse's snapshot")
+        for sid in sids:
+            got, _ = _answer_through_failover(
+                fab, sid, rhs[sid], bad, "replica")
+            if got is not None and not np.array_equal(got, ref[sid]):
+                bad.append(f"replica drill: post-re-point solve not "
+                           f"bitwise: {sid}")
+        st = fab.stats()
+        if st["sessions"] != len(sids):
+            bad.append(f"replica drill census {st['sessions']} != "
+                       f"{len(sids)}")
+        if st["lost_sessions"]:
+            bad.append("replica drill lost_sessions = "
+                       f"{st['lost_sessions']}")
+        info["victim"] = victim
+        info["sessions"] = st["sessions"]
+    return info
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser()
     ap.add_argument("dir", help="scratch root for checkpoints/sockets")
@@ -341,7 +441,11 @@ def main(argv=None) -> int:
           f"0 lost; wire drill torn_reply "
           f"{w['torn_reply']['recovery_s'] * 1e3:.0f}ms / die_mid_write "
           f"{w['die_mid_write']['recovery_s'] * 1e3:.0f}ms, "
-          f"{w['shm_leaks']} shm leaks; total {out['elapsed_s']:.1f}s")
+          f"{w['shm_leaks']} shm leaks; replica drill re-pointed "
+          f"{out['replica']['recovery']['repointed']} sessions in "
+          f"{out['replica']['recovery']['seconds'] * 1e3:.0f}ms with "
+          f"{out['replica']['snapshot_restores']} snapshot restores; "
+          f"total {out['elapsed_s']:.1f}s")
     return 0
 
 

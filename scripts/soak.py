@@ -1851,6 +1851,257 @@ def run_fabric_trial(seed: int) -> tuple[bool, str]:
                   f"injected={sum(wire_faults.injected.values())}")
 
 
+def run_elastic_trial(seed: int) -> tuple[bool, str]:
+    """One diurnal-wave chaos trial of the ELASTIC fabric (ISSUE 19).
+
+    A LocalHost fabric (2 seed hosts, K ∈ {1, 2} replica placement,
+    durable admission) rides a load wave up and back down while a
+    deterministic `FabricAutoscaler` (fake clock, one `step()` per
+    wave beat) grows and shrinks the host set, and the fabric fault
+    menu fires underneath: heartbeat crashes/delays, route crashes,
+    migrate crashes at the hand-off barrier, replica-push crashes
+    (the standby goes one generation stale — the coherence gate's
+    food) and whole-host kills. On top of the autoscaler's own
+    membership traffic the trial injects join/leave/kill storms:
+    random `add_host` joins, random drain-removals (an incomplete
+    drain must ABANDON, not half-apply) and abrupt kills.
+
+    Invariants: failures are STRUCTURED resilience errors only; every
+    session answers against its OWN f64 oracle (rollback-aware — a
+    fail-over may legally revive the last durable state, nothing
+    else); recovery windows END (bounded retries); the session census
+    is EXACTLY conserved through every join/leave/kill/drain/resize
+    (admitted == open + lost + closed) with durable admission making
+    lost == 0; and a removed/dead id never resurrects."""
+    import tempfile
+
+    from conflux_tpu import fabric as fabric_mod
+    from conflux_tpu import serve
+    from conflux_tpu.control import AutoscalePolicy, FabricAutoscaler
+    from conflux_tpu.engine import EngineSaturated
+    from conflux_tpu.fabric import FabricPolicy, LocalHost
+    from conflux_tpu.resilience import (
+        FaultPlan,
+        FaultSpec,
+        FleetDegraded,
+        HostUnavailable,
+        InjectedFault,
+    )
+
+    rng = np.random.default_rng(seed)
+    serve.clear_plans()
+    N = int(rng.choice([24, 32]))
+    K = int(rng.choice([1, 2]))
+    plan = serve.FactorPlan.create((N, N), jnp.float32, v=8)
+    menu = [
+        FaultSpec("heartbeat", "crash", prob=0.4,
+                  count=int(rng.integers(1, 4))),
+        FaultSpec("heartbeat", "delay", prob=0.3, delay_s=0.002,
+                  count=3),
+        FaultSpec("route", "crash", prob=0.4,
+                  count=int(rng.integers(1, 3))),
+        FaultSpec("migrate", "crash", prob=0.5, count=1),
+        FaultSpec("replicate", "crash", prob=0.6,
+                  count=int(rng.integers(1, 3))),
+        FaultSpec("host_kill", "kill", prob=0.5, count=1),
+    ]
+    picks = [m for m in menu if rng.integers(2)]
+    faults = FaultPlan(picks, seed=seed)
+    killful = any(f.site == "host_kill" for f in picks)
+    label = (f"seed={seed} elastic N={N} K={K} "
+             f"faults={[(f.site, f.kind) for f in picks]}")
+    ok_exc = (HostUnavailable, FleetDegraded, InjectedFault,
+              EngineSaturated)
+
+    def with_patience(fn, what, deadline_s=30.0):
+        t0 = time.time()
+        while True:
+            try:
+                return fn()
+            except ok_exc as e:
+                if time.time() - t0 > deadline_s:
+                    raise TimeoutError(
+                        f"{what} never recovered: {e}")
+                time.sleep(min(0.05, max(0.01,
+                                         getattr(e, "retry_after",
+                                                 0.0))))
+
+    pol = FabricPolicy(heartbeat_interval=0.02, heartbeat_timeout=1.0,
+                       suspect_after=2, dead_after=3, replicas=K)
+    answered = joins = leaves = kills = abandons = rollbacks = 0
+    opened = closed = 0
+    with tempfile.TemporaryDirectory() as tmp:
+        fab = fabric_mod.local_fabric(
+            2, tmp, policy=pol, fault_plan=faults,
+            engine_kwargs={"max_batch_delay": 0.0})
+
+        def provider(hid):
+            return LocalHost(hid, os.path.join(tmp, hid),
+                             engine_kwargs={"max_batch_delay": 0.0})
+
+        # util = sessions/host / 4 under this capacity model, so the
+        # wave's peak (~5 sids/host) forces scale-out and its trough
+        # (<1 sid/host) forces drain-and-shrink
+        auto = FabricAutoscaler(fab, provider, policy=AutoscalePolicy(
+            min_hosts=2, max_hosts=4, low_water=0.25, high_water=0.8,
+            sustain=2, cooldown=3.0, bytes_per_session=525e3,
+            host_bytes=4 * 525e3,
+            max_rebalance_moves=2, rebalance_floor=3,
+            rebalance_ratio=1.5))
+        clock = 0.0
+        try:
+            with fab:
+                As, pre, rhs = {}, {}, {}
+
+                def admit(i):
+                    nonlocal opened
+                    sid = f"el-{seed}-{i}"
+                    A = (rng.standard_normal((N, N)) / np.sqrt(N)
+                         + 2.0 * np.eye(N)).astype(np.float32)
+                    with_patience(lambda: fab.open(sid, plan, A),
+                                  f"admission of {sid}")
+                    As[sid] = pre[sid] = A.astype(np.float64)
+                    rhs[sid] = rng.standard_normal(
+                        (N, int(rng.choice([1, 2])))).astype(
+                            np.float32)
+                    opened += 1
+                    if rng.integers(3) == 0:  # drift (oracle tracks)
+                        k = int(rng.integers(1, 3))
+                        U = (0.01 * rng.standard_normal((N, k))
+                             ).astype(np.float32)
+                        Vm = (0.01 * rng.standard_normal((N, k))
+                              ).astype(np.float32)
+                        try:
+                            fab.update(sid, U, Vm)
+                            As[sid] = (As[sid]
+                                       + U.astype(np.float64)
+                                       @ Vm.astype(np.float64).T)
+                        except ok_exc:
+                            pass
+                    return sid
+
+                def check(sid):
+                    nonlocal answered, rollbacks
+                    b = rhs[sid]
+                    x = with_patience(lambda: np.asarray(
+                        fab.solve(sid, b)), f"solve of {sid}")
+                    want = np.linalg.solve(As[sid],
+                                           b.astype(np.float64))
+                    err = (np.linalg.norm(x - want)
+                           / max(np.linalg.norm(want), 1e-30))
+                    if not (err < 1e-3):
+                        wpre = np.linalg.solve(pre[sid],
+                                               b.astype(np.float64))
+                        epre = (np.linalg.norm(x - wpre)
+                                / max(np.linalg.norm(wpre), 1e-30))
+                        if epre < 1e-3:
+                            # fail-over revived the last durable
+                            # state: legal rollback, now authoritative
+                            As[sid] = pre[sid]
+                            rollbacks += 1
+                        else:
+                            raise AssertionError(
+                                f"{sid} off its own oracle "
+                                f"({err:.2e}) — cross-host "
+                                "corruption?")
+                    answered += 1
+
+                def chaos():
+                    nonlocal joins, leaves, kills, abandons
+                    arm = int(rng.integers(5))
+                    hosts = sorted(fab.stats()["hosts"])
+                    alive = [h for h in hosts
+                             if fab.host_state(h) == "alive"]
+                    if arm == 0:  # join storm
+                        hid = f"j{seed % 1000}-{joins}"
+                        fab.add_host(provider(hid))
+                        joins += 1
+                    elif arm == 1 and len(alive) > 2:  # drain-leave
+                        victim = alive[int(rng.integers(len(alive)))]
+                        try:
+                            fab.remove_host(victim)
+                            leaves += 1
+                        except (HostUnavailable, FleetDegraded,
+                                ValueError, KeyError):
+                            abandons += 1  # abandoned, never half-done
+                    elif arm == 2 and len(alive) > 2:  # abrupt kill
+                        victim = alive[int(rng.integers(len(alive)))]
+                        fab._hosts[victim].kill()
+                        kills += 1
+
+                # ---- the diurnal wave ----------------------------- #
+                sids: list = []
+                peak = int(rng.integers(8, 12))
+                for i in range(peak):          # morning ramp
+                    sids.append(admit(i))
+                    if rng.integers(2):
+                        check(sids[int(rng.integers(len(sids)))])
+                    auto.step(now=clock)
+                    clock += 1.0
+                chaos()
+                for _ in range(4):             # midday plateau
+                    for sid in sids:
+                        check(sid)
+                    auto.step(now=clock)
+                    clock += 1.0
+                    chaos()
+                rng.shuffle(sids)
+                while len(sids) > 2:           # evening recede
+                    sid = sids.pop()
+                    with_patience(lambda: fab.close_session(sid),
+                                  f"close of {sid}")
+                    closed += 1
+                    del As[sid], pre[sid], rhs[sid]
+                    auto.step(now=clock)
+                    clock += 1.0
+                for _ in range(6):             # night: shrink beats
+                    for sid in sids:
+                        check(sid)
+                    auto.step(now=clock)
+                    clock += 1.0
+
+                # ---- conservation + zero-lost gates --------------- #
+                st = fab.stats()
+                if (st["admitted_sessions"] != st["sessions"]
+                        + st["lost_sessions"] + st["closed_sessions"]):
+                    return False, (f"{label}: census identity broken "
+                                   f"({st['admitted_sessions']} != "
+                                   f"{st['sessions']}+"
+                                   f"{st['lost_sessions']}+"
+                                   f"{st['closed_sessions']})")
+                if st["sessions"] != len(sids) or st["closed_sessions"] != closed:
+                    return False, (f"{label}: census drifted from the "
+                                   f"trial's own ledger "
+                                   f"({st['sessions']} open != "
+                                   f"{len(sids)} or "
+                                   f"{st['closed_sessions']} closed "
+                                   f"!= {closed})")
+                if st["lost_sessions"]:
+                    return False, (f"{label}: elastic churn lost "
+                                   f"{st['lost_sessions']} sessions")
+                deaths = sum(1 for h in st["hosts"].values()
+                             if h["state"] == "dead")
+                if deaths > kills + (1 if killful else 0):
+                    return False, (f"{label}: {deaths} deaths exceed "
+                                   f"{kills} explicit + injected "
+                                   "kills")
+                for sid in sids:
+                    check(sid)
+                ast = auto.stats()
+        finally:
+            fab.close()
+
+    return True, (f"{label}: ok {answered} solves, {opened} opened, "
+                  f"{closed} closed, {rollbacks} rollbacks; "
+                  f"membership {joins} joins, {leaves} leaves, "
+                  f"{kills} kills, {abandons} abandoned drains; "
+                  f"autoscaler out={ast['scale_out']} "
+                  f"in={ast['scale_in']} "
+                  f"rebalanced={ast['rebalanced']} "
+                  f"ticks={ast['ticks']}; "
+                  f"injected={sum(faults.injected.values())}")
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser()
     ap.add_argument("--trials", type=int, default=200)
@@ -1945,6 +2196,17 @@ def main(argv=None) -> int:
                     "per-request f64 oracle answers (zero cross-"
                     "tenant corruption), coherent per-class counters "
                     "and a fully drained ledger after close()")
+    ap.add_argument("--elastic", action="store_true",
+                    help="chaos-soak the ELASTIC fabric (DESIGN §34): "
+                    "diurnal load waves served by a LocalHost fleet "
+                    "whose host set expands and contracts under a "
+                    "deterministic FabricAutoscaler while join/leave/"
+                    "kill/drain storms and the fabric+replicate fault "
+                    "menu fire; asserts structured failures only, "
+                    "rollback-aware per-session f64 oracles, EXACT "
+                    "census conservation (admitted == open + lost + "
+                    "closed), zero lost sessions and no id "
+                    "resurrection")
     ap.add_argument("--lockcheck", action="store_true",
                     help="run trials under the conflint runtime "
                     "lock-order harness (conflux_tpu.analysis."
@@ -1953,7 +2215,8 @@ def main(argv=None) -> int:
                     "cycle or lock-held-across-dispatch fails the soak")
     args = ap.parse_args(argv)
 
-    trial = (run_mesh_trial if args.mesh
+    trial = (run_elastic_trial if args.elastic
+             else run_mesh_trial if args.mesh
              else run_precision_trial if args.precision
              else run_qos_trial if args.qos
              else run_fabric_trial if args.fabric
