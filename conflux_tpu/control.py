@@ -1366,8 +1366,15 @@ class AutoscalePolicy:
         working set (BENCH_WORKINGSET: ~525 KB/session for the
         default serve shapes; re-seed from your own artifact).
     host_bytes: per-host session-state budget the memory axis fills.
-    drain_capacity_per_s: optional per-host solve-rate capacity for
-        the `qos_drain_per_s` axis; 0 disables it (memory axis only).
+    drain_capacity_per_s: per-host solve-rate capacity for the
+        `qos_drain_per_s` axis; 0 disables it (memory axis only).
+        Seeded from the measured bench drain numbers the same way
+        the memory axis rides BENCH_WORKINGSET: BENCH_QOS's bulk
+        overload leg drains ~1348 solves/s and BENCH_ADAPTIVE's
+        burst leg ~1253/s per host at the default serve shapes —
+        the default takes the conservative burst figure, so
+        sustained qos pressure (not just memory) can trigger
+        growth. Re-seed from your own artifact for other shapes.
     rebalance_ratio / rebalance_floor / max_rebalance_moves: the
         hot-host skew detector forwarded to `ServeFabric.rebalance`
         every tick (bounded background correction, independent of the
@@ -1383,7 +1390,7 @@ class AutoscalePolicy:
     cooldown: float = 5.0
     bytes_per_session: float = 525e3
     host_bytes: float = 64e6
-    drain_capacity_per_s: float = 0.0
+    drain_capacity_per_s: float = 1250.0
     rebalance_ratio: float = 2.0
     rebalance_floor: int = 4
     max_rebalance_moves: int = 2
@@ -1396,7 +1403,8 @@ class AutoscalePolicy:
         if self.sustain < 1 or self.interval <= 0:
             raise ValueError("sustain must be >= 1 and interval > 0")
         if self.cooldown < 0 or self.bytes_per_session <= 0 \
-                or self.host_bytes <= 0:
+                or self.host_bytes <= 0 \
+                or self.drain_capacity_per_s < 0:
             raise ValueError("cooldown >= 0 and positive capacity "
                              "model required")
 

@@ -2864,7 +2864,8 @@ class ServeEngine:
     # durable checkpoint / warm restart (DESIGN §23)
     # ------------------------------------------------------------------ #
 
-    def checkpoint(self, path: str, sessions=None, names=None) -> dict:
+    def checkpoint(self, path: str, sessions=None, names=None, *,
+                   base=None, gen=None, full=True) -> dict:
         """Snapshot the served fleet to `path` at a drain barrier.
 
         Admission holds (both `on_full` policies block briefly) while
@@ -2875,7 +2876,13 @@ class ServeEngine:
         spilled records serialize in place; `conflux_tpu.tier.
         save_fleet`). `sessions` defaults to the attached residency's
         fleet. Restored sessions (`restore`) solve BITWISE identically
-        to their pre-checkpoint selves. Returns {name: record dir}."""
+        to their pre-checkpoint selves. Returns {name: record dir}.
+
+        `base`/`gen`/`full` pass through to `tier.save_fleet`'s
+        incremental mode (DESIGN §35): against a previous generation
+        dir, clean sessions (dirty clock unchanged) carry as
+        references (``full=False``) or byte-identical local copies
+        (``full=True`` compaction) instead of re-serializing."""
         if sessions is None and self.residency is None:
             raise ValueError(
                 "checkpoint() needs sessions= when the engine has "
@@ -2896,7 +2903,8 @@ class ServeEngine:
                     # adopted while we queued behind an earlier
                     # checkpoint still make this snapshot
                     sessions = self.residency.sessions()
-                return tier.save_fleet(path, sessions, names)
+                return tier.save_fleet(path, sessions, names,
+                                       base=base, gen=gen, full=full)
             finally:
                 with self._lock:
                     self._draining = False

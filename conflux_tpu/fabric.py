@@ -194,6 +194,23 @@ def checkpoint_sids(snapshot: str) -> dict[Any, str]:
             if e.get("sid") is not None}
 
 
+def checkpoint_manifest(snapshot: str) -> dict[Any, tuple[str, int]]:
+    """{sid: (record name, record WRITE generation)} for a fleet
+    snapshot — the name for adoption, the generation for the §35
+    dirty gates: replica pushes skip standbys already holding the
+    record's exact bytes, and fail-over's re-point gate refuses only
+    genuinely stale standbys (a session unchanged since generation g
+    is coherent on any standby pushed at ≥ g, however many delta
+    generations have passed). Format-1 entries (no per-record gen)
+    report the snapshot's own generation — the pre-§35 conservative
+    gate, bitwise the old behavior."""
+    with open(os.path.join(snapshot, "fleet.json")) as f:
+        fleet = json.load(f)
+    default = int(fleet.get("gen", _snapshot_gen(snapshot)))
+    return {e["sid"]: (e["name"], int(e.get("gen", default)))
+            for e in fleet["sessions"] if e.get("sid") is not None}
+
+
 # --------------------------------------------------------------------------- #
 # policy
 # --------------------------------------------------------------------------- #
@@ -215,6 +232,14 @@ class FabricPolicy:
         explicit checkpoints). Bounds fail-over staleness to one
         interval of drift updates.
     checkpoint_keep: completed snapshot generations kept per host.
+    checkpoint_compact_every: delta-checkpoint cadence (DESIGN §35) —
+        every Nth generation is a self-contained full compaction;
+        the generations between carry clean (unmutated) sessions as
+        references into earlier generations, so a steady-state
+        checkpoint costs O(dirty sessions), not O(fleet). <= 1 makes
+        every generation full (the pre-§35 behavior). Disk held
+        grows with the reference chain: worst case
+        checkpoint_keep + checkpoint_compact_every generations.
     durable_open: checkpoint the owning host synchronously after every
         `open` — every admitted session is recoverable from birth (the
         soak's session-count conservation oracle). Costs one fleet
@@ -244,6 +269,7 @@ class FabricPolicy:
     call_timeout: float = 120.0
     checkpoint_interval: float = 0.0
     checkpoint_keep: int = 2
+    checkpoint_compact_every: int = 8
     durable_open: bool = True
     min_live: int = 1
     breaker_threshold: int = 3
@@ -267,6 +293,8 @@ class FabricPolicy:
         if self.checkpoint_interval < 0 or self.checkpoint_keep < 1:
             raise ValueError("checkpoint_interval must be >= 0 and "
                              "checkpoint_keep >= 1")
+        if self.checkpoint_compact_every < 0:
+            raise ValueError("checkpoint_compact_every must be >= 0")
 
 
 # --------------------------------------------------------------------------- #
@@ -286,6 +314,11 @@ class _HostCore:
         os.makedirs(ckpt_dir, exist_ok=True)
         self.eng = engine
         self.ckpt_keep = 2
+        # delta-checkpoint cadence (DESIGN §35): every Nth generation
+        # is a self-contained compaction; the rest carry clean
+        # sessions as references into earlier generations. <=1 means
+        # every generation is full (the pre-§35 behavior).
+        self.ckpt_compact_every = 8
         self._lock = threading.Lock()
         self._registry: dict = {}  # guarded-by: _lock — sid -> session
         self._ckpt_seq = 0         # guarded-by: _lock
@@ -389,25 +422,60 @@ class _HostCore:
     def checkpoint(self) -> str:
         """Snapshot the whole registry at the engine's drain barrier
         into a fresh generation dir, flip LATEST, prune old
-        generations. Returns the snapshot dir."""
+        generations. Returns the snapshot dir.
+
+        Incremental (DESIGN §35): against the previous LATEST, clean
+        sessions (dirty clock unchanged since their last record) are
+        carried as single-hop references instead of re-serialized, so
+        a steady-state generation costs O(dirty) d2h/IO. Every
+        `ckpt_compact_every`-th generation is a full compaction
+        (byte-identical local copies, no d2h) so reference chains stay
+        bounded and pruning can retire old generations."""
         with self._lock:
             items = sorted(self._registry.items(), key=lambda kv: str(kv[0]))
             seq = self._ckpt_seq
             self._ckpt_seq += 1
+        base = latest_checkpoint(self.ckpt_dir)
+        every = int(self.ckpt_compact_every)
+        full = base is None or every <= 1 or (seq % every == 0)
         dest = os.path.join(self.ckpt_dir, f"fleet-{seq:06d}")
         self.eng.checkpoint(dest, sessions=[s for _, s in items],
-                            names=[record_name(sid) for sid, _ in items])
+                            names=[record_name(sid) for sid, _ in items],
+                            base=base, gen=seq, full=full)
         _write_latest(self.ckpt_dir, dest)
         self._prune()
         return dest
 
     def _prune(self) -> None:
+        # reference-aware (DESIGN §35): a delta generation's carried
+        # records physically live in OLDER generation dirs. Keep the
+        # newest `ckpt_keep` generations plus every generation a kept
+        # fleet.json references, so pruning never strands a record a
+        # restorable snapshot still needs.
         keep = self.ckpt_keep
         gens = sorted(d for d in os.listdir(self.ckpt_dir)
                       if d.startswith("fleet-"))
-        for d in gens[:-keep]:
-            shutil.rmtree(os.path.join(self.ckpt_dir, d),
-                          ignore_errors=True)
+        kept = set(gens[-keep:])
+        frontier = sorted(kept)
+        while frontier:
+            d = frontier.pop()
+            try:
+                with open(os.path.join(self.ckpt_dir, d,
+                                       "fleet.json")) as f:
+                    entries = json.load(f)["sessions"]
+            except (OSError, ValueError, KeyError):
+                continue  # unreadable gen: keeps nothing extra
+            for e in entries:
+                parts = os.path.normpath(e.get("dir", "")).split(os.sep)
+                if (len(parts) >= 2 and parts[0] == ".."
+                        and parts[1].startswith("fleet-")
+                        and parts[1] not in kept):
+                    kept.add(parts[1])
+                    frontier.append(parts[1])
+        for d in gens:
+            if d not in kept:
+                shutil.rmtree(os.path.join(self.ckpt_dir, d),
+                              ignore_errors=True)
 
     def adopt(self, src: str, names: list[str]) -> list:
         """Restore a `names` subset of another host's snapshot into
@@ -460,8 +528,14 @@ class _HostCore:
             tmp = os.path.join(rep_root, f"{name}.tmp")
             shutil.rmtree(tmp, ignore_errors=True)
             os.makedirs(tmp)
-            shutil.copytree(os.path.join(src, e["dir"]),
-                            os.path.join(tmp, e["dir"]))
+            # resolve through delta references (a carried entry's dir
+            # points into an older generation — DESIGN §35) and store
+            # the record under its own name, so the replica fleet is
+            # self-contained whatever the source entry's shape
+            shutil.copytree(os.path.normpath(
+                os.path.join(src, e["dir"])),
+                os.path.join(tmp, name))
+            e = {**e, "dir": name}
             with open(os.path.join(tmp, "fleet.json"), "w") as f:
                 json.dump({"format": 1, "gen": int(gen),
                            "sessions": [e]}, f)
@@ -1560,6 +1634,17 @@ class ServeFabric:
         self._state = {h: "alive" for h in self._hosts}  # guarded-by: _lock
         self._misses = {h: 0 for h in self._hosts}       # guarded-by: _lock
         self._owners: dict[Any, str] = {}                # guarded-by: _lock
+        # inverted ownership index + capacity pricing (DESIGN §35):
+        # `_owned[hid]` mirrors `_owners` per host, `_sid_cost` is the
+        # session's qos.request_cost weight fixed at admission, and
+        # `_host_cost[hid]` the per-host sum — so fail-over, drain,
+        # replica pushes and the rebalancer read a host's load in
+        # O(owned)/O(hosts) instead of scanning the fleet-wide map,
+        # and a large-N mesh tenant weighs as the capacity it
+        # actually consumes (ISSUE 20 satellite).
+        self._owned: dict[str, set] = {}                 # guarded-by: _lock
+        self._sid_cost: dict[Any, float] = {}            # guarded-by: _lock
+        self._host_cost: dict[str, float] = {}           # guarded-by: _lock
         self._lost: dict[Any, str] = {}                  # guarded-by: _lock
         self._recoveries: list[dict] = []                # guarded-by: _lock
         self._mig_seq = 0                                # guarded-by: _lock
@@ -1601,6 +1686,8 @@ class ServeFabric:
             h.start()
             if isinstance(h, LocalHost):
                 h.core.ckpt_keep = self.policy.checkpoint_keep
+                h.core.ckpt_compact_every = \
+                    self.policy.checkpoint_compact_every
         self._hb_thread = threading.Thread(
             target=self._hb_loop, daemon=True, name="fabric-heartbeat")
         self._hb_thread.start()
@@ -1654,14 +1741,42 @@ class ServeFabric:
         with self._lock:
             return self._owners.get(sid)
 
+    # requires-lock: _lock
+    def _own(self, sid, hid: str) -> None:
+        """Single writer for the ownership map: keeps `_owned` and the
+        per-host cost gauge in lockstep with `_owners` (DESIGN §35) —
+        every ownership change MUST route through here or `_disown`."""
+        old = self._owners.get(sid)
+        c = self._sid_cost.get(sid, 1.0)
+        if old is not None:
+            s = self._owned.get(old)
+            if s is not None:
+                s.discard(sid)
+            self._host_cost[old] = self._host_cost.get(old, 0.0) - c
+        self._owners[sid] = hid
+        self._owned.setdefault(hid, set()).add(sid)
+        self._host_cost[hid] = self._host_cost.get(hid, 0.0) + c
+
+    # requires-lock: _lock
+    def _disown(self, sid) -> None:
+        """Retire a session from the ownership map + index (close,
+        loss, voided admission). Drops its cost entry — a re-admission
+        re-prices at open."""
+        hid = self._owners.pop(sid, None)
+        c = self._sid_cost.pop(sid, 1.0)
+        if hid is None:
+            return
+        s = self._owned.get(hid)
+        if s is not None:
+            s.discard(sid)
+        self._host_cost[hid] = self._host_cost.get(hid, 0.0) - c
+
     def owner_census(self) -> dict[str, int]:
         """{host id: owned-session count} — the autoscaler's memory
-        axis and the rebalancer's skew input."""
+        axis and the rebalancer's skew input. O(hosts) off the
+        inverted index, not O(fleet)."""
         with self._lock:
-            per: dict[str, int] = {}
-            for _sid, h in self._owners.items():
-                per[h] = per.get(h, 0) + 1
-            return per
+            return {h: len(s) for h, s in self._owned.items() if s}
 
     def taken_ids(self) -> set[str]:
         """Every host id that would be refused by :meth:`add_host` —
@@ -1762,8 +1877,7 @@ class ServeFabric:
         moved: list = []
         if drain:
             with self._lock:
-                owned = sorted((s for s, h in self._owners.items()
-                                if h == host_id), key=str)
+                owned = sorted(self._owned.get(host_id) or (), key=str)
             for sid in owned:
                 try:
                     self.migrate(sid)
@@ -1773,8 +1887,7 @@ class ServeFabric:
                     continue  # undrained: stays on the live source
                 moved.append(sid)
         with self._lock:
-            undrained = sorted((s for s, h in self._owners.items()
-                                if h == host_id), key=str)
+            undrained = sorted(self._owned.get(host_id) or (), key=str)
             died = self._state.get(host_id) == "dead"
         if undrained and not died:
             # put the host back in service; the caller retries
@@ -1887,7 +2000,12 @@ class ServeFabric:
                 f"host {hid} unreachable during open: {e}",
                 retry_after=self._retry_hint(), host=hid) from e
         with self._lock:
-            self._owners[sid] = hid
+            # price the tenant once at admission: the rebalancer and
+            # autoscaler weigh this session by the capacity its shape
+            # actually consumes (qos.request_cost, DESIGN §32)
+            self._sid_cost[sid] = qos_mod.request_cost(
+                tuple(spec["shape"]))
+            self._own(sid, hid)
         if self.policy.durable_open:
             snap = self._checkpoint_host(hid)
             if snap is not None:
@@ -1903,7 +2021,7 @@ class ServeFabric:
                 # durable_open admits a session that the very next
                 # fail-over must declare lost.
                 with self._lock:
-                    self._owners.pop(sid, None)
+                    self._disown(sid)
                 try:
                     host.drop(sid, timeout=self.policy.call_timeout)
                 except _TRANSPORT_ERRORS:
@@ -2024,7 +2142,7 @@ class ServeFabric:
                 f"host {hid} unreachable during close({sid!r}): {e}",
                 retry_after=self._retry_hint(), host=hid) from e
         with self._lock:
-            self._owners.pop(sid, None)
+            self._disown(sid)
             reps = self._replicas.pop(sid, None) or {}
             self._closed_sids += 1
         name = record_name(sid)
@@ -2157,26 +2275,33 @@ class ServeFabric:
 
         t0 = time.perf_counter()
         with self._lock:
-            owned = sorted((sid for sid, h in self._owners.items()
-                            if h == hid), key=str)
+            owned = sorted(self._owned.get(hid) or (), key=str)
             reps = {sid: dict(self._replicas.get(sid, {}))
                     for sid in owned}
         handle = self._hosts.get(hid)
         snap = (latest_checkpoint(handle.ckpt_dir)
                 if handle is not None else None)
         snap_gen = _snapshot_gen(snap)
-        have = checkpoint_sids(snap) if snap is not None else {}
+        manifest = checkpoint_manifest(snap) if snap is not None else {}
+        have = {sid: nm for sid, (nm, _g) in manifest.items()}
         adopted: dict[Any, str] = {}
         repointed: dict[Any, str] = {}
         lost: dict[Any, str] = {}
 
-        # rail 1: re-point to live standbys holding coherent replicas
+        # rail 1: re-point to live standbys holding coherent replicas.
+        # The coherence bar is the RECORD's write generation (§35): a
+        # session clean since generation g is current on any standby
+        # pushed at ≥ g — delta checkpoints and skipped clean pushes
+        # never widen the staleness bound. Sids absent from the
+        # snapshot fall back to the snapshot-generation bar.
         live_set = set(self._live())
         groups_rp: dict[str, list] = {}
         for sid in owned:
+            ent = manifest.get(sid)
+            need = ent[1] if ent is not None else snap_gen
             cands = sorted(
                 ((g, h) for h, g in reps[sid].items()
-                 if h in live_set and g >= snap_gen),
+                 if h in live_set and g >= need),
                 reverse=True)
             if cands:
                 groups_rp.setdefault(cands[0][1], []).append(sid)
@@ -2236,9 +2361,9 @@ class ServeFabric:
                         adopted[s] = tgt
         with self._lock:
             for sid, tgt in adopted.items():
-                self._owners[sid] = tgt
+                self._own(sid, tgt)
             for sid, why in lost.items():
-                self._owners.pop(sid, None)
+                self._disown(sid)
                 self._replicas.pop(sid, None)
                 self._lost[sid] = why
             dt = time.perf_counter() - t0
@@ -2314,7 +2439,7 @@ class ServeFabric:
                 f"stays on {hid}", retry_after=self._retry_hint(),
                 host=target) from e
         with self._lock:
-            self._owners[sid] = target
+            self._own(sid, target)
         try:
             src.drop(sid, timeout=self.policy.call_timeout)
         except _TRANSPORT_ERRORS:
@@ -2337,32 +2462,47 @@ class ServeFabric:
         """One bounded background-rebalancing pass (DESIGN §34).
 
         Skew detector + corrective storm: find the hottest alive host
-        by owned-session count; when it carries more than `ratio` ×
-        the alive-host mean (and at least `floor` sessions — tiny
-        fleets are never 'skewed'), live-migrate up to `max_moves` of
-        its sessions through :meth:`_pick_target` with the wire-
-        headroom requirement (a hot-host fix must not aim at a ≥90%
-        full wire). Everything else preserves the no-reshuffle
-        contract: only the hot host's sids move, at a bounded rate,
-        each over the §28 crash-safe migrate barrier. Returns the
-        sids moved. The :class:`~conflux_tpu.control.FabricAutoscaler`
-        calls this every tick; it is also a public one-shot knob."""
-        with self._lock:
-            per: dict[str, list] = {}
-            for sid, h in self._owners.items():
-                per.setdefault(h, []).append(sid)
+        by owned CAPACITY COST (each session weighted by its
+        `qos.request_cost` at admission — one large-N mesh tenant
+        counts as the capacity it actually consumes, ISSUE 20
+        satellite; with uniform shapes this reduces exactly to the
+        former session-count greed). When the hot host carries more
+        than `ratio` × the alive-host mean cost (and at least `floor`
+        sessions — tiny fleets are never 'skewed'), live-migrate up to
+        `max_moves` of its costliest sessions through
+        :meth:`_pick_target` with the wire-headroom requirement (a
+        hot-host fix must not aim at a ≥90% full wire). Everything
+        else preserves the no-reshuffle contract: only the hot host's
+        sids move, at a bounded rate, each over the §28 crash-safe
+        migrate barrier. Returns the sids moved. The
+        :class:`~conflux_tpu.control.FabricAutoscaler` calls this
+        every tick; it is also a public one-shot knob. Census reads
+        ride the inverted `_owned` index — O(hosts + hot-host owned),
+        not O(fleet) (DESIGN §35)."""
         alive = self._alive()
         if len(alive) < 2:
             return []
-        counts = {h: len(per.get(h, [])) for h in alive}
-        hot = max(alive, key=lambda h: (counts[h], h))
-        mean = sum(counts.values()) / len(alive)
-        if counts[hot] < floor or counts[hot] <= ratio * max(mean, 1e-9):
+        with self._lock:
+            counts = {h: len(self._owned.get(h) or ()) for h in alive}
+            costs = {h: self._host_cost.get(h, 0.0) for h in alive}
+            hot = max(alive, key=lambda h: (costs[h], counts[h], h))
+            # costliest first; str(sid) tie-break keeps uniform-cost
+            # fleets on the former deterministic victim order
+            victims = sorted(
+                self._owned.get(hot) or (),
+                key=lambda s: (-self._sid_cost.get(s, 1.0), str(s)))
+            vcost = {s: self._sid_cost.get(s, 1.0) for s in victims}
+        mean = sum(costs.values()) / len(alive)
+        if counts[hot] < floor or costs[hot] <= ratio * max(mean, 1e-9):
             return []
-        moves = min(int(max_moves),
-                    max(1, counts[hot] - int(round(mean))))
+        excess = costs[hot] - mean
+        moved_cost = 0.0
         moved: list = []
-        for sid in sorted(per[hot], key=str)[:moves]:
+        for sid in victims:
+            if len(moved) >= int(max_moves):
+                break
+            if moved and moved_cost >= excess:
+                break  # enough capacity moved to reach the mean
             tgt = self._pick_target(exclude={hot},
                                     require_wire_headroom=True)
             if tgt is None:
@@ -2374,6 +2514,7 @@ class ServeFabric:
                     KeyError, InjectedFault, InjectedKill):
                 break
             moved.append(sid)
+            moved_cost += vcost[sid]
         if moved:
             bump("fabric_rebalance_migrations", len(moved))
         return moved
@@ -2410,33 +2551,39 @@ class ServeFabric:
         rendezvous-RANKED candidate list (owner excluded) receive a
         local copy of its record, batched one `replicate` RPC per
         standby, all tagged with the snapshot's generation — the
-        coherence token `_failover`'s re-point gate checks. Standbys
-        the new ranking drops (membership changed, session migrated)
-        get a best-effort `drop_replica`. Push failures are counted,
-        never fatal: the session stays durable via the primary
-        snapshot, and the stale standby is exactly what the
-        generation gate exists to refuse."""
+        coherence token `_failover`'s re-point gate checks. Two §35
+        scale rails: the push set is DIRTY-ONLY (a standby whose last
+        accepted generation is ≥ the record's write generation already
+        holds those exact bytes — clean sessions cost zero wire), and
+        the per-standby RPCs dispatch CONCURRENTLY, mirroring how
+        fail-over batches `adopt_replica` — a push round costs one
+        slowest-standby round trip, not the sum. Standbys the new
+        ranking drops (membership changed, session migrated) get a
+        best-effort `drop_replica`. Push failures are counted, never
+        fatal: the session stays durable via the primary snapshot,
+        and the stale standby is exactly what the generation gate
+        exists to refuse."""
         if self.policy.replicas <= 1:
             return
         from conflux_tpu.engine import rendezvous_ranked
 
         gen = _snapshot_gen(snap)
         with self._lock:
-            owned = sorted((s for s, h in self._owners.items()
-                            if h == hid), key=str)
+            owned = sorted(self._owned.get(hid) or (), key=str)
         if not owned:
             return
         try:
-            have = checkpoint_sids(snap)
+            manifest = checkpoint_manifest(snap)
         except (OSError, ValueError, KeyError):
             return
         cands = [h for h in self._live() if h != hid]
         groups: dict[str, list] = {}
         stale: dict[str, list] = {}
         for sid in owned:
-            name = have.get(sid)
-            if name is None:
+            ent = manifest.get(sid)
+            if ent is None:
                 continue
+            name, egen = ent
             standbys = rendezvous_ranked(
                 sid, cands, k=self.policy.replicas - 1)
             with self._lock:
@@ -2444,30 +2591,53 @@ class ServeFabric:
                 drop = [h for h in cur if h not in standbys]
                 for h in drop:
                     cur.pop(h, None)
+                known = {h: cur.get(h, -1) for h in standbys}
             for h in drop:
                 stale.setdefault(h, []).append(name)
             for tgt in standbys:
+                if known[tgt] >= egen:
+                    continue  # standby already holds these exact bytes
                 groups.setdefault(tgt, []).append((sid, name))
+        # fault injection stays on the caller thread in sorted-target
+        # order (deterministic under test fault plans); only the real
+        # RPCs fan out
+        jobs: list = []
         for tgt, pairs in sorted(groups.items()):
-            handle = self._hosts.get(tgt)
-            if handle is None:
+            if self._hosts.get(tgt) is None:
                 continue
             try:
                 maybe_fault(self._fault_plan(), "replicate")
+            # conflint: disable=CFX-EXCEPT injected replicate fault: the standby simply stays a generation stale
+            except (InjectedFault, InjectedKill):
+                bump("fabric_replica_push_failures", len(pairs))
+                continue
+            jobs.append((tgt, pairs))
+
+        def _push_one(tgt: str, pairs: list) -> None:
+            handle = self._hosts.get(tgt)
+            if handle is None:
+                return
+            try:
                 handle.replicate(snap, [n for _, n in pairs], gen,
                                  timeout=self.policy.call_timeout)
             except _TRANSPORT_ERRORS:
                 self._note_request_failure(tgt)
                 bump("fabric_replica_push_failures", len(pairs))
-                continue
-            # conflint: disable=CFX-EXCEPT injected replicate fault: the standby simply stays a generation stale
-            except (InjectedFault, InjectedKill):
-                bump("fabric_replica_push_failures", len(pairs))
-                continue
+                return
             with self._lock:
                 for sid, _n in pairs:
                     self._replicas.setdefault(sid, {})[tgt] = gen
             bump("fabric_replica_pushes", len(pairs))
+
+        if len(jobs) <= 1:
+            for tgt, pairs in jobs:
+                _push_one(tgt, pairs)
+        else:
+            with ThreadPoolExecutor(
+                    max_workers=min(8, len(jobs)),
+                    thread_name_prefix="fabric-replica-push") as ex:
+                for f in [ex.submit(_push_one, t, p) for t, p in jobs]:
+                    f.result()
         for tgt, names in sorted(stale.items()):
             handle = self._hosts.get(tgt)
             if handle is None:
@@ -2498,12 +2668,11 @@ class ServeFabric:
         load estimates — merged into `profiler.serve_stats()['fabric']`
         via :func:`fabric_stats`."""
         with self._lock:
-            per_sid = {}
-            for sid, h in self._owners.items():
-                per_sid[h] = per_sid.get(h, 0) + 1
             hosts = {hid: {"state": self._state[hid],
                            "misses": self._misses[hid],
-                           "sessions": per_sid.get(hid, 0),
+                           "sessions": len(self._owned.get(hid) or ()),
+                           "cost": round(
+                               self._host_cost.get(hid, 0.0), 3),
                            "breaker": self._breakers[hid].state}
                      for hid in sorted(self._hosts)}
             recoveries = list(self._recoveries[-8:])

@@ -791,6 +791,9 @@ def escalate_precision(session, buf, precision, policy, limit,
                 rung = serve.PRECISION_TIERS.index(nxt)
                 if rung > session._auto_rung:
                     session._auto_rung = rung
+                    # the persisted auto-rung changed: the session is
+                    # checkpoint-dirty even though this is a solve path
+                    session._ckpt_ver += 1
             x, verdict = session.solve_checked(buf, precision=nxt)
             ok, finite, res = evaluate(verdict, limit)
             if data_fault(faults, "solve", "unhealthy") is not None:

@@ -2023,6 +2023,14 @@ class SolveSession:
         self._gang = None
         self._gang_slot = None
         self._gang_ver = 0         # guarded-by: _lock
+        # checkpoint dirty clock (DESIGN §35): bumped by every mutation
+        # that changes what `tier.save_fleet` would persist (update /
+        # refactor / device move / precision escalation / adopt).
+        # Solve-only traffic leaves it untouched, so the incremental
+        # checkpointer can skip clean sessions. Counters that only
+        # solves advance (solve/residual tallies) lag in carried
+        # records by design — they are observability, not state.
+        self._ckpt_ver = 0         # guarded-by: _lock
 
     @property
     def factors(self):
@@ -2155,6 +2163,7 @@ class SolveSession:
                 self._upd = {**self._upd, **moved["upd"]}
             self.device = device
             self._gang_ver += 1
+            self._ckpt_ver += 1
             if self._gang is not None:
                 # the gang's stack lives on the OLD device — leave it
                 # (release requires this held session lock; the session
@@ -2398,6 +2407,7 @@ class SolveSession:
             self.factorizations += 1
             self.refactors += 1
             self._gang_ver += 1  # the gang slot is stale; lazy re-sync
+            self._ckpt_ver += 1
             return self
 
     # ------------------------------------------------------------------ #
@@ -2484,6 +2494,7 @@ class SolveSession:
                          "Y": Y, "Cinv": Cinv}
             self.updates += 1
             self._gang_ver += 1  # the gang slot is stale; lazy re-sync
+            self._ckpt_ver += 1
             if self._residency is not None:
                 # footprint grew by the Woodbury state: refresh the
                 # manager's byte gauge (nbytes under this held lock,
@@ -2528,5 +2539,6 @@ class SolveSession:
             self.factorizations += 1
             self.refactors += 1
             self._gang_ver += 1  # the gang slot is stale; lazy re-sync
+            self._ckpt_ver += 1
             if self._residency is not None:
                 self._residency._note_bytes(self)

@@ -61,6 +61,7 @@ outcome lands in ``profiler.serve_stats()['tier']``.
 
 from __future__ import annotations
 
+import heapq
 import itertools
 import json
 import os
@@ -103,6 +104,8 @@ _TIER_KEYS = (
     "disk_bytes_read",
     "checkpoints",        # save_fleet calls
     "restores",           # load_fleet calls
+    "checkpoint_records_written",  # records freshly serialized (dirty)
+    "checkpoint_records_carried",  # clean records carried/copied (§35)
 )
 
 _TIER_LOCK = threading.Lock()
@@ -557,6 +560,43 @@ class ResidentSet:
         # caught: both sized their eviction against the same snapshot)
         self._claims: dict[int, tuple] = {}  # guarded-by: _lock
         self._claim_seq = itertools.count()
+        # O(log F) hot paths (DESIGN §35): `_state` mutations route
+        # through `_set_state`, which keeps these incremental views
+        # coherent so no hot path ever scans the fleet under `_lock`:
+        #  - `_state_counts`: population per state (stats/_resident_now)
+        #  - `_claimed_n`/`_claimed_b` (+ per-device `_claims_dev`):
+        #    running claim totals (victim math)
+        #  - `_dev_res`: per-device resident [count, bytes] census
+        #  - `_lru_dev`/`_lru_host`/`_lru_by_dev`: lazy-invalidation
+        #    min-heap LRU orders, each a (heap, entry) pair. The heap
+        #    holds (stamp, sid) hints; `entry[sid]` is the stamp of the
+        #    sid's ONE canonical hint (popped hints that don't match it
+        #    are discarded; canonical hints staler than the session's
+        #    live `_tier_stamp` are re-pushed at the live stamp). Valid
+        #    pops therefore come out in exactly the live-stamp order the
+        #    retired full sort produced — victim sets are bitwise
+        #    identical (tests/test_scale.py holds the oracle).
+        self._state_counts: dict[str, int] = {}     # guarded-by: _lock
+        self._claimed_n = 0                         # guarded-by: _lock
+        self._claimed_b = 0                         # guarded-by: _lock
+        self._claims_dev: dict[Any, list] = {}      # guarded-by: _lock
+        self._dev_res: dict[Any, list] = {}         # guarded-by: _lock
+        self._devkey: dict[int, Any] = {}           # guarded-by: _lock
+        self._lru_dev: tuple[list, dict] = ([], {})   # guarded-by: _lock
+        self._lru_host: tuple[list, dict] = ([], {})  # guarded-by: _lock
+        self._lru_by_dev: dict[Any, tuple] = {}       # guarded-by: _lock
+        # per-device / host-tier LRU maintenance is armed only when the
+        # matching caps can ever consume it (heaps nobody pops would
+        # grow with churn); arming later rebuilds in one O(F) pass
+        self._per_dev_lru = (max_sessions_per_device is not None
+                             or max_bytes_per_device is not None)
+        self._host_lru = (disk_dir is not None
+                          and (host_max_sessions is not None
+                               or host_max_bytes is not None))
+        # victim-pick implementation: 'heap' (O(victims·log F)) or
+        # 'sort' (the retired full-sort — kept as the measured baseline
+        # for scripts/replay.py's interleaved before/after legs)
+        self._lru_impl = os.environ.get("CONFLUX_TIER_LRU", "heap")
         self._device_bytes = 0               # guarded-by: _lock
         self._device_hw = 0                  # guarded-by: _lock
         self._resident_hw = 0                # guarded-by: _lock
@@ -572,6 +612,206 @@ class ResidentSet:
 
     def _tick(self) -> int:
         return next(self._clock)
+
+    # -------------------------------------------------------------- #
+    # incremental bookkeeping (DESIGN §35): every `_state` mutation
+    # goes through `_set_state`, every `_bytes` mutation through
+    # `_set_bytes`, every `_claims` mutation through the `_claims_*`
+    # helpers — that single-writer discipline is what lets the hot
+    # paths read counts and LRU minima instead of scanning the fleet
+    # -------------------------------------------------------------- #
+
+    @staticmethod
+    # requires-lock: _lock
+    def _lru_push(dom: tuple, sid: int, stamp: int) -> None:
+        """Install sid's canonical LRU hint at `stamp`. The heap keeps
+        any superseded hint as garbage (discarded lazily on pop);
+        compaction rebuilds from the canonical map when garbage
+        outgrows the live population — amortized O(1), since regrowing
+        past the bound takes at least that many pushes."""
+        heap, entry = dom
+        entry[sid] = stamp
+        heapq.heappush(heap, (stamp, sid))
+        if len(heap) > 2 * len(entry) + 64:
+            heap[:] = [(st, d) for d, st in entry.items()]
+            heapq.heapify(heap)
+
+    @staticmethod
+    # requires-lock: _lock
+    def _lru_drop(dom: tuple, sid: int) -> None:
+        dom[1].pop(sid, None)  # the heap hint dies lazily on pop
+
+    # requires-lock: _lock
+    def _lru_min(self, dom: tuple):
+        """The live LRU minimum of one order domain as (sid, session),
+        or None when the domain is empty. Pops discard non-canonical
+        hints and refresh canonical-but-stale ones (a touch bumped the
+        session's `_tier_stamp` since the hint was pushed) at the live
+        stamp, so accepted minima come out in exactly live-stamp order
+        — the order the retired full sort produced. The `_tier_stamp`
+        read is as racy as the old sort's was: benign staleness by
+        design."""
+        heap, entry = dom
+        while heap:
+            stamp, sid = heap[0]
+            if entry.get(sid) != stamp:
+                heapq.heappop(heap)
+                continue
+            s = self._sessions.get(sid)
+            if s is None:
+                heapq.heappop(heap)
+                entry.pop(sid, None)
+                continue
+            live = s._tier_stamp
+            if live != stamp:
+                heapq.heapreplace(heap, (live, sid))
+                entry[sid] = live
+                continue
+            return sid, s
+        return None
+
+    # requires-lock: _lock
+    def _dev_dom(self, devkey) -> tuple:
+        dom = self._lru_by_dev.get(devkey)
+        if dom is None:
+            dom = ([], {})
+            self._lru_by_dev[devkey] = dom
+        return dom
+
+    # requires-lock: _lock
+    def _enable_per_dev_lru(self) -> None:
+        """Arm per-device LRU maintenance after construction (the caps
+        were set on a live manager): one O(F) rebuild from the resident
+        census, then incremental forever."""
+        self._per_dev_lru = True
+        self._lru_by_dev.clear()
+        for sid, dk in self._devkey.items():
+            s = self._sessions.get(sid)
+            if s is None:
+                continue
+            heap, entry = self._dev_dom(dk)
+            entry[sid] = s._tier_stamp
+            heap.append((s._tier_stamp, sid))
+        for heap, _entry in self._lru_by_dev.values():
+            heapq.heapify(heap)
+
+    # requires-lock: _lock
+    def _enable_host_lru(self) -> None:
+        """Arm host-tier LRU maintenance after construction — same
+        one-shot O(F) rebuild as `_enable_per_dev_lru`."""
+        self._host_lru = True
+        heap, entry = self._lru_host
+        heap.clear()
+        entry.clear()
+        for sid, st in self._state.items():
+            if st != "host":
+                continue
+            s = self._sessions.get(sid)
+            if s is None:
+                continue
+            entry[sid] = s._tier_stamp
+            heap.append((s._tier_stamp, sid))
+        heapq.heapify(heap)
+
+    # requires-lock: _lock
+    def _set_state(self, sid: int, s, new: str) -> None:
+        """The single writer for `_state[sid]`: transitions update the
+        per-state counts, the per-device resident census and the LRU
+        order domains in O(log F)."""
+        old = self._state.get(sid)
+        self._state[sid] = new
+        if old == new:
+            return
+        cnt = self._state_counts
+        if old is not None:
+            cnt[old] = cnt.get(old, 1) - 1
+        cnt[new] = cnt.get(new, 0) + 1
+        if old == "resident":
+            self._lru_drop(self._lru_dev, sid)
+            dk = self._devkey.pop(sid, None)
+            dom = self._lru_by_dev.get(dk)
+            if dom is not None:
+                self._lru_drop(dom, sid)
+            d = self._dev_res.get(dk)
+            if d is not None:
+                d[0] -= 1
+                d[1] -= self._bytes.get(sid, 0)
+                if d[0] <= 0:
+                    self._dev_res.pop(dk, None)
+        elif old == "host":
+            self._lru_drop(self._lru_host, sid)
+        if new == "resident":
+            stamp = s._tier_stamp
+            self._lru_push(self._lru_dev, sid, stamp)
+            dk = _session_devkey(s)
+            self._devkey[sid] = dk
+            if self._per_dev_lru:
+                self._lru_push(self._dev_dom(dk), sid, stamp)
+            d = self._dev_res.get(dk)
+            if d is None:
+                self._dev_res[dk] = [1, self._bytes.get(sid, 0)]
+            else:
+                d[0] += 1
+                d[1] += self._bytes.get(sid, 0)
+        elif new == "host" and self._host_lru:
+            self._lru_push(self._lru_host, sid, s._tier_stamp)
+
+    # requires-lock: _lock
+    def _set_bytes(self, sid: int, nbytes: int) -> None:
+        """The single writer for `_bytes[sid]` — keeps the per-device
+        resident byte census true while a resident session's footprint
+        changes (updates/refactors)."""
+        old = self._bytes.get(sid, 0)
+        self._bytes[sid] = nbytes
+        if self._state.get(sid) == "resident":
+            d = self._dev_res.get(self._devkey.get(sid))
+            if d is not None:
+                d[1] += nbytes - old
+
+    # requires-lock: _lock
+    def _claims_add(self, token: int, nbytes: int, count: int,
+                    devkey) -> None:
+        self._claims[token] = (int(nbytes), int(count), devkey)
+        self._claimed_b += int(nbytes)
+        self._claimed_n += int(count)
+        d = self._claims_dev.get(devkey)
+        if d is None:
+            self._claims_dev[devkey] = [int(count), int(nbytes)]
+        else:
+            d[0] += int(count)
+            d[1] += int(nbytes)
+
+    # requires-lock: _lock
+    def _claims_remove(self, token: int) -> None:
+        c = self._claims.pop(token, None)
+        if c is None:
+            return
+        cb, cn, dk = c
+        self._claimed_b -= cb
+        self._claimed_n -= cn
+        d = self._claims_dev.get(dk)
+        if d is not None:
+            d[0] -= cn
+            d[1] -= cb
+            if d[0] <= 0 and d[1] <= 0:
+                self._claims_dev.pop(dk, None)
+
+    # requires-lock: _lock
+    def _claim_retire_one(self, token: int, nbytes: int) -> None:
+        """Retire one landed slot's share of a multi-session claim
+        (`revive_many` chunks) — the last slot retires the claim."""
+        cb, cn, dk = self._claims.get(token, (0, 0, None))
+        if cn > 1:
+            freed = min(cb, int(nbytes))
+            self._claims[token] = (cb - freed, cn - 1, dk)
+            self._claimed_b -= freed
+            self._claimed_n -= 1
+            d = self._claims_dev.get(dk)
+            if d is not None:
+                d[0] -= 1
+                d[1] -= freed
+        else:
+            self._claims_remove(token)
 
     def adopt(self, *sessions) -> "ResidentSet":
         """Bring sessions under management (resident ones count against
@@ -591,6 +831,9 @@ class ResidentSet:
             with s._lock:
                 s._residency = self
                 s._tier_stamp = self._tick()
+                # adoption changes persisted identity (manager, tier
+                # registration): mark checkpoint-dirty (DESIGN §35)
+                s._ckpt_ver += 1
                 rec = s._spill
                 nb = s.nbytes
                 with self._lock:
@@ -612,9 +855,9 @@ class ResidentSet:
                             # re-adoption spill its own adoptee through
                             # the reentrant RLock (review-caught)
                             token = next(self._claim_seq)
-                            self._claims[token] = (nb, 1,
-                                                   _session_devkey(s))
-                            self._state[sid] = "reviving"
+                            self._claims_add(token, nb, 1,
+                                             _session_devkey(s))
+                            self._set_state(sid, s, "reviving")
                         elif state == "resident":
                             # re-adoption of a managed resident
                             # session: already counted — refresh the
@@ -622,16 +865,17 @@ class ResidentSet:
                             # the caps without holding this lock
                             self._device_bytes += \
                                 nb - self._bytes.get(sid, 0)
-                            self._bytes[sid] = nb
+                            self._set_bytes(sid, nb)
                             self._device_hw = max(self._device_hw,
                                                   self._device_bytes)
                         # 'spilling'/'reviving' in flight: the owning
                         # enforcer/fault-in lands the gauges
                     else:
-                        self._state[sid] = rec.tier \
-                            if rec.tier in ("host", "disk", "corrupt") \
-                            else "host"
-                        self._bytes[sid] = rec.nbytes
+                        self._set_state(sid, s, rec.tier
+                                        if rec.tier in ("host", "disk",
+                                                        "corrupt")
+                                        else "host")
+                        self._set_bytes(sid, rec.nbytes)
                         if fresh and rec.tier == "host":
                             self._host_bytes += rec.nbytes
                         elif fresh and rec.tier == "disk":
@@ -648,10 +892,10 @@ class ResidentSet:
                         # wave lands the gauges: the session IS
                         # device-resident, and _enforce below retries
                         # the caps
-                        self._claims.pop(token, None)
+                        self._claims_remove(token)
                         if self._state.get(sid) == "reviving":
-                            self._state[sid] = "resident"
-                            self._bytes[sid] = nb
+                            self._set_state(sid, s, "resident")
+                            self._set_bytes(sid, nb)
                             self._device_bytes += nb
                             self._device_hw = max(self._device_hw,
                                                   self._device_bytes)
@@ -675,7 +919,7 @@ class ResidentSet:
         with self._lock:
             if self._state.get(sid) == "resident":
                 self._device_bytes += nb - self._bytes.get(sid, 0)
-                self._bytes[sid] = nb
+                self._set_bytes(sid, nb)
                 self._device_hw = max(self._device_hw,
                                       self._device_bytes)
 
@@ -692,19 +936,30 @@ class ResidentSet:
             for s in sessions:
                 sid = id(s)
                 if self._state.get(sid) == "resident":
-                    self._state[sid] = "spilling"
+                    self._set_state(sid, s, "spilling")
                     victims.append(s)
         return self._spill_batch(victims)
 
     def spill_lru(self, n: int) -> int:
-        """Spill the n least-recently-used resident sessions."""
+        """Spill the n least-recently-used resident sessions —
+        O(n·log F) off the LRU heap, not a fleet sort."""
+        victims: list = []
         with self._lock:
-            resident = [s for sid, s in self._sessions.items()
-                        if self._state.get(sid) == "resident"]
-            resident.sort(key=lambda s: s._tier_stamp)
-            victims = resident[:n]
-            for s in victims:
-                self._state[id(s)] = "spilling"
+            if self._lru_impl == "sort":
+                resident = [s for sid, s in self._sessions.items()
+                            if self._state.get(sid) == "resident"]
+                resident.sort(key=lambda s: s._tier_stamp)
+                for s in resident[:n]:
+                    self._set_state(id(s), s, "spilling")
+                    victims.append(s)
+            else:
+                while len(victims) < n:
+                    nxt = self._lru_min(self._lru_dev)
+                    if nxt is None:
+                        break
+                    sid, s = nxt
+                    self._set_state(sid, s, "spilling")
+                    victims.append(s)
         return self._spill_batch(victims)
 
     def _spill_batch(self, victims: list) -> int:
@@ -726,15 +981,18 @@ class ResidentSet:
                         if self._state.get(sid) == "spilling":
                             # a 'transit' record registers as host-tier
                             # (phase 2 pending elsewhere)
-                            self._state[sid] = t if t in (
-                                "host", "disk", "corrupt") else "host"
+                            self._set_state(sid, s, t if t in (
+                                "host", "disk", "corrupt") else "host")
                     continue
                 try:
                     resilience.maybe_fault(self._faults, "spill")
                 except InjectedFault:
                     bump("spill_faults")
                     with self._lock:  # fail-safe: stays resident
-                        self._state[sid] = "resident"
+                        # the session keeps its OLD stamp — the heap
+                        # re-admits it at that stamp, exactly where the
+                        # full sort would have placed it
+                        self._set_state(sid, s, "resident")
                     continue
                 leaves, meta = _extract_state(s)
                 rec = _SpillRecord("transit", leaves, meta)
@@ -754,7 +1012,12 @@ class ResidentSet:
                     # engine._gang_readopt, singles at next dispatch)
                     g.release(s)
             with self._lock:
-                self._state[sid] = "host"
+                if self._state.get(sid) == "spilling":
+                    # guarded like the raced branch above: a fault-in
+                    # that reclaimed the transit record mid-handoff
+                    # already owns the state; clobbering it to 'host'
+                    # would strand a resident session outside the LRU
+                    self._set_state(sid, s, "host")
                 self._device_bytes -= self._bytes.get(sid, 0)
             recs.append((s, rec))
         if not recs:
@@ -780,7 +1043,7 @@ class ResidentSet:
             finally:
                 s._lock.release()
             with self._lock:
-                self._bytes[id(s)] = rec.nbytes
+                self._set_bytes(id(s), rec.nbytes)
                 self._host_bytes += rec.nbytes
             bump("spills_host")
             moved += 1
@@ -825,10 +1088,10 @@ class ResidentSet:
         finally:
             s._lock.release()
         with self._lock:
-            self._state[sid] = "disk"
+            self._set_state(sid, s, "disk")
             self._host_bytes -= host_nb
             self._disk_bytes += nbytes
-            self._bytes[sid] = nbytes
+            self._set_bytes(sid, nbytes)
         bump("spills_disk")
         bump("disk_bytes_written", nbytes)
         return 1
@@ -837,22 +1100,43 @@ class ResidentSet:
         if self.disk_dir is None:
             return
         while True:
+            victims: list = []
             with self._lock:
-                hosts = [s for sid, s in self._sessions.items()
-                         if self._state.get(sid) == "host"]
+                if not self._host_lru:
+                    self._enable_host_lru()
                 over = 0
                 if self.host_max_sessions is not None:
-                    over = max(over, len(hosts) - self.host_max_sessions)
+                    over = max(over,
+                               self._state_counts.get("host", 0)
+                               - self.host_max_sessions)
                 if self.host_max_bytes is not None \
                         and self._host_bytes > self.host_max_bytes:
                     over = max(over, 1)
                 if over <= 0:
                     return
-                hosts.sort(key=lambda s: s._tier_stamp)
-                victims = hosts[:over]
+                heap, entry = self._lru_host
+                while len(victims) < over:
+                    nxt = self._lru_min(self._lru_host)
+                    if nxt is None:
+                        break
+                    # pop the candidate off the order (demotion may
+                    # fail — failures re-enter below, still host-tier)
+                    heapq.heappop(heap)
+                    entry.pop(nxt[0], None)
+                    victims.append(nxt[1])
             if not victims:
                 return
-            if sum(self._demote_one(s) for s in victims) == 0:
+            moved = sum(self._demote_one(s) for s in victims)
+            with self._lock:
+                for s in victims:
+                    sid = id(s)
+                    if self._state.get(sid) == "host":
+                        # demotion failed (fault / lock contention):
+                        # the record stays host-tier, back into the LRU
+                        # at its unchanged stamp
+                        self._lru_push(self._lru_host, sid,
+                                       s._tier_stamp)
+            if moved == 0:
                 return  # nothing demotable (faults): stop, don't spin
 
     # -------------------------------------------------------------- #
@@ -870,8 +1154,7 @@ class ResidentSet:
         would double-count one slot for the duration of the handoff
         (the accounted-byte gauge retires victims at stash time for
         the same reason)."""
-        res = sum(1 for x in self._state.values() if x == "resident")
-        return res + sum(cn for _cb, cn, _dk in self._claims.values())
+        return self._state_counts.get("resident", 0) + self._claimed_n
 
     def _claim(self, nbytes: int, count: int, devkey=None) -> int:
         """Reserve incoming device capacity ahead of a fault-in/adopt.
@@ -884,7 +1167,7 @@ class ResidentSet:
         :meth:`_unclaim`."""
         token = next(self._claim_seq)
         with self._lock:
-            self._claims[token] = (int(nbytes), int(count), devkey)
+            self._claims_add(token, nbytes, count, devkey)
         return token
 
     def _unclaim(self, token: int) -> None:
@@ -893,7 +1176,7 @@ class ResidentSet:
         harmless; a window counted by neither would re-open the race)
         or when the fault-in fails and nothing lands."""
         with self._lock:
-            self._claims.pop(token, None)
+            self._claims_remove(token)
 
     def _pick_victims(self, incoming_bytes: int,
                       incoming_count: int) -> list:
@@ -902,7 +1185,89 @@ class ResidentSet:
         plus every in-flight capacity claim under the caps. A session
         mid-fault-in is 'reviving' (never 'resident'), so it is never
         picked — which is what keeps two concurrent fault-ins from
-        deadlocking on each other's session locks."""
+        deadlocking on each other's session locks.
+
+        O(victims · log F) off the lazy-invalidation heaps (DESIGN
+        §35) — the retired materialize-and-sort baseline survives as
+        `_pick_victims_sorted` (CONFLUX_TIER_LRU=sort) for the replay
+        bench's interleaved before/after legs; both produce the SAME
+        victim set on the same trace (tests/test_scale.py)."""
+        if self._lru_impl == "sort":
+            return self._pick_victims_sorted(incoming_bytes,
+                                             incoming_count)
+        with self._lock:
+            need_n = 0
+            if self.max_sessions is not None:
+                need_n = (self._state_counts.get("resident", 0)
+                          + self._claimed_n + incoming_count
+                          - self.max_sessions)
+            need_b = 0
+            if self.max_bytes is not None:
+                need_b = (self._device_bytes + self._claimed_b
+                          + incoming_bytes - self.max_bytes)
+            victims: list = []
+            freed = 0
+            while len(victims) < need_n or freed < need_b:
+                nxt = self._lru_min(self._lru_dev)
+                if nxt is None:
+                    break
+                sid, s = nxt
+                victims.append(s)
+                freed += self._bytes.get(sid, 0)
+                self._set_state(sid, s, "spilling")
+            # round small count-pressure waves up to the amortization
+            # batch (never byte-pressure ones: bytes freed beyond the
+            # need would thrash)
+            if victims and need_n > 0 and need_b <= 0:
+                while len(victims) < self.evict_batch:
+                    nxt = self._lru_min(self._lru_dev)
+                    if nxt is None:
+                        break
+                    sid, s = nxt
+                    victims.append(s)
+                    self._set_state(sid, s, "spilling")
+            # per-DEVICE caps (DESIGN §25): each device's overage is
+            # relieved by victims living ON that device — LRU within
+            # the device — so one hot device's pressure never evicts a
+            # cold device's residents, and the hot device itself stays
+            # under its own cap. Global victims were already marked
+            # 'spilling' above, so the live census credits their relief
+            # and the residual per-device need is census + claims − cap.
+            if self.max_sessions_per_device is not None \
+                    or self.max_bytes_per_device is not None:
+                if not self._per_dev_lru:
+                    self._enable_per_dev_lru()
+                for dk in list(self._dev_res):
+                    d = self._dev_res.get(dk)
+                    if d is None:
+                        continue
+                    cl = self._claims_dev.get(dk, (0, 0))
+                    need_n_d = need_b_d = 0
+                    if self.max_sessions_per_device is not None:
+                        need_n_d = (d[0] + cl[0]
+                                    - self.max_sessions_per_device)
+                    if self.max_bytes_per_device is not None:
+                        need_b_d = (d[1] + cl[1]
+                                    - self.max_bytes_per_device)
+                    dom = self._lru_by_dev.get(dk)
+                    while dom is not None \
+                            and (need_n_d > 0 or need_b_d > 0):
+                        nxt = self._lru_min(dom)
+                        if nxt is None:
+                            break
+                        sid, s = nxt
+                        victims.append(s)
+                        need_n_d -= 1
+                        need_b_d -= self._bytes.get(sid, 0)
+                        self._set_state(sid, s, "spilling")
+        return victims
+
+    def _pick_victims_sorted(self, incoming_bytes: int,
+                             incoming_count: int) -> list:
+        """The pre-§35 victim picker: materialize and sort the ENTIRE
+        resident list under the manager lock. Kept as the bench
+        baseline and the equivalence oracle — same victim sets as the
+        heap path, O(F log F) per pick."""
         with self._lock:
             resident = [(sid, s) for sid, s in self._sessions.items()
                         if self._state.get(sid) == "resident"]
@@ -926,20 +1291,11 @@ class ResidentSet:
                     break
                 victims.append(s)
                 freed += self._bytes.get(sid, 0)
-            # round small count-pressure waves up to the amortization
-            # batch (never byte-pressure ones: bytes freed beyond the
-            # need would thrash)
             if victims and need_n > 0 and need_b <= 0:
                 for sid, s in resident[len(victims):]:
                     if len(victims) >= self.evict_batch:
                         break
                     victims.append(s)
-            # per-DEVICE caps (DESIGN §25): each device's overage is
-            # relieved by victims living ON that device — LRU within
-            # the device — so one hot device's pressure never evicts a
-            # cold device's residents, and the hot device itself stays
-            # under its own cap. Already-picked global victims credit
-            # their device's relief first.
             if self.max_sessions_per_device is not None \
                     or self.max_bytes_per_device is not None:
                 picked = {id(s) for s in victims}
@@ -977,7 +1333,7 @@ class ResidentSet:
                         taken += 1
                         freed_d += self._bytes.get(sid, 0)
             for s in victims:
-                self._state[id(s)] = "spilling"
+                self._set_state(id(s), s, "spilling")
         return victims
 
     def _make_room(self, incoming_bytes: int,
@@ -1072,7 +1428,7 @@ class ResidentSet:
                         "in-flight revival completes")
             try:
                 with self._lock:
-                    self._state[sid] = "reviving"
+                    self._set_state(sid, session, "reviving")
                 self._fault_in_admitted(session, rec, sid)
             except RestoreCorrupt as e:
                 bump("restore_corrupt")
@@ -1088,7 +1444,7 @@ class ResidentSet:
                     # pinned error keeps the path as evidence)
                     shutil.rmtree(path0, ignore_errors=True)
                 with self._lock:
-                    self._state[sid] = "corrupt"
+                    self._set_state(sid, session, "corrupt")
                     # retire the dead record from the tier gauges:
                     # without this, _disk_bytes counted the removed
                     # record forever
@@ -1096,15 +1452,16 @@ class ResidentSet:
                         self._disk_bytes -= nb0
                     elif tier0 == "host":
                         self._host_bytes -= nb0
-                    self._bytes[sid] = 0
+                    self._set_bytes(sid, 0)
                 raise
             except BaseException:
                 # injected/real revive failure: fully spilled, record
                 # intact — the next touch retries
                 with self._lock:
                     if self._state.get(sid) == "reviving":
-                        self._state[sid] = rec.tier \
-                            if rec.tier in ("host", "disk") else "host"
+                        self._set_state(sid, session, rec.tier
+                                        if rec.tier in ("host", "disk")
+                                        else "host")
                 raise
             finally:
                 if self._revive_sem is not None:
@@ -1164,13 +1521,13 @@ class ResidentSet:
                     # retires in the same lock acquisition that counts
                     # the landed session, so no concurrent reader ever
                     # sees it twice (or not at all)
-                    self._claims.pop(token, None)
-                    self._state[sid] = "resident"
+                    self._claims_remove(token)
+                    self._set_state(sid, session, "resident")
                     if rec.tier == "host":
                         self._host_bytes -= rec.nbytes
                     elif rec.tier == "disk":
                         self._disk_bytes -= rec.nbytes
-                    self._bytes[sid] = nb
+                    self._set_bytes(sid, nb)
                     self._device_bytes += nb
                     self._device_hw = max(self._device_hw,
                                           self._device_bytes)
@@ -1389,17 +1746,11 @@ class ResidentSet:
                                 # retire this slot's share of the
                                 # chunk claim in the same lock
                                 # acquisition that counts it landed
-                                cb, cn, cdk = self._claims.get(
-                                    token, (0, 0, None))
-                                if cn > 1:
-                                    self._claims[token] = (
-                                        max(0, cb - rec.nbytes),
-                                        cn - 1, cdk)
-                                else:
-                                    self._claims.pop(token, None)
-                                self._state[sid] = "resident"
+                                self._claim_retire_one(token,
+                                                       rec.nbytes)
+                                self._set_state(sid, s, "resident")
                                 self._host_bytes -= rec.nbytes
-                                self._bytes[sid] = nb
+                                self._set_bytes(sid, nb)
                                 self._device_bytes += nb
                                 self._device_hw = max(self._device_hw,
                                                       self._device_bytes)
@@ -1444,15 +1795,15 @@ class ResidentSet:
         device-tier high-water marks the capacity bound is judged by
         (merged fleet-wide into `profiler.serve_stats()['tier']`)."""
         with self._lock:
-            st = list(self._state.values())
-            resident = sum(1 for x in st
-                           if x in ("resident", "spilling", "reviving"))
+            cnt = self._state_counts
+            resident = (cnt.get("resident", 0) + cnt.get("spilling", 0)
+                        + cnt.get("reviving", 0))
             return {
                 "managed_sessions": len(self._sessions),
                 "resident_sessions": resident,
-                "host_sessions": st.count("host"),
-                "disk_sessions": st.count("disk"),
-                "corrupt_sessions": st.count("corrupt"),
+                "host_sessions": cnt.get("host", 0),
+                "disk_sessions": cnt.get("disk", 0),
+                "corrupt_sessions": cnt.get("corrupt", 0),
                 "device_bytes": self._device_bytes,
                 "device_bytes_high_water": self._device_hw,
                 "resident_high_water": self._resident_hw,
@@ -1469,16 +1820,10 @@ class ResidentSet:
     def _per_device_locked(self) -> dict:
         """Resident population/bytes per device — the balance gauge the
         per-device caps are judged by (str devkey -> counts; 'None' is
-        the default device)."""
-        out: dict = {}
-        for sid, s in self._sessions.items():
-            if self._state.get(sid) != "resident":
-                continue
-            dk = str(_session_devkey(s))
-            d = out.setdefault(dk, {"sessions": 0, "bytes": 0})
-            d["sessions"] += 1
-            d["bytes"] += self._bytes.get(sid, 0)
-        return out
+        the default device). Served from the incremental census, not a
+        fleet scan."""
+        return {str(dk): {"sessions": d[0], "bytes": d[1]}
+                for dk, d in self._dev_res.items() if d[0] > 0}
 
 
 # --------------------------------------------------------------------------- #
@@ -1506,20 +1851,94 @@ def _policy_fields(policy) -> dict:
             "refine": policy.refine}
 
 
-def save_fleet(path: str, sessions, names=None) -> dict:
+def _load_base_entries(base: str) -> dict:
+    """Previous-generation fleet.json entries by name, or {} when the
+    base is missing/unreadable (the caller then degrades to a full
+    write — a broken base must never break the NEXT checkpoint)."""
+    try:
+        with open(os.path.join(base, "fleet.json")) as f:
+            return {e["name"]: e for e in json.load(f)["sessions"]}
+    except (OSError, ValueError, KeyError, TypeError):
+        return {}
+
+
+def save_fleet(path: str, sessions, names=None, *, base=None,
+               gen=None, full=True) -> dict:
     """Serialize a fleet snapshot to `path`: one disk record per
     session (the spill serialization, CRCs and all) + fleet.json naming
     each session's record dir, plan key and drift policy. Works across
     tiers WITHOUT moving anything: resident sessions d2h their state,
     host records serialize directly, disk records re-read (the engine's
     `checkpoint()` provides the drain barrier that makes the snapshot
-    consistent). Returns {name: record dir}."""
+    consistent). Returns {name: record dir}.
+
+    Incremental mode (DESIGN §35): with `base` (the previous
+    generation's directory) a session whose `_ckpt_ver` dirty clock
+    matches its base entry is CLEAN — its state is bitwise what the
+    base already persists. With ``full=False`` the clean session's
+    record is NOT rewritten; its fleet.json entry instead points at the
+    existing record via a single-hop relative dir
+    (``../fleet-NNNNNN/<name>``, re-based every generation so chains
+    never deepen), making a delta generation O(dirty) in d2h/CRC/IO
+    and O(fleet) only in cheap JSON. With ``full=True`` (compaction,
+    and the only mode when `base` is None) every record lands locally —
+    clean ones by a byte-identical file copy, no d2h — so the
+    generation is self-contained and older generations can be pruned.
+    Every entry carries ``ver`` (the dirty clock it persists) and
+    ``gen`` (the generation whose WRITE produced the record bytes —
+    compaction copies keep their original ``gen``, so replica pushes
+    and fail-over staleness gates see through compaction instead of
+    re-pushing an unchanged fleet). `gen` is this generation's number;
+    None (standalone snapshots) stamps fresh records with 0."""
     os.makedirs(path, exist_ok=True)
+    prev_map = _load_base_entries(base) if base is not None else {}
+    this_gen = int(gen) if gen is not None else 0
     entries = []
+    carried = 0
     for i, s in enumerate(sessions):
         name = names[i] if names is not None else f"s{i:04d}"
+        sid = getattr(s, "sid", None)
         with s._lock:
             rec = s._spill
+            if rec is not None and rec.tier == "corrupt":
+                # corrupt: this session has no state (carrying a stale
+                # base record would silently resurrect it). The pinned
+                # instance is shared across threads (see fault_in's
+                # corrupt branch)
+                raise RestoreCorrupt(
+                    str(rec.error),
+                    dict(rec.error.evidence)) from rec.error
+            ver = s._ckpt_ver
+            prev = prev_map.get(name)
+            clean = (prev is not None and prev.get("ver") == ver
+                     and prev.get("sid") == sid)
+            src = (os.path.normpath(os.path.join(base, prev["dir"]))
+                   if clean else None)
+            if clean and not os.path.isdir(src):
+                clean = False  # base record gone: degrade to a write
+            if clean and not full:
+                # delta carry: reference the existing record, zero IO
+                entries.append({
+                    "name": name,
+                    "dir": os.path.relpath(src, path),
+                    "plan": _plan_fields(s.plan),
+                    "nbytes": prev["nbytes"], "sid": sid,
+                    "ver": ver, "gen": prev.get("gen", 0)})
+                carried += 1
+                continue
+            if clean:
+                # compaction: localize the record by a byte-identical
+                # copy (no d2h, no CRC recompute); keep the original
+                # write generation so standbys holding that push stay
+                # provably current
+                shutil.copytree(src, os.path.join(path, name))
+                entries.append({
+                    "name": name, "dir": name,
+                    "plan": _plan_fields(s.plan),
+                    "nbytes": prev["nbytes"], "sid": sid,
+                    "ver": ver, "gen": prev.get("gen", 0)})
+                carried += 1
+                continue
             if rec is None:
                 leaves, meta = _extract_state(s)
                 leaves = jax.device_get(leaves)
@@ -1527,32 +1946,32 @@ def save_fleet(path: str, sessions, names=None) -> dict:
                 leaves, meta = jax.device_get(rec.leaves), rec.meta
             elif rec.tier == "host":
                 leaves, meta = rec.leaves, rec.meta
-            elif rec.tier == "disk":
+            else:  # disk ("corrupt" raised above)
                 leaves, meta = _read_record(rec.path)
-            else:
-                # corrupt: this session has no state. Fresh copy — the
-                # pinned instance is shared across threads (see
-                # fault_in's corrupt branch)
-                raise RestoreCorrupt(
-                    str(rec.error),
-                    dict(rec.error.evidence)) from rec.error
             meta = dict(meta)
             meta["policy"] = _policy_fields(s.policy)
+            meta["ckpt_ver"] = ver
             # the stable session id rides the checkpoint (placement
             # identity): a restored fleet re-pins deterministically
             # through engine.place_session. Devices themselves are NOT
             # persisted — the restoring process may have a different
             # device list
-            if getattr(s, "sid", None) is not None:
-                meta["sid"] = s.sid
+            if sid is not None:
+                meta["sid"] = sid
             nbytes = _write_record(os.path.join(path, name), leaves,
                                    meta)
         entries.append({"name": name, "dir": name,
                         "plan": _plan_fields(s.plan), "nbytes": nbytes,
-                        "sid": getattr(s, "sid", None)})
+                        "sid": sid, "ver": ver, "gen": this_gen})
+    doc = {"format": 2, "gen": this_gen, "carried": carried,
+           "sessions": entries}
+    if base is not None:
+        doc["base"] = os.path.basename(os.path.normpath(base))
     with open(os.path.join(path, "fleet.json"), "w") as f:
-        json.dump({"format": 1, "sessions": entries}, f, indent=1)
+        json.dump(doc, f, indent=1)
     bump("checkpoints")
+    bump("checkpoint_records_carried", carried)
+    bump("checkpoint_records_written", len(entries) - carried)
     return {e["name"]: e["dir"] for e in entries}
 
 
@@ -1611,6 +2030,10 @@ def load_fleet(path: str, *, residency: ResidentSet | None = None,
             s.refactors = c["refactors"]
             s.last_cond = meta["last_cond"]
             s._owns_base = meta["owns_base"]
+            # resume the dirty clock where the record left it: the
+            # restored session's first mutation makes it delta-dirty
+            # again without a spurious full rewrite (DESIGN §35)
+            s._ckpt_ver = int(meta.get("ckpt_ver", 0) or 0)
             s._factors = None
             s._spill = rec
         sessions.append(s)

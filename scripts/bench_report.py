@@ -44,6 +44,7 @@ _RATIO_KEYS = (
     "speedup_vs_naive",
     "speedup_vs_xla_trsm", "speedup_vs_staged_factor",
     "speedup_vs_all_f32",
+    "control_plane_speedup_x",
     "transitions_won", "noqos_blowup_x",
 )
 _GATE_KEYS = (

@@ -2102,6 +2102,189 @@ def run_elastic_trial(seed: int) -> tuple[bool, str]:
                   f"injected={sum(faults.injected.values())}")
 
 
+def run_scale_trial(seed: int) -> tuple[bool, str]:
+    """One chaos trial of the §35 scale control plane (ISSUE 20).
+
+    A Zipf stream drives a fleet >> device capacity through a tiered
+    engine while the spill/revive fault sites fire, the LRU
+    implementation is FLIPPED live between heap and sort mid-trial
+    (`CONFLUX_TIER_LRU` is read per pick — both paths must serve the
+    same fleet interchangeably), and an incremental checkpoint chain
+    (full → delta → delta-or-compaction) runs at the engine's drain
+    barrier between waves. Invariants: structured failures only and
+    per-session f64 oracle answers (the tier-trial contract); every
+    generation COVERS the fleet (records written + carried == F); a
+    generation taken after solve-only traffic writes ZERO records
+    (solves never touch the dirty clock); and the final generation —
+    restored through the delta chain with cold plan caches — answers
+    BITWISE identically to the live fleet. The disk corruption sites
+    ride `--tier`; here the chain itself must stay restorable."""
+    import tempfile
+
+    import jax.numpy as jnp
+
+    from conflux_tpu import serve, tier
+    from conflux_tpu.engine import EngineSaturated, ServeEngine
+    from conflux_tpu.resilience import (
+        DeadlineExceeded,
+        FaultPlan,
+        FaultSpec,
+        InjectedFault,
+        RestoreCorrupt,
+        RhsNonFinite,
+        SessionQuarantined,
+        SessionSpilled,
+        SolveUnhealthy,
+    )
+
+    rng = np.random.default_rng(seed)
+    serve.clear_plans()
+    tier.clear_tier()
+    N = int(rng.choice([24, 32]))
+    F = int(rng.integers(8, 13))
+    C = int(rng.integers(2, 4))
+    plan = serve.FactorPlan.create((N, N), jnp.float32, v=8)
+    As, fleet = [], []
+    for _ in range(F):
+        A = (rng.standard_normal((N, N)) / np.sqrt(N)
+             + 2.0 * np.eye(N)).astype(np.float32)
+        sess = plan.factor(jnp.asarray(A))
+        As.append(A.astype(np.float64))
+        fleet.append(sess)
+    menu = [
+        FaultSpec("spill", "crash", prob=0.3, count=2),
+        FaultSpec("spill", "delay", prob=0.3, delay_s=0.001, count=3),
+        FaultSpec("revive", "crash", prob=0.3, count=2),
+        FaultSpec("revive", "delay", prob=0.3, delay_s=0.001, count=3),
+    ]
+    picks = [m for m in menu if rng.integers(2)]
+    faults = FaultPlan(picks, seed=seed)
+    label = (f"seed={seed} scale N={N} F={F} C={C} "
+             f"faults={[(f.site, f.kind) for f in picks]}")
+    pmf = 1.0 / np.arange(1, F + 1) ** 1.1
+    pmf /= pmf.sum()
+    ok_exc = (RhsNonFinite, DeadlineExceeded, SolveUnhealthy,
+              SessionQuarantined, InjectedFault, SessionSpilled,
+              RestoreCorrupt)
+
+    def ckpt_counters():
+        h = tier.tier_stats()
+        return (h.get("checkpoint_records_written", 0),
+                h.get("checkpoint_records_carried", 0))
+
+    names = [f"m{i}" for i in range(F)]
+    with tempfile.TemporaryDirectory() as tmp:
+        rs = tier.ResidentSet(
+            max_sessions=C, disk_dir=os.path.join(tmp, "tier"),
+            evict_batch=max(1, C - 1), max_concurrent_revives=2,
+            fault_plan=faults)
+        eng = ServeEngine(
+            max_batch_delay=float(rng.choice([0.0, 0.002])),
+            max_pending=64, max_coalesce_width=8,
+            residency=rs, revive_wait=5.0, watchdog_interval=0.05)
+        rs.adopt(*fleet)
+        gens, reqs, updates = [], [], 0
+        try:
+            for wave in range(3):
+                rs._lru_impl = "sort" if rng.integers(2) else "heap"
+                for _ in range(int(rng.integers(6, 10))):
+                    si = int(rng.choice(F, p=pmf))
+                    b = rng.standard_normal(
+                        (N, int(rng.choice([1, 2])))).astype(np.float32)
+                    try:
+                        fut = eng.submit(fleet[si], b)
+                    except (RhsNonFinite, SessionQuarantined,
+                            EngineSaturated, SessionSpilled,
+                            RestoreCorrupt):
+                        continue
+                    reqs.append((si, b, fut))
+                if wave < 2 and rng.integers(2):
+                    # SMW drift: food for the delta generations (the
+                    # dirty clock must single these sessions out);
+                    # wave 2 stays solve-only so its generation is a
+                    # provable zero-write
+                    si = int(rng.choice(F, p=pmf))
+                    U = (0.01 * rng.standard_normal(
+                        (N, 1))).astype(np.float32)
+                    Vm = (0.01 * rng.standard_normal(
+                        (N, 1))).astype(np.float32)
+                    try:
+                        fleet[si].update(U, Vm)
+                        As[si] = As[si] + (U.astype(np.float64)
+                                           @ Vm.astype(np.float64).T)
+                        updates += 1
+                    except ok_exc:
+                        pass
+                path = os.path.join(tmp, f"fleet-{wave:06d}")
+                full = wave == 0 or (wave == 2 and bool(rng.integers(2)))
+                w0, c0 = ckpt_counters()
+                eng.checkpoint(path, sessions=fleet, names=names,
+                               base=gens[-1] if gens else None,
+                               gen=wave, full=full)
+                w1, c1 = ckpt_counters()
+                if (w1 - w0) + (c1 - c0) != F:
+                    return False, (f"{label}: gen {wave} covers "
+                                   f"{(w1 - w0) + (c1 - c0)}/{F} "
+                                   "sessions")
+                if wave == 2 and not full and w1 - w0 != 0:
+                    return False, (f"{label}: solve-only delta wrote "
+                                   f"{w1 - w0} records — solves "
+                                   "touched the dirty clock")
+                gens.append(path)
+            wedged = eng.close(timeout=120)
+            if wedged:
+                return False, f"{label}: close() wedged {wedged}"
+        finally:
+            eng.close(timeout=10)
+        answered = 0
+        for si, b, fut in reqs:
+            if not fut.done():
+                return False, f"{label}: close() left a future open"
+            try:
+                x = np.asarray(fut.result(0))
+            except ok_exc:
+                continue
+            except Exception as e:  # noqa: BLE001 — a leak is a bug
+                return False, (f"{label}: UNSTRUCTURED "
+                               f"{type(e).__name__}: {e}")
+            want = np.linalg.solve(As[si], b.astype(np.float64))
+            err = (np.linalg.norm(x - want)
+                   / max(np.linalg.norm(want), 1e-30))
+            if not (err < 1e-3):
+                return False, (f"{label}: answer off its own oracle "
+                               f"({err:.2e})")
+            answered += 1
+        # the final generation sits on the delta chain: restoring it
+        # with cold caches must answer bitwise vs the live fleet
+        b = rng.standard_normal((N, 1)).astype(np.float32)
+        live = []
+        for s in fleet:
+            t0 = time.time()
+            while True:
+                try:
+                    live.append(np.asarray(s.solve(b)))
+                    break
+                except ok_exc:
+                    if time.time() - t0 > 20.0:
+                        return False, (f"{label}: live solve never "
+                                       "recovered")
+                    time.sleep(0.01)
+        serve.clear_plans()
+        restored = tier.load_fleet(gens[-1])
+        for i, r in enumerate(restored):
+            if not np.array_equal(live[i], np.asarray(r.solve(b))):
+                return False, (f"{label}: restore from the delta "
+                               f"chain not bitwise (session {i})")
+        h = tier.tier_stats()
+        return True, (f"{label}: ok {answered}/{len(reqs)} answered, "
+                      f"{updates} updates, "
+                      f"ckpt written={h['checkpoint_records_written']}"
+                      f" carried={h['checkpoint_records_carried']}, "
+                      f"spills={h['spills_host']}, "
+                      f"revives={h['revives_h2d']}, "
+                      f"injected={sum(faults.injected.values())}")
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser()
     ap.add_argument("--trials", type=int, default=200)
@@ -2207,6 +2390,19 @@ def main(argv=None) -> int:
                     "census conservation (admitted == open + lost + "
                     "closed), zero lost sessions and no id "
                     "resurrection")
+    ap.add_argument("--scale", action="store_true",
+                    help="chaos-soak the §35 scale control plane: "
+                    "Zipf traffic over a fleet >> device capacity "
+                    "with the LRU implementation flipped live "
+                    "between heap and sort mid-trial and an "
+                    "incremental checkpoint chain (full → delta → "
+                    "delta-or-compaction) taken at the engine drain "
+                    "barrier between waves; asserts structured "
+                    "failures only, per-session f64 oracles, every "
+                    "generation covering the fleet (written + "
+                    "carried == F), solve-only deltas writing zero "
+                    "records, and a cold-cache restore from the "
+                    "delta chain answering bitwise vs the live fleet")
     ap.add_argument("--lockcheck", action="store_true",
                     help="run trials under the conflint runtime "
                     "lock-order harness (conflux_tpu.analysis."
@@ -2215,7 +2411,8 @@ def main(argv=None) -> int:
                     "cycle or lock-held-across-dispatch fails the soak")
     args = ap.parse_args(argv)
 
-    trial = (run_elastic_trial if args.elastic
+    trial = (run_scale_trial if args.scale
+             else run_elastic_trial if args.elastic
              else run_mesh_trial if args.mesh
              else run_precision_trial if args.precision
              else run_qos_trial if args.qos

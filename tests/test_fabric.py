@@ -460,7 +460,11 @@ def test_host_load_estimator_window_plumbing(tmp_path):
 
 
 def test_checkpoint_generations_pruned(tmp_path):
-    with _fab(tmp_path, n=1, checkpoint_keep=2) as fab:
+    # compact_every=1: every generation self-contained, so the prune
+    # bound is exactly checkpoint_keep (the pre-§35 contract; delta
+    # chains are covered in tests/test_scale.py)
+    with _fab(tmp_path, n=1, checkpoint_keep=2,
+              checkpoint_compact_every=1) as fab:
         fab.open("gen", _plan(), _mk(15))
         for _ in range(4):
             fab.checkpoint_all()

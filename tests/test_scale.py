@@ -1,0 +1,413 @@
+"""Control-plane scale contracts (ISSUE 20, DESIGN §35).
+
+- The O(log F) lazy-invalidation LRU heaps pick EXACTLY the victim
+  sets the retired materialize-and-sort baseline picked, on randomized
+  touch/churn traces, global and per-device caps included — the heap
+  path is a pure complexity change, never a policy change.
+- The checkpoint dirty clock: solve-only sessions stay CLEAN (skipped
+  by delta generations, carried as pointers into the base); update /
+  refactor / adopt mark dirty; carried chains re-base every generation
+  (single-hop links) and restore BITWISE, through compaction and
+  through fabric fail-over off a delta chain.
+- Reference-aware pruning: a kept delta generation pins the base
+  generations its carried records point into; compaction releases
+  them.
+- The scripts/replay.py harness invariants hold at a small
+  deterministic scale (victim-set equality inside the bench loop,
+  schedule determinism).
+"""
+
+import importlib.util
+import json
+import os
+import sys
+import time
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from conflux_tpu import fabric, serve, tier
+from conflux_tpu.fabric import FabricPolicy, LocalHost, ServeFabric
+from conflux_tpu.tier import ResidentSet
+
+N, V = 24, 8
+
+
+def _plan():
+    return serve.FactorPlan.create((N, N), jnp.float32, v=V)
+
+
+def _mk(rng, n=N):
+    return (rng.standard_normal((n, n)) / np.sqrt(n)
+            + 2.0 * np.eye(n)).astype(np.float32)
+
+
+def _fleet(plan, count, seed=0):
+    rng = np.random.default_rng(seed)
+    return [plan.factor(jnp.asarray(_mk(rng))) for _ in range(count)]
+
+
+# --------------------------------------------------------------------------- #
+# the LRU heaps vs the sort oracle
+# --------------------------------------------------------------------------- #
+
+
+class _FakeDev:
+    """Hashable stand-in for a jax device (platform/id are all the
+    tier's devkey reads)."""
+
+    def __init__(self, i):
+        self.platform = "cpu"
+        self.id = i
+
+
+class _Stub:
+    """Metadata-only session: the tier manages lock/stamp/bytes, and
+    `_pick_victims` only MARKS victims — no device state needed."""
+
+    __slots__ = ("_lock", "_residency", "_tier_stamp", "_spill",
+                 "_ckpt_ver", "nbytes", "device")
+
+    def __init__(self, nbytes, device=None):
+        import threading
+
+        self._lock = threading.RLock()
+        self._residency = None
+        self._tier_stamp = 0
+        self._spill = None
+        self._ckpt_ver = 0
+        self.nbytes = nbytes
+        self.device = device
+
+
+def _pick_both(rs, incoming_bytes, incoming_count):
+    """One victim pick per impl on the SAME tier state: pick, record,
+    revert (stamps untouched). Returns (sort_ids, heap_ids)."""
+    out = {}
+    for impl in ("sort", "heap"):
+        rs._lru_impl = impl
+        victims = rs._pick_victims(incoming_bytes, incoming_count)
+        out[impl] = frozenset(id(s) for s in victims)
+        with rs._lock:
+            for s in victims:
+                rs._set_state(id(s), s, "resident")
+    return out["sort"], out["heap"]
+
+
+@pytest.mark.parametrize("seed", [0, 1, 2])
+def test_victim_sets_match_sort_oracle_randomized(seed):
+    """Randomized touch traces + byte/count pressure: the heap pick and
+    the full-sort oracle claim IDENTICAL victim sets, every wave."""
+    rng = np.random.default_rng(seed)
+    F = 160
+    rs = ResidentSet(evict_batch=int(rng.integers(1, 4)))
+    stubs = [_Stub(int(rng.integers(1_000, 50_000))) for _ in range(F)]
+    rs.adopt(*stubs)
+    for wave in range(30):
+        for i in rng.choice(F, size=40):
+            stubs[i]._tier_stamp = rs._tick()
+        rs.max_sessions = int(rng.integers(F - 12, F + 4))
+        rs.max_bytes = (None if rng.random() < 0.5 else
+                        int(rng.integers(1, F) * 25_000))
+        sort_ids, heap_ids = _pick_both(
+            rs, int(rng.integers(0, 100_000)), int(rng.integers(0, 4)))
+        assert sort_ids == heap_ids, f"wave {wave}: victim sets differ"
+
+
+def test_victim_sets_match_with_per_device_caps():
+    """Per-device pressure picks victims from the overfull device only,
+    identically in both impls (the §25 cap path over the §35 heaps)."""
+    rng = np.random.default_rng(7)
+    devs = [_FakeDev(0), _FakeDev(1), _FakeDev(2)]
+    rs = ResidentSet(evict_batch=1)
+    stubs = [_Stub(10_000, device=devs[i % 3]) for i in range(60)]
+    rs.adopt(*stubs)  # cap set AFTER adopt: stubs mark, never spill
+    for wave in range(20):
+        for i in rng.choice(60, size=15):
+            stubs[i]._tier_stamp = rs._tick()
+        rs.max_sessions_per_device = int(rng.integers(5, 22))
+        sort_ids, heap_ids = _pick_both(rs, 0, 0)
+        assert sort_ids == heap_ids, f"wave {wave}: victim sets differ"
+        # and the pick honored device locality: census never negative
+        with rs._lock:
+            assert all(d[0] >= 0 for d in rs._dev_res.values())
+
+
+def test_spill_lru_uses_heap_order():
+    """spill_lru(n) must take the n OLDEST stamps — off the heap, no
+    fleet sort."""
+    plan = _plan()
+    sessions = _fleet(plan, 5, seed=3)
+    rs = ResidentSet()
+    rs.adopt(*sessions)
+    # freshen 2 and 4: the spill must take 0, 1, 3
+    for i in (2, 4):
+        with sessions[i]._lock:
+            sessions[i]._tier_stamp = rs._tick()
+    assert rs.spill_lru(3) == 3
+    st = {i: rs._state[id(s)] for i, s in enumerate(sessions)}
+    assert [st[i] for i in range(5)] == [
+        "host", "host", "resident", "host", "resident"]
+
+
+# --------------------------------------------------------------------------- #
+# the checkpoint dirty clock + delta generations
+# --------------------------------------------------------------------------- #
+
+
+def test_solves_stay_clean_mutations_dirty():
+    plan = _plan()
+    (s,) = _fleet(plan, 1, seed=5)
+    rng = np.random.default_rng(5)
+    b = rng.standard_normal((N, 1)).astype(np.float32)
+    v0 = s._ckpt_ver
+    s.solve(b)
+    s.solve_checked(b)
+    assert s._ckpt_ver == v0  # solve-only traffic leaves it untouched
+    u = (0.01 * rng.standard_normal((N, 1))).astype(np.float32)
+    w = (0.01 * rng.standard_normal((N, 1))).astype(np.float32)
+    s.update(u, w)
+    assert s._ckpt_ver > v0  # drift is persisted state
+    v1 = s._ckpt_ver
+    ResidentSet().adopt(s)
+    assert s._ckpt_ver > v1  # so is the manager identity
+
+
+def _counters():
+    st = tier.tier_stats()
+    return (st.get("checkpoint_records_written", 0),
+            st.get("checkpoint_records_carried", 0))
+
+
+def test_delta_generation_skips_clean_sessions(tmp_path):
+    plan = _plan()
+    sessions = _fleet(plan, 3, seed=6)
+    for i, s in enumerate(sessions):
+        s.sid = f"sess{i}"  # records carry by (sid, ver) identity
+    rng = np.random.default_rng(6)
+    b = rng.standard_normal((N, 2)).astype(np.float32)
+    for s in sessions:
+        s.solve(b)
+    p0, p1 = str(tmp_path / "g0"), str(tmp_path / "g1")
+    tier.save_fleet(p0, sessions, gen=0)
+    want = [np.asarray(s.solve(b)) for s in sessions]  # stays clean
+    u = (0.01 * rng.standard_normal((N, 1))).astype(np.float32)
+    w = (0.01 * rng.standard_normal((N, 1))).astype(np.float32)
+    sessions[1].update(u, w)
+    want[1] = np.asarray(sessions[1].solve(b))
+    w0, c0 = _counters()
+    tier.save_fleet(p1, sessions, base=p0, gen=1, full=False)
+    w1, c1 = _counters()
+    assert w1 - w0 == 1 and c1 - c0 == 2  # only the dirty one written
+    with open(os.path.join(p1, "fleet.json")) as f:
+        doc = json.load(f)
+    assert doc["format"] == 2 and doc["carried"] == 2
+    dirs = {e["sid"]: e["dir"] for e in doc["sessions"]}
+    gens = {e["sid"]: e["gen"] for e in doc["sessions"]}
+    assert dirs["sess0"].startswith("..")  # carried: a pointer
+    assert not dirs["sess1"].startswith("..")  # dirty: fresh bytes
+    assert gens["sess1"] == 1 and gens["sess0"] == 0
+    serve.clear_plans()
+    restored = tier.load_fleet(p1)
+    for i, r in enumerate(restored):
+        assert np.array_equal(want[i], np.asarray(r.solve(b)))
+
+
+def test_delta_chain_rebases_and_compaction_localizes(tmp_path):
+    """gen0 full -> gen1,gen2 deltas (carried links re-based to stay
+    single-hop) -> gen3 compaction (no out-of-tree links at all);
+    every generation restores bitwise."""
+    plan = _plan()
+    sessions = _fleet(plan, 3, seed=8)
+    for i, s in enumerate(sessions):
+        s.sid = f"sess{i}"
+    rng = np.random.default_rng(8)
+    b = rng.standard_normal((N, 1)).astype(np.float32)
+    paths = [str(tmp_path / f"g{i}") for i in range(4)]
+    tier.save_fleet(paths[0], sessions, gen=0)
+
+    def drift(i):
+        u = (0.01 * rng.standard_normal((N, 1))).astype(np.float32)
+        w = (0.01 * rng.standard_normal((N, 1))).astype(np.float32)
+        sessions[i].update(u, w)
+
+    drift(0)
+    tier.save_fleet(paths[1], sessions, base=paths[0], gen=1, full=False)
+    drift(1)
+    tier.save_fleet(paths[2], sessions, base=paths[1], gen=2, full=False)
+    with open(os.path.join(paths[2], "fleet.json")) as f:
+        doc2 = json.load(f)
+    for e in doc2["sessions"]:
+        d = os.path.normpath(e["dir"])
+        if d.startswith(".."):  # re-based: one hop, never a chain
+            assert d.count("..") == 1
+            assert os.path.isdir(os.path.normpath(
+                os.path.join(paths[2], d)))
+    # session 2 was never dirtied: its record still carries gen 0
+    gens = {e["sid"]: e["gen"] for e in doc2["sessions"]}
+    assert gens["sess2"] == 0
+    tier.save_fleet(paths[3], sessions, base=paths[2], gen=3, full=True)
+    with open(os.path.join(paths[3], "fleet.json")) as f:
+        doc3 = json.load(f)
+    assert all(not os.path.normpath(e["dir"]).startswith("..")
+               for e in doc3["sessions"])  # compaction localizes
+    # compaction copies keep the ORIGINAL write generation (standbys
+    # holding that push stay provably current)
+    gens3 = {e["sid"]: e["gen"] for e in doc3["sessions"]}
+    assert gens3["sess2"] == 0
+    want = [np.asarray(s.solve(b)) for s in sessions]
+    for p in (paths[2], paths[3]):
+        serve.clear_plans()
+        restored = tier.load_fleet(p)
+        for i, r in enumerate(restored):
+            assert np.array_equal(want[i], np.asarray(r.solve(b))), p
+
+
+def test_missing_base_degrades_to_full_write(tmp_path):
+    import shutil
+
+    plan = _plan()
+    sessions = _fleet(plan, 2, seed=9)
+    p0, p1 = str(tmp_path / "g0"), str(tmp_path / "g1")
+    tier.save_fleet(p0, sessions, gen=0)
+    shutil.rmtree(p0)  # the base vanished (pruned / lost disk)
+    w0, _ = _counters()
+    tier.save_fleet(p1, sessions, base=p0, gen=1, full=False)
+    w1, _ = _counters()
+    assert w1 - w0 == 2  # every record freshly written, no broken link
+    rng = np.random.default_rng(9)
+    b = rng.standard_normal((N, 1)).astype(np.float32)
+    want = [np.asarray(s.solve(b)) for s in sessions]
+    serve.clear_plans()
+    restored = tier.load_fleet(p1)
+    for i, r in enumerate(restored):
+        assert np.array_equal(want[i], np.asarray(r.solve(b)))
+
+
+# --------------------------------------------------------------------------- #
+# fabric: delta chains under fail-over + reference-aware pruning
+# --------------------------------------------------------------------------- #
+
+
+def _scale_fab(tmp_path, n=2, **pol):
+    kw = dict(heartbeat_interval=0.05, heartbeat_timeout=1.0,
+              suspect_after=2, dead_after=4, checkpoint_interval=0.0,
+              durable_open=False)
+    kw.update(pol)
+    return fabric.local_fabric(
+        n, str(tmp_path), policy=FabricPolicy(**kw),
+        engine_kwargs={"max_batch_delay": 0.0})
+
+
+def test_prune_keeps_delta_referenced_generations(tmp_path):
+    """checkpoint_keep bounds the KEPT generations; a kept delta pins
+    the base generations its carried records point into, so no kept
+    fleet.json ever dangles."""
+    with _scale_fab(tmp_path, n=1, checkpoint_keep=2,
+                    checkpoint_compact_every=100) as fab:
+        rng = np.random.default_rng(11)
+        for i in range(3):
+            fab.open(f"s{i}", _plan(), _mk(rng))
+        for _ in range(5):  # gen0 full, gens1.. all deltas
+            fab.checkpoint_all()
+        core = fab._hosts["h0"].core
+        have = {d for d in os.listdir(core.ckpt_dir)
+                if d.startswith("fleet-")}
+        kept = sorted(have)[-2:]
+        for g in kept:
+            with open(os.path.join(core.ckpt_dir, g,
+                                   "fleet.json")) as f:
+                doc = json.load(f)
+            for e in doc["sessions"]:
+                src = os.path.normpath(os.path.join(
+                    core.ckpt_dir, g, e["dir"]))
+                assert os.path.isdir(src), (g, e["dir"])
+        # gen0 is pinned (every delta carries into it) but the
+        # unreferenced middle deltas are gone
+        assert "fleet-000000" in have and len(have) == 3
+
+
+def test_failover_recovers_from_delta_chain(tmp_path):
+    """Kill the owner AFTER a full->delta->delta chain: the survivor
+    adopts every session (carried records resolved through the chain)
+    and recovered solves answer bitwise vs the checkpointed state."""
+    rng = np.random.default_rng(12)
+    b = rng.standard_normal((N, 1)).astype(np.float32)
+    with _scale_fab(tmp_path, n=2, replicas=2,
+                    checkpoint_compact_every=100) as fab:
+        sids = [f"user-{i}" for i in range(6)]
+        As = {}
+        for s in sids:
+            As[s] = _mk(rng)
+            fab.open(s, _plan(), As[s])
+        fab.checkpoint_all()  # gen0: full
+        dirty = sids[:2]
+        for s in dirty:
+            u = (0.01 * rng.standard_normal((N, 1))).astype(np.float32)
+            w = (0.01 * rng.standard_normal((N, 1))).astype(np.float32)
+            fab.update(s, u, w)
+        fab.checkpoint_all()  # gen1: delta (2 written, rest carried)
+        fab.checkpoint_all()  # gen2: delta (all carried)
+        want = {s: np.asarray(fab.solve(s, b)) for s in sids}
+        victim_hid = fab.owner_of(sids[0])
+        moved = [s for s in sids if fab.owner_of(s) == victim_hid]
+        assert moved
+        fab._hosts[victim_hid].kill()
+        t0 = time.perf_counter()
+        while time.perf_counter() - t0 < 20.0:
+            if fab.host_state(victim_hid) == "dead":
+                break
+            time.sleep(0.02)
+        st = fab.stats()
+        assert st["lost_sessions"] == 0
+        for s in sids:
+            t1 = time.perf_counter()
+            while True:
+                try:
+                    got = np.asarray(fab.solve(s, b))
+                    break
+                except Exception:  # noqa: BLE001 — fail-over window
+                    if time.perf_counter() - t1 > 20.0:
+                        raise
+                    time.sleep(0.02)
+            assert np.array_equal(want[s], got), s
+
+
+# --------------------------------------------------------------------------- #
+# the replay harness at deterministic small scale
+# --------------------------------------------------------------------------- #
+
+
+def _load_replay():
+    path = os.path.join(os.path.dirname(__file__), "..", "scripts",
+                        "replay.py")
+    spec = importlib.util.spec_from_file_location("replay_mod", path)
+    mod = importlib.util.module_from_spec(spec)
+    sys.modules.setdefault("replay_mod", mod)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def test_replay_control_plane_leg_equivalence():
+    replay = _load_replay()
+    out = replay.control_plane_leg(fleet=300, pairs=4,
+                                   victims_per_pick=6,
+                                   touches_per_round=200, seed=3)
+    assert out["victim_set_mismatches"] == 0
+    assert out["sort_us_per_victim_p50"] > 0
+    assert out["heap_us_per_victim_p50"] > 0
+
+
+def test_replay_schedule_deterministic():
+    replay = _load_replay()
+    a = replay.make_schedule(np.random.default_rng(5), 50, 2.0, 10.0,
+                             storms=2, storm_frac=0.1)
+    bb = replay.make_schedule(np.random.default_rng(5), 50, 2.0, 10.0,
+                              storms=2, storm_frac=0.1)
+    assert a == bb  # same seed, same scenario — replayable
+    assert a == sorted(a, key=lambda e: e[0])
+    kinds = {e[1] for e in a}
+    assert kinds == {"solve", "update"}
+    assert all(0 <= e[2] < 50 for e in a)

@@ -694,10 +694,10 @@ def test_checkpoint_vs_stale_revival_no_deadlock(tmp_path, monkeypatch):
     client_done = threading.Event()
     real_save = tier.save_fleet
 
-    def slow_save(path, sessions, names=None):
+    def slow_save(path, sessions, names=None, **kw):
         in_barrier.set()
         client_done.wait(30)  # hold the barrier across the revival
-        return real_save(path, sessions, names)
+        return real_save(path, sessions, names, **kw)
 
     monkeypatch.setattr(tier, "save_fleet", slow_save)
     try:
@@ -752,13 +752,13 @@ def test_concurrent_checkpoints_serialize(tmp_path, monkeypatch):
     alock = threading.Lock()
     active, peak = [0], [0]
 
-    def counted_save(path, sessions, names=None):
+    def counted_save(path, sessions, names=None, **kw):
         with alock:
             active[0] += 1
             peak[0] = max(peak[0], active[0])
         try:
             time.sleep(0.05)
-            return real_save(path, sessions, names)
+            return real_save(path, sessions, names, **kw)
         finally:
             with alock:
                 active[0] -= 1
